@@ -1,0 +1,13 @@
+"""crowdllama-tpu: a TPU-native peer-to-peer LLM inference swarm.
+
+A ground-up JAX/XLA rebuild of the capabilities of crowdllama/crowdllama
+(reference mounted at /root/reference): DHT peer discovery with provider
+records, capability-advertising workers, a health-managed peer table with
+load-aware routing, a length-prefixed-protobuf stream protocol, an
+Ollama-compatible HTTP gateway, a unix-socket IPC surface and a unified CLI —
+with model execution running natively on TPU through a JAX engine
+(tensor-parallel decode over ICI meshes, continuous batching, paged KV cache)
+instead of delegating to an embedded Ollama binary.
+"""
+
+from crowdllama_tpu.version import VERSION as __version__  # noqa: F401

@@ -1,0 +1,20 @@
+"""Core protocol layer: wire schema, codec, metadata types, protocol IDs.
+
+TPU-native counterpart of the reference's pkg/crowdllama
+(/root/reference/pkg/crowdllama/{types.go,pbwire.go,api.go}).
+"""
+
+from crowdllama_tpu.core import llama_v1_pb2 as pb  # noqa: F401
+from crowdllama_tpu.core.protocol import (  # noqa: F401
+    CROWDLLAMA_PROTOCOL,
+    INFERENCE_PROTOCOL,
+    METADATA_PROTOCOL,
+    NAMESPACE,
+)
+from crowdllama_tpu.core.resource import Resource  # noqa: F401
+from crowdllama_tpu.core.wire import (  # noqa: F401
+    MAX_MESSAGE_SIZE,
+    WireError,
+    read_length_prefixed_pb,
+    write_length_prefixed_pb,
+)

@@ -1,0 +1,92 @@
+"""Constructors / extractors for wire messages.
+
+Counterpart of /root/reference/pkg/crowdllama/api.go:191-222
+(CreateGenerateRequest / CreateGenerateResponse / ExtractGenerateRequest /
+ExtractGenerateResponse), plus helpers for the Ollama-style chat JSON the
+gateway speaks (gateway.go:31-51).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Iterable, Mapping
+
+from crowdllama_tpu.core import llama_v1_pb2 as pb
+
+
+def create_generate_request(
+    model: str,
+    prompt: str = "",
+    stream: bool = False,
+    messages: Iterable[Mapping[str, str]] = (),
+    max_tokens: int = 0,
+    temperature: float = 0.0,
+    top_p: float = 0.0,
+    seed: int = 0,
+) -> pb.BaseMessage:
+    req = pb.GenerateRequest(
+        model=model,
+        prompt=prompt,
+        stream=stream,
+        max_tokens=max_tokens,
+        temperature=temperature,
+        top_p=top_p,
+        seed=seed,
+    )
+    for m in messages:
+        req.messages.append(pb.ChatMessage(role=m.get("role", "user"), content=m.get("content", "")))
+    return pb.BaseMessage(generate_request=req)
+
+
+def create_generate_response(
+    model: str,
+    response: str,
+    worker_id: str = "",
+    done: bool = True,
+    done_reason: str = "stop",
+    total_duration_ns: int = 0,
+    prompt_tokens: int = 0,
+    completion_tokens: int = 0,
+) -> pb.BaseMessage:
+    resp = pb.GenerateResponse(
+        model=model,
+        response=response,
+        done=done,
+        done_reason=done_reason if done else "",
+        worker_id=worker_id,
+        total_duration=total_duration_ns,
+        prompt_tokens=prompt_tokens,
+        completion_tokens=completion_tokens,
+    )
+    resp.created_at.FromNanoseconds(time.time_ns())
+    return resp_msg(resp)
+
+
+def resp_msg(resp: pb.GenerateResponse) -> pb.BaseMessage:
+    return pb.BaseMessage(generate_response=resp)
+
+
+def extract_generate_request(msg: pb.BaseMessage) -> pb.GenerateRequest:
+    if msg.WhichOneof("message") != "generate_request":
+        raise ValueError("message does not contain a GenerateRequest")
+    return msg.generate_request
+
+
+def extract_generate_response(msg: pb.BaseMessage) -> pb.GenerateResponse:
+    if msg.WhichOneof("message") != "generate_response":
+        raise ValueError("message does not contain a GenerateResponse")
+    return msg.generate_response
+
+
+def flatten_chat(messages: Iterable[Mapping[str, str]]) -> str:
+    """Flatten Ollama-style chat messages into a single prompt string.
+
+    The reference concatenates message contents (gateway.go:189-207); we keep a
+    simple role-tagged flattening for engines that have no chat template.
+    """
+    parts = []
+    for m in messages:
+        role = m.get("role", "user")
+        parts.append(f"{role}: {m.get('content', '')}")
+    parts.append("assistant:")
+    return "\n".join(parts)

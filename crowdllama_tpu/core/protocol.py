@@ -1,0 +1,45 @@
+"""Protocol identifiers and namespace constants.
+
+Mirrors /root/reference/pkg/crowdllama/types.go:12-27: versioned protocol IDs
+for the app / metadata / inference streams, the DHT key prefix, and the
+rendezvous namespace string whose (identity-hashed) CID every peer advertises
+as a provider record.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+# Stream protocol IDs (cf. types.go:14-20).
+CROWDLLAMA_PROTOCOL = "/crowdllama/1.0.0"
+METADATA_PROTOCOL = "/crowdllama/metadata/1.0.0"
+INFERENCE_PROTOCOL = "/crowdllama/inference/1.0.0"
+
+# DHT key namespace prefix (cf. types.go:23).
+DHT_PREFIX = "/crowdllama/peer/"
+
+# Rendezvous namespace advertised by every peer (cf. types.go:26).
+NAMESPACE = "crowdllama-ns"
+
+# Default ports: DHT bootstrap server (reference cmd/dht listens on :9000,
+# /root/reference/pkg/dht/dht.go:25-28) and the gateway HTTP API (:9001, used
+# by examples/chat/chat.py:7).
+DEFAULT_DHT_PORT = 9000
+DEFAULT_GATEWAY_PORT = 9001
+
+
+def namespace_key(namespace: str = NAMESPACE) -> bytes:
+    """DHT content key for a rendezvous namespace.
+
+    The reference builds a CIDv1 from the IDENTITY multihash of the namespace
+    string (/root/reference/internal/discovery/discovery.go:176-183) — i.e. the
+    key *is* the string, wrapped.  Our DHT keys are raw 32-byte digests, so we
+    hash the namespace; the semantics (one well-known key everyone provides)
+    are identical.
+    """
+    return hashlib.sha256(b"crowdllama-tpu:ns:" + namespace.encode()).digest()
+
+
+def metadata_key(metadata_json: bytes) -> bytes:
+    """Content key for a metadata blob (cf. peer.go:432-437, SHA2-256 CID)."""
+    return hashlib.sha256(b"crowdllama-tpu:meta:" + metadata_json).digest()

@@ -1,0 +1,96 @@
+"""Peer capability metadata (`Resource`).
+
+TPU-native counterpart of the reference's Resource schema
+(/root/reference/pkg/crowdllama/types.go:30-74): the JSON blob a peer serves
+over the metadata stream protocol and whose freshness gates discovery
+(1 h reject, /root/reference/internal/discovery/discovery.go:316) and health.
+
+Extended for TPU workers per the north star (BASELINE.json): instead of
+gpu_model/vram_gb the worker advertises its accelerator kind, chip count, HBM
+per chip and ICI mesh topology; and — designed in from day one for
+cross-worker MoE / multi-worker sharding (SURVEY §7 hard part 4) — optional
+shard-group fields describing which slice of a sharded model this worker
+serves.  The original fields are kept so consumers of the reference schema
+find everything they expect.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field, asdict
+from typing import Any
+
+
+@dataclass
+class ShardGroup:
+    """Membership of a multi-worker sharded-model group (EP / cross-worker TP).
+
+    A worker serving expert shards of Mixtral (BASELINE config 4) or a slice
+    of a model too big for one host (config 5) advertises its group so the
+    gateway can assemble a full replica before routing.
+    """
+
+    group_id: str = ""
+    model: str = ""
+    strategy: str = ""  # "ep" | "tp" | "pp"
+    shard_index: int = 0
+    shard_count: int = 1
+    # For EP: which expert indices this worker hosts.
+    expert_ids: list[int] = field(default_factory=list)
+
+
+@dataclass
+class Resource:
+    """Worker/consumer capability advertisement (cf. types.go:30-40)."""
+
+    peer_id: str = ""
+    supported_models: list[str] = field(default_factory=list)
+    tokens_throughput: float = 0.0  # tokens/sec
+    load: float = 0.0  # 0..1 utilization of decode slots
+    last_updated: float = 0.0  # unix seconds (reference uses RFC3339)
+    version: str = ""
+    worker_mode: bool = False
+
+    # GPU-era fields kept for schema parity (reference hardcodes RTX 4090 /
+    # 24 GB at peer.go:320-334); TPU workers leave these empty.
+    gpu_model: str = ""
+    vram_gb: int = 0
+
+    # TPU-native capability surface.
+    accelerator: str = ""  # e.g. "tpu-v5e"
+    tpu_chip_count: int = 0
+    hbm_gb_per_chip: float = 0.0
+    ici_topology: str = ""  # e.g. "2x4"
+    max_context_length: int = 0
+    shard_group: ShardGroup | None = None
+
+    def touch(self) -> None:
+        self.last_updated = time.time()
+
+    @property
+    def age_seconds(self) -> float:
+        return time.time() - self.last_updated
+
+    def to_json(self) -> bytes:
+        d = asdict(self)
+        if self.shard_group is None:
+            d.pop("shard_group")
+        return json.dumps(d, sort_keys=True).encode()
+
+    @classmethod
+    def from_json(cls, data: bytes | str) -> "Resource":
+        try:
+            d: dict[str, Any] = json.loads(data)
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            raise ValueError(f"invalid resource JSON: {e}") from e
+        if not isinstance(d, dict):
+            raise ValueError("invalid resource JSON: not an object")
+        sg = d.pop("shard_group", None)
+        known = {f for f in cls.__dataclass_fields__ if f != "shard_group"}
+        r = cls(**{k: v for k, v in d.items() if k in known})
+        if sg:
+            r.shard_group = ShardGroup(
+                **{k: v for k, v in sg.items() if k in ShardGroup.__dataclass_fields__}
+            )
+        return r

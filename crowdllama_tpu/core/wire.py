@@ -1,0 +1,84 @@
+"""Length-prefixed protobuf framing over byte streams.
+
+TPU-native counterpart of /root/reference/pkg/crowdllama/pbwire.go:14-70:
+4-byte big-endian length followed by a marshaled ``llama.v1.BaseMessage``,
+with a 10 MB read cap.  Provided both for asyncio streams (the control plane
+is asyncio end-to-end) and for blocking sockets (used by the IPC surface and
+simple clients).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import socket
+import struct
+
+from crowdllama_tpu.core import llama_v1_pb2 as pb
+
+# Reference caps frames at 10 MB (pbwire.go:53).
+MAX_MESSAGE_SIZE = 10 * 1024 * 1024
+
+_LEN = struct.Struct(">I")
+
+
+class WireError(Exception):
+    """Framing-level error (oversized frame, truncated stream)."""
+
+
+def encode_frame(msg: pb.BaseMessage) -> bytes:
+    payload = msg.SerializeToString()
+    if len(payload) > MAX_MESSAGE_SIZE:
+        raise WireError(f"message size {len(payload)} exceeds maximum {MAX_MESSAGE_SIZE}")
+    return _LEN.pack(len(payload)) + payload
+
+
+def decode_payload(payload: bytes) -> pb.BaseMessage:
+    msg = pb.BaseMessage()
+    msg.ParseFromString(payload)
+    return msg
+
+
+async def write_length_prefixed_pb(writer: asyncio.StreamWriter, msg: pb.BaseMessage) -> None:
+    writer.write(encode_frame(msg))
+    await writer.drain()
+
+
+async def read_length_prefixed_pb(
+    reader: asyncio.StreamReader, timeout: float | None = None
+) -> pb.BaseMessage:
+    async def _read() -> pb.BaseMessage:
+        try:
+            header = await reader.readexactly(_LEN.size)
+            (length,) = _LEN.unpack(header)
+            if length > MAX_MESSAGE_SIZE:
+                raise WireError(f"message size {length} exceeds maximum {MAX_MESSAGE_SIZE}")
+            payload = await reader.readexactly(length)
+        except asyncio.IncompleteReadError as e:
+            raise WireError("stream closed mid-frame") from e
+        return decode_payload(payload)
+
+    if timeout is None:
+        return await _read()
+    return await asyncio.wait_for(_read(), timeout)
+
+
+def write_length_prefixed_pb_sync(sock: socket.socket, msg: pb.BaseMessage) -> None:
+    sock.sendall(encode_frame(msg))
+
+
+def read_length_prefixed_pb_sync(sock: socket.socket) -> pb.BaseMessage:
+    header = _recvexact(sock, _LEN.size)
+    (length,) = _LEN.unpack(header)
+    if length > MAX_MESSAGE_SIZE:
+        raise WireError(f"message size {length} exceeds maximum {MAX_MESSAGE_SIZE}")
+    return decode_payload(_recvexact(sock, length))
+
+
+def _recvexact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise WireError("stream closed mid-frame")
+        buf.extend(chunk)
+    return bytes(buf)

@@ -1,0 +1,21 @@
+"""Version information.
+
+The reference injects Version/CommitHash/BuildDate via Go ldflags
+(/root/reference/pkg/version/version.go:9-18); here the analogous knobs are env
+vars set by packaging, with sane dev defaults.  The version string doubles as
+the ``version`` field of advertised peer metadata, mirroring
+/root/reference/pkg/peer/peer.go:335.
+"""
+
+from __future__ import annotations
+
+import os
+
+VERSION = os.environ.get("CROWDLLAMA_TPU_VERSION", "0.1.0-dev")
+COMMIT_HASH = os.environ.get("CROWDLLAMA_TPU_COMMIT", "unknown")
+BUILD_DATE = os.environ.get("CROWDLLAMA_TPU_BUILD_DATE", "unknown")
+
+
+def version_string() -> str:
+    """Human-readable version banner (cf. reference version.go:39-47)."""
+    return f"crowdllama-tpu {VERSION} (commit {COMMIT_HASH}, built {BUILD_DATE})"

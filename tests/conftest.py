@@ -1,0 +1,34 @@
+"""Test configuration.
+
+Forces JAX onto a virtual 8-device CPU platform BEFORE jax is imported so
+multi-chip sharding (TP/DP/EP meshes) is exercised without TPU hardware —
+the TPU translation of the reference's loopback-libp2p strategy (SURVEY §4).
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Compressed intervals everywhere, mirroring CROWDLLAMA_TEST_MODE=1
+# (/root/reference/pkg/peer/peer.go:159-175).
+os.environ.setdefault("CROWDLLAMA_TPU_TEST_MODE", "1")
+
+import asyncio  # noqa: E402
+import inspect  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+# Minimal asyncio runner so tests don't depend on pytest-asyncio being
+# installed: any `async def test_*` is run to completion on a fresh loop.
+@pytest.hookimpl(tryfirst=True)
+def pytest_pyfunc_call(pyfuncitem):
+    fn = pyfuncitem.function
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+        asyncio.run(fn(**kwargs))
+        return True
+    return None

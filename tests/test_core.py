@@ -1,0 +1,166 @@
+"""Core protocol unit tests.
+
+Mirrors the reference's pure-unit layer: Resource JSON round-trip / invalid
+JSON (/root/reference/pkg/crowdllama/types_test.go:11-145) and wire codec
+round-trips for request & response (pbwire_test.go:12-92).
+"""
+
+import asyncio
+import socket
+
+import pytest
+
+from crowdllama_tpu.core import pb, protocol, wire
+from crowdllama_tpu.core.messages import (
+    create_generate_request,
+    create_generate_response,
+    extract_generate_request,
+    extract_generate_response,
+    flatten_chat,
+)
+from crowdllama_tpu.core.resource import Resource, ShardGroup
+
+
+class TestResource:
+    def test_json_roundtrip(self):
+        r = Resource(
+            peer_id="peer-1",
+            supported_models=["tinyllama-1.1b", "llama-3-8b"],
+            tokens_throughput=42.5,
+            load=0.3,
+            version="abc123",
+            worker_mode=True,
+            accelerator="tpu-v5e",
+            tpu_chip_count=8,
+            hbm_gb_per_chip=16.0,
+            ici_topology="2x4",
+            max_context_length=8192,
+        )
+        r.touch()
+        r2 = Resource.from_json(r.to_json())
+        assert r2 == r
+        assert r2.age_seconds < 5
+
+    def test_shard_group_roundtrip(self):
+        r = Resource(peer_id="p", worker_mode=True)
+        r.shard_group = ShardGroup(
+            group_id="g1", model="mixtral-8x7b", strategy="ep",
+            shard_index=2, shard_count=4, expert_ids=[4, 5],
+        )
+        r2 = Resource.from_json(r.to_json())
+        assert r2.shard_group == r.shard_group
+
+    def test_invalid_json(self):
+        with pytest.raises(ValueError):
+            Resource.from_json(b"{not json")
+        with pytest.raises(ValueError):
+            Resource.from_json(b"[1,2,3]")
+
+    def test_unknown_fields_ignored(self):
+        r = Resource(peer_id="p")
+        import json
+        d = json.loads(r.to_json())
+        d["future_field"] = "x"
+        r2 = Resource.from_json(json.dumps(d))
+        assert r2.peer_id == "p"
+
+
+class TestProtocolIDs:
+    def test_ids(self):
+        assert protocol.CROWDLLAMA_PROTOCOL == "/crowdllama/1.0.0"
+        assert protocol.METADATA_PROTOCOL == "/crowdllama/metadata/1.0.0"
+        assert protocol.INFERENCE_PROTOCOL == "/crowdllama/inference/1.0.0"
+        assert protocol.NAMESPACE == "crowdllama-ns"
+
+    def test_namespace_key_deterministic(self):
+        assert protocol.namespace_key() == protocol.namespace_key()
+        assert len(protocol.namespace_key()) == 32
+        assert protocol.namespace_key("other") != protocol.namespace_key()
+
+
+class TestWireCodec:
+    def test_request_roundtrip_async(self):
+        async def run():
+            msg = create_generate_request(
+                "llama-3-8b", "hello", stream=True,
+                messages=[{"role": "user", "content": "hi"}],
+                max_tokens=64, temperature=0.7, top_p=0.9, seed=7,
+            )
+            server_got = asyncio.Future()
+
+            async def handle(reader, writer):
+                got = await wire.read_length_prefixed_pb(reader)
+                server_got.set_result(got)
+                await wire.write_length_prefixed_pb(
+                    writer, create_generate_response("llama-3-8b", "world", worker_id="w")
+                )
+                writer.close()
+
+            server = await asyncio.start_server(handle, "127.0.0.1", 0)
+            port = server.sockets[0].getsockname()[1]
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            await wire.write_length_prefixed_pb(writer, msg)
+            reply = await wire.read_length_prefixed_pb(reader, timeout=5)
+            writer.close()
+            server.close()
+            await server.wait_closed()
+
+            got = extract_generate_request(server_got.result())
+            assert got.model == "llama-3-8b"
+            assert got.prompt == "hello"
+            assert got.stream is True
+            assert got.messages[0].content == "hi"
+            assert got.max_tokens == 64
+            resp = extract_generate_response(reply)
+            assert resp.response == "world"
+            assert resp.worker_id == "w"
+            assert resp.done is True
+
+        asyncio.run(run())
+
+    def test_sync_roundtrip(self):
+        a, b = socket.socketpair()
+        msg = create_generate_response("m", "r", completion_tokens=3)
+        wire.write_length_prefixed_pb_sync(a, msg)
+        got = wire.read_length_prefixed_pb_sync(b)
+        assert extract_generate_response(got).completion_tokens == 3
+        a.close(); b.close()
+
+    def test_oversized_rejected(self):
+        with pytest.raises(wire.WireError):
+            wire.encode_frame(create_generate_request("m", "x" * (wire.MAX_MESSAGE_SIZE + 1)))
+
+    def test_oversized_read_rejected(self):
+        a, b = socket.socketpair()
+        a.sendall((wire.MAX_MESSAGE_SIZE + 1).to_bytes(4, "big"))
+        with pytest.raises(wire.WireError):
+            wire.read_length_prefixed_pb_sync(b)
+        a.close(); b.close()
+
+    def test_truncated_stream(self):
+        a, b = socket.socketpair()
+        a.sendall(b"\x00\x00\x00\x10abc")
+        a.close()
+        with pytest.raises(wire.WireError):
+            wire.read_length_prefixed_pb_sync(b)
+        b.close()
+
+    def test_extract_wrong_type(self):
+        with pytest.raises(ValueError):
+            extract_generate_response(create_generate_request("m", "p"))
+        with pytest.raises(ValueError):
+            extract_generate_request(create_generate_response("m", "r"))
+
+
+def test_flatten_chat():
+    out = flatten_chat([{"role": "system", "content": "be brief"},
+                        {"role": "user", "content": "hi"}])
+    assert "system: be brief" in out
+    assert out.endswith("assistant:")
+
+
+def test_pb_oneof():
+    m = pb.BaseMessage()
+    assert m.WhichOneof("message") is None
+    m.generate_request.model = "x"
+    assert m.WhichOneof("message") == "generate_request"
