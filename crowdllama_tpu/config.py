@@ -1,0 +1,141 @@
+"""Layered configuration.
+
+Counterpart of /root/reference/pkg/config/config.go: a Configuration object
+populated defaults → ``CROWDLLAMA_TPU_*`` environment (config.go:58-79 uses
+viper with the ``CROWDLLAMA_`` prefix) → CLI flags (config.go:46-55), plus the
+test-mode switch that compresses every background interval
+(``CROWDLLAMA_TEST_MODE`` in the reference, checked in 6 places — here it is
+read in exactly one).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from dataclasses import dataclass, field
+
+
+def is_test_mode() -> bool:
+    return os.environ.get("CROWDLLAMA_TPU_TEST_MODE", "") == "1"
+
+
+@dataclass
+class Intervals:
+    """Every background cadence in one place, test-mode aware.
+
+    Defaults mirror the reference's constants: metadata publish 5 s
+    (main.go:267-281), advertise 1 s (peer.go:450-504), local metadata refresh
+    30 s (peer.go:361-389), discovery 10 s (manager.go:66-104), health check
+    20 s, stale 60 s, quarantine 600 s; test mode compresses them the way
+    CROWDLLAMA_TEST_MODE=1 does (peer.go:159-175, gateway.go:360).
+    """
+
+    discovery: float = 10.0
+    advertise: float = 1.0
+    metadata_publish: float = 5.0
+    metadata_refresh: float = 30.0
+    health_check: float = 20.0
+    stale_after: float = 60.0
+    cleanup: float = 20.0
+    quarantine: float = 600.0
+    metadata_max_age: float = 3600.0
+    metadata_timeout: float = 5.0
+    stream_read_timeout: float = 5.0
+    backoff_base: float = 10.0
+    max_failed_attempts: int = 3
+
+    @classmethod
+    def default(cls) -> "Intervals":
+        if is_test_mode():
+            return cls(
+                discovery=2.0,
+                advertise=0.5,
+                metadata_publish=1.0,
+                metadata_refresh=5.0,
+                health_check=5.0,
+                stale_after=30.0,
+                cleanup=5.0,
+                quarantine=30.0,
+                backoff_base=0.5,
+            )
+        return cls()
+
+
+@dataclass
+class Configuration:
+    """Node configuration (cf. config.go:25-33, extended for the TPU engine)."""
+
+    verbose: bool = False
+    key_path: str = ""
+    bootstrap_peers: list[str] = field(default_factory=list)  # "host:port" addrs
+    listen_host: str = "0.0.0.0"
+    listen_port: int = 0  # 0 = ephemeral
+    gateway_port: int = 9001
+    ipc_socket: str = ""
+
+    # Engine configuration (replaces the reference's OllamaBaseURL).
+    model: str = "tinyllama-1.1b"
+    model_path: str = ""  # local HF checkpoint dir; empty = random-init weights
+    engine_backend: str = "jax"  # "jax" | "fake" (testing)
+    max_batch_slots: int = 8
+    max_context_length: int = 2048
+    mesh_shape: str = ""  # e.g. "1x8" → (dp=1, tp=8); empty = all devices on tp
+
+    intervals: Intervals = field(default_factory=Intervals.default)
+
+    @classmethod
+    def from_environment(cls, **overrides) -> "Configuration":
+        """Defaults ← env ← explicit overrides (cf. config.go:58-79)."""
+        cfg = cls()
+        env = os.environ
+        cfg.verbose = env.get("CROWDLLAMA_TPU_VERBOSE", "") in ("1", "true")
+        cfg.key_path = env.get("CROWDLLAMA_TPU_KEY_PATH", cfg.key_path)
+        if env.get("CROWDLLAMA_TPU_BOOTSTRAP_PEERS"):
+            cfg.bootstrap_peers = [
+                a.strip()
+                for a in env["CROWDLLAMA_TPU_BOOTSTRAP_PEERS"].split(",")
+                if a.strip()
+            ]
+        cfg.listen_host = env.get("CROWDLLAMA_TPU_LISTEN_HOST", cfg.listen_host)
+        cfg.listen_port = int(env.get("CROWDLLAMA_TPU_LISTEN_PORT", cfg.listen_port))
+        cfg.gateway_port = int(env.get("CROWDLLAMA_TPU_GATEWAY_PORT", cfg.gateway_port))
+        cfg.ipc_socket = env.get("CROWDLLAMA_TPU_SOCKET", cfg.ipc_socket)
+        cfg.model = env.get("CROWDLLAMA_TPU_MODEL", cfg.model)
+        cfg.model_path = env.get("CROWDLLAMA_TPU_MODEL_PATH", cfg.model_path)
+        cfg.engine_backend = env.get("CROWDLLAMA_TPU_ENGINE", cfg.engine_backend)
+        cfg.mesh_shape = env.get("CROWDLLAMA_TPU_MESH", cfg.mesh_shape)
+        for k, v in overrides.items():
+            if v is not None:
+                setattr(cfg, k, v)
+        return cfg
+
+    @staticmethod
+    def add_flags(parser: argparse.ArgumentParser) -> None:
+        """Register shared CLI flags (cf. config.go:46-55)."""
+        parser.add_argument("--verbose", action="store_true", default=None)
+        parser.add_argument("--key-path", dest="key_path")
+        parser.add_argument(
+            "--bootstrap-peers",
+            dest="bootstrap_peers",
+            help="comma-separated host:port bootstrap addresses",
+        )
+        parser.add_argument("--listen-port", dest="listen_port", type=int)
+        parser.add_argument("--gateway-port", dest="gateway_port", type=int)
+        parser.add_argument("--model", dest="model")
+        parser.add_argument("--model-path", dest="model_path")
+        parser.add_argument("--engine", dest="engine_backend")
+        parser.add_argument("--mesh", dest="mesh_shape")
+
+    @classmethod
+    def from_flags(cls, args: argparse.Namespace) -> "Configuration":
+        overrides = {
+            k: getattr(args, k, None)
+            for k in (
+                "verbose", "key_path", "listen_port", "gateway_port",
+                "model", "model_path", "engine_backend", "mesh_shape",
+            )
+        }
+        bp = getattr(args, "bootstrap_peers", None)
+        if isinstance(bp, str):
+            overrides["bootstrap_peers"] = [a.strip() for a in bp.split(",") if a.strip()]
+        return cls.from_environment(**overrides)

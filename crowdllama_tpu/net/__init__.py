@@ -1,0 +1,13 @@
+"""Control-plane networking: stream host, Kademlia-style DHT, discovery.
+
+TPU-native counterpart of the reference's libp2p layer
+(/root/reference/internal/discovery/discovery.go, pkg/dht/dht.go): an asyncio
+TCP stream host with Ed25519-authenticated hellos and versioned protocol IDs,
+and a small Kademlia DHT providing exactly the surface the reference consumes
+— Provide / FindProviders / FindPeer plus raw app streams (SURVEY §7 hard
+part 3).  Inter-worker tensor traffic does NOT ride this layer: that is ICI
+collectives inside a worker's jit-compiled program (crowdllama_tpu.parallel).
+"""
+
+from crowdllama_tpu.net.host import Contact, Host, Stream  # noqa: F401
+from crowdllama_tpu.net.dht import DHTNode  # noqa: F401

@@ -1,0 +1,353 @@
+"""Kademlia-style DHT with provider records.
+
+The reference rides go-libp2p-kad-dht in server mode on every node
+(/root/reference/internal/discovery/discovery.go:48-84, pkg/dht/dht.go) and
+consumes only a small surface: Provide, FindProvidersAsync, FindPeer, plus
+bootstrap and reconnect-on-empty-routing-table (peer.go:409-447,513-525).
+This module implements exactly that surface over the asyncio stream Host:
+XOR-metric k-bucket routing table, iterative lookups (alpha=3, k=20), and
+TTL'd provider records, with RPCs as JSON frames on a dedicated protocol.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+import time
+from dataclasses import dataclass, field
+
+from crowdllama_tpu.net.host import (
+    Contact,
+    Host,
+    Stream,
+    read_json_frame,
+    write_json_frame,
+)
+from crowdllama_tpu.utils.keys import peer_id_to_dht_id
+
+KAD_PROTOCOL = "/crowdllama-tpu/kad/1.0.0"
+K = 20  # bucket size / lookup width
+ALPHA = 3  # lookup concurrency
+RPC_TIMEOUT = 5.0
+PROVIDER_TTL = 30 * 60.0  # reference re-provides every 1-5 s; 30 min is ample
+ID_BITS = 256
+
+log = logging.getLogger("crowdllama.net.dht")
+
+
+def _xor_int(a: bytes, b: bytes) -> int:
+    return int.from_bytes(a, "big") ^ int.from_bytes(b, "big")
+
+
+def key_for(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+class RoutingTable:
+    """256 k-buckets over XOR distance, least-recently-seen eviction."""
+
+    def __init__(self, self_id: bytes, k: int = K):
+        self.self_id = self_id
+        self.k = k
+        self.buckets: list[list[tuple[bytes, Contact]]] = [[] for _ in range(ID_BITS)]
+
+    def _bucket_index(self, node_id: bytes) -> int:
+        d = _xor_int(self.self_id, node_id)
+        if d == 0:
+            return 0
+        return max(0, d.bit_length() - 1)
+
+    def update(self, contact: Contact) -> None:
+        node_id = peer_id_to_dht_id(contact.peer_id)
+        if node_id == self.self_id:
+            return
+        bucket = self.buckets[self._bucket_index(node_id)]
+        for i, (nid, _) in enumerate(bucket):
+            if nid == node_id:
+                bucket.pop(i)
+                bucket.append((node_id, contact))
+                return
+        if len(bucket) >= self.k:
+            bucket.pop(0)  # drop least-recently-seen (no liveness probe in v0)
+        bucket.append((node_id, contact))
+
+    def remove(self, peer_id: str) -> None:
+        node_id = peer_id_to_dht_id(peer_id)
+        bucket = self.buckets[self._bucket_index(node_id)]
+        bucket[:] = [(nid, c) for nid, c in bucket if nid != node_id]
+
+    def closest(self, target: bytes, k: int | None = None) -> list[Contact]:
+        k = k or self.k
+        all_contacts = [(nid, c) for bucket in self.buckets for nid, c in bucket]
+        all_contacts.sort(key=lambda nc: _xor_int(nc[0], target))
+        return [c for _, c in all_contacts[:k]]
+
+    def __len__(self) -> int:
+        return sum(len(b) for b in self.buckets)
+
+    def contacts(self) -> list[Contact]:
+        return [c for bucket in self.buckets for _, c in bucket]
+
+
+@dataclass
+class _ProviderRecord:
+    contact: Contact
+    expires_at: float
+
+
+class ProviderStore:
+    """TTL'd provider records (libp2p providers-store analog)."""
+
+    def __init__(self, ttl: float = PROVIDER_TTL):
+        self.ttl = ttl
+        self._records: dict[bytes, dict[str, _ProviderRecord]] = {}
+
+    def add(self, key: bytes, contact: Contact) -> None:
+        self._records.setdefault(key, {})[contact.peer_id] = _ProviderRecord(
+            contact=contact, expires_at=time.time() + self.ttl
+        )
+
+    def get(self, key: bytes) -> list[Contact]:
+        now = time.time()
+        recs = self._records.get(key, {})
+        live = {pid: r for pid, r in recs.items() if r.expires_at > now}
+        if len(live) != len(recs):
+            if live:
+                self._records[key] = live
+            else:
+                self._records.pop(key, None)
+        return [r.contact for r in live.values()]
+
+
+@dataclass
+class _LookupState:
+    target: bytes
+    shortlist: dict[str, Contact] = field(default_factory=dict)
+    queried: set[str] = field(default_factory=set)
+
+
+class DHTNode:
+    """DHT node in server mode (every peer stores and serves records)."""
+
+    def __init__(self, host: Host, server_mode: bool = True):
+        self.host = host
+        self.node_id = peer_id_to_dht_id(host.peer_id)
+        self.table = RoutingTable(self.node_id)
+        self.providers = ProviderStore()
+        self.server_mode = server_mode
+        self.bootstrap_addrs: list[str] = []
+        host.set_stream_handler(KAD_PROTOCOL, self._handle_stream)
+
+    # ------------------------------------------------------------------ RPC
+
+    async def _handle_stream(self, stream: Stream) -> None:
+        """Serve one RPC per stream (reference opens a stream per exchange)."""
+        if stream.remote_contact is not None:
+            self.table.update(stream.remote_contact)
+        try:
+            req = await read_json_frame(stream.reader, RPC_TIMEOUT)
+        except Exception:
+            return
+        op = req.get("op")
+        resp: dict = {"ok": True}
+        try:
+            if op == "ping":
+                pass
+            elif op == "find_node":
+                target = bytes.fromhex(req["target"])
+                resp["contacts"] = [c.to_dict() for c in self.table.closest(target)]
+            elif op == "get_providers":
+                key = bytes.fromhex(req["key"])
+                resp["providers"] = [c.to_dict() for c in self.providers.get(key)]
+                resp["contacts"] = [c.to_dict() for c in self.table.closest(key)]
+            elif op == "add_provider":
+                if not self.server_mode:
+                    raise ValueError("not a DHT server")
+                key = bytes.fromhex(req["key"])
+                contact = Contact.from_dict(req["provider"])
+                # Only accept the caller as provider for itself (no spoofing
+                # third parties), but trust its advertised address.
+                if contact.peer_id != stream.remote_peer_id:
+                    raise ValueError("provider record must be for the calling peer")
+                self.providers.add(key, contact)
+            elif op == "find_peer":
+                pid = str(req["peer_id"])
+                found = self.host.peerstore.get(pid)
+                resp["contact"] = found.to_dict() if found else None
+                resp["contacts"] = [
+                    c.to_dict() for c in self.table.closest(peer_id_to_dht_id(pid))
+                ]
+            else:
+                raise ValueError(f"unknown op {op!r}")
+        except Exception as e:
+            resp = {"ok": False, "error": str(e)}
+        try:
+            await write_json_frame(stream.writer, resp)
+        except Exception:
+            pass
+
+    async def _rpc(self, contact: Contact | str, payload: dict) -> dict | None:
+        """Open a kad stream, send one request, read one response."""
+        stream = None
+        try:
+            stream = await self.host.new_stream(contact, KAD_PROTOCOL, timeout=RPC_TIMEOUT)
+            await write_json_frame(stream.writer, payload)
+            resp = await read_json_frame(stream.reader, RPC_TIMEOUT)
+            if stream.remote_contact is not None:
+                self.table.update(stream.remote_contact)
+            return resp
+        except Exception as e:
+            if isinstance(contact, Contact):
+                self.table.remove(contact.peer_id)
+            log.debug("rpc %s to %s failed: %s", payload.get("op"), contact, e)
+            return None
+        finally:
+            if stream is not None:
+                stream.close()
+
+    # ------------------------------------------------------------- lookups
+
+    async def bootstrap(self, addrs: list[str]) -> int:
+        """Dial bootstrap addresses and populate the routing table.
+
+        cf. discovery.go:87-141 (BootstrapDHTWithPeers): connect to each peer,
+        then run a self-lookup to fill buckets.  Returns the number of
+        bootstrap peers successfully contacted.
+        """
+        self.bootstrap_addrs = list(addrs) or self.bootstrap_addrs
+        ok = 0
+        for addr in self.bootstrap_addrs:
+            resp = await self._rpc(addr, {"op": "ping"})
+            if resp and resp.get("ok"):
+                ok += 1
+        if ok:
+            await self.lookup(self.node_id)
+        return ok
+
+    def is_connected(self) -> bool:
+        """Routing-table-non-empty check (cf. peer.go:513-525 IsDHTConnected)."""
+        return len(self.table) > 0
+
+    async def reconnect_if_needed(self) -> None:
+        """Re-bootstrap when the routing table went empty (peer.go:409-424)."""
+        if not self.is_connected() and self.bootstrap_addrs:
+            log.info("routing table empty; re-bootstrapping")
+            await self.bootstrap(self.bootstrap_addrs)
+
+    def _unqueried_in_top_k(self, state: _LookupState) -> list[Contact]:
+        """Unqueried candidates among the K closest known — Kademlia's
+        termination rule is 'the K closest seen have all been queried'."""
+        top_k = sorted(
+            state.shortlist.values(),
+            key=lambda c: _xor_int(peer_id_to_dht_id(c.peer_id), state.target),
+        )[:K]
+        return [c for c in top_k if c.peer_id not in state.queried]
+
+    async def lookup(self, target: bytes) -> list[Contact]:
+        """Iterative FIND_NODE: returns up to K closest contacts to target."""
+        state = _LookupState(target=target)
+        for c in self.table.closest(target):
+            state.shortlist[c.peer_id] = c
+
+        while True:
+            candidates = self._unqueried_in_top_k(state)[:ALPHA]
+            if not candidates:
+                break
+            for c in candidates:
+                state.queried.add(c.peer_id)
+            results = await asyncio.gather(
+                *(self._rpc(c, {"op": "find_node", "target": target.hex()}) for c in candidates)
+            )
+            for resp in results:
+                if not resp or not resp.get("ok"):
+                    continue
+                for d in resp.get("contacts", []):
+                    try:
+                        contact = Contact.from_dict(d)
+                    except (KeyError, ValueError):
+                        continue
+                    if contact.peer_id == self.host.peer_id:
+                        continue
+                    state.shortlist.setdefault(contact.peer_id, contact)
+
+        out = sorted(
+            state.shortlist.values(),
+            key=lambda c: _xor_int(peer_id_to_dht_id(c.peer_id), target),
+        )[:K]
+        return out
+
+    async def provide(self, key: bytes) -> int:
+        """Advertise self as provider for key on the K closest nodes.
+
+        cf. peer.go:409-447 (PublishMetadata → DHT.Provide).  Also stores
+        locally so single-node and two-node topologies resolve.  Returns the
+        number of remote nodes that accepted the record.
+        """
+        me = self.host.contact
+        if self.server_mode:
+            self.providers.add(key, me)
+        targets = await self.lookup(key)
+        payload = {"op": "add_provider", "key": key.hex(), "provider": me.to_dict()}
+        results = await asyncio.gather(*(self._rpc(c, payload) for c in targets))
+        return sum(1 for r in results if r and r.get("ok"))
+
+    async def find_providers(self, key: bytes, limit: int = 10) -> list[Contact]:
+        """Iterative GET_PROVIDERS (cf. discovery.go:332-366, limit 10)."""
+        found: dict[str, Contact] = {}
+        for c in self.providers.get(key):
+            if c.peer_id != self.host.peer_id:
+                found[c.peer_id] = c
+        state = _LookupState(target=key)
+        for c in self.table.closest(key):
+            state.shortlist[c.peer_id] = c
+
+        while len(found) < limit:
+            candidates = self._unqueried_in_top_k(state)[:ALPHA]
+            if not candidates:
+                break
+            for c in candidates:
+                state.queried.add(c.peer_id)
+            results = await asyncio.gather(
+                *(self._rpc(c, {"op": "get_providers", "key": key.hex()}) for c in candidates)
+            )
+            for resp in results:
+                if not resp or not resp.get("ok"):
+                    continue
+                for d in resp.get("providers", []):
+                    try:
+                        contact = Contact.from_dict(d)
+                    except (KeyError, ValueError):
+                        continue
+                    if contact.peer_id != self.host.peer_id:
+                        found[contact.peer_id] = contact
+                for d in resp.get("contacts", []):
+                    try:
+                        contact = Contact.from_dict(d)
+                    except (KeyError, ValueError):
+                        continue
+                    if (
+                        contact.peer_id != self.host.peer_id
+                        and contact.peer_id not in state.shortlist
+                    ):
+                        state.shortlist[contact.peer_id] = contact
+        return list(found.values())[:limit]
+
+    async def find_peer(self, peer_id: str) -> Contact | None:
+        """Resolve a peer ID to a dialable contact (cf. gateway.go:248)."""
+        local = self.host.peerstore.get(peer_id)
+        if local is not None:
+            return local
+        target = peer_id_to_dht_id(peer_id)
+        for c in await self.lookup(target):
+            if c.peer_id == peer_id:
+                return c
+        # Ask the closest nodes' peerstores directly.
+        for c in self.table.closest(target, ALPHA):
+            resp = await self._rpc(c, {"op": "find_peer", "peer_id": peer_id})
+            if resp and resp.get("ok") and resp.get("contact"):
+                try:
+                    return Contact.from_dict(resp["contact"])
+                except (KeyError, ValueError):
+                    continue
+        return None

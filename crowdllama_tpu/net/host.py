@@ -1,0 +1,351 @@
+"""Asyncio TCP stream host with authenticated protocol-tagged streams.
+
+Plays the role libp2p's host plays in the reference
+(/root/reference/internal/discovery/discovery.go:48-84): a node listens on one
+TCP port; every logical *stream* is a fresh TCP connection opened with a
+signed hello naming a protocol ID, and is dispatched to the handler registered
+for that protocol (cf. peer.go:177-182 setupStreamHandler).  Identity is an
+Ed25519 key; peer IDs are derived from the public key so a forged hello fails
+signature or ID verification.  (The reference gets transport security from
+libp2p's noise/TLS defaults; here the hello authenticates the peer, payload
+encryption is a non-goal for the control plane v0.)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import struct
+import time
+from dataclasses import dataclass
+from typing import Awaitable, Callable
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+    Ed25519PrivateKey,
+    Ed25519PublicKey,
+)
+
+from crowdllama_tpu.utils.keys import peer_id_from_public_key
+
+_LEN = struct.Struct(">I")
+MAX_JSON_FRAME = 1 * 1024 * 1024
+HELLO_MAX_SKEW = 300.0  # seconds of clock skew tolerated in signed hellos
+HANDSHAKE_TIMEOUT = 10.0
+
+log = logging.getLogger("crowdllama.net.host")
+
+
+class HandshakeError(Exception):
+    pass
+
+
+async def write_json_frame(writer: asyncio.StreamWriter, obj: dict) -> None:
+    payload = json.dumps(obj, separators=(",", ":")).encode()
+    if len(payload) > MAX_JSON_FRAME:
+        raise ValueError(f"json frame too large: {len(payload)}")
+    writer.write(_LEN.pack(len(payload)) + payload)
+    await writer.drain()
+
+
+async def read_json_frame(reader: asyncio.StreamReader, timeout: float | None = None) -> dict:
+    async def _read() -> dict:
+        try:
+            header = await reader.readexactly(_LEN.size)
+            (length,) = _LEN.unpack(header)
+            if length > MAX_JSON_FRAME:
+                raise HandshakeError(f"json frame too large: {length}")
+            payload = await reader.readexactly(length)
+        except asyncio.IncompleteReadError as e:
+            raise HandshakeError("stream closed mid-frame") from e
+        obj = json.loads(payload)
+        if not isinstance(obj, dict):
+            raise HandshakeError("json frame is not an object")
+        return obj
+
+    if timeout is None:
+        return await _read()
+    return await asyncio.wait_for(_read(), timeout)
+
+
+@dataclass(frozen=True)
+class Contact:
+    """A dialable peer: identity + address (libp2p AddrInfo analog)."""
+
+    peer_id: str
+    host: str
+    port: int
+
+    @property
+    def addr(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def to_dict(self) -> dict:
+        return {"peer_id": self.peer_id, "host": self.host, "port": self.port}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Contact":
+        return cls(peer_id=str(d["peer_id"]), host=str(d["host"]), port=int(d["port"]))
+
+
+@dataclass
+class Stream:
+    """An open protocol-tagged byte stream to an authenticated remote peer."""
+
+    protocol: str
+    remote_peer_id: str
+    remote_contact: Contact | None  # None when the remote is not listening
+    reader: asyncio.StreamReader
+    writer: asyncio.StreamWriter
+
+    def close(self) -> None:
+        try:
+            self.writer.close()
+        except Exception:  # pragma: no cover - best-effort close
+            pass
+
+    async def wait_closed(self) -> None:
+        try:
+            await self.writer.wait_closed()
+        except Exception:  # pragma: no cover
+            pass
+
+
+def _hello_signing_bytes(
+    proto: str, peer_id: str, ts: float, nonce: str, listen_port: int
+) -> bytes:
+    """Bytes covered by a hello/ack signature.
+
+    ``nonce`` is the *remote* side's fresh challenge, making hellos
+    non-replayable; ``listen_port`` is covered so an observer cannot rewrite
+    the advertised dial-back address.
+    """
+    return b"crowdllama-tpu-hello|" + "|".join(
+        [proto, peer_id, f"{ts:.3f}", nonce, str(listen_port)]
+    ).encode()
+
+
+StreamHandler = Callable[[Stream], Awaitable[None]]
+
+
+class Host:
+    """One listening node; opens/accepts authenticated protocol streams."""
+
+    def __init__(
+        self,
+        key: Ed25519PrivateKey,
+        listen_host: str = "0.0.0.0",
+        listen_port: int = 0,
+        advertise_host: str | None = None,
+    ):
+        self.key = key
+        self.public_key = key.public_key()
+        self.peer_id = peer_id_from_public_key(self.public_key)
+        self.listen_host = listen_host
+        self.listen_port = listen_port
+        self.advertise_host = advertise_host
+        self._handlers: dict[str, StreamHandler] = {}
+        self._server: asyncio.Server | None = None
+        # peerstore: peer_id -> Contact learned from hellos / DHT results
+        self.peerstore: dict[str, Contact] = {}
+        self._conn_tasks: set[asyncio.Task] = set()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._on_connection, self.listen_host, self.listen_port
+        )
+        self.listen_port = self._server.sockets[0].getsockname()[1]
+        log.debug("host %s listening on %s:%d", self.peer_id[:8], self.listen_host, self.listen_port)
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        for t in list(self._conn_tasks):
+            t.cancel()
+
+    @property
+    def contact(self) -> Contact:
+        host = self.advertise_host or (
+            "127.0.0.1" if self.listen_host in ("0.0.0.0", "::") else self.listen_host
+        )
+        return Contact(peer_id=self.peer_id, host=host, port=self.listen_port)
+
+    # -- handlers ----------------------------------------------------------
+
+    def set_stream_handler(self, protocol: str, handler: StreamHandler) -> None:
+        self._handlers[protocol] = handler
+
+    def remove_stream_handler(self, protocol: str) -> None:
+        self._handlers.pop(protocol, None)
+
+    # -- outbound ----------------------------------------------------------
+
+    async def new_stream(
+        self, target: Contact | str, protocol: str, timeout: float = HANDSHAKE_TIMEOUT
+    ) -> Stream:
+        """Dial a peer and open an authenticated stream for ``protocol``.
+
+        ``target`` may be a Contact (identity verified against its peer_id) or
+        a bare "host:port" address (identity learned from the remote hello, as
+        when dialing a bootstrap address, cf. discovery.go:92-141).
+        """
+        if isinstance(target, Contact):
+            host, port, expect_id = target.host, target.port, target.peer_id
+        else:
+            host, _, port_s = target.rpartition(":")
+            host, port, expect_id = host or "127.0.0.1", int(port_s), None
+
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(host, port), timeout
+        )
+        try:
+            # Nonce exchange: we challenge the server, it challenges us.
+            my_nonce = os.urandom(16).hex()
+            await write_json_frame(writer, {"proto": protocol, "nonce": my_nonce})
+            challenge = await read_json_frame(reader, timeout)
+            if challenge.get("error"):
+                raise HandshakeError(f"remote rejected stream: {challenge['error']}")
+            server_nonce = str(challenge.get("nonce", ""))
+            if not server_nonce:
+                raise HandshakeError("missing server nonce")
+
+            ts = time.time()
+            sig = self.key.sign(
+                _hello_signing_bytes(protocol, self.peer_id, ts, server_nonce, self.listen_port)
+            )
+            await write_json_frame(
+                writer,
+                {
+                    "proto": protocol,
+                    "peer_id": self.peer_id,
+                    "pubkey": self._pubkey_hex(),
+                    "ts": ts,
+                    "sig": sig.hex(),
+                    "listen_port": self.listen_port,
+                },
+            )
+            ack = await read_json_frame(reader, timeout)
+            if not ack.get("ok"):
+                raise HandshakeError(f"remote rejected stream: {ack.get('error', 'unknown')}")
+            remote_id = _verify_hello(ack, protocol, my_nonce)
+            if expect_id is not None and remote_id != expect_id:
+                raise HandshakeError(
+                    f"peer identity mismatch: expected {expect_id[:8]} got {remote_id[:8]}"
+                )
+            remote_contact = Contact(remote_id, host, port)
+            self.peerstore[remote_id] = remote_contact
+            return Stream(
+                protocol=protocol,
+                remote_peer_id=remote_id,
+                remote_contact=remote_contact,
+                reader=reader,
+                writer=writer,
+            )
+        except Exception:
+            writer.close()
+            raise
+
+    # -- inbound -----------------------------------------------------------
+
+    async def _on_connection(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        task = asyncio.current_task()
+        if task is not None:
+            self._conn_tasks.add(task)
+            task.add_done_callback(self._conn_tasks.discard)
+        try:
+            # Nonce exchange first (see new_stream).
+            opening = await read_json_frame(reader, HANDSHAKE_TIMEOUT)
+            proto = str(opening.get("proto", ""))
+            client_nonce = str(opening.get("nonce", ""))
+            handler = self._handlers.get(proto)
+            if handler is None:
+                await write_json_frame(writer, {"error": f"unknown protocol {proto!r}"})
+                return
+            my_nonce = os.urandom(16).hex()
+            await write_json_frame(writer, {"nonce": my_nonce})
+
+            hello = await read_json_frame(reader, HANDSHAKE_TIMEOUT)
+            if str(hello.get("proto", "")) != proto:
+                raise HandshakeError("protocol changed mid-handshake")
+            remote_id = _verify_hello(hello, proto, my_nonce)
+
+            # Learn a dialable contact for the remote: observed source host +
+            # its advertised listening port.
+            remote_contact: Contact | None = None
+            peername = writer.get_extra_info("peername")
+            lport = int(hello.get("listen_port", 0))
+            if peername and lport > 0:
+                remote_contact = Contact(remote_id, peername[0], lport)
+                self.peerstore[remote_id] = remote_contact
+
+            ts = time.time()
+            sig = self.key.sign(
+                _hello_signing_bytes(proto, self.peer_id, ts, client_nonce, self.listen_port)
+            )
+            await write_json_frame(
+                writer,
+                {
+                    "ok": True,
+                    "proto": proto,
+                    "peer_id": self.peer_id,
+                    "pubkey": self._pubkey_hex(),
+                    "ts": ts,
+                    "sig": sig.hex(),
+                    "listen_port": self.listen_port,
+                },
+            )
+            stream = Stream(
+                protocol=proto,
+                remote_peer_id=remote_id,
+                remote_contact=remote_contact,
+                reader=reader,
+                writer=writer,
+            )
+            await handler(stream)
+        except (HandshakeError, json.JSONDecodeError, asyncio.TimeoutError) as e:
+            log.debug("inbound stream rejected: %s", e)
+        except asyncio.CancelledError:  # host shutting down
+            raise
+        except Exception:
+            log.exception("stream handler error")
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    def _pubkey_hex(self) -> str:
+        from cryptography.hazmat.primitives import serialization
+
+        return self.public_key.public_bytes(
+            serialization.Encoding.Raw, serialization.PublicFormat.Raw
+        ).hex()
+
+
+def _verify_hello(hello: dict, proto: str, expected_nonce: str) -> str:
+    """Verify a signed hello/ack against our challenge; returns the peer ID."""
+    try:
+        peer_id = str(hello["peer_id"])
+        pubkey_raw = bytes.fromhex(str(hello["pubkey"]))
+        ts = float(hello["ts"])
+        listen_port = int(hello.get("listen_port", 0))
+        sig = bytes.fromhex(str(hello["sig"]))
+    except (KeyError, ValueError, TypeError) as e:
+        raise HandshakeError(f"malformed hello: {e}") from e
+    if abs(time.time() - ts) > HELLO_MAX_SKEW:
+        raise HandshakeError("hello timestamp outside accepted window")
+    try:
+        pub = Ed25519PublicKey.from_public_bytes(pubkey_raw)
+        pub.verify(
+            sig, _hello_signing_bytes(proto, peer_id, ts, expected_nonce, listen_port)
+        )
+    except (InvalidSignature, ValueError) as e:
+        raise HandshakeError("hello signature verification failed") from e
+    if peer_id_from_public_key(pub) != peer_id:
+        raise HandshakeError("peer id does not match public key")
+    return peer_id

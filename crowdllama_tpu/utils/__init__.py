@@ -1,0 +1,1 @@
+"""Shared utilities: identity keys, logging, misc helpers."""

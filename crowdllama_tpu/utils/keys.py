@@ -1,0 +1,90 @@
+"""Ed25519 identity key management.
+
+Counterpart of /root/reference/internal/keys/keys.go: one Ed25519 identity per
+component at ``~/.crowdllama-tpu/<component>.key`` (0700 dir / 0600 file),
+get-or-create under a lock so concurrent starts produce exactly one key
+(keys.go:36-98; concurrency contract tested at keys_test.go:252-289).  The
+peer ID is derived from the public key (hex SHA-256, truncated), giving stable
+node identity across restarts — the only durable state in the system, as in
+the reference (SURVEY §5 checkpoint/resume note).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from pathlib import Path
+
+from cryptography.hazmat.primitives import serialization
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+    Ed25519PrivateKey,
+    Ed25519PublicKey,
+)
+
+DEFAULT_DIR = Path(os.environ.get("CROWDLLAMA_TPU_HOME", "~/.crowdllama-tpu")).expanduser()
+
+
+def peer_id_from_public_key(pub: Ed25519PublicKey) -> str:
+    """Stable peer ID: hex SHA-256 of the raw public key, truncated to 40 chars."""
+    raw = pub.public_bytes(
+        serialization.Encoding.Raw, serialization.PublicFormat.Raw
+    )
+    return hashlib.sha256(raw).hexdigest()[:40]
+
+
+def peer_id_to_dht_id(peer_id: str) -> bytes:
+    """Map a peer ID into the 256-bit DHT keyspace."""
+    return hashlib.sha256(b"crowdllama-tpu:peer:" + peer_id.encode()).digest()
+
+
+class KeyManager:
+    """Get-or-create Ed25519 identities on disk (cf. keys.go:22-140)."""
+
+    def __init__(self, base_dir: str | os.PathLike | None = None):
+        self.base_dir = Path(base_dir).expanduser() if base_dir else DEFAULT_DIR
+        self._mu = threading.Lock()
+
+    def key_path(self, component: str) -> Path:
+        return self.base_dir / f"{component}.key"
+
+    def get_or_create_private_key(self, component: str) -> Ed25519PrivateKey:
+        with self._mu:
+            path = self.key_path(component)
+            if path.exists():
+                return self._load(path)
+            self.base_dir.mkdir(parents=True, exist_ok=True)
+            os.chmod(self.base_dir, 0o700)
+            key = Ed25519PrivateKey.generate()
+            raw = key.private_bytes(
+                serialization.Encoding.Raw,
+                serialization.PrivateFormat.Raw,
+                serialization.NoEncryption(),
+            )
+            try:
+                fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o600)
+            except FileExistsError:
+                # Another *process* won the race; use its key.
+                return self._load(path)
+            try:
+                os.write(fd, raw)
+            finally:
+                os.close(fd)
+            return key
+
+    def load_private_key(self, component: str) -> Ed25519PrivateKey:
+        path = self.key_path(component)
+        if not path.exists():
+            raise FileNotFoundError(f"no key for component {component!r} at {path}")
+        return self._load(path)
+
+    @staticmethod
+    def _load(path: Path) -> Ed25519PrivateKey:
+        raw = path.read_bytes()
+        if len(raw) != 32:
+            raise ValueError(f"invalid key file {path}: expected 32 raw bytes, got {len(raw)}")
+        return Ed25519PrivateKey.from_private_bytes(raw)
+
+    def peer_id(self, component: str) -> str:
+        """Peer-ID of an on-disk key, for logs (cf. keys.go:133-140)."""
+        return peer_id_from_public_key(self.load_private_key(component).public_key())

@@ -1,0 +1,67 @@
+"""Key management tests, mirroring /root/reference/internal/keys/keys_test.go:
+create/load/invalid keys, directory creation, concurrent get-or-create
+produces exactly one file, permission checks."""
+
+import stat
+import threading
+
+import pytest
+
+from crowdllama_tpu.utils.keys import KeyManager, peer_id_from_public_key
+
+
+def test_create_and_load(tmp_path):
+    km = KeyManager(tmp_path / "keys")
+    k1 = km.get_or_create_private_key("worker")
+    k2 = km.load_private_key("worker")
+    assert k1.private_bytes_raw() == k2.private_bytes_raw()
+    assert km.peer_id("worker") == peer_id_from_public_key(k1.public_key())
+
+
+def test_get_or_create_idempotent(tmp_path):
+    km = KeyManager(tmp_path)
+    a = km.get_or_create_private_key("c")
+    b = km.get_or_create_private_key("c")
+    assert a.private_bytes_raw() == b.private_bytes_raw()
+
+
+def test_load_missing(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        KeyManager(tmp_path).load_private_key("nope")
+
+
+def test_invalid_key_file(tmp_path):
+    km = KeyManager(tmp_path)
+    tmp_path.mkdir(exist_ok=True)
+    km.key_path("bad").parent.mkdir(parents=True, exist_ok=True)
+    km.key_path("bad").write_bytes(b"too short")
+    with pytest.raises(ValueError):
+        km.load_private_key("bad")
+
+
+def test_permissions(tmp_path):
+    km = KeyManager(tmp_path / "sub")
+    km.get_or_create_private_key("w")
+    assert stat.S_IMODE(km.key_path("w").stat().st_mode) == 0o600
+    assert stat.S_IMODE((tmp_path / "sub").stat().st_mode) == 0o700
+
+
+def test_concurrent_get_or_create_single_file(tmp_path):
+    """10 threads racing get-or-create must yield exactly one key file
+    (cf. keys_test.go:252-289)."""
+    km = KeyManager(tmp_path)
+    keys = []
+    mu = threading.Lock()
+
+    def run():
+        k = km.get_or_create_private_key("shared")
+        with mu:
+            keys.append(k.private_bytes_raw())
+
+    threads = [threading.Thread(target=run) for _ in range(10)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(set(keys)) == 1
+    assert [p.name for p in tmp_path.glob("*.key")] == ["shared.key"]

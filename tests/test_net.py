@@ -1,0 +1,205 @@
+"""Stream host + DHT tests on loopback — the TPU translation of the
+reference's real-libp2p-on-loopback strategy (SURVEY §4): no network mocks,
+real sockets, compressed intervals."""
+
+import asyncio
+
+from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+
+from crowdllama_tpu.config import Intervals
+from crowdllama_tpu.core.protocol import namespace_key
+from crowdllama_tpu.core.resource import Resource
+from crowdllama_tpu.net.dht import DHTNode, RoutingTable, ProviderStore
+from crowdllama_tpu.net.discovery import (
+    Advertiser,
+    discover_peers,
+    new_host_and_dht,
+    request_peer_metadata,
+)
+from crowdllama_tpu.net.host import Contact, Host, HandshakeError
+from crowdllama_tpu.core.protocol import METADATA_PROTOCOL
+from crowdllama_tpu.utils.keys import peer_id_to_dht_id
+
+
+def _key():
+    return Ed25519PrivateKey.generate()
+
+
+async def _mknode(bootstrap=None):
+    host, dht = await new_host_and_dht(_key(), listen_host="127.0.0.1")
+    if bootstrap:
+        await dht.bootstrap([bootstrap])
+    return host, dht
+
+
+async def test_stream_handshake_and_echo():
+    a = Host(_key(), listen_host="127.0.0.1")
+    b = Host(_key(), listen_host="127.0.0.1")
+    await a.start()
+    await b.start()
+    got = asyncio.Future()
+
+    async def handler(stream):
+        data = await stream.reader.readexactly(5)
+        got.set_result((stream.remote_peer_id, data))
+        stream.writer.write(b"world")
+        await stream.writer.drain()
+
+    b.set_stream_handler("/test/1.0.0", handler)
+    s = await a.new_stream(b.contact, "/test/1.0.0")
+    assert s.remote_peer_id == b.peer_id
+    s.writer.write(b"hello")
+    await s.writer.drain()
+    assert await s.reader.readexactly(5) == b"world"
+    peer, data = await asyncio.wait_for(got, 5)
+    assert peer == a.peer_id and data == b"hello"
+    s.close()
+    await a.close()
+    await b.close()
+
+
+async def test_unknown_protocol_rejected():
+    a = Host(_key(), listen_host="127.0.0.1")
+    b = Host(_key(), listen_host="127.0.0.1")
+    await a.start()
+    await b.start()
+    try:
+        try:
+            await a.new_stream(b.contact, "/nope/1.0.0")
+            raise AssertionError("expected HandshakeError")
+        except HandshakeError as e:
+            assert "unknown protocol" in str(e) or "rejected" in str(e)
+    finally:
+        await a.close()
+        await b.close()
+
+
+async def test_identity_mismatch_rejected():
+    a = Host(_key(), listen_host="127.0.0.1")
+    b = Host(_key(), listen_host="127.0.0.1")
+    await a.start()
+    await b.start()
+    b.set_stream_handler("/t/1", lambda s: asyncio.sleep(0))
+    wrong = Contact(peer_id="f" * 40, host="127.0.0.1", port=b.listen_port)
+    try:
+        try:
+            await a.new_stream(wrong, "/t/1")
+            raise AssertionError("expected HandshakeError")
+        except HandshakeError as e:
+            assert "mismatch" in str(e)
+    finally:
+        await a.close()
+        await b.close()
+
+
+def test_routing_table_basics():
+    rt = RoutingTable(peer_id_to_dht_id("self"), k=3)
+    contacts = [Contact(f"peer-{i}", "127.0.0.1", 1000 + i) for i in range(10)]
+    for c in contacts:
+        rt.update(c)
+    assert len(rt) <= 10
+    target = peer_id_to_dht_id("peer-3")
+    closest = rt.closest(target, 5)
+    assert closest and closest[0].peer_id == "peer-3"
+    rt.remove("peer-3")
+    assert all(c.peer_id != "peer-3" for c in rt.contacts())
+
+
+def test_provider_store_ttl():
+    ps = ProviderStore(ttl=0.05)
+    c = Contact("p", "127.0.0.1", 1)
+    ps.add(b"k" * 32, c)
+    assert ps.get(b"k" * 32) == [c]
+    import time
+    time.sleep(0.08)
+    assert ps.get(b"k" * 32) == []
+
+
+async def test_dht_provide_and_find_providers():
+    """Three nodes: bootstrap + two peers; provider records propagate."""
+    boot_host, boot_dht = await _mknode()
+    addr = f"127.0.0.1:{boot_host.listen_port}"
+    h1, d1 = await _mknode(bootstrap=addr)
+    h2, d2 = await _mknode(bootstrap=addr)
+    try:
+        key = namespace_key()
+        await d1.provide(key)
+        # h2 discovers h1 as provider through the bootstrap node
+        providers = await d2.find_providers(key)
+        ids = {c.peer_id for c in providers}
+        assert h1.peer_id in ids
+    finally:
+        for h in (boot_host, h1, h2):
+            await h.close()
+
+
+async def test_dht_find_peer():
+    boot_host, _ = await _mknode()
+    addr = f"127.0.0.1:{boot_host.listen_port}"
+    h1, d1 = await _mknode(bootstrap=addr)
+    h2, d2 = await _mknode(bootstrap=addr)
+    try:
+        c = await d2.find_peer(h1.peer_id)
+        assert c is not None and c.port == h1.listen_port
+    finally:
+        for h in (boot_host, h1, h2):
+            await h.close()
+
+
+async def test_metadata_fetch_and_discover():
+    boot_host, _ = await _mknode()
+    addr = f"127.0.0.1:{boot_host.listen_port}"
+    worker_host, worker_dht = await _mknode(bootstrap=addr)
+    consumer_host, consumer_dht = await _mknode(bootstrap=addr)
+
+    resource = Resource(
+        peer_id=worker_host.peer_id,
+        supported_models=["tinyllama-1.1b"],
+        tokens_throughput=100.0,
+        worker_mode=True,
+        accelerator="tpu-v5e",
+        tpu_chip_count=1,
+    )
+    resource.touch()
+
+    async def serve_metadata(stream):
+        stream.writer.write(resource.to_json())
+        await stream.writer.drain()
+        stream.writer.write_eof()
+
+    worker_host.set_stream_handler(METADATA_PROTOCOL, serve_metadata)
+    try:
+        await worker_dht.provide(namespace_key())
+        # Direct metadata fetch
+        got = await request_peer_metadata(consumer_host, worker_host.contact)
+        assert got.supported_models == ["tinyllama-1.1b"]
+        # Full discovery path
+        found = await discover_peers(consumer_host, consumer_dht)
+        assert any(r.peer_id == worker_host.peer_id for r in found)
+        # Stale metadata is rejected
+        resource.last_updated -= 7200
+        found = await discover_peers(consumer_host, consumer_dht)
+        assert not any(r.peer_id == worker_host.peer_id for r in found)
+    finally:
+        for h in (boot_host, worker_host, consumer_host):
+            await h.close()
+
+
+async def test_advertiser_loop_and_reconnect():
+    boot_host, boot_dht = await _mknode()
+    addr = f"127.0.0.1:{boot_host.listen_port}"
+    h1, d1 = await _mknode(bootstrap=addr)
+    try:
+        adv = Advertiser(d1, Intervals(advertise=0.1))
+        adv.start()
+        await asyncio.sleep(0.35)
+        assert boot_dht.providers.get(namespace_key())
+        # Simulate routing-table loss; advertiser must re-bootstrap
+        d1.table = type(d1.table)(d1.node_id)
+        assert not d1.is_connected()
+        await asyncio.sleep(0.3)
+        assert d1.is_connected()
+        await adv.stop()
+    finally:
+        await boot_host.close()
+        await h1.close()
