@@ -80,6 +80,8 @@ class Configuration:
     max_batch_slots: int = 8
     max_context_length: int = 2048
     mesh_shape: str = ""  # e.g. "1x8" → (dp=1, tp=8); empty = all devices on tp
+    decode_chunk: int = 8  # decode steps per device dispatch
+    warmup: bool = True  # compile prefill/decode at engine start
 
     intervals: Intervals = field(default_factory=Intervals.default)
 
@@ -104,6 +106,9 @@ class Configuration:
         cfg.model_path = env.get("CROWDLLAMA_TPU_MODEL_PATH", cfg.model_path)
         cfg.engine_backend = env.get("CROWDLLAMA_TPU_ENGINE", cfg.engine_backend)
         cfg.mesh_shape = env.get("CROWDLLAMA_TPU_MESH", cfg.mesh_shape)
+        cfg.decode_chunk = int(env.get("CROWDLLAMA_TPU_DECODE_CHUNK", cfg.decode_chunk))
+        if env.get("CROWDLLAMA_TPU_WARMUP"):
+            cfg.warmup = env["CROWDLLAMA_TPU_WARMUP"] in ("1", "true")
         for k, v in overrides.items():
             if v is not None:
                 setattr(cfg, k, v)

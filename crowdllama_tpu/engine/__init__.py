@@ -1,0 +1,12 @@
+"""The TPU inference engine.
+
+Replaces the reference's wholesale delegation to an embedded Ollama server
+(/root/reference/cmd/crowdllama/main.go:286-297, pkg/crowdllama/api.go:108-160)
+with a first-class JAX engine: jitted bucketed prefill, slot-based continuous
+batching decode, on-device sampling, token streaming, and TP/EP sharding over
+the worker's ICI mesh.  The single pluggable seam the reference exposes —
+``UnifiedAPIHandler = func(ctx, *BaseMessage) (*BaseMessage, error)``
+(api.go:19) — is preserved as ``Engine.handle`` / ``Engine.handle_streaming``.
+"""
+
+from crowdllama_tpu.engine.engine import Engine, FakeEngine, JaxEngine  # noqa: F401

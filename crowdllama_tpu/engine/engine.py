@@ -1,0 +1,268 @@
+"""Engine facade: the inference seam between the swarm and the model.
+
+``Engine`` is the TPU-native replacement for the reference's
+``UnifiedAPIHandler`` (/root/reference/pkg/crowdllama/api.go:19): everything
+above it (worker stream handler, gateway, IPC) talks BaseMessage; everything
+below is JAX.  ``JaxEngine`` serves real models with continuous batching and
+token streaming; ``FakeEngine`` is the test double at the same seam the
+reference mocks with an HTTP fake (test/integration_test.go:32-135).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass
+from typing import AsyncIterator
+
+from crowdllama_tpu.config import Configuration
+from crowdllama_tpu.core import pb
+from crowdllama_tpu.core.messages import (
+    create_generate_response,
+    extract_generate_request,
+    flatten_chat,
+)
+
+log = logging.getLogger("crowdllama.engine")
+
+
+@dataclass
+class Chunk:
+    text: str
+    done: bool = False
+    done_reason: str = ""
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+
+
+class Engine:
+    """Abstract engine seam."""
+
+    models: list[str] = []
+
+    async def start(self) -> None: ...
+    async def stop(self) -> None: ...
+
+    def describe(self) -> dict:
+        """Capability/telemetry snapshot for Resource advertisement."""
+        return {"models": self.models, "throughput": 0.0, "load": 0.0}
+
+    def generate(
+        self,
+        prompt: str,
+        model: str = "",
+        max_tokens: int = 128,
+        temperature: float = 0.0,
+        top_p: float = 1.0,
+    ) -> AsyncIterator[Chunk]:
+        raise NotImplementedError
+
+    # ---- the UnifiedAPIHandler seam (api.go:19) --------------------------
+
+    async def handle(self, msg: pb.BaseMessage, worker_id: str = "") -> pb.BaseMessage:
+        """Blocking BaseMessage → BaseMessage (reference semantics)."""
+        req = extract_generate_request(msg)
+        t0 = time.monotonic_ns()
+        text_parts: list[str] = []
+        final: Chunk | None = None
+        async for chunk in self._gen_from_request(req):
+            text_parts.append(chunk.text)
+            final = chunk
+        assert final is not None
+        return create_generate_response(
+            model=req.model,
+            response="".join(text_parts),
+            worker_id=worker_id,
+            done=True,
+            done_reason=final.done_reason or "stop",
+            total_duration_ns=time.monotonic_ns() - t0,
+            prompt_tokens=final.prompt_tokens,
+            completion_tokens=final.completion_tokens,
+        )
+
+    async def handle_streaming(
+        self, msg: pb.BaseMessage, worker_id: str = ""
+    ) -> AsyncIterator[pb.BaseMessage]:
+        """Streaming superset: one GenerateResponse frame per chunk, done
+        marked on the last (SURVEY §7 hard part 5 — the reference carries a
+        stream flag but never streams)."""
+        req = extract_generate_request(msg)
+        t0 = time.monotonic_ns()
+        async for chunk in self._gen_from_request(req):
+            yield create_generate_response(
+                model=req.model,
+                response=chunk.text,
+                worker_id=worker_id,
+                done=chunk.done,
+                done_reason=chunk.done_reason if chunk.done else "",
+                total_duration_ns=(time.monotonic_ns() - t0) if chunk.done else 0,
+                prompt_tokens=chunk.prompt_tokens if chunk.done else 0,
+                completion_tokens=chunk.completion_tokens if chunk.done else 0,
+            )
+
+    def _gen_from_request(self, req: pb.GenerateRequest) -> AsyncIterator[Chunk]:
+        prompt = req.prompt
+        if not prompt and req.messages:
+            prompt = flatten_chat(
+                [{"role": m.role, "content": m.content} for m in req.messages]
+            )
+        return self.generate(
+            prompt,
+            model=req.model,
+            max_tokens=req.max_tokens or 128,
+            temperature=req.temperature,
+            top_p=req.top_p or 1.0,
+        )
+
+
+class JaxEngine(Engine):
+    """The real engine: ModelRunner + continuous-batching Scheduler."""
+
+    def __init__(self, config: Configuration | None = None, **overrides):
+        self.config = config or Configuration.from_environment()
+        for k, v in overrides.items():
+            setattr(self.config, k, v)
+        self.models = [self.config.model]
+        self.scheduler = None
+        self.tokenizer = None
+        self._runner = None
+
+    async def start(self) -> None:
+        """Build tokenizer/params/runner (compiles on first use)."""
+        from crowdllama_tpu.engine.runner import ModelRunner
+        from crowdllama_tpu.engine.scheduler import Scheduler
+        from crowdllama_tpu.engine.tokenizer import get_tokenizer
+        from crowdllama_tpu.engine.weights import load_or_init_params
+        from crowdllama_tpu.models.config import get_config
+
+        cfg = get_config(self.config.model)
+        if self.config.max_context_length:
+            cfg = get_config(
+                self.config.model,
+                max_context_length=min(cfg.max_context_length,
+                                       self.config.max_context_length),
+            )
+        self.tokenizer = get_tokenizer(self.config.model_path)
+        loop = asyncio.get_running_loop()
+
+        def _build():
+            params = load_or_init_params(cfg, self.config.model_path)
+            return ModelRunner(
+                cfg,
+                params=params,
+                mesh_spec=self.config.mesh_shape,
+                max_slots=self.config.max_batch_slots,
+                max_seq=cfg.max_context_length,
+            )
+
+        self._runner = await loop.run_in_executor(None, _build)
+        if self.config.warmup:
+            await loop.run_in_executor(None, self._warmup)
+        self.scheduler = Scheduler(self._runner,
+                                   decode_chunk=self.config.decode_chunk)
+        self.scheduler.start()
+        log.info(
+            "engine up: model=%s mesh=%s slots=%d max_seq=%d",
+            cfg.name, dict(self._runner.mesh.shape), self._runner.max_slots,
+            self._runner.max_seq,
+        )
+
+    def _warmup(self) -> None:
+        """Compile the hot paths before serving (smallest prefill bucket,
+        decode chunks of 1 and decode_chunk) so the first request doesn't pay
+        30-40 s of XLA compilation in its TTFT."""
+        import jax
+
+        r = self._runner
+        state = r.init_state()
+        tok, ks, vs, plen = r.prefill([1, 2, 3], 0.0, 1.0, jax.random.PRNGKey(0))
+        state = r.insert(state, 0, ks, vs, plen, tok, 0.0, 1.0)
+        for k in {1, self.config.decode_chunk}:
+            _, state = r.decode_steps(state, k)
+        log.info("warmup compile done")
+
+    async def stop(self) -> None:
+        if self.scheduler is not None:
+            await self.scheduler.stop()
+
+    def describe(self) -> dict:
+        d = {"models": self.models, "throughput": 0.0, "load": 0.0}
+        if self.scheduler is not None:
+            d["throughput"] = round(self.scheduler.throughput_ema, 2)
+            d["load"] = round(self.scheduler.load, 3)
+        return d
+
+    async def generate(  # type: ignore[override]
+        self,
+        prompt: str,
+        model: str = "",
+        max_tokens: int = 128,
+        temperature: float = 0.0,
+        top_p: float = 1.0,
+    ) -> AsyncIterator[Chunk]:
+        from crowdllama_tpu.engine.scheduler import DONE, GenRequest
+
+        if self.scheduler is None:
+            raise RuntimeError("engine not started")
+        if model and model not in self.models:
+            raise ValueError(f"model {model!r} not served (have {self.models})")
+
+        prompt_ids = self.tokenizer.encode(prompt)
+        req = GenRequest(
+            prompt_ids=prompt_ids,
+            max_tokens=max_tokens,
+            temperature=temperature,
+            top_p=top_p,
+            eos_id=self.tokenizer.eos_id,
+        )
+        await self.scheduler.submit(req)
+        decoder = self.tokenizer.stream_decoder()
+        completion = 0
+        while True:
+            token, reason = await req.out.get()
+            if token is DONE:
+                if reason.startswith("error"):
+                    raise RuntimeError(reason)
+                yield Chunk(
+                    text="", done=True, done_reason=reason,
+                    prompt_tokens=len(prompt_ids), completion_tokens=completion,
+                )
+                return
+            completion += 1
+            if token == req.eos_id:
+                continue  # silent; DONE follows
+            text = decoder.feed(token)
+            if text:
+                yield Chunk(text=text)
+
+
+class FakeEngine(Engine):
+    """Echo engine for tests (the engine-seam mock, cf. MockOllamaServer)."""
+
+    def __init__(self, models: list[str] | None = None, delay: float = 0.0):
+        self.models = models or ["tiny-test"]
+        self.delay = delay
+        self.calls = 0
+
+    async def start(self) -> None:
+        return
+
+    async def stop(self) -> None:
+        return
+
+    def describe(self) -> dict:
+        return {"models": self.models, "throughput": 100.0, "load": 0.1}
+
+    async def generate(  # type: ignore[override]
+        self, prompt: str, model: str = "", max_tokens: int = 128,
+        temperature: float = 0.0, top_p: float = 1.0,
+    ) -> AsyncIterator[Chunk]:
+        self.calls += 1
+        if self.delay:
+            await asyncio.sleep(self.delay)
+        words = f"echo: {prompt}".split(" ")
+        for w in words[:-1]:
+            yield Chunk(text=w + " ")
+        yield Chunk(text=words[-1], done=True, done_reason="stop",
+                    prompt_tokens=len(prompt.split()), completion_tokens=len(words))

@@ -1,0 +1,249 @@
+"""ModelRunner: compiled prefill / insert / decode over a device mesh.
+
+Owns the parameter pytree (sharded per parallel.sharding rules), the decode
+state (slot-based KV cache), and the three jitted programs of the serving hot
+path:
+
+- ``prefill(tokens)``   — bucketed full-prompt forward; returns the prompt's
+  KV and the first sampled token.  Buckets bound compilation count.
+- ``insert(...)``       — writes a prefilled sequence into a batch slot.
+- ``decode_step(state)``— one token for every slot (active or not: shapes are
+  static), sampling on device, cache updated in place (buffers donated).
+
+Design per SURVEY §7 hard part 1: fixed shapes, slot management, and
+prefill/decode interleaving live here; the asyncio continuous-batching policy
+lives in engine.scheduler.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from crowdllama_tpu.engine.sampling import sample_tokens
+from crowdllama_tpu.models import transformer as T
+from crowdllama_tpu.models.config import ModelConfig
+from crowdllama_tpu.parallel.mesh import AXIS_DP, build_mesh, choose_mesh_shape
+from crowdllama_tpu.parallel.sharding import cache_pspec, shard_params
+
+log = logging.getLogger("crowdllama.engine.runner")
+
+Params = dict[str, Any]
+
+
+@dataclass
+class DecodeState:
+    """Per-slot decode state (a pytree; all arrays device-resident)."""
+
+    k_cache: jnp.ndarray   # [L, B, S, Hkv, Dh]
+    v_cache: jnp.ndarray   # [L, B, S, Hkv, Dh]
+    seq_lens: jnp.ndarray  # [B] int32 — tokens in cache (last token pending)
+    tokens: jnp.ndarray    # [B] int32 — last sampled token per slot
+    active: jnp.ndarray    # [B] bool
+    temperature: jnp.ndarray  # [B] fp32
+    top_p: jnp.ndarray     # [B] fp32
+    key: jax.Array         # PRNG carry
+
+
+jax.tree_util.register_dataclass(
+    DecodeState,
+    data_fields=["k_cache", "v_cache", "seq_lens", "tokens", "active",
+                 "temperature", "top_p", "key"],
+    meta_fields=[],
+)
+
+
+def prefill_buckets(max_seq: int) -> list[int]:
+    buckets, b = [], 32
+    while b < max_seq:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_seq)
+    return buckets
+
+
+class ModelRunner:
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        params: Params | None = None,
+        mesh: Mesh | None = None,
+        mesh_spec: str = "",
+        max_slots: int = 8,
+        max_seq: int = 0,
+        dtype=jnp.bfloat16,
+        seed: int = 0,
+    ):
+        self.cfg = cfg
+        self.max_slots = max_slots
+        self.max_seq = max_seq or cfg.max_context_length
+        self.dtype = dtype
+
+        if mesh is None:
+            n = len(jax.devices())
+            if mesh_spec:
+                mesh = build_mesh(mesh_spec)
+            else:
+                mesh = build_mesh(
+                    choose_mesh_shape(n, cfg.num_kv_heads, cfg.num_experts)
+                )
+        self.mesh = mesh
+        dp = mesh.shape[AXIS_DP]
+        if self.max_slots % dp != 0:
+            self.max_slots = max(dp, (self.max_slots // dp) * dp)
+            log.warning("max_slots rounded to %d (dp=%d)", self.max_slots, dp)
+
+        if params is None:
+            params = T.init_params(cfg, jax.random.PRNGKey(seed), dtype=dtype)
+        self.params = shard_params(params, cfg, mesh)
+
+        self._replicated = NamedSharding(mesh, P())
+        self._cache_sharding = NamedSharding(mesh, cache_pspec())
+        # Prefill KV has batch dim 1 — kv-heads shard on tp, no dp.
+        self._prefill_kv_sharding = NamedSharding(
+            mesh, P(None, None, None, "tp", None))
+        self.buckets = prefill_buckets(self.max_seq)
+
+        self._prefill = jax.jit(
+            self._prefill_impl,
+            out_shardings=(
+                self._replicated, self._prefill_kv_sharding, self._prefill_kv_sharding,
+            ),
+        )
+        self._decode = jax.jit(self._decode_impl, donate_argnums=(1,),
+                               static_argnums=(2,))
+        self._insert = jax.jit(self._insert_impl, donate_argnums=(0,))
+        self._release = jax.jit(self._release_impl, donate_argnums=(0,))
+
+    # ------------------------------------------------------------- programs
+
+    def _prefill_impl(self, params, tokens, plen, temperature, top_p, key):
+        """tokens [1, T] padded; plen scalar; returns (first_token, ks, vs)."""
+        t = tokens.shape[1]
+        # Padding positions clamp to plen-1; kv_valid excludes them from
+        # attention (clamped positions would otherwise pass the causal mask).
+        positions = jnp.minimum(jnp.arange(t)[None, :], plen - 1)
+        kv_valid = (jnp.arange(t) < plen)[None, :]
+        logits, ks, vs = T.prefill(params, self.cfg, tokens, positions,
+                                   kv_valid=kv_valid)
+        last = logits[0, plen - 1]  # [V]
+        tok = sample_tokens(last[None, :], temperature[None], top_p[None], key)[0]
+        return tok, ks, vs
+
+    def _insert_impl(self, state: DecodeState, slot, ks, vs, plen, first_token,
+                     temperature, top_p) -> DecodeState:
+        """Write a prefilled sequence (ks/vs [L,1,T,...]) into ``slot``."""
+        k_cache = jax.lax.dynamic_update_slice(
+            state.k_cache, ks.astype(state.k_cache.dtype), (0, slot, 0, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            state.v_cache, vs.astype(state.v_cache.dtype), (0, slot, 0, 0, 0))
+        return DecodeState(
+            k_cache=k_cache,
+            v_cache=v_cache,
+            seq_lens=state.seq_lens.at[slot].set(plen),
+            tokens=state.tokens.at[slot].set(first_token),
+            active=state.active.at[slot].set(True),
+            temperature=state.temperature.at[slot].set(temperature),
+            top_p=state.top_p.at[slot].set(top_p),
+            key=state.key,
+        )
+
+    def _release_impl(self, state: DecodeState, slot) -> DecodeState:
+        return DecodeState(
+            k_cache=state.k_cache, v_cache=state.v_cache,
+            seq_lens=state.seq_lens.at[slot].set(0),
+            tokens=state.tokens.at[slot].set(0),
+            active=state.active.at[slot].set(False),
+            temperature=state.temperature, top_p=state.top_p, key=state.key,
+        )
+
+    def _decode_impl(self, params, state: DecodeState, num_steps: int):
+        """``num_steps`` decode steps in one dispatch; returns
+        (tokens [K, B], new state).
+
+        Multi-step decode amortizes host→device dispatch latency — essential
+        when the chip sits behind a network tunnel (measured 87 ms/step
+        single-step vs sub-10ms amortized) and good hygiene everywhere.  The
+        scheduler picks K; EOS overshoot within a chunk is discarded host-side.
+        """
+
+        def step(st: DecodeState, _):
+            positions = jnp.minimum(st.seq_lens, self.max_seq - 1)
+            logits, k_cache, v_cache = T.decode_step(
+                params, self.cfg, st.tokens, positions,
+                st.k_cache, st.v_cache,
+                jnp.minimum(st.seq_lens + 1, self.max_seq),
+            )
+            key, sub = jax.random.split(st.key)
+            next_tokens = sample_tokens(logits, st.temperature, st.top_p, sub)
+            next_tokens = jnp.where(st.active, next_tokens, 0)
+            new_state = DecodeState(
+                k_cache=k_cache, v_cache=v_cache,
+                seq_lens=jnp.where(st.active, st.seq_lens + 1, st.seq_lens),
+                tokens=next_tokens,
+                active=st.active,
+                temperature=st.temperature, top_p=st.top_p, key=key,
+            )
+            return new_state, next_tokens
+
+        new_state, tokens = jax.lax.scan(step, state, length=num_steps)
+        return tokens, new_state
+
+    # ------------------------------------------------------------------ API
+
+    def init_state(self, seed: int = 0) -> DecodeState:
+        l, b, s = self.cfg.num_layers, self.max_slots, self.max_seq
+        hkv, dh = self.cfg.num_kv_heads, self.cfg.resolved_head_dim()
+        zeros = jnp.zeros((l, b, s, hkv, dh), self.dtype)
+        return DecodeState(
+            k_cache=jax.device_put(zeros, self._cache_sharding),
+            v_cache=jax.device_put(zeros, self._cache_sharding),
+            seq_lens=jnp.zeros((b,), jnp.int32),
+            tokens=jnp.zeros((b,), jnp.int32),
+            active=jnp.zeros((b,), bool),
+            temperature=jnp.zeros((b,), jnp.float32),
+            top_p=jnp.ones((b,), jnp.float32),
+            key=jax.random.PRNGKey(seed),
+        )
+
+    def bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"prompt length {n} exceeds max_seq {self.max_seq}")
+
+    def prefill(self, prompt_ids: list[int], temperature: float, top_p: float,
+                key: jax.Array):
+        """Run bucketed prefill; returns (first_token, ks, vs, plen)."""
+        plen = len(prompt_ids)
+        bucket = self.bucket_for(plen)
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :plen] = prompt_ids
+        tok, ks, vs = self._prefill(
+            self.params, jnp.asarray(tokens), jnp.int32(plen),
+            jnp.float32(temperature), jnp.float32(top_p), key,
+        )
+        return int(tok), ks, vs, plen
+
+    def insert(self, state: DecodeState, slot: int, ks, vs, plen: int,
+               first_token: int, temperature: float, top_p: float) -> DecodeState:
+        # KV buckets shorter than max_seq: pad via dynamic slice into cache
+        return self._insert(
+            state, jnp.int32(slot), ks, vs, jnp.int32(plen),
+            jnp.int32(first_token), jnp.float32(temperature), jnp.float32(top_p),
+        )
+
+    def release(self, state: DecodeState, slot: int) -> DecodeState:
+        return self._release(state, jnp.int32(slot))
+
+    def decode_steps(self, state: DecodeState, num_steps: int = 1):
+        """Run ``num_steps`` decode steps; returns (tokens [K, B] np, state)."""
+        tokens, new_state = self._decode(self.params, state, num_steps)
+        return np.asarray(tokens), new_state

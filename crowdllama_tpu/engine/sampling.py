@@ -1,0 +1,38 @@
+"""On-device sampling: greedy / temperature / nucleus (top-p), per-slot.
+
+Runs inside the jitted decode step so only sampled token ids leave the
+device.  Per-slot temperature and top_p let one continuous batch mix greedy
+and sampled requests.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_tokens(
+    logits: jnp.ndarray,        # [B, V] fp32
+    temperature: jnp.ndarray,   # [B] — 0 means greedy
+    top_p: jnp.ndarray,         # [B] — 1 means no nucleus filtering
+    key: jax.Array,
+) -> jnp.ndarray:
+    greedy = jnp.argmax(logits, axis=-1)
+
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = logits / temp
+
+    # Nucleus filter on the sorted distribution.
+    sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
+    sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(sorted_probs, axis=-1)
+    # keep tokens while cumulative prob (exclusive) < top_p
+    keep_sorted = (cum - sorted_probs) < top_p[:, None]
+    # threshold = smallest kept logit per row
+    thresholds = jnp.min(
+        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    filtered = jnp.where(scaled >= thresholds, scaled, -jnp.inf)
+
+    sampled = jax.random.categorical(key, filtered, axis=-1)
+    return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)

@@ -1,0 +1,202 @@
+"""Continuous batching scheduler.
+
+The async policy layer over ModelRunner: admit pending requests into free
+batch slots (bucketed prefill), run the shared decode loop while any slot is
+active, stream each new token to its request's queue, retire slots on EOS /
+max-tokens.  This is the component the reference outsources to Ollama's
+internal server loop; here it is explicit and TPU-shaped (fixed-shape decode
+batch, prefill interleaved between steps).
+
+JAX dispatch happens on the event-loop thread but blocks only while a step is
+in flight; token host-transfer is one small [B] array per step.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import time
+from dataclasses import dataclass, field
+
+import jax
+
+from crowdllama_tpu.engine.runner import ModelRunner
+
+log = logging.getLogger("crowdllama.engine.scheduler")
+
+_DONE = object()
+
+
+@dataclass
+class GenRequest:
+    prompt_ids: list[int]
+    max_tokens: int = 128
+    temperature: float = 0.0
+    top_p: float = 1.0
+    eos_id: int = -1
+    id: int = field(default_factory=itertools.count().__next__)
+    # queue of (token_id | _DONE sentinel, finish_reason)
+    out: asyncio.Queue = field(default_factory=asyncio.Queue)
+    submitted_at: float = field(default_factory=time.monotonic)
+    first_token_at: float = 0.0
+
+
+@dataclass
+class _SlotInfo:
+    req: GenRequest
+    prompt_len: int = 0
+    generated: int = 0
+
+
+class Scheduler:
+    def __init__(self, runner: ModelRunner, max_queue: int = 256,
+                 decode_chunk: int = 8):
+        self.runner = runner
+        self.decode_chunk = max(1, decode_chunk)
+        self.state = runner.init_state()
+        self.slots: list[_SlotInfo | None] = [None] * runner.max_slots
+        self.pending: asyncio.Queue[GenRequest] = asyncio.Queue(max_queue)
+        self._wake = asyncio.Event()
+        self._task: asyncio.Task | None = None
+        self._rng = jax.random.PRNGKey(int(time.time()) & 0x7FFFFFFF)
+        # Telemetry for Resource advertisement + /api/health.
+        self.tokens_generated = 0
+        self.throughput_ema = 0.0  # tokens/sec across the batch
+        self.requests_served = 0
+
+    # ---------------------------------------------------------------- public
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.create_task(self._loop(), name="decode-loop")
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def submit(self, req: GenRequest) -> None:
+        if len(req.prompt_ids) >= self.runner.max_seq:
+            raise ValueError(
+                f"prompt of {len(req.prompt_ids)} tokens exceeds max context "
+                f"{self.runner.max_seq}"
+            )
+        await self.pending.put(req)
+        self._wake.set()
+
+    @property
+    def load(self) -> float:
+        busy = sum(1 for s in self.slots if s is not None)
+        return busy / max(1, len(self.slots))
+
+    # ------------------------------------------------------------------ loop
+
+    def _free_slot(self) -> int | None:
+        for i, s in enumerate(self.slots):
+            if s is None:
+                return i
+        return None
+
+    def _admit_one(self, req: GenRequest, slot: int) -> None:
+        self._rng, sub = jax.random.split(self._rng)
+        first, ks, vs, plen = self.runner.prefill(
+            req.prompt_ids, req.temperature, req.top_p, sub
+        )
+        self.state = self.runner.insert(
+            self.state, slot, ks, vs, plen, first, req.temperature, req.top_p
+        )
+        info = _SlotInfo(req=req, prompt_len=plen)
+        self.slots[slot] = info
+        req.first_token_at = time.monotonic()
+        self._emit(req, first, info)
+
+    def _emit(self, req: GenRequest, token: int, info: _SlotInfo) -> None:
+        info.generated += 1
+        self.tokens_generated += 1
+        req.out.put_nowait((token, ""))
+        # Retire on EOS, request budget, or context exhaustion (the KV slot is
+        # full; decoding further would clamp-and-overwrite the last position).
+        out_of_context = info.prompt_len + info.generated >= self.runner.max_seq - 1
+        if token == req.eos_id or info.generated >= req.max_tokens or out_of_context:
+            reason = "stop" if token == req.eos_id else "length"
+            req.out.put_nowait((_DONE, reason))
+            slot = self.slots.index(info)
+            self.slots[slot] = None
+            self.state = self.runner.release(self.state, slot)
+            self.requests_served += 1
+
+    def _chunk_size(self) -> int:
+        """Steps per dispatch.  Only two sizes are ever used — 1 (requests
+        waiting: admission latency beats amortization) and decode_chunk — so
+        only two decode programs are compiled (warmup covers both).  EOS /
+        budget overshoot within a chunk is discarded by _loop's snapshot."""
+        return 1 if not self.pending.empty() else self.decode_chunk
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                await self._loop_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # A failed dispatch must not silently kill serving: fail every
+                # in-flight request, reset device state, keep the loop alive.
+                log.exception("decode loop error; failing in-flight requests")
+                for i, info in enumerate(self.slots):
+                    if info is not None:
+                        info.req.out.put_nowait((_DONE, "error: engine failure"))
+                        self.slots[i] = None
+                while not self.pending.empty():
+                    self.pending.get_nowait().out.put_nowait(
+                        (_DONE, "error: engine failure"))
+                self.state = self.runner.init_state()
+
+    async def _loop_once(self) -> None:
+        # Idle: wait for work.
+        if all(s is None for s in self.slots) and self.pending.empty():
+            self._wake.clear()
+            await self._wake.wait()
+
+        # Admit as many pending requests as there are free slots.
+        while not self.pending.empty():
+            slot = self._free_slot()
+            if slot is None:
+                break
+            req = self.pending.get_nowait()
+            try:
+                self._admit_one(req, slot)
+            except ValueError as e:  # bad request (too long, etc.)
+                log.warning("admit failed: %s", e)
+                req.out.put_nowait((_DONE, f"error: {e}"))
+
+        if all(s is None for s in self.slots):
+            return
+
+        # A chunk of decode steps for the whole batch in one dispatch.
+        k = self._chunk_size()
+        t0 = time.monotonic()
+        tokens, self.state = self.runner.decode_steps(self.state, k)  # [K,B]
+        dt = max(time.monotonic() - t0, 1e-6)
+        emitted = 0
+        for step in range(tokens.shape[0]):
+            # _emit may retire a slot mid-chunk; later steps for that slot
+            # are EOS overshoot and are discarded by the snapshot below.
+            live = [(i, s) for i, s in enumerate(self.slots) if s is not None]
+            for i, info in live:
+                self._emit(info.req, int(tokens[step, i]), info)
+                emitted += 1
+        rate = emitted / dt
+        self.throughput_ema = (
+            rate if self.throughput_ema == 0.0
+            else 0.9 * self.throughput_ema + 0.1 * rate
+        )
+        # Yield so submitters/streamers run between chunks.
+        await asyncio.sleep(0)
+
+
+DONE = _DONE

@@ -1,0 +1,129 @@
+"""Tokenizers: a dependency-free byte tokenizer and an HF wrapper.
+
+The byte tokenizer is the zero-egress default (no downloaded vocab needed):
+ids 0-255 are raw bytes, then PAD/BOS/EOS.  Real checkpoints use
+``HFTokenizer`` over a local tokenizer.json directory.  Streaming decode is
+incremental and UTF-8-safe (partial multibyte sequences are held back).
+"""
+
+from __future__ import annotations
+
+import codecs
+import logging
+from typing import Protocol
+
+
+class Tokenizer(Protocol):
+    bos_id: int
+    eos_id: int
+    pad_id: int
+
+    def encode(self, text: str) -> list[int]: ...
+    def decode(self, ids: list[int]) -> str: ...
+    def stream_decoder(self) -> "StreamDecoder": ...
+
+
+class StreamDecoder:
+    """Incremental detokenizer: feed ids, get printable text deltas."""
+
+    def __init__(self, tok: "Tokenizer"):
+        self._tok = tok
+
+    def feed(self, token_id: int) -> str:
+        return self._tok.decode([token_id])
+
+
+class ByteStreamDecoder(StreamDecoder):
+    def __init__(self, tok: "ByteTokenizer"):
+        super().__init__(tok)
+        self._decoder = codecs.getincrementaldecoder("utf-8")(errors="replace")
+        self._specials = {tok.pad_id, tok.bos_id, tok.eos_id}
+
+    def feed(self, token_id: int) -> str:
+        if token_id in self._specials or token_id > 255:
+            return ""
+        return self._decoder.decode(bytes([token_id]))
+
+
+class ByteTokenizer:
+    """Bytes + specials; works with any model vocab >= 259."""
+
+    PAD, BOS, EOS = 256, 257, 258
+
+    def __init__(self):
+        self.pad_id = self.PAD
+        self.bos_id = self.BOS
+        self.eos_id = self.EOS
+        self.vocab_size = 259
+
+    def encode(self, text: str) -> list[int]:
+        return [self.bos_id] + list(text.encode("utf-8"))
+
+    def decode(self, ids: list[int]) -> str:
+        data = bytes(i for i in ids if 0 <= i <= 255)
+        return data.decode("utf-8", errors="replace")
+
+    def stream_decoder(self) -> StreamDecoder:
+        return ByteStreamDecoder(self)
+
+
+class HFTokenizer:
+    """transformers AutoTokenizer over a local checkpoint directory."""
+
+    def __init__(self, path: str):
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
+        # `x if x is not None` — 0 is a legitimate token id for any of these.
+        self.bos_id = self._tok.bos_token_id if self._tok.bos_token_id is not None else -1
+        self.eos_id = self._tok.eos_token_id if self._tok.eos_token_id is not None else -1
+        self.pad_id = (self._tok.pad_token_id
+                       if self._tok.pad_token_id is not None else self.eos_id)
+
+    def encode(self, text: str) -> list[int]:
+        return self._tok.encode(text)
+
+    def decode(self, ids: list[int]) -> str:
+        return self._tok.decode(ids, skip_special_tokens=True)
+
+    def stream_decoder(self) -> StreamDecoder:
+        return _HFStreamDecoder(self)
+
+
+class _HFStreamDecoder(StreamDecoder):
+    """Incremental detokenizer over a pending-id window (O(1) per token).
+
+    Only the not-yet-emitted ids are re-decoded each step; a window flushes
+    once its text is stable (no trailing replacement char).  Sentencepiece
+    word-boundary markers on the window's first token are restored manually
+    since a windowed decode loses the leading space.
+    """
+
+    def __init__(self, tok: HFTokenizer):
+        super().__init__(tok)
+        self._pending: list[int] = []
+        self._first = True
+
+    def feed(self, token_id: int) -> str:
+        self._pending.append(token_id)
+        text = self._tok.decode(self._pending)
+        if text.endswith("�"):  # mid-multibyte; wait for more ids
+            return ""
+        lead = self._tok._tok.convert_ids_to_tokens([self._pending[0]])[0]
+        if not self._first and lead and lead[0] in ("▁", "Ġ") and not text.startswith(" "):
+            text = " " + text
+        self._pending.clear()
+        if text:
+            self._first = False
+        return text
+
+
+def get_tokenizer(model_path: str = "") -> Tokenizer:
+    if model_path:
+        try:
+            return HFTokenizer(model_path)
+        except Exception as e:
+            logging.getLogger("crowdllama.engine.tokenizer").warning(
+                "no usable tokenizer at %s (%s); falling back to byte "
+                "tokenizer — WRONG for real checkpoints", model_path, e)
+    return ByteTokenizer()

@@ -1,0 +1,10 @@
+"""Model families served by the TPU engine.
+
+The reference delegates all model execution to an embedded Ollama binary
+(/root/reference/cmd/crowdllama/main.go:49,286-297); here models are
+first-class JAX programs.  One functional decoder core covers the Llama,
+Gemma-2 and Mixtral families (BASELINE.json configs 1-5) with per-family
+modules supplying configs and weight initialisation/conversion.
+"""
+
+from crowdllama_tpu.models.config import ModelConfig, get_config, list_models  # noqa: F401

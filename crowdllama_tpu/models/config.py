@@ -1,0 +1,127 @@
+"""Model architecture configs and the named-model registry.
+
+Covers every family named by the driver's benchmark configs
+(/root/repo/BASELINE.json): TinyLlama-1.1B, Llama-3 8B/70B, Mixtral 8x7B
+(MoE), Gemma-2 27B — plus tiny variants for tests.  One config dataclass
+describes all three families; family-specific behavior (Gemma logit
+softcapping, sliding-window interleave, MoE routing) is driven by fields.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    name: str = "custom"
+    family: str = "llama"  # "llama" | "gemma2" | "mixtral"
+    vocab_size: int = 32000
+    hidden_size: int = 2048
+    intermediate_size: int = 5632
+    num_layers: int = 22
+    num_heads: int = 32
+    num_kv_heads: int = 4
+    head_dim: int = 0  # 0 → hidden_size // num_heads
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    tie_word_embeddings: bool = False
+    max_context_length: int = 4096
+
+    # Gemma-2 specifics (family="gemma2")
+    query_pre_attn_scalar: float = 0.0  # 0 → 1/sqrt(head_dim)
+    attn_logit_softcap: float = 0.0  # 0 → disabled
+    final_logit_softcap: float = 0.0
+    sliding_window: int = 0  # 0 → all layers global; else even layers sliding
+    post_norms: bool = False  # post-attention/post-mlp RMSNorms (Gemma-2)
+    embedding_multiplier: float = 0.0  # 0 → disabled (Gemma scales by sqrt(D))
+
+    # MoE specifics (family="mixtral")
+    num_experts: int = 0  # 0 → dense MLP
+    num_experts_per_tok: int = 2
+
+    def resolved_head_dim(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
+
+
+_REGISTRY: dict[str, ModelConfig] = {}
+
+
+def _register(cfg: ModelConfig) -> ModelConfig:
+    _REGISTRY[cfg.name] = cfg
+    return cfg
+
+
+# ---- test-scale models ----------------------------------------------------
+
+TINY_TEST = _register(ModelConfig(
+    name="tiny-test", family="llama", vocab_size=512, hidden_size=64,
+    intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=2,
+    max_context_length=256,
+))
+
+TINY_TEST_MOE = _register(ModelConfig(
+    name="tiny-test-moe", family="mixtral", vocab_size=512, hidden_size=64,
+    intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=2,
+    num_experts=4, num_experts_per_tok=2, max_context_length=256,
+))
+
+TINY_TEST_GEMMA = _register(ModelConfig(
+    name="tiny-test-gemma", family="gemma2", vocab_size=512, hidden_size=64,
+    intermediate_size=128, num_layers=4, num_heads=4, num_kv_heads=2,
+    head_dim=16, attn_logit_softcap=50.0, final_logit_softcap=30.0,
+    sliding_window=32, post_norms=True, embedding_multiplier=8.0,
+    max_context_length=256, rms_norm_eps=1e-6,
+))
+
+# ---- production models (BASELINE.json configs) ----------------------------
+
+TINYLLAMA_1_1B = _register(ModelConfig(
+    name="tinyllama-1.1b", family="llama", vocab_size=32000, hidden_size=2048,
+    intermediate_size=5632, num_layers=22, num_heads=32, num_kv_heads=4,
+    rope_theta=10000.0, max_context_length=2048,
+))
+
+LLAMA3_8B = _register(ModelConfig(
+    name="llama-3-8b", family="llama", vocab_size=128256, hidden_size=4096,
+    intermediate_size=14336, num_layers=32, num_heads=32, num_kv_heads=8,
+    rope_theta=500000.0, max_context_length=8192,
+))
+
+LLAMA3_70B = _register(ModelConfig(
+    name="llama-3-70b", family="llama", vocab_size=128256, hidden_size=8192,
+    intermediate_size=28672, num_layers=80, num_heads=64, num_kv_heads=8,
+    rope_theta=500000.0, max_context_length=8192,
+))
+
+MIXTRAL_8X7B = _register(ModelConfig(
+    name="mixtral-8x7b", family="mixtral", vocab_size=32000, hidden_size=4096,
+    intermediate_size=14336, num_layers=32, num_heads=32, num_kv_heads=8,
+    rope_theta=1000000.0, num_experts=8, num_experts_per_tok=2,
+    max_context_length=32768,
+))
+
+GEMMA2_27B = _register(ModelConfig(
+    name="gemma-2-27b", family="gemma2", vocab_size=256128, hidden_size=4608,
+    intermediate_size=36864, num_layers=46, num_heads=32, num_kv_heads=16,
+    head_dim=128, rope_theta=10000.0, rms_norm_eps=1e-6,
+    query_pre_attn_scalar=144.0, attn_logit_softcap=50.0,
+    final_logit_softcap=30.0, sliding_window=4096, post_norms=True,
+    embedding_multiplier=67.882251,  # sqrt(4608)
+    tie_word_embeddings=True, max_context_length=8192,
+))
+
+
+def get_config(name: str, **overrides) -> ModelConfig:
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown model {name!r}; known: {sorted(_REGISTRY)}")
+    cfg = _REGISTRY[name]
+    return replace(cfg, **overrides) if overrides else cfg
+
+
+def list_models() -> list[str]:
+    return sorted(_REGISTRY)

@@ -1,0 +1,247 @@
+"""The unified functional decoder core (Llama / Gemma-2 / Mixtral).
+
+Pure functions over a parameter pytree — no module framework.  Layer
+parameters are stacked along a leading layer axis and the layer loop is a
+``lax.scan``, so compile time is O(1) in depth and XLA sees one fused layer
+body (the idiomatic TPU pattern; contrast the reference which has no model
+code at all and shells out to Ollama, /root/reference/pkg/crowdllama/api.go:108-160).
+
+Weights live in bfloat16; norms/softmax accumulate in fp32.  All shapes are
+static: prompt prefill is bucketed, decode is one token per active slot over a
+fixed slot-count batch.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from crowdllama_tpu.models.config import ModelConfig
+from crowdllama_tpu.ops.attention import decode_attention, prefill_attention
+from crowdllama_tpu.ops.norms import rms_norm
+from crowdllama_tpu.ops.rope import apply_rope, rope_table
+
+Params = dict[str, Any]
+
+
+# --------------------------------------------------------------------- init
+
+def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
+    """Random-init a parameter pytree (layers stacked on axis 0)."""
+    dh = cfg.resolved_head_dim()
+    d, f, v = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+    h, hkv, nl = cfg.num_heads, cfg.num_kv_heads, cfg.num_layers
+
+    keys = iter(jax.random.split(key, 16))
+
+    def dense(k, *shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32) / math.sqrt(fan_in)).astype(dtype)
+
+    layers: Params = {
+        "ln1": jnp.ones((nl, d), dtype),
+        "ln2": jnp.ones((nl, d), dtype),
+        "wq": dense(next(keys), nl, d, h * dh, fan_in=d),
+        "wk": dense(next(keys), nl, d, hkv * dh, fan_in=d),
+        "wv": dense(next(keys), nl, d, hkv * dh, fan_in=d),
+        "wo": dense(next(keys), nl, h * dh, d, fan_in=h * dh),
+    }
+    if cfg.is_moe:
+        e = cfg.num_experts
+        layers["router"] = dense(next(keys), nl, d, e, fan_in=d)
+        layers["w_gate"] = dense(next(keys), nl, e, d, f, fan_in=d)
+        layers["w_up"] = dense(next(keys), nl, e, d, f, fan_in=d)
+        layers["w_down"] = dense(next(keys), nl, e, f, d, fan_in=f)
+    else:
+        layers["w_gate"] = dense(next(keys), nl, d, f, fan_in=d)
+        layers["w_up"] = dense(next(keys), nl, d, f, fan_in=d)
+        layers["w_down"] = dense(next(keys), nl, f, d, fan_in=f)
+    if cfg.post_norms:
+        layers["post_ln1"] = jnp.ones((nl, d), dtype)
+        layers["post_ln2"] = jnp.ones((nl, d), dtype)
+
+    params: Params = {
+        "embed": dense(next(keys), v, d, fan_in=d),
+        "layers": layers,
+        "final_norm": jnp.ones((d,), dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = dense(next(keys), d, v, fan_in=d)
+    return params
+
+
+def layer_sliding_windows(cfg: ModelConfig) -> jnp.ndarray:
+    """Per-layer sliding-window size ([L] int32, 0 = global attention).
+
+    Gemma-2 interleaves sliding (even) and global (odd) layers; other
+    families are all-global.
+    """
+    if cfg.sliding_window > 0:
+        return jnp.asarray(
+            [cfg.sliding_window if i % 2 == 0 else 0 for i in range(cfg.num_layers)],
+            jnp.int32,
+        )
+    return jnp.zeros((cfg.num_layers,), jnp.int32)
+
+
+def attn_scale(cfg: ModelConfig) -> float:
+    if cfg.query_pre_attn_scalar > 0:
+        return cfg.query_pre_attn_scalar ** -0.5
+    return cfg.resolved_head_dim() ** -0.5
+
+
+# ------------------------------------------------------------------ helpers
+
+def _embed(params: Params, cfg: ModelConfig, tokens: jnp.ndarray) -> jnp.ndarray:
+    x = params["embed"][tokens]
+    if cfg.embedding_multiplier > 0:
+        x = (x.astype(jnp.float32) * cfg.embedding_multiplier).astype(x.dtype)
+    return x
+
+
+def _unembed(params: Params, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps,
+                 plus_one=cfg.family == "gemma2")
+    if cfg.tie_word_embeddings:
+        logits = jnp.einsum("...d,vd->...v", x.astype(jnp.float32),
+                            params["embed"].astype(jnp.float32))
+    else:
+        logits = jnp.einsum("...d,dv->...v", x.astype(jnp.float32),
+                            params["lm_head"].astype(jnp.float32))
+    if cfg.final_logit_softcap > 0:
+        logits = cfg.final_logit_softcap * jnp.tanh(logits / cfg.final_logit_softcap)
+    return logits
+
+
+def _mlp(lp: Params, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
+    """Dense SwiGLU (Llama) / GeGLU-tanh (Gemma) MLP. x: [..., D]."""
+    gate = jnp.einsum("...d,df->...f", x, lp["w_gate"])
+    up = jnp.einsum("...d,df->...f", x, lp["w_up"])
+    act = jax.nn.gelu(gate, approximate=True) if cfg.family == "gemma2" else jax.nn.silu(gate)
+    return jnp.einsum("...f,fd->...d", act * up, lp["w_down"])
+
+
+def _moe(lp: Params, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
+    """Mixtral top-k MoE.  x: [..., D].
+
+    v0 computes every expert and masks by router weight — correct and
+    compiler-friendly; a sort-based token-grouping dispatch (and EP sharding
+    of the expert axis) is the planned optimization.
+    """
+    router_logits = jnp.einsum("...d,de->...e", x.astype(jnp.float32),
+                               lp["router"].astype(jnp.float32))
+    topw, topi = jax.lax.top_k(router_logits, cfg.num_experts_per_tok)
+    topw = jax.nn.softmax(topw, axis=-1)  # [..., K]
+    # Scatter top-k probs back to a dense per-expert weighting [..., E].
+    one_hot = jax.nn.one_hot(topi, cfg.num_experts, dtype=jnp.float32)  # [...,K,E]
+    weights = jnp.einsum("...ke,...k->...e", one_hot, topw)
+
+    gate = jnp.einsum("...d,edf->...ef", x, lp["w_gate"])
+    up = jnp.einsum("...d,edf->...ef", x, lp["w_up"])
+    act = jax.nn.silu(gate) * up
+    per_expert = jnp.einsum("...ef,efd->...ed", act, lp["w_down"])  # [..., E, D]
+    out = jnp.einsum("...ed,...e->...d", per_expert.astype(jnp.float32), weights)
+    return out.astype(x.dtype)
+
+
+def _layer_params(layers: Params, idx_or_slice) -> Params:
+    return jax.tree_util.tree_map(lambda a: a[idx_or_slice], layers)
+
+
+# ------------------------------------------------------------------ prefill
+
+def prefill(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,     # [B, T] int32, padded
+    positions: jnp.ndarray,  # [B, T] int32; padding may repeat last pos
+    kv_valid: jnp.ndarray | None = None,  # [B, T] bool; False for padding
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Full-prompt forward.  Returns (logits [B,T,V], k, v [L,B,T,Hkv,Dh])."""
+    dh = cfg.resolved_head_dim()
+    hkv = cfg.num_kv_heads
+    scale = attn_scale(cfg)
+    cos, sin = rope_table(cfg.max_context_length, dh, cfg.rope_theta)
+    windows = layer_sliding_windows(cfg)
+    x = _embed(params, cfg, tokens)
+    b, t = tokens.shape
+
+    def body(x, scanned):
+        lp, window = scanned
+        h = rms_norm(x, lp["ln1"], cfg.rms_norm_eps, plus_one=cfg.family == "gemma2")
+        q = jnp.einsum("btd,dk->btk", h, lp["wq"]).reshape(b, t, cfg.num_heads, dh)
+        k = jnp.einsum("btd,dk->btk", h, lp["wk"]).reshape(b, t, hkv, dh)
+        v = jnp.einsum("btd,dk->btk", h, lp["wv"]).reshape(b, t, hkv, dh)
+        q = apply_rope(q, positions, cos, sin)
+        k = apply_rope(k, positions, cos, sin)
+        attn = prefill_attention(q, k, v, positions, scale,
+                                 softcap=cfg.attn_logit_softcap,
+                                 sliding_window=window, kv_valid=kv_valid)
+        attn = jnp.einsum("btk,kd->btd", attn.reshape(b, t, -1), lp["wo"])
+        if cfg.post_norms:
+            attn = rms_norm(attn, lp["post_ln1"], cfg.rms_norm_eps, plus_one=True)
+        x = x + attn
+        h = rms_norm(x, lp["ln2"], cfg.rms_norm_eps, plus_one=cfg.family == "gemma2")
+        mlp_out = _moe(lp, cfg, h) if cfg.is_moe else _mlp(lp, cfg, h)
+        if cfg.post_norms:
+            mlp_out = rms_norm(mlp_out, lp["post_ln2"], cfg.rms_norm_eps, plus_one=True)
+        x = x + mlp_out
+        return x, (k, v)
+
+    x, (ks, vs) = jax.lax.scan(body, x, (params["layers"], windows))
+    logits = _unembed(params, cfg, x)
+    return logits, ks, vs  # ks/vs: [L, B, T, Hkv, Dh]
+
+
+# ------------------------------------------------------------------- decode
+
+def decode_step(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,     # [B] int32 — last sampled token per slot
+    positions: jnp.ndarray,  # [B] int32 — position of this token
+    k_cache: jnp.ndarray,    # [L, B, S, Hkv, Dh]
+    v_cache: jnp.ndarray,    # [L, B, S, Hkv, Dh]
+    seq_lens: jnp.ndarray,   # [B] valid lengths AFTER appending this token
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One token per slot.  Returns (logits [B,V], k_cache, v_cache)."""
+    dh = cfg.resolved_head_dim()
+    hkv = cfg.num_kv_heads
+    scale = attn_scale(cfg)
+    cos, sin = rope_table(cfg.max_context_length, dh, cfg.rope_theta)
+    windows = layer_sliding_windows(cfg)
+    x = _embed(params, cfg, tokens)  # [B, D]
+    b = tokens.shape[0]
+    slot_idx = jnp.arange(b)
+
+    def body(x, scanned):
+        lp, kc, vc, window = scanned  # kc/vc: [B, S, Hkv, Dh]
+        h = rms_norm(x, lp["ln1"], cfg.rms_norm_eps, plus_one=cfg.family == "gemma2")
+        q = jnp.einsum("bd,dk->bk", h, lp["wq"]).reshape(b, cfg.num_heads, dh)
+        k = jnp.einsum("bd,dk->bk", h, lp["wk"]).reshape(b, hkv, dh)
+        v = jnp.einsum("bd,dk->bk", h, lp["wv"]).reshape(b, hkv, dh)
+        q = apply_rope(q[:, None], positions[:, None], cos, sin)[:, 0]
+        k = apply_rope(k[:, None], positions[:, None], cos, sin)[:, 0]
+        kc = kc.at[slot_idx, positions].set(k)
+        vc = vc.at[slot_idx, positions].set(v)
+        attn = decode_attention(q, kc, vc, seq_lens, scale,
+                                softcap=cfg.attn_logit_softcap,
+                                sliding_window=window)
+        attn = jnp.einsum("bk,kd->bd", attn.reshape(b, -1), lp["wo"])
+        if cfg.post_norms:
+            attn = rms_norm(attn, lp["post_ln1"], cfg.rms_norm_eps, plus_one=True)
+        x = x + attn
+        h = rms_norm(x, lp["ln2"], cfg.rms_norm_eps, plus_one=cfg.family == "gemma2")
+        mlp_out = _moe(lp, cfg, h) if cfg.is_moe else _mlp(lp, cfg, h)
+        if cfg.post_norms:
+            mlp_out = rms_norm(mlp_out, lp["post_ln2"], cfg.rms_norm_eps, plus_one=True)
+        x = x + mlp_out
+        return x, (kc, vc)
+
+    x, (k_cache, v_cache) = jax.lax.scan(
+        body, x, (params["layers"], k_cache, v_cache, windows)
+    )
+    logits = _unembed(params, cfg, x)
+    return logits, k_cache, v_cache

@@ -1,0 +1,9 @@
+"""TPU-friendly primitive ops: norms, rotary embeddings, attention, sampling.
+
+Pure jnp implementations designed for XLA fusion onto the MXU/VPU; the hot
+attention path has a Pallas kernel variant (ops.pallas_attention) selected at
+runtime when running on TPU.
+"""
+
+from crowdllama_tpu.ops.norms import rms_norm  # noqa: F401
+from crowdllama_tpu.ops.rope import apply_rope, rope_table  # noqa: F401

@@ -1,0 +1,95 @@
+"""Attention ops: causal prefill and single-step cached decode.
+
+Grouped-query attention without materializing repeated KV heads (query heads
+are folded into [Hkv, G] groups so the einsums stay MXU-shaped), fp32 softmax,
+optional Gemma-2 logit softcapping and sliding-window masking.  These jnp
+implementations are the portable baseline; a Pallas TPU kernel can be slotted
+in behind the same signatures (see crowdllama_tpu/ops/pallas/).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _softcap(logits: jnp.ndarray, cap: float) -> jnp.ndarray:
+    if cap and cap > 0:
+        return cap * jnp.tanh(logits / cap)
+    return logits
+
+
+def _grouped(q: jnp.ndarray, num_kv_heads: int) -> jnp.ndarray:
+    """[B, T, H, Dh] -> [B, T, Hkv, G, Dh]."""
+    b, t, h, d = q.shape
+    return q.reshape(b, t, num_kv_heads, h // num_kv_heads, d)
+
+
+def prefill_attention(
+    q: jnp.ndarray,  # [B, T, H, Dh]
+    k: jnp.ndarray,  # [B, T, Hkv, Dh]
+    v: jnp.ndarray,  # [B, T, Hkv, Dh]
+    positions: jnp.ndarray,  # [B, T] absolute positions (for masking)
+    scale: float,
+    softcap: float = 0.0,
+    sliding_window: int = 0,
+    kv_valid: jnp.ndarray | None = None,  # [B, T] bool — False for padding keys
+) -> jnp.ndarray:
+    """Causal self-attention over a full (padded) prompt.
+
+    ``kv_valid`` excludes bucket-padding keys: padded positions are clamped
+    to plen-1 by the caller, so the causal mask alone would let the real last
+    token attend to padding garbage.
+    """
+    num_kv = k.shape[2]
+    qg = _grouped(q, num_kv)  # [B,T,Hkv,G,Dh]
+    logits = jnp.einsum(
+        "bqhgd,bkhd->bhgqk", qg.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    logits = _softcap(logits, softcap)
+
+    qpos = positions[:, :, None]  # [B,T,1]
+    kpos = positions[:, None, :]  # [B,1,T]
+    mask = kpos <= qpos  # causal
+    # sliding_window may be a traced scalar (per-layer inside lax.scan); <=0
+    # disables it.
+    window = jnp.asarray(sliding_window)
+    mask &= (window <= 0) | (kpos > qpos - window)
+    if kv_valid is not None:
+        mask &= kv_valid[:, None, :]
+    logits = jnp.where(mask[:, None, None, :, :], logits, NEG_INF)
+    probs = jnp.exp(logits - jnp.max(logits, axis=-1, keepdims=True))
+    probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v.astype(jnp.float32))
+    b, t, hkv, g, d = out.shape
+    return out.reshape(b, t, hkv * g, d).astype(q.dtype)
+
+
+def decode_attention(
+    q: jnp.ndarray,  # [B, H, Dh] (one new token per slot)
+    k_cache: jnp.ndarray,  # [B, S, Hkv, Dh]
+    v_cache: jnp.ndarray,  # [B, S, Hkv, Dh]
+    seq_lens: jnp.ndarray,  # [B] number of valid cache positions (incl. new)
+    scale: float,
+    softcap: float = 0.0,
+    sliding_window: int = 0,
+) -> jnp.ndarray:
+    """One decode step attending over the slot's cached KV."""
+    num_kv = k_cache.shape[2]
+    b, h, d = q.shape
+    qg = q.reshape(b, num_kv, h // num_kv, d)  # [B,Hkv,G,Dh]
+    logits = jnp.einsum(
+        "bhgd,bkhd->bhgk", qg.astype(jnp.float32), k_cache.astype(jnp.float32)
+    ) * scale
+    logits = _softcap(logits, softcap)
+
+    kpos = jnp.arange(k_cache.shape[1])[None, :]  # [1,S]
+    valid = kpos < seq_lens[:, None]  # [B,S]
+    window = jnp.asarray(sliding_window)
+    valid &= (window <= 0) | (kpos > (seq_lens[:, None] - 1) - window)
+    logits = jnp.where(valid[:, None, None, :], logits, NEG_INF)
+    probs = jnp.exp(logits - jnp.max(logits, axis=-1, keepdims=True))
+    probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    out = jnp.einsum("bhgk,bkhd->bhgd", probs, v_cache.astype(jnp.float32))
+    return out.reshape(b, h, d).astype(q.dtype)

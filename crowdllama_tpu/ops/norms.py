@@ -1,0 +1,22 @@
+"""Normalization ops."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5,
+             plus_one: bool = False) -> jnp.ndarray:
+    """RMSNorm in fp32 accumulation, cast back to the input dtype.
+
+    ``plus_one`` selects the Gemma convention ``x * (1 + w)``; Llama/Mixtral
+    use ``x * w``.
+    """
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    normed = xf / jnp.sqrt(var + eps)
+    w = weight.astype(jnp.float32)
+    if plus_one:
+        w = 1.0 + w
+    return (normed * w).astype(dtype)

@@ -1,0 +1,30 @@
+"""Rotary position embeddings (half-rotation layout, HF-compatible)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_table(max_len: int, head_dim: int, theta: float) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Precompute (cos, sin) tables of shape [max_len, head_dim//2], fp32."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    pos = jnp.arange(max_len, dtype=jnp.float32)
+    angles = jnp.outer(pos, inv_freq)  # [T, Dh/2]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jnp.ndarray, positions: jnp.ndarray,
+               cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+    """Rotate ``x`` [..., T, H, Dh] by per-token ``positions`` [..., T].
+
+    Uses the 'rotate_half' convention (x split into two halves), matching the
+    HF Llama implementation so converted checkpoints are bit-compatible.
+    """
+    dtype = x.dtype
+    c = cos[positions]  # [..., T, Dh/2]
+    s = sin[positions]
+    c = jnp.expand_dims(c, axis=-2)  # broadcast over heads: [..., T, 1, Dh/2]
+    s = jnp.expand_dims(s, axis=-2)
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(dtype)

@@ -1,0 +1,15 @@
+"""Device-mesh parallelism: sharding rules, mesh construction, collectives.
+
+The intra-worker data plane.  Where the reference's only parallelism is
+whole-request routing to a single worker (SURVEY §2 "zero model-parallelism
+strategies"), a TPU worker here runs tensor-parallel (and expert-parallel)
+decode over its ICI mesh: parameters and KV caches carry NamedShardings and
+XLA/GSPMD inserts the psum/all-gather collectives.
+"""
+
+from crowdllama_tpu.parallel.mesh import build_mesh, choose_mesh_shape  # noqa: F401
+from crowdllama_tpu.parallel.sharding import (  # noqa: F401
+    cache_pspec,
+    param_pspecs,
+    shard_params,
+)

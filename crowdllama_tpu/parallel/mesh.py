@@ -1,0 +1,65 @@
+"""Mesh construction over local TPU devices.
+
+Axes: ``dp`` (data/batch), ``ep`` (experts, MoE), ``tp`` (tensor).  A spec
+string "AxBxC" assigns dp=A, ep=B, tp=C; "AxB" means dp=A, tp=B; empty puts
+every device on tp.  ICI topology is respected via
+mesh_utils.create_device_mesh when available.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+AXIS_DP, AXIS_EP, AXIS_TP = "dp", "ep", "tp"
+AXES = (AXIS_DP, AXIS_EP, AXIS_TP)
+
+
+def parse_mesh_spec(spec: str, n_devices: int) -> tuple[int, int, int]:
+    if not spec:
+        return (1, 1, n_devices)
+    parts = [int(p) for p in spec.lower().replace("x", " ").split()]
+    if len(parts) == 1:
+        shape = (1, 1, parts[0])
+    elif len(parts) == 2:
+        shape = (parts[0], 1, parts[1])
+    elif len(parts) == 3:
+        shape = (parts[0], parts[1], parts[2])
+    else:
+        raise ValueError(f"bad mesh spec {spec!r}")
+    if int(np.prod(shape)) > n_devices:
+        raise ValueError(
+            f"mesh spec {spec!r} = {shape} needs {int(np.prod(shape))} devices, "
+            f"have {n_devices}"
+        )
+    return shape
+
+
+def choose_mesh_shape(n_devices: int, num_kv_heads: int,
+                      num_experts: int = 0) -> tuple[int, int, int]:
+    """Pick (dp, ep, tp) automatically: as much tp as kv-head divisibility
+    allows (KV cache heads are tp-sharded), spill the rest to ep (MoE) or dp."""
+    tp = 1
+    for cand in range(min(n_devices, num_kv_heads), 0, -1):
+        if n_devices % cand == 0 and num_kv_heads % cand == 0:
+            tp = cand
+            break
+    rest = n_devices // tp
+    if num_experts and num_experts % rest == 0:
+        return (1, rest, tp)
+    return (rest, 1, tp)
+
+
+def build_mesh(spec: str = "", devices: list | None = None) -> Mesh:
+    """Build a (dp, ep, tp) Mesh; a spec smaller than the device count uses a
+    prefix of the devices (e.g. benchmarking tp=4 on an 8-chip host)."""
+    devices = devices if devices is not None else jax.devices()
+    shape = parse_mesh_spec(spec, len(devices)) if isinstance(spec, str) else spec
+    devices = devices[: int(np.prod(shape))]
+    try:
+        dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    except Exception:  # non-TPU platforms / odd shapes: plain reshape
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, AXES)

@@ -1,0 +1,76 @@
+"""Per-parameter partition rules (GSPMD NamedShardings).
+
+Megatron-style tensor parallelism expressed as shardings, with XLA inserting
+the collectives: attention QKV and MLP up/gate are column-parallel (output
+dim on ``tp``), attention output and MLP down are row-parallel (input dim on
+``tp``) — each layer then needs exactly one psum after wo and one after
+w_down, which GSPMD derives automatically.  MoE expert banks additionally
+shard the expert dim on ``ep``.  KV caches shard kv-heads on ``tp``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from crowdllama_tpu.models.config import ModelConfig
+from crowdllama_tpu.parallel.mesh import AXIS_DP, AXIS_EP, AXIS_TP
+
+Params = dict[str, Any]
+
+
+def param_pspecs(cfg: ModelConfig) -> Params:
+    """PartitionSpec pytree mirroring models.transformer.init_params."""
+    layers: Params = {
+        "ln1": P(),
+        "ln2": P(),
+        # [L, D, H*Dh] column-parallel
+        "wq": P(None, None, AXIS_TP),
+        "wk": P(None, None, AXIS_TP),
+        "wv": P(None, None, AXIS_TP),
+        # [L, H*Dh, D] row-parallel
+        "wo": P(None, AXIS_TP, None),
+    }
+    if cfg.is_moe:
+        layers["router"] = P()
+        layers["w_gate"] = P(None, AXIS_EP, None, AXIS_TP)  # [L,E,D,F]
+        layers["w_up"] = P(None, AXIS_EP, None, AXIS_TP)
+        layers["w_down"] = P(None, AXIS_EP, AXIS_TP, None)  # [L,E,F,D]
+    else:
+        layers["w_gate"] = P(None, None, AXIS_TP)  # [L,D,F]
+        layers["w_up"] = P(None, None, AXIS_TP)
+        layers["w_down"] = P(None, AXIS_TP, None)  # [L,F,D]
+    if cfg.post_norms:
+        layers["post_ln1"] = P()
+        layers["post_ln2"] = P()
+    specs: Params = {
+        "embed": P(AXIS_TP, None),  # [V, D] vocab-sharded
+        "layers": layers,
+        "final_norm": P(),
+    }
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = P(None, AXIS_TP)  # [D, V]
+    return specs
+
+
+def cache_pspec() -> P:
+    """KV cache [L, B, S, Hkv, Dh]: kv-heads on tp, slots on dp."""
+    return P(None, AXIS_DP, None, AXIS_TP, None)
+
+
+def shard_params(params: Params, cfg: ModelConfig, mesh: Mesh) -> Params:
+    """Place a param pytree onto the mesh with the TP/EP partition rules."""
+    specs = param_pspecs(cfg)
+    return jax.tree_util.tree_map(
+        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)), params, specs
+    )
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def cache_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, cache_pspec())

@@ -7,8 +7,17 @@ the TPU translation of the reference's loopback-libp2p strategy (SURVEY §4).
 
 import os
 
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Overwrite, don't setdefault: the image pins JAX_PLATFORMS=axon (the real
+# TPU tunnel) globally and pre-imports jax from sitecustomize, so env vars
+# alone are too late — update jax.config before any backend initializes.
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 # Compressed intervals everywhere, mirroring CROWDLLAMA_TEST_MODE=1
 # (/root/reference/pkg/peer/peer.go:159-175).
 os.environ.setdefault("CROWDLLAMA_TPU_TEST_MODE", "1")
