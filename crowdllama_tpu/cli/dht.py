@@ -1,0 +1,81 @@
+"""DHT bootstrap server CLI: ``crowdllama-tpu-dht start | version``.
+
+Counterpart of /root/reference/cmd/dht/dht.go + pkg/dht/dht.go: a long-running
+rendezvous node on a well-known port (:9000, dht.go:25-28) with its own
+identity key, periodic peer-stats logging (dht.go:398-423; NAT classification
+is out of scope for a DCN deployment), and graceful shutdown.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import signal
+import sys
+
+from crowdllama_tpu.core.protocol import DEFAULT_DHT_PORT, namespace_key
+from crowdllama_tpu.logutil import new_app_logger
+from crowdllama_tpu.net.discovery import new_host_and_dht
+from crowdllama_tpu.utils.keys import KeyManager
+from crowdllama_tpu.version import version_string
+
+log = logging.getLogger("crowdllama.dht-server")
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="crowdllama-tpu-dht",
+                                description="DHT bootstrap/rendezvous server")
+    sub = p.add_subparsers(dest="command")
+    start = sub.add_parser("start")
+    start.add_argument("--port", type=int, default=DEFAULT_DHT_PORT)
+    start.add_argument("--host", default="0.0.0.0")
+    start.add_argument("--key-path", default="")
+    start.add_argument("--verbose", action="store_true")
+    sub.add_parser("version")
+    args = p.parse_args(argv)
+
+    if args.command == "version":
+        print(version_string())
+        return 0
+    if args.command == "start":
+        new_app_logger("crowdllama-dht", args.verbose)
+        logging.basicConfig(stream=sys.stderr,
+                            level=logging.DEBUG if args.verbose else logging.INFO)
+        try:
+            asyncio.run(run_server(args.host, args.port, args.key_path))
+            return 0
+        except KeyboardInterrupt:
+            return 0
+    p.print_help()
+    return 1
+
+
+async def run_server(host: str, port: int, key_path: str) -> None:
+    km = KeyManager(key_path or None)
+    key = km.get_or_create_private_key("dht")
+    h, dht = await new_host_and_dht(key, listen_host=host, listen_port=port)
+    log.info("dht server %s listening on %s:%d (%s)",
+             h.peer_id[:12], host, h.listen_port, version_string())
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+
+    async def stats_loop() -> None:
+        while True:
+            await asyncio.sleep(15)
+            log.info("routing table: %d peers | namespace providers: %d",
+                     len(dht.table), len(dht.providers.get(namespace_key())))
+
+    stats = asyncio.create_task(stats_loop())
+    try:
+        await stop.wait()
+    finally:
+        stats.cancel()
+        await h.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,155 @@
+"""Unified node CLI: ``crowdllama-tpu start [--worker-mode] | version |
+network-status``.
+
+Counterpart of /root/reference/cmd/crowdllama/main.go: one binary, two roles —
+``start --worker-mode`` runs a worker (engine + stream handlers),
+plain ``start`` runs a consumer (gateway HTTP server) (main.go:184-190);
+optional IPC server from config/env (main.go:133-143); periodic stats logging
+(main.go:391-427); SIGINT/SIGTERM graceful shutdown (main.go:450-460).
+The reference's embedded Ollama CLI has no counterpart: the engine is
+in-process JAX, so there is nothing to embed or shell out to.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import signal
+import sys
+
+from crowdllama_tpu.config import Configuration
+from crowdllama_tpu.logutil import new_app_logger
+from crowdllama_tpu.utils.keys import KeyManager
+from crowdllama_tpu.version import version_string
+
+log = logging.getLogger("crowdllama.cli")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="crowdllama-tpu",
+                                description="TPU-native p2p LLM inference swarm")
+    sub = p.add_subparsers(dest="command")
+    start = sub.add_parser("start", help="run a swarm node")
+    start.add_argument("--worker-mode", action="store_true",
+                       help="serve inference (default: consumer/gateway mode)")
+    Configuration.add_flags(start)
+    sub.add_parser("version", help="print version")
+    status = sub.add_parser("network-status", help="probe a gateway's health endpoint")
+    status.add_argument("--gateway", default="http://127.0.0.1:9001")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.command == "version":
+        print(version_string())
+        return 0
+    if args.command == "network-status":
+        return asyncio.run(_network_status(args.gateway))
+    if args.command == "start":
+        cfg = Configuration.from_flags(args)
+        new_app_logger("crowdllama", cfg.verbose)
+        logging.getLogger().setLevel(
+            logging.DEBUG if cfg.verbose else logging.INFO)
+        logging.basicConfig(stream=sys.stderr)
+        try:
+            asyncio.run(run_node(cfg, worker_mode=args.worker_mode))
+            return 0
+        except KeyboardInterrupt:
+            return 0
+    build_parser().print_help()
+    return 1
+
+
+async def _network_status(gateway: str) -> int:
+    import aiohttp
+
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{gateway}/api/health",
+                             timeout=aiohttp.ClientTimeout(total=5)) as resp:
+                body = await resp.json()
+    except Exception as e:
+        print(f"gateway unreachable: {e}", file=sys.stderr)
+        return 1
+    print(f"gateway: {gateway}")
+    print(f"peer id: {body.get('peer_id', '?')}")
+    workers = body.get("workers", {})
+    print(f"workers: {len(workers)}")
+    for pid, w in workers.items():
+        mark = "healthy" if w.get("is_healthy") else "unhealthy"
+        print(f"  {pid[:12]} [{mark}] models={','.join(w.get('supported_models', []))} "
+              f"tput={w.get('tokens_throughput', 0)} accel={w.get('accelerator', '?')}")
+    return 0
+
+
+def _make_engine(cfg: Configuration, worker_mode: bool):
+    from crowdllama_tpu.engine.engine import FakeEngine, JaxEngine
+
+    if not worker_mode:
+        # Consumers never run inference locally (reference uses an echo stub,
+        # api.go:163-189).
+        return FakeEngine(models=[])
+    if cfg.engine_backend == "fake":
+        return FakeEngine(models=[cfg.model])
+    return JaxEngine(cfg)
+
+
+async def run_node(cfg: Configuration, worker_mode: bool) -> None:
+    """Worker: engine + peer.  Consumer: peer + gateway.  Either may add IPC."""
+    from crowdllama_tpu.gateway.gateway import Gateway
+    from crowdllama_tpu.ipc.server import IPCServer
+    from crowdllama_tpu.peer.peer import Peer
+
+    km = KeyManager(cfg.key_path or None)
+    component = "worker" if worker_mode else "consumer"
+    key = km.get_or_create_private_key(component)
+
+    engine = _make_engine(cfg, worker_mode)
+    log.info("starting %s node (%s)", component, version_string())
+    await engine.start()
+
+    peer = Peer(key, cfg, engine=engine, worker_mode=worker_mode)
+    await peer.start()
+
+    gateway = None
+    if not worker_mode:
+        gateway = Gateway(peer, port=cfg.gateway_port)
+        await gateway.start()
+
+    ipc = None
+    if cfg.ipc_socket:
+        ipc = IPCServer(cfg.ipc_socket, engine, peer=peer)
+        await ipc.start()
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+
+    async def stats_loop() -> None:
+        while True:
+            await asyncio.sleep(10)
+            pm = peer.peer_manager
+            if pm is not None:
+                log.info("peers: %d total, %d healthy, %d workers | engine: %s",
+                         len(pm.peers), len(pm.get_healthy_peers()),
+                         len(pm.get_workers()), engine.describe())
+
+    stats = asyncio.create_task(stats_loop())
+    try:
+        await stop.wait()
+    finally:
+        log.info("shutting down")
+        stats.cancel()
+        if ipc is not None:
+            await ipc.stop()
+        if gateway is not None:
+            await gateway.stop()
+        await peer.stop()
+        await engine.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,3 @@
+"""Ollama-compatible HTTP gateway."""
+
+from crowdllama_tpu.gateway.gateway import Gateway  # noqa: F401

@@ -1,0 +1,272 @@
+"""Consumer-facing HTTP gateway (Ollama-compatible API).
+
+Counterpart of /root/reference/pkg/gateway/gateway.go: ``POST /api/chat``
+accepts Ollama-style JSON {model, messages[], stream, options}
+(gateway.go:31-41,168-231), routes to the best worker via the peer manager
+(:191,346-348), forwards the request over an inference stream, and converts
+the protobuf reply back to Ollama-shaped JSON (:209-230).  ``GET /api/health``
+dumps the per-worker health map (:426-461).  Request logging middleware with
+real durations (:107-135).
+
+Supersets over the reference: ``stream: true`` actually streams — NDJSON
+chunks exactly like Ollama's own API — and worker-side failures retry once on
+the next-best worker (the reference surfaces them directly, gateway.go:210-217).
+The stock ``ollama`` Python client works against this server
+(examples/chat.py, cf. reference examples/chat/chat.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+
+from aiohttp import web
+
+from crowdllama_tpu.core import wire
+from crowdllama_tpu.core.messages import create_generate_request, extract_generate_response
+from crowdllama_tpu.core.protocol import INFERENCE_PROTOCOL
+from crowdllama_tpu.peer.peer import Peer
+
+log = logging.getLogger("crowdllama.gateway")
+
+
+def _now_rfc3339() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime()) + "Z"
+
+
+class _StreamStarted(Exception):
+    """A streamed response failed after headers were sent (not retryable)."""
+
+    def __init__(self, response: "web.StreamResponse", cause: Exception):
+        super().__init__(str(cause))
+        self.response = response
+        self.cause = cause
+
+
+class Gateway:
+    def __init__(self, peer: Peer, port: int = 9001, host: str = "0.0.0.0"):
+        self.peer = peer
+        self.port = port
+        self.host = host
+        self._runner: web.AppRunner | None = None
+        self.app = web.Application(middlewares=[self._log_middleware])
+        self.app.router.add_post("/api/chat", self.handle_chat)
+        self.app.router.add_post("/api/generate", self.handle_generate)
+        self.app.router.add_get("/api/health", self.handle_health)
+        self.app.router.add_get("/api/tags", self.handle_tags)
+
+    # ----------------------------------------------------------- lifecycle
+
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self.app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        log.info("gateway listening on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    # ---------------------------------------------------------- middleware
+
+    @web.middleware
+    async def _log_middleware(self, request: web.Request, handler):
+        t0 = time.monotonic()
+        try:
+            resp = await handler(request)
+            return resp
+        finally:
+            log.info("%s %s -> %.0fms", request.method, request.path,
+                     (time.monotonic() - t0) * 1000)
+
+    # ------------------------------------------------------------ handlers
+
+    async def handle_chat(self, request: web.Request) -> web.StreamResponse:
+        """POST /api/chat — Ollama chat API (gateway.go:168-231)."""
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"error": "invalid JSON"}, status=400)
+        model = body.get("model", "")
+        messages = body.get("messages", [])
+        if not model or not isinstance(messages, list) or not messages:
+            return web.json_response(
+                {"error": "model and messages are required"}, status=400)
+        stream = bool(body.get("stream", False))
+        options = body.get("options", {}) or {}
+        return await self._route(
+            request, model, stream, options, messages=messages, chat=True)
+
+    async def handle_generate(self, request: web.Request) -> web.StreamResponse:
+        """POST /api/generate — Ollama completion API (prompt in, text out)."""
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"error": "invalid JSON"}, status=400)
+        model = body.get("model", "")
+        prompt = body.get("prompt", "")
+        if not model or not prompt:
+            return web.json_response(
+                {"error": "model and prompt are required"}, status=400)
+        stream = bool(body.get("stream", False))
+        options = body.get("options", {}) or {}
+        return await self._route(
+            request, model, stream, options, prompt=prompt, chat=False)
+
+    async def handle_health(self, request: web.Request) -> web.Response:
+        """GET /api/health — per-worker health map (gateway.go:426-461)."""
+        pm = self.peer.peer_manager
+        workers = {}
+        if pm is not None:
+            for p in pm.get_workers():
+                r = p.resource
+                workers[p.peer_id] = {
+                    "is_healthy": p.is_healthy,
+                    "last_seen": time.time() - (time.monotonic() - p.last_seen),
+                    "failed_attempts": p.failed_attempts,
+                    "supported_models": r.supported_models,
+                    "tokens_throughput": r.tokens_throughput,
+                    "load": r.load,
+                    "accelerator": r.accelerator,
+                    "tpu_chip_count": r.tpu_chip_count,
+                    "ici_topology": r.ici_topology,
+                    "version": r.version,
+                }
+        return web.json_response({
+            "status": "ok",
+            "peer_id": self.peer.peer_id,
+            "worker_count": len(workers),
+            "workers": workers,
+        })
+
+    async def handle_tags(self, request: web.Request) -> web.Response:
+        """GET /api/tags — available models (Ollama client handshake)."""
+        pm = self.peer.peer_manager
+        models: dict[str, dict] = {}
+        if pm is not None:
+            for p in pm.get_healthy_peers():
+                if not p.is_worker:
+                    continue
+                for m in p.resource.supported_models:
+                    models.setdefault(m, {"name": m, "model": m})
+        return web.json_response({"models": list(models.values())})
+
+    # -------------------------------------------------------------- routing
+
+    def _find_worker(self, model: str, exclude: set[str] = frozenset()):
+        pm = self.peer.peer_manager
+        return pm.find_best_worker(model, exclude=exclude) if pm else None
+
+    async def _route(self, request, model, stream, options,
+                     messages=None, prompt="", chat=True) -> web.StreamResponse:
+        msg = create_generate_request(
+            model=model,
+            prompt=prompt,
+            stream=stream,
+            messages=messages or (),
+            max_tokens=int(options.get("num_predict", 0)),
+            temperature=float(options.get("temperature", 0.0)),
+            top_p=float(options.get("top_p", 1.0)),
+            seed=int(options.get("seed", 0)),
+        )
+        tried: set[str] = set()
+        last_err = "no workers available for model"
+        for _attempt in range(2):  # retry once on next-best worker
+            worker = self._find_worker(model, exclude=tried)
+            if worker is None:
+                break
+            tried.add(worker.peer_id)
+            try:
+                return await self._forward(request, worker.peer_id, msg, stream, chat)
+            except _StreamStarted as e:
+                # Headers/chunks already went out: no retry, no second
+                # response — the error frame was already written downstream.
+                log.warning("stream to client aborted mid-flight: %s", e.cause)
+                return e.response
+            except Exception as e:
+                last_err = str(e)
+                log.warning("worker %s failed: %s", worker.peer_id[:8], e)
+        return web.json_response(
+            {"error": f"inference failed: {last_err}", "model": model}, status=503)
+
+    async def _forward(self, request, worker_id: str, msg, stream: bool,
+                       chat: bool) -> web.StreamResponse:
+        """Open an inference stream to the worker and relay the reply
+        (gateway.go:243-298)."""
+        contact = await self.peer.dht.find_peer(worker_id)
+        if contact is None:
+            raise LookupError(f"worker {worker_id[:8]} not resolvable")
+        s = await self.peer.host.new_stream(contact, INFERENCE_PROTOCOL)
+        try:
+            await wire.write_length_prefixed_pb(s.writer, msg)
+            if not stream:
+                reply = await wire.read_length_prefixed_pb(s.reader, timeout=600)
+                resp = extract_generate_response(reply)
+                if resp.done_reason == "error":
+                    raise RuntimeError(resp.response)
+                return web.json_response(self._ollama_json(resp, chat, final=True))
+
+            # NDJSON streaming: one line per chunk, like Ollama.  Read the
+            # FIRST frame before sending headers, so a worker that dies
+            # immediately is still retryable by _route.
+            first = extract_generate_response(
+                await wire.read_length_prefixed_pb(s.reader, timeout=600))
+            if first.done_reason == "error":
+                raise RuntimeError(first.response)
+            out = web.StreamResponse(
+                status=200,
+                headers={"Content-Type": "application/x-ndjson"},
+            )
+            await out.prepare(request)
+            resp = first
+            try:
+                while True:
+                    if resp.done_reason == "error":
+                        raise RuntimeError(resp.response)
+                    line = json.dumps(self._ollama_json(resp, chat, final=resp.done))
+                    await out.write(line.encode() + b"\n")
+                    if resp.done:
+                        break
+                    resp = extract_generate_response(
+                        await wire.read_length_prefixed_pb(s.reader, timeout=600))
+            except Exception as e:
+                # Mid-stream failure: emit a terminal error line; wrap so
+                # _route doesn't retry or double-respond.
+                try:
+                    err_line = json.dumps({
+                        "model": resp.model, "created_at": _now_rfc3339(),
+                        "done": True, "done_reason": "error",
+                        "error": str(e),
+                    })
+                    await out.write(err_line.encode() + b"\n")
+                except Exception:
+                    pass
+                raise _StreamStarted(out, e) from e
+            await out.write_eof()
+            return out
+        finally:
+            s.close()
+
+    @staticmethod
+    def _ollama_json(resp, chat: bool, final: bool) -> dict:
+        """PB → Ollama-shaped JSON (gateway.go:220-230)."""
+        d: dict = {
+            "model": resp.model,
+            "created_at": _now_rfc3339(),
+            "done": resp.done,
+        }
+        if chat:
+            d["message"] = {"role": "assistant", "content": resp.response}
+        else:
+            d["response"] = resp.response
+        if final:
+            d["done_reason"] = resp.done_reason or "stop"
+            d["total_duration"] = resp.total_duration
+            d["prompt_eval_count"] = resp.prompt_tokens
+            d["eval_count"] = resp.completion_tokens
+            d["worker_id"] = resp.worker_id
+        return d

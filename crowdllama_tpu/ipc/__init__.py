@@ -1,0 +1,3 @@
+"""Unix-socket IPC surface for desktop-app embedding."""
+
+from crowdllama_tpu.ipc.server import IPCServer  # noqa: F401

@@ -1,0 +1,149 @@
+"""Unix-domain-socket IPC server.
+
+Counterpart of /root/reference/pkg/ipc/ipc.go: a socket for an Electron-style
+desktop app (socket path from config / CROWDLLAMA_TPU_SOCKET, 0600 perms,
+ipc.go:158).  Heuristic framing as in the reference (ipc.go:196-237): a
+4-byte big-endian length prefix that parses as a protobuf BaseMessage is
+treated as PB; anything else is newline-delimited JSON.
+
+JSON message types (ipc.go:278-313,437-477): ``ping`` → ``pong``,
+``initialize`` {mode} → ack, ``prompt`` {text, model?} → {response};
+PB GenerateRequests are routed through the same engine seam.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import struct
+from pathlib import Path
+
+from crowdllama_tpu.core import wire
+from crowdllama_tpu.core.messages import create_generate_request
+from crowdllama_tpu.engine.engine import Engine
+
+log = logging.getLogger("crowdllama.ipc")
+
+_LEN = struct.Struct(">I")
+
+
+class IPCServer:
+    def __init__(self, socket_path: str, engine: Engine, peer=None):
+        self.socket_path = socket_path
+        self.engine = engine
+        self.peer = peer  # optional live Peer for status queries
+        self._server: asyncio.Server | None = None
+
+    async def start(self) -> None:
+        path = Path(self.socket_path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        if path.exists():
+            path.unlink()
+        # Bind under a restrictive umask so the socket is never
+        # world-connectable, not even between bind and chmod.
+        old_umask = os.umask(0o177)
+        try:
+            self._server = await asyncio.start_unix_server(self._handle, path=str(path))
+        finally:
+            os.umask(old_umask)
+        os.chmod(path, 0o600)
+        log.info("ipc listening on %s", path)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        try:
+            Path(self.socket_path).unlink(missing_ok=True)
+        except OSError:
+            pass
+
+    # ------------------------------------------------------------- framing
+
+    async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                # Framing heuristic (cf. ipc.go:196-237), disambiguated by the
+                # first byte: JSON messages start with '{' (0x7B, which as a
+                # length prefix would mean a >2 GB frame), PB frames start
+                # with a length prefix whose first byte is 0x00 for any sane
+                # size.  One byte is read first so short JSON lines like
+                # "{}\n" never splice into the next message.
+                first = await reader.read(1)
+                if not first:
+                    break
+                if first == b"{":
+                    rest = await reader.readline()
+                    await self._handle_json_line(first + rest, writer)
+                    continue
+                try:
+                    head = first + await reader.readexactly(3)
+                    (length,) = _LEN.unpack(head)
+                    if not 0 < length <= wire.MAX_MESSAGE_SIZE:
+                        raise ValueError(f"bad frame length {length}")
+                    payload = await reader.readexactly(length)
+                    msg = wire.decode_payload(payload)
+                except (asyncio.IncompleteReadError, ValueError):
+                    break  # truncated or unframeable: drop the connection
+                await self._handle_pb(msg, writer)
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        except Exception:
+            log.exception("ipc connection error")
+        finally:
+            writer.close()
+
+    async def _handle_pb(self, msg, writer: asyncio.StreamWriter) -> None:
+        worker_id = self.peer.peer_id if self.peer is not None else ""
+        reply = await self.engine.handle(msg, worker_id=worker_id)
+        await wire.write_length_prefixed_pb(writer, reply)
+
+    async def _handle_json_line(self, data: bytes, writer: asyncio.StreamWriter) -> None:
+        try:
+            obj = json.loads(data)
+        except json.JSONDecodeError:
+            await self._send_json(writer, {"type": "error", "error": "unparseable message"})
+            return
+        mtype = obj.get("type", "")
+        if mtype == "ping":
+            await self._send_json(writer, {"type": "pong"})
+        elif mtype == "initialize":
+            mode = obj.get("mode", "consumer")
+            await self._send_json(writer, {
+                "type": "initialized", "mode": mode,
+                "peer_id": self.peer.peer_id if self.peer else "",
+            })
+        elif mtype == "prompt":
+            text = obj.get("text") or obj.get("prompt") or ""
+            model = obj.get("model", "")
+            try:
+                msg = create_generate_request(model=model, prompt=text)
+                reply = await self.engine.handle(
+                    msg, worker_id=self.peer.peer_id if self.peer else "")
+                await self._send_json(writer, {
+                    "type": "response",
+                    "response": reply.generate_response.response,
+                    "done": True,
+                })
+            except Exception as e:
+                await self._send_json(writer, {"type": "error", "error": str(e)})
+        elif mtype == "status":
+            workers = []
+            if self.peer is not None and self.peer.peer_manager is not None:
+                workers = [p.peer_id for p in self.peer.peer_manager.get_workers()]
+            await self._send_json(writer, {
+                "type": "status",
+                "peer_id": self.peer.peer_id if self.peer else "",
+                "workers": workers,
+            })
+        else:
+            await self._send_json(writer, {"type": "error",
+                                           "error": f"unknown type {mtype!r}"})
+
+    @staticmethod
+    async def _send_json(writer: asyncio.StreamWriter, obj: dict) -> None:
+        writer.write(json.dumps(obj, separators=(",", ":")).encode() + b"\n")
+        await writer.drain()

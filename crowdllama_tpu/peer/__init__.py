@@ -1,0 +1,3 @@
+"""Unified worker/consumer peer runtime."""
+
+from crowdllama_tpu.peer.peer import Peer  # noqa: F401

@@ -1,0 +1,247 @@
+"""Unified worker/consumer peer.
+
+Counterpart of /root/reference/pkg/peer/peer.go: one node object owning the
+stream host, DHT, capability metadata, peer manager and the engine seam.
+Registers the inference stream handler (peer.go:177-256) and metadata handler
+(peer.go:284-316); runs the metadata refresh / publish / advertise loops
+(peer.go:361-504) with DHT reconnect-on-empty-routing-table (peer.go:513-525).
+
+Where the reference hardcodes a fake RTX 4090 advertisement (peer.go:320-343),
+metadata here is real: model list, measured throughput EMA and slot load from
+the engine, TPU chip count / HBM / ICI topology from the JAX runtime.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+
+from crowdllama_tpu.config import Configuration
+from crowdllama_tpu.core import wire
+from crowdllama_tpu.core.protocol import (
+    INFERENCE_PROTOCOL,
+    METADATA_PROTOCOL,
+    metadata_key,
+    namespace_key,
+)
+from crowdllama_tpu.core.resource import Resource
+from crowdllama_tpu.engine.engine import Engine
+from crowdllama_tpu.net.discovery import discover_peers, new_host_and_dht, request_peer_metadata
+from crowdllama_tpu.net.host import Stream
+from crowdllama_tpu.peermanager.manager import PeerHealthConfig, PeerManager
+from crowdllama_tpu.utils.aio import run_every
+from crowdllama_tpu.version import VERSION
+
+log = logging.getLogger("crowdllama.peer")
+
+
+def _tpu_capabilities() -> dict:
+    """Real accelerator capabilities from the JAX runtime."""
+    try:
+        import jax
+
+        devs = jax.devices()
+        kind = devs[0].device_kind if devs else "cpu"
+        n = len(devs)
+        return {
+            "accelerator": kind.lower().replace(" ", "-"),
+            "tpu_chip_count": n,
+            # v5e: 16 GiB HBM per chip; report 0 when unknown.
+            "hbm_gb_per_chip": 16.0 if "tpu" in kind.lower() else 0.0,
+            "ici_topology": f"1x{n}",
+        }
+    except Exception:  # pragma: no cover - jax always importable here
+        return {"accelerator": "unknown", "tpu_chip_count": 0,
+                "hbm_gb_per_chip": 0.0, "ici_topology": ""}
+
+
+class Peer:
+    """One swarm node (worker when ``engine`` serves real models)."""
+
+    def __init__(
+        self,
+        key: Ed25519PrivateKey,
+        config: Configuration,
+        engine: Engine,
+        worker_mode: bool,
+    ):
+        self.config = config
+        self.key = key
+        self.engine = engine
+        self.worker_mode = worker_mode
+        self.host = None
+        self.dht = None
+        self.resource = Resource(worker_mode=worker_mode, version=VERSION)
+        self.peer_manager: PeerManager | None = None
+        self._tasks: list[asyncio.Task] = []
+
+    # ----------------------------------------------------------- lifecycle
+
+    async def start(self) -> None:
+        self.host, self.dht = await new_host_and_dht(
+            self.key,
+            listen_host=self.config.listen_host,
+            listen_port=self.config.listen_port,
+        )
+        self.resource.peer_id = self.host.peer_id
+        self.update_metadata()
+
+        self.host.set_stream_handler(METADATA_PROTOCOL, self._handle_metadata_stream)
+        self.host.set_stream_handler(INFERENCE_PROTOCOL, self._handle_inference_stream)
+
+        self.peer_manager = PeerManager(
+            self_peer_id=self.host.peer_id,
+            config=PeerHealthConfig(intervals=self.config.intervals),
+            metadata_fetcher=self._fetch_peer_metadata,
+            discovery=self._run_discovery,
+        )
+
+        if self.config.bootstrap_peers:
+            n = await self.dht.bootstrap(self.config.bootstrap_peers)
+            log.info("bootstrapped to %d/%d peers", n, len(self.config.bootstrap_peers))
+
+        self.peer_manager.start()
+        iv = self.config.intervals
+        self._tasks = [
+            asyncio.create_task(
+                run_every(iv.metadata_refresh, self._refresh_metadata, log, logging.DEBUG),
+                name="peer-metadata-refresh"),
+            asyncio.create_task(
+                run_every(iv.metadata_publish, self._publish_metadata, log, logging.DEBUG),
+                name="peer-publish"),
+            asyncio.create_task(
+                run_every(iv.advertise, self._advertise, log, logging.DEBUG),
+                name="peer-advertise"),
+        ]
+        log.info("peer %s up (%s) on %s",
+                 self.host.peer_id[:8],
+                 "worker" if self.worker_mode else "consumer",
+                 self.host.contact.addr)
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except asyncio.CancelledError:
+                pass
+        self._tasks = []
+        if self.peer_manager is not None:
+            await self.peer_manager.stop()
+        if self.host is not None:
+            await self.host.close()
+
+    @property
+    def peer_id(self) -> str:
+        return self.host.peer_id if self.host else ""
+
+    # ------------------------------------------------------------ metadata
+
+    def update_metadata(self) -> None:
+        """Refresh the advertised Resource from live engine telemetry
+        (replaces the reference's hardcoded advertisement, peer.go:320-343)."""
+        d = self.engine.describe()
+        r = self.resource
+        r.supported_models = list(d.get("models", []))
+        r.tokens_throughput = float(d.get("throughput", 0.0))
+        r.load = float(d.get("load", 0.0))
+        r.version = VERSION
+        r.worker_mode = self.worker_mode
+        r.max_context_length = self.config.max_context_length
+        for k, v in _tpu_capabilities().items():
+            setattr(r, k, v)
+        sg = d.get("shard_group")
+        if sg is not None:
+            r.shard_group = sg
+        r.touch()
+
+    async def _refresh_metadata(self) -> None:
+        self.update_metadata()
+
+    async def _publish_metadata(self) -> None:
+        """Provide the metadata reachability key (peer.go:409-447).
+
+        Divergence from the reference: it derives the key from the metadata
+        JSON (a brand-new CID every refresh — write-only churn, nothing ever
+        looks content-addressed metadata up); we provide a stable per-peer
+        key so the record refreshes in place instead of accumulating.
+        """
+        await self.dht.reconnect_if_needed()
+        await self.dht.provide(metadata_key(self.host.peer_id.encode()))
+
+    async def _advertise(self) -> None:
+        """Provide the namespace rendezvous key (peer.go:450-504)."""
+        await self.dht.reconnect_if_needed()
+        await self.dht.provide(namespace_key())
+
+    # ------------------------------------------------------------- streams
+
+    async def _handle_metadata_stream(self, stream: Stream) -> None:
+        """Serve Resource JSON and close (peer.go:284-316)."""
+        self.update_metadata()
+        stream.writer.write(self.resource.to_json())
+        await stream.writer.drain()
+        stream.writer.write_eof()
+        if self.peer_manager is not None:
+            self.peer_manager.mark_seen(stream.remote_peer_id)
+
+    async def _handle_inference_stream(self, stream: Stream) -> None:
+        """Serve one inference request per stream (peer.go:190-256).
+
+        Non-streaming: one request frame in, one response frame out.
+        Streaming (req.stream=true): one frame per token chunk, done on last —
+        the superset the reference never implements (its TTFT == total
+        latency, SURVEY §3.3).
+        """
+        try:
+            msg = await wire.read_length_prefixed_pb(
+                stream.reader, timeout=self.config.intervals.stream_read_timeout
+            )
+        except wire.WireError as e:
+            log.debug("inference stream read failed: %s", e)
+            return
+        try:
+            req = msg.generate_request
+            if msg.WhichOneof("message") != "generate_request":
+                raise ValueError("expected GenerateRequest")
+            if req.stream:
+                async for frame in self.engine.handle_streaming(msg, worker_id=self.peer_id):
+                    await wire.write_length_prefixed_pb(stream.writer, frame)
+            else:
+                reply = await self.engine.handle(msg, worker_id=self.peer_id)
+                await wire.write_length_prefixed_pb(stream.writer, reply)
+        except Exception as e:
+            # Synthesize an error response (peer.go:233-243).
+            log.warning("inference failed: %s", e)
+            from crowdllama_tpu.core.messages import create_generate_response
+
+            err = create_generate_response(
+                model=msg.generate_request.model if msg.generate_request else "",
+                response=f"error: {e}",
+                worker_id=self.peer_id,
+                done=True,
+                done_reason="error",
+            )
+            try:
+                await wire.write_length_prefixed_pb(stream.writer, err)
+            except Exception:
+                pass
+
+    # ----------------------------------------------------------- discovery
+
+    async def _fetch_peer_metadata(self, peer_id: str) -> Resource:
+        contact = await self.dht.find_peer(peer_id)
+        if contact is None:
+            raise LookupError(f"peer {peer_id[:8]} not resolvable")
+        return await request_peer_metadata(
+            self.host, contact, timeout=self.config.intervals.metadata_timeout
+        )
+
+    async def _run_discovery(self, skip: set[str]) -> list[Resource]:
+        return await discover_peers(
+            self.host, self.dht, intervals=self.config.intervals,
+            skip_peer_ids=skip,
+        )

@@ -1,0 +1,3 @@
+"""Peer table with health state machine and worker scheduling."""
+
+from crowdllama_tpu.peermanager.manager import PeerHealthConfig, PeerInfo, PeerManager  # noqa: F401

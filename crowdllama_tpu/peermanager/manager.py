@@ -1,0 +1,279 @@
+"""Peer manager: peer table, health state machine, worker scheduler.
+
+Functional counterpart of /root/reference/pkg/peermanager/manager.go — the
+most intricate logic in the reference, kept with its constants as defaults
+(SURVEY §7 build order 4):
+
+- PeerInfo records with failure counts (manager.go:106-116)
+- add/update/remove with a 10-minute ``recently_removed`` quarantine against
+  flapping re-adds (manager.go:179-274)
+- worker/consumer filters (manager.go:287-307)
+- scheduler: filter by supported model, maximize throughput/(1+load)
+  (manager.go:338-387); extended with shard-group awareness for multi-worker
+  models (only complete groups are routable)
+- background loops: discovery, health probing with 3-strikes + linear
+  backoff, stale cleanup (manager.go:440-622) — asyncio tasks instead of
+  goroutines, intervals from config.Intervals (test-mode aware)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable
+
+from crowdllama_tpu.config import Intervals
+from crowdllama_tpu.core.resource import Resource
+
+log = logging.getLogger("crowdllama.peermanager")
+
+# Async callback fetching fresh metadata for a peer id; raises on failure.
+MetadataFetcher = Callable[[str], Awaitable[Resource]]
+# Async callback running one discovery round, returning found resources.
+DiscoveryFunc = Callable[[set[str]], Awaitable[list[Resource]]]
+
+
+@dataclass
+class PeerHealthConfig:
+    """Mirrors DefaultPeerHealthConfig (manager.go:66-104), via Intervals."""
+
+    intervals: Intervals = field(default_factory=Intervals.default)
+
+    @property
+    def stale_after(self) -> float:
+        return self.intervals.stale_after
+
+    @property
+    def max_failed_attempts(self) -> int:
+        return self.intervals.max_failed_attempts
+
+    @property
+    def backoff_base(self) -> float:
+        return self.intervals.backoff_base
+
+
+@dataclass
+class PeerInfo:
+    """One row of the peer table (cf. manager.go:106-116)."""
+
+    peer_id: str
+    resource: Resource
+    last_seen: float = field(default_factory=time.monotonic)
+    failed_attempts: int = 0
+    is_healthy: bool = True
+    next_check_at: float = 0.0
+
+    @property
+    def is_worker(self) -> bool:
+        return self.resource.worker_mode
+
+
+class PeerManager:
+    def __init__(
+        self,
+        self_peer_id: str = "",
+        config: PeerHealthConfig | None = None,
+        metadata_fetcher: MetadataFetcher | None = None,
+        discovery: DiscoveryFunc | None = None,
+    ):
+        self.self_peer_id = self_peer_id
+        self.config = config or PeerHealthConfig()
+        self.metadata_fetcher = metadata_fetcher
+        self.discovery = discovery
+        self.peers: dict[str, PeerInfo] = {}
+        self.recently_removed: dict[str, float] = {}  # peer_id -> removed_at
+        self._tasks: list[asyncio.Task] = []
+
+    # ------------------------------------------------------------- mutation
+
+    def add_or_update_peer(self, resource: Resource) -> None:
+        pid = resource.peer_id
+        if not pid or pid == self.self_peer_id:
+            return
+        if pid in self.recently_removed:
+            # Quarantined: rejects flap re-adds unless genuinely fresh
+            # (manager.go:254-274 unquarantines on new metadata).
+            if resource.age_seconds > self.config.intervals.metadata_max_age:
+                return
+            del self.recently_removed[pid]
+        info = self.peers.get(pid)
+        if info is None:
+            self.peers[pid] = PeerInfo(peer_id=pid, resource=resource)
+        else:
+            info.resource = resource
+            info.last_seen = time.monotonic()
+            info.failed_attempts = 0
+            info.is_healthy = True
+
+    def remove_peer(self, peer_id: str, quarantine: bool = True) -> None:
+        if self.peers.pop(peer_id, None) is not None and quarantine:
+            self.recently_removed[peer_id] = time.monotonic()
+
+    def mark_seen(self, peer_id: str) -> None:
+        info = self.peers.get(peer_id)
+        if info is not None:
+            info.last_seen = time.monotonic()
+
+    # -------------------------------------------------------------- queries
+
+    def get_peer(self, peer_id: str) -> PeerInfo | None:
+        return self.peers.get(peer_id)
+
+    def get_healthy_peers(self) -> list[PeerInfo]:
+        return [p for p in self.peers.values() if p.is_healthy]
+
+    def get_workers(self) -> list[PeerInfo]:
+        return [p for p in self.peers.values() if p.is_worker]
+
+    def get_consumers(self) -> list[PeerInfo]:
+        return [p for p in self.peers.values() if not p.is_worker]
+
+    def is_peer_unhealthy(self, peer_id: str) -> bool:
+        info = self.peers.get(peer_id)
+        return info is not None and not info.is_healthy
+
+    def skip_set(self) -> set[str]:
+        """Peers discovery should skip (unhealthy or quarantined),
+        cf. discovery.go:292."""
+        return (
+            {pid for pid, p in self.peers.items() if not p.is_healthy}
+            | set(self.recently_removed)
+        )
+
+    # ------------------------------------------------------------ scheduler
+
+    def find_best_worker(
+        self, model: str, exclude: set[str] = frozenset()
+    ) -> PeerInfo | None:
+        """Model-filtered best worker by throughput/(1+load)
+        (manager.go:338-387).  Workers in an incomplete shard group are not
+        routable (multi-worker models need the full group); ``exclude`` lets
+        callers fail over past workers that just errored."""
+        groups = self._complete_groups(model)
+        best, best_score = None, -1.0
+        for p in self.get_healthy_peers():
+            if not p.is_worker or p.peer_id in exclude:
+                continue
+            r = p.resource
+            if model and model not in r.supported_models:
+                continue
+            if r.shard_group is not None:
+                if r.shard_group.group_id not in groups:
+                    continue
+                if r.shard_group.shard_index != 0:
+                    continue  # group leader routes for the whole group
+            score = r.tokens_throughput / (1.0 + max(r.load, 0.0))
+            if score > best_score:
+                best, best_score = p, score
+        return best
+
+    def group_members(self, group_id: str) -> list[PeerInfo]:
+        return sorted(
+            (p for p in self.get_healthy_peers()
+             if p.resource.shard_group is not None
+             and p.resource.shard_group.group_id == group_id),
+            key=lambda p: p.resource.shard_group.shard_index,
+        )
+
+    def _complete_groups(self, model: str) -> set[str]:
+        seen: dict[str, set[int]] = {}
+        want: dict[str, int] = {}
+        for p in self.get_healthy_peers():
+            sg = p.resource.shard_group
+            if sg is None or (model and sg.model != model):
+                continue
+            seen.setdefault(sg.group_id, set()).add(sg.shard_index)
+            want[sg.group_id] = sg.shard_count
+        return {
+            gid for gid, idxs in seen.items()
+            if len(idxs) == want[gid] and idxs == set(range(want[gid]))
+        }
+
+    # ------------------------------------------------------- health machine
+
+    async def health_check_peer(self, info: PeerInfo) -> bool:
+        """Active probe: live metadata fetch with timeout
+        (manager.go:592-622).  3 strikes → unhealthy; linear backoff
+        failed_attempts × backoff_base (manager.go:540-564)."""
+        if self.metadata_fetcher is None:
+            return info.is_healthy
+        try:
+            resource = await asyncio.wait_for(
+                self.metadata_fetcher(info.peer_id),
+                self.config.intervals.metadata_timeout,
+            )
+            info.resource = resource
+            info.last_seen = time.monotonic()
+            info.failed_attempts = 0
+            info.is_healthy = True
+            return True
+        except Exception as e:
+            info.failed_attempts += 1
+            info.next_check_at = (
+                time.monotonic() + info.failed_attempts * self.config.backoff_base
+            )
+            if info.failed_attempts >= self.config.max_failed_attempts:
+                info.is_healthy = False
+            log.debug("health probe failed for %s (%d/%d): %s",
+                      info.peer_id[:8], info.failed_attempts,
+                      self.config.max_failed_attempts, e)
+            return False
+
+    async def perform_health_checks(self) -> None:
+        now = time.monotonic()
+        await asyncio.gather(*(
+            self.health_check_peer(p)
+            for p in list(self.peers.values())
+            if p.next_check_at <= now
+        ))
+
+    def perform_cleanup(self) -> None:
+        """Evict peers unseen past stale_after; purge old quarantine entries
+        (manager.go:568-589)."""
+        now = time.monotonic()
+        for pid, info in list(self.peers.items()):
+            if now - info.last_seen > self.config.stale_after:
+                log.info("evicting stale peer %s", pid[:8])
+                self.remove_peer(pid)
+        cutoff = now - self.config.intervals.quarantine
+        self.recently_removed = {
+            pid: t for pid, t in self.recently_removed.items() if t > cutoff
+        }
+
+    async def run_discovery_once(self) -> None:
+        if self.discovery is None:
+            return
+        try:
+            found = await self.discovery(self.skip_set())
+        except Exception as e:
+            log.debug("discovery round failed: %s", e)
+            return
+        for resource in found:
+            self.add_or_update_peer(resource)
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        from crowdllama_tpu.utils.aio import run_every
+
+        iv = self.config.intervals
+        self._tasks = [
+            asyncio.create_task(run_every(iv.discovery, self.run_discovery_once, log),
+                                name="pm-discovery"),
+            asyncio.create_task(run_every(iv.health_check, self.perform_health_checks, log),
+                                name="pm-health"),
+            asyncio.create_task(run_every(iv.cleanup, self.perform_cleanup, log),
+                                name="pm-cleanup"),
+        ]
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except asyncio.CancelledError:
+                pass
+        self._tasks = []

@@ -1,0 +1,29 @@
+"""Asyncio helpers shared across background services."""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import logging
+from typing import Callable
+
+
+async def run_every(interval: float, fn: Callable, log: logging.Logger,
+                    level: int = logging.ERROR) -> None:
+    """Run ``fn`` (sync or async) every ``interval`` seconds forever.
+
+    The single loop contract for every background service (peer publish /
+    advertise / refresh, manager discovery / health / cleanup): errors are
+    logged at ``level`` and never kill the loop; cancellation propagates.
+    """
+    while True:
+        try:
+            result = fn()
+            if inspect.isawaitable(result):
+                await result
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            log.log(level, "background loop error (%s)",
+                    getattr(fn, "__name__", fn), exc_info=level >= logging.ERROR)
+        await asyncio.sleep(interval)

@@ -1,0 +1,52 @@
+"""Chat against a swarm gateway.
+
+Counterpart of /root/reference/examples/chat/chat.py, which uses the official
+``ollama`` Python client against the gateway — proof of API compatibility.
+If the ``ollama`` package is installed this script uses it identically;
+otherwise it speaks the same HTTP API with stdlib urllib.
+
+Run a swarm first:
+    crowdllama-tpu-dht start &
+    crowdllama-tpu start --worker-mode --bootstrap-peers 127.0.0.1:9000 &
+    crowdllama-tpu start --bootstrap-peers 127.0.0.1:9000 &
+    python examples/chat.py "why is the sky blue?"
+"""
+
+import json
+import sys
+import urllib.request
+
+GATEWAY = "http://localhost:9001"
+MODEL = "tinyllama-1.1b"
+
+
+def main() -> None:
+    prompt = " ".join(sys.argv[1:]) or "Why is the sky blue?"
+    messages = [{"role": "user", "content": prompt}]
+    try:
+        import ollama  # the stock client works against the gateway
+
+        client = ollama.Client(host=GATEWAY)
+        stream = client.chat(model=MODEL, messages=messages, stream=True)
+        for chunk in stream:
+            print(chunk["message"]["content"], end="", flush=True)
+        print()
+        return
+    except ImportError:
+        pass
+
+    body = json.dumps({"model": MODEL, "messages": messages, "stream": True}).encode()
+    req = urllib.request.Request(
+        f"{GATEWAY}/api/chat", data=body,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req) as resp:
+        for line in resp:
+            chunk = json.loads(line)
+            print(chunk["message"]["content"], end="", flush=True)
+            if chunk.get("done"):
+                break
+    print()
+
+
+if __name__ == "__main__":
+    main()

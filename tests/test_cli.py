@@ -1,0 +1,55 @@
+"""CLI surface tests: parser wiring, version, config layering from env."""
+
+import os
+
+from crowdllama_tpu.cli.dht import main as dht_main
+from crowdllama_tpu.cli.main import build_parser, main
+from crowdllama_tpu.config import Configuration
+
+
+def test_version_command(capsys):
+    assert main(["version"]) == 0
+    assert "crowdllama-tpu" in capsys.readouterr().out
+
+
+def test_dht_version(capsys):
+    assert dht_main(["version"]) == 0
+    assert "crowdllama-tpu" in capsys.readouterr().out
+
+
+def test_no_command_prints_help(capsys):
+    assert main([]) == 1
+    assert "start" in capsys.readouterr().out
+
+
+def test_start_flags_parse():
+    args = build_parser().parse_args([
+        "start", "--worker-mode", "--model", "llama-3-8b",
+        "--bootstrap-peers", "10.0.0.1:9000,10.0.0.2:9000",
+        "--mesh", "1x8", "--gateway-port", "9005",
+    ])
+    cfg = Configuration.from_flags(args)
+    assert args.worker_mode
+    assert cfg.model == "llama-3-8b"
+    assert cfg.bootstrap_peers == ["10.0.0.1:9000", "10.0.0.2:9000"]
+    assert cfg.mesh_shape == "1x8"
+    assert cfg.gateway_port == 9005
+
+
+def test_env_layering(monkeypatch):
+    monkeypatch.setenv("CROWDLLAMA_TPU_MODEL", "mixtral-8x7b")
+    monkeypatch.setenv("CROWDLLAMA_TPU_BOOTSTRAP_PEERS", "a:1, b:2 ,")
+    monkeypatch.setenv("CROWDLLAMA_TPU_VERBOSE", "1")
+    cfg = Configuration.from_environment()
+    assert cfg.model == "mixtral-8x7b"
+    assert cfg.bootstrap_peers == ["a:1", "b:2"]
+    assert cfg.verbose is True
+    # flags override env
+    args = build_parser().parse_args(["start", "--model", "tiny-test"])
+    cfg = Configuration.from_flags(args)
+    assert cfg.model == "tiny-test"
+
+
+def test_network_status_unreachable(capsys):
+    assert main(["network-status", "--gateway", "http://127.0.0.1:1"]) == 1
+    assert "unreachable" in capsys.readouterr().err

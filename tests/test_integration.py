@@ -1,0 +1,171 @@
+"""Full-topology integration test, the analog of the reference's
+TestFullIntegration (/root/reference/test/integration_test.go): DHT bootstrap
+node + worker peer (FakeEngine at the engine seam) + consumer peer + gateway,
+all real sockets on loopback with compressed intervals; drive through HTTP
+and validate the Ollama-shaped reply."""
+
+import asyncio
+import json
+
+import aiohttp
+from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+
+from crowdllama_tpu.config import Configuration, Intervals
+from crowdllama_tpu.engine.engine import FakeEngine
+from crowdllama_tpu.gateway.gateway import Gateway
+from crowdllama_tpu.net.discovery import new_host_and_dht
+from crowdllama_tpu.peer.peer import Peer
+
+
+def _cfg(bootstrap, **kw):
+    cfg = Configuration(
+        listen_host="127.0.0.1",
+        bootstrap_peers=[bootstrap],
+        intervals=Intervals.default(),  # test mode: compressed
+    )
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+async def _wait_for(cond, timeout=20.0, interval=0.1, what="condition"):
+    """Poll-with-deadline, the reference's synchronization style
+    (integration_test.go:421-488)."""
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        if cond():
+            return
+        await asyncio.sleep(interval)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+async def _topology():
+    boot_host, boot_dht = await new_host_and_dht(
+        Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    bootstrap = f"127.0.0.1:{boot_host.listen_port}"
+
+    worker = Peer(Ed25519PrivateKey.generate(), _cfg(bootstrap),
+                  engine=FakeEngine(models=["tiny-test"]), worker_mode=True)
+    await worker.start()
+
+    consumer = Peer(Ed25519PrivateKey.generate(), _cfg(bootstrap),
+                    engine=FakeEngine(models=[]), worker_mode=False)
+    await consumer.start()
+
+    gateway = Gateway(consumer, port=0, host="127.0.0.1")
+    await gateway.start()
+    gw_port = gateway._runner.addresses[0][1]
+
+    async def teardown():
+        await gateway.stop()
+        await consumer.stop()
+        await worker.stop()
+        await boot_host.close()
+
+    return worker, consumer, gateway, gw_port, teardown
+
+
+async def test_full_integration_chat():
+    worker, consumer, gateway, gw_port, teardown = await _topology()
+    try:
+        # Mutual discovery: consumer's manager must see the worker as healthy.
+        await _wait_for(
+            lambda: any(
+                p.peer_id == worker.peer_id
+                for p in consumer.peer_manager.get_healthy_peers()
+            ),
+            what="consumer discovering worker",
+        )
+
+        base = f"http://127.0.0.1:{gw_port}"
+        async with aiohttp.ClientSession() as s:
+            # Non-streaming chat (the reference's only mode).
+            body = {"model": "tiny-test",
+                    "messages": [{"role": "user", "content": "hello swarm"}]}
+            async with s.post(f"{base}/api/chat", json=body) as resp:
+                assert resp.status == 200, await resp.text()
+                d = await resp.json()
+            assert d["model"] == "tiny-test"
+            assert d["done"] is True
+            assert d["message"]["role"] == "assistant"
+            assert "hello swarm" in d["message"]["content"]
+            assert d["worker_id"] == worker.peer_id
+            assert d["total_duration"] >= 0
+
+            # Streaming chat (NDJSON superset).
+            body["stream"] = True
+            async with s.post(f"{base}/api/chat", json=body) as resp:
+                assert resp.status == 200
+                assert resp.headers["Content-Type"].startswith("application/x-ndjson")
+                lines = [json.loads(l) for l in (await resp.text()).splitlines()]
+            assert lines[-1]["done"] is True
+            assert all(not l["done"] for l in lines[:-1])
+            text = "".join(l["message"]["content"] for l in lines)
+            assert "hello swarm" in text
+
+            # /api/generate
+            async with s.post(f"{base}/api/generate",
+                              json={"model": "tiny-test", "prompt": "ping"}) as resp:
+                assert resp.status == 200
+                d = await resp.json()
+            assert "ping" in d["response"]
+
+            # /api/health shows the worker with TPU-era fields
+            async with s.get(f"{base}/api/health") as resp:
+                h = await resp.json()
+            assert h["status"] == "ok"
+            assert worker.peer_id in h["workers"]
+            w = h["workers"][worker.peer_id]
+            assert w["is_healthy"] is True
+            assert w["supported_models"] == ["tiny-test"]
+
+            # /api/tags lists the model
+            async with s.get(f"{base}/api/tags") as resp:
+                tags = await resp.json()
+            assert any(m["name"] == "tiny-test" for m in tags["models"])
+
+            # Unknown model -> 503 with error body
+            async with s.post(f"{base}/api/chat", json={
+                "model": "nope", "messages": [{"role": "user", "content": "x"}]
+            }) as resp:
+                assert resp.status == 503
+
+            # Malformed bodies -> 400
+            async with s.post(f"{base}/api/chat", data=b"{not json") as resp:
+                assert resp.status == 400
+            async with s.post(f"{base}/api/chat", json={"model": "m"}) as resp:
+                assert resp.status == 400
+    finally:
+        await teardown()
+
+
+async def test_worker_death_detected():
+    worker, consumer, gateway, gw_port, teardown = await _topology()
+    try:
+        await _wait_for(
+            lambda: any(
+                p.peer_id == worker.peer_id
+                for p in consumer.peer_manager.get_healthy_peers()
+            ),
+            what="consumer discovering worker",
+        )
+        wid = worker.peer_id
+        await worker.stop()
+        # Health machine (3 strikes / stale eviction) must drop the worker.
+        await _wait_for(
+            lambda: not any(
+                p.peer_id == wid for p in consumer.peer_manager.get_healthy_peers()
+            ),
+            timeout=40.0,
+            what="worker eviction after death",
+        )
+        # Routing now fails cleanly.
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"http://127.0.0.1:{gw_port}/api/chat", json={
+                "model": "tiny-test",
+                "messages": [{"role": "user", "content": "x"}],
+            }) as resp:
+                assert resp.status == 503
+    finally:
+        await teardown()

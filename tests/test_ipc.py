@@ -1,0 +1,86 @@
+"""IPC server tests mirroring /root/reference/pkg/ipc/ipc_test.go: real unix
+socket, mock engine at the seam, length-prefixed PB and JSON clients."""
+
+import asyncio
+import json
+import struct
+
+from crowdllama_tpu.core import wire
+from crowdllama_tpu.core.messages import create_generate_request, extract_generate_response
+from crowdllama_tpu.engine.engine import FakeEngine
+from crowdllama_tpu.ipc.server import IPCServer
+
+
+async def _client(path):
+    return await asyncio.open_unix_connection(path)
+
+
+async def test_pb_roundtrip(tmp_path):
+    sock = str(tmp_path / "ipc.sock")
+    srv = IPCServer(sock, FakeEngine(models=["m"]))
+    await srv.start()
+    try:
+        reader, writer = await _client(sock)
+        msg = create_generate_request("m", "hello ipc")
+        writer.write(wire.encode_frame(msg))
+        await writer.drain()
+        reply = await wire.read_length_prefixed_pb(reader, timeout=5)
+        resp = extract_generate_response(reply)
+        assert resp.response == "echo: hello ipc"
+        assert resp.done
+        writer.close()
+    finally:
+        await srv.stop()
+
+
+async def test_json_ping_initialize_prompt_status(tmp_path):
+    sock = str(tmp_path / "ipc.sock")
+    srv = IPCServer(sock, FakeEngine(models=["m"]))
+    await srv.start()
+    try:
+        reader, writer = await _client(sock)
+
+        async def ask(obj):
+            writer.write(json.dumps(obj).encode() + b"\n")
+            await writer.drain()
+            return json.loads(await asyncio.wait_for(reader.readline(), 5))
+
+        assert (await ask({"type": "ping"}))["type"] == "pong"
+        init = await ask({"type": "initialize", "mode": "worker"})
+        assert init["type"] == "initialized" and init["mode"] == "worker"
+        resp = await ask({"type": "prompt", "text": "hi"})
+        assert resp["type"] == "response" and "hi" in resp["response"]
+        st = await ask({"type": "status"})
+        assert st["type"] == "status"
+        err = await ask({"type": "bogus"})
+        assert err["type"] == "error"
+        writer.close()
+    finally:
+        await srv.stop()
+
+
+async def test_socket_permissions(tmp_path):
+    import stat
+    sock = str(tmp_path / "ipc.sock")
+    srv = IPCServer(sock, FakeEngine())
+    await srv.start()
+    try:
+        mode = stat.S_IMODE((tmp_path / "ipc.sock").stat().st_mode)
+        assert mode == 0o600
+    finally:
+        await srv.stop()
+
+
+async def test_garbage_line(tmp_path):
+    sock = str(tmp_path / "ipc.sock")
+    srv = IPCServer(sock, FakeEngine())
+    await srv.start()
+    try:
+        reader, writer = await _client(sock)
+        writer.write(b"{garbage that is not json\n")
+        await writer.drain()
+        reply = json.loads(await asyncio.wait_for(reader.readline(), 5))
+        assert reply["type"] == "error"
+        writer.close()
+    finally:
+        await srv.stop()

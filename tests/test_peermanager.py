@@ -1,0 +1,144 @@
+"""Peer manager unit tests mirroring the manager.go state machine:
+scoring, health strikes/backoff, quarantine, stale cleanup, shard groups."""
+
+import asyncio
+import time
+
+from crowdllama_tpu.config import Intervals
+from crowdllama_tpu.core.resource import Resource, ShardGroup
+from crowdllama_tpu.peermanager.manager import PeerHealthConfig, PeerManager
+
+
+def _res(pid, models=("m",), tput=100.0, load=0.0, worker=True, sg=None):
+    r = Resource(
+        peer_id=pid, supported_models=list(models), tokens_throughput=tput,
+        load=load, worker_mode=worker, shard_group=sg,
+    )
+    r.touch()
+    return r
+
+
+def _pm(**kw):
+    return PeerManager(self_peer_id="self", config=PeerHealthConfig(Intervals()), **kw)
+
+
+def test_find_best_worker_scoring():
+    pm = _pm()
+    pm.add_or_update_peer(_res("slow", tput=50, load=0.0))
+    pm.add_or_update_peer(_res("fast-loaded", tput=200, load=1.0))   # 100
+    pm.add_or_update_peer(_res("fast-idle", tput=150, load=0.1))     # ~136
+    pm.add_or_update_peer(_res("wrong-model", models=("other",), tput=999))
+    pm.add_or_update_peer(_res("consumer", worker=False, tput=999))
+    best = pm.find_best_worker("m")
+    assert best.peer_id == "fast-idle"
+    assert pm.find_best_worker("missing") is None
+
+
+def test_self_and_empty_ignored():
+    pm = _pm()
+    pm.add_or_update_peer(_res("self"))
+    pm.add_or_update_peer(_res(""))
+    assert pm.peers == {}
+
+
+def test_health_three_strikes_and_recovery():
+    fail = True
+
+    async def fetch(pid):
+        if fail:
+            raise ConnectionError("down")
+        return _res(pid)
+
+    pm = _pm(metadata_fetcher=fetch)
+    pm.add_or_update_peer(_res("w1"))
+    info = pm.get_peer("w1")
+
+    async def run():
+        nonlocal fail
+        for i in range(3):
+            info.next_check_at = 0
+            await pm.perform_health_checks()
+        assert not info.is_healthy
+        assert info.failed_attempts == 3
+        assert "w1" in pm.skip_set()
+        # recovery on a successful probe
+        fail = False
+        info.next_check_at = 0
+        await pm.perform_health_checks()
+        assert info.is_healthy and info.failed_attempts == 0
+
+    asyncio.run(run())
+
+
+def test_backoff_schedules_next_check():
+    async def fetch(pid):
+        raise ConnectionError("down")
+
+    pm = _pm(metadata_fetcher=fetch)
+    pm.add_or_update_peer(_res("w1"))
+    info = pm.get_peer("w1")
+
+    async def run():
+        await pm.perform_health_checks()
+        first = info.next_check_at
+        assert first > time.monotonic()
+        # not due yet → second round skips it
+        await pm.perform_health_checks()
+        assert info.failed_attempts == 1
+        assert info.next_check_at == first
+
+    asyncio.run(run())
+
+
+def test_stale_cleanup_and_quarantine():
+    iv = Intervals(stale_after=0.01, quarantine=0.05)
+    pm = PeerManager(config=PeerHealthConfig(iv))
+    pm.add_or_update_peer(_res("w1"))
+    time.sleep(0.02)
+    pm.perform_cleanup()
+    assert pm.get_peer("w1") is None
+    assert "w1" in pm.recently_removed
+    # quarantined: stale metadata can't re-add... (fresh can)
+    stale = _res("w1")
+    stale.last_updated -= 7200
+    pm.add_or_update_peer(stale)
+    assert pm.get_peer("w1") is None
+    fresh = _res("w1")
+    pm.add_or_update_peer(fresh)
+    assert pm.get_peer("w1") is not None
+    # quarantine purges after its window
+    pm.remove_peer("w1")
+    time.sleep(0.06)
+    pm.perform_cleanup()
+    assert "w1" not in pm.recently_removed
+
+
+def test_shard_group_routing():
+    pm = _pm()
+    # complete 2-shard EP group
+    for i in range(2):
+        pm.add_or_update_peer(_res(
+            f"g1-{i}", models=("mix",), tput=100,
+            sg=ShardGroup(group_id="g1", model="mix", strategy="ep",
+                          shard_index=i, shard_count=2),
+        ))
+    # incomplete group
+    pm.add_or_update_peer(_res(
+        "g2-0", models=("mix",), tput=999,
+        sg=ShardGroup(group_id="g2", model="mix", strategy="ep",
+                      shard_index=0, shard_count=4),
+    ))
+    best = pm.find_best_worker("mix")
+    assert best is not None and best.peer_id == "g1-0"  # leader of complete group
+    members = pm.group_members("g1")
+    assert [m.peer_id for m in members] == ["g1-0", "g1-1"]
+
+
+def test_discovery_applies_results():
+    async def disc(skip):
+        assert isinstance(skip, set)
+        return [_res("found-1"), _res("found-2")]
+
+    pm = _pm(discovery=disc)
+    asyncio.run(pm.run_discovery_once())
+    assert set(pm.peers) == {"found-1", "found-2"}
