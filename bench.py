@@ -1,0 +1,90 @@
+"""Headline benchmark: decode throughput (tokens/sec/chip) of the JAX engine.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no measured numbers (SURVEY §6); the only throughput
+figure in its tree is the hardcoded 150 tokens/sec a worker *advertises*
+(/root/reference/pkg/peer/peer.go:323-333).  ``vs_baseline`` is therefore
+measured tokens/sec/chip divided by that advertised 150 tok/s.
+
+Model defaults to TinyLlama-1.1B (BASELINE config 1, randomly initialized —
+throughput does not depend on weight values).  Overridables via env:
+  CROWDLLAMA_BENCH_MODEL   (default tinyllama-1.1b)
+  CROWDLLAMA_BENCH_SLOTS   batch slots        (default 8)
+  CROWDLLAMA_BENCH_STEPS   timed decode steps (default 128)
+  CROWDLLAMA_BENCH_CTX     max context        (default 1024)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import jax
+import numpy as np
+
+BASELINE_ADVERTISED_TOKS = 150.0  # reference worker's hardcoded claim
+
+
+def main() -> None:
+    from crowdllama_tpu.engine.runner import ModelRunner
+    from crowdllama_tpu.models.config import get_config
+
+    model = os.environ.get("CROWDLLAMA_BENCH_MODEL", "tinyllama-1.1b")
+    slots = int(os.environ.get("CROWDLLAMA_BENCH_SLOTS", "8"))
+    steps = int(os.environ.get("CROWDLLAMA_BENCH_STEPS", "128"))
+    ctx = int(os.environ.get("CROWDLLAMA_BENCH_CTX", "1024"))
+
+    cfg = get_config(model)
+    cfg = get_config(model, max_context_length=min(cfg.max_context_length, ctx))
+    n_chips = max(1, len(jax.devices()))
+
+    print(f"# bench: model={model} slots={slots} steps={steps} "
+          f"ctx={cfg.max_context_length} devices={n_chips} "
+          f"platform={jax.devices()[0].platform}", file=sys.stderr)
+
+    t0 = time.monotonic()
+    runner = ModelRunner(cfg, max_slots=slots, max_seq=cfg.max_context_length)
+    state = runner.init_state()
+
+    # Fill every slot with a short prompt so the decode batch is saturated.
+    rng = np.random.default_rng(0)
+    key = jax.random.PRNGKey(0)
+    for slot in range(runner.max_slots):
+        prompt = rng.integers(1, cfg.vocab_size, size=24).tolist()
+        key, sub = jax.random.split(key)
+        first, ks, vs, plen = runner.prefill(prompt, 0.7, 0.95, sub)
+        state = runner.insert(state, slot, ks, vs, plen, first, 0.7, 0.95)
+    print(f"# setup+prefill: {time.monotonic() - t0:.1f}s", file=sys.stderr)
+
+    # Warmup compile of the timed decode program.
+    chunk = min(32, steps)
+    tokens, state = runner.decode_steps(state, chunk)
+    tokens[-1].sum()  # sync
+
+    t0 = time.monotonic()
+    done = 0
+    while done < steps:
+        k = min(chunk, steps - done)
+        if k != chunk:  # avoid compiling a second program for the remainder
+            break
+        tokens, state = runner.decode_steps(state, k)
+        done += k
+    tokens[-1].sum()  # sync
+    dt = time.monotonic() - t0
+
+    toks_per_sec = done * runner.max_slots / dt
+    per_chip = toks_per_sec / n_chips
+    result = {
+        "metric": f"{model} decode throughput",
+        "value": round(per_chip, 2),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(per_chip / BASELINE_ADVERTISED_TOKS, 3),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

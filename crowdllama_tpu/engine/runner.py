@@ -30,7 +30,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from crowdllama_tpu.engine.sampling import sample_tokens
 from crowdllama_tpu.models import transformer as T
 from crowdllama_tpu.models.config import ModelConfig
-from crowdllama_tpu.parallel.mesh import AXIS_DP, build_mesh, choose_mesh_shape
+from crowdllama_tpu.parallel.mesh import (
+    AXIS_DP,
+    AXIS_SP,
+    build_mesh,
+    choose_mesh_shape,
+)
 from crowdllama_tpu.parallel.sharding import cache_pspec, shard_params
 
 log = logging.getLogger("crowdllama.engine.runner")
@@ -99,17 +104,27 @@ class ModelRunner:
         if self.max_slots % dp != 0:
             self.max_slots = max(dp, (self.max_slots // dp) * dp)
             log.warning("max_slots rounded to %d (dp=%d)", self.max_slots, dp)
+        # Sequence parallelism: sp > 1 shards the KV cache sequence dim and
+        # switches prefill to ring attention, decode to distributed flash
+        # decoding (ops/ring.py).
+        self.sp = mesh.shape.get(AXIS_SP, 1)
+        self._sp_mesh = mesh if self.sp > 1 else None
+        if self.sp > 1:
+            assert self.max_seq % self.sp == 0, (
+                f"max_seq {self.max_seq} must divide by sp={self.sp}")
 
         if params is None:
             params = T.init_params(cfg, jax.random.PRNGKey(seed), dtype=dtype)
         self.params = shard_params(params, cfg, mesh)
 
         self._replicated = NamedSharding(mesh, P())
-        self._cache_sharding = NamedSharding(mesh, cache_pspec())
-        # Prefill KV has batch dim 1 — kv-heads shard on tp, no dp.
+        self._cache_sharding = NamedSharding(mesh, cache_pspec(mesh))
+        # Prefill KV has batch dim 1 — sequence on sp, kv-heads on tp, no dp.
+        sp_ax = AXIS_SP if AXIS_SP in mesh.shape else None
         self._prefill_kv_sharding = NamedSharding(
-            mesh, P(None, None, None, "tp", None))
-        self.buckets = prefill_buckets(self.max_seq)
+            mesh, P(None, None, sp_ax, "tp", None))
+        self.buckets = [b for b in prefill_buckets(self.max_seq)
+                        if b % self.sp == 0]
 
         self._prefill = jax.jit(
             self._prefill_impl,
@@ -132,7 +147,8 @@ class ModelRunner:
         positions = jnp.minimum(jnp.arange(t)[None, :], plen - 1)
         kv_valid = (jnp.arange(t) < plen)[None, :]
         logits, ks, vs = T.prefill(params, self.cfg, tokens, positions,
-                                   kv_valid=kv_valid)
+                                   kv_valid=kv_valid, sp_mesh=self._sp_mesh,
+                                   sp_batch_axis=None)
         last = logits[0, plen - 1]  # [V]
         tok = sample_tokens(last[None, :], temperature[None], top_p[None], key)[0]
         return tok, ks, vs
@@ -180,6 +196,7 @@ class ModelRunner:
                 params, self.cfg, st.tokens, positions,
                 st.k_cache, st.v_cache,
                 jnp.minimum(st.seq_lens + 1, self.max_seq),
+                sp_mesh=self._sp_mesh, dp_axis=AXIS_DP,
             )
             key, sub = jax.random.split(st.key)
             next_tokens = sample_tokens(logits, st.temperature, st.top_p, sub)
@@ -201,10 +218,14 @@ class ModelRunner:
     def init_state(self, seed: int = 0) -> DecodeState:
         l, b, s = self.cfg.num_layers, self.max_slots, self.max_seq
         hkv, dh = self.cfg.num_kv_heads, self.cfg.resolved_head_dim()
-        zeros = jnp.zeros((l, b, s, hkv, dh), self.dtype)
+        shape = (l, b, s, hkv, dh)
+        # Two distinct buffers: device_put of one array twice may alias, and
+        # aliased k/v caches break donation in the jitted insert/decode.
         return DecodeState(
-            k_cache=jax.device_put(zeros, self._cache_sharding),
-            v_cache=jax.device_put(zeros, self._cache_sharding),
+            k_cache=jax.device_put(jnp.zeros(shape, self.dtype),
+                                   self._cache_sharding),
+            v_cache=jax.device_put(jnp.zeros(shape, self.dtype),
+                                   self._cache_sharding),
             seq_lens=jnp.zeros((b,), jnp.int32),
             tokens=jnp.zeros((b,), jnp.int32),
             active=jnp.zeros((b,), bool),

@@ -22,6 +22,11 @@ import jax.numpy as jnp
 from crowdllama_tpu.models.config import ModelConfig
 from crowdllama_tpu.ops.attention import decode_attention, prefill_attention
 from crowdllama_tpu.ops.norms import rms_norm
+from crowdllama_tpu.ops.ring import (
+    ring_prefill_attention,
+    sp_cache_update,
+    sp_decode_attention,
+)
 from crowdllama_tpu.ops.rope import apply_rope, rope_table
 
 Params = dict[str, Any]
@@ -158,8 +163,15 @@ def prefill(
     tokens: jnp.ndarray,     # [B, T] int32, padded
     positions: jnp.ndarray,  # [B, T] int32; padding may repeat last pos
     kv_valid: jnp.ndarray | None = None,  # [B, T] bool; False for padding
+    sp_mesh=None,            # Mesh → ring attention over its "sp" axis
+    sp_batch_axis: str | None = None,  # mesh axis the batch dim is sharded on
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Full-prompt forward.  Returns (logits [B,T,V], k, v [L,B,T,Hkv,Dh])."""
+    """Full-prompt forward.  Returns (logits [B,T,V], k, v [L,B,T,Hkv,Dh]).
+
+    With ``sp_mesh`` the sequence dim is sharded over the mesh's ``sp`` axis
+    and attention runs as a ppermute ring (ops/ring.py) — the long-context
+    path; T must be divisible by the sp axis size.
+    """
     dh = cfg.resolved_head_dim()
     hkv = cfg.num_kv_heads
     scale = attn_scale(cfg)
@@ -176,9 +188,15 @@ def prefill(
         v = jnp.einsum("btd,dk->btk", h, lp["wv"]).reshape(b, t, hkv, dh)
         q = apply_rope(q, positions, cos, sin)
         k = apply_rope(k, positions, cos, sin)
-        attn = prefill_attention(q, k, v, positions, scale,
-                                 softcap=cfg.attn_logit_softcap,
-                                 sliding_window=window, kv_valid=kv_valid)
+        if sp_mesh is not None:
+            attn = ring_prefill_attention(
+                q, k, v, positions, scale, sp_mesh,
+                softcap=cfg.attn_logit_softcap, sliding_window=window,
+                kv_valid=kv_valid, dp_axis=sp_batch_axis)
+        else:
+            attn = prefill_attention(q, k, v, positions, scale,
+                                     softcap=cfg.attn_logit_softcap,
+                                     sliding_window=window, kv_valid=kv_valid)
         attn = jnp.einsum("btk,kd->btd", attn.reshape(b, t, -1), lp["wo"])
         if cfg.post_norms:
             attn = rms_norm(attn, lp["post_ln1"], cfg.rms_norm_eps, plus_one=True)
@@ -205,8 +223,15 @@ def decode_step(
     k_cache: jnp.ndarray,    # [L, B, S, Hkv, Dh]
     v_cache: jnp.ndarray,    # [L, B, S, Hkv, Dh]
     seq_lens: jnp.ndarray,   # [B] valid lengths AFTER appending this token
+    sp_mesh=None,            # Mesh → S-sharded cache + distributed decode
+    dp_axis: str | None = "dp",
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """One token per slot.  Returns (logits [B,V], k_cache, v_cache)."""
+    """One token per slot.  Returns (logits [B,V], k_cache, v_cache).
+
+    With ``sp_mesh`` the KV cache's sequence dim is sharded over ``sp``: the
+    new token's KV is written shard-locally and attention is flash-decoding
+    merged with pmax/psum (ops/ring.py).
+    """
     dh = cfg.resolved_head_dim()
     hkv = cfg.num_kv_heads
     scale = attn_scale(cfg)
@@ -224,11 +249,18 @@ def decode_step(
         v = jnp.einsum("bd,dk->bk", h, lp["wv"]).reshape(b, hkv, dh)
         q = apply_rope(q[:, None], positions[:, None], cos, sin)[:, 0]
         k = apply_rope(k[:, None], positions[:, None], cos, sin)[:, 0]
-        kc = kc.at[slot_idx, positions].set(k)
-        vc = vc.at[slot_idx, positions].set(v)
-        attn = decode_attention(q, kc, vc, seq_lens, scale,
-                                softcap=cfg.attn_logit_softcap,
-                                sliding_window=window)
+        if sp_mesh is not None:
+            kc, vc = sp_cache_update(k, v, positions, kc, vc, sp_mesh,
+                                     dp_axis=dp_axis)
+            attn = sp_decode_attention(q, kc, vc, seq_lens, scale, sp_mesh,
+                                       softcap=cfg.attn_logit_softcap,
+                                       sliding_window=window, dp_axis=dp_axis)
+        else:
+            kc = kc.at[slot_idx, positions].set(k)
+            vc = vc.at[slot_idx, positions].set(v)
+            attn = decode_attention(q, kc, vc, seq_lens, scale,
+                                    softcap=cfg.attn_logit_softcap,
+                                    sliding_window=window)
         attn = jnp.einsum("bk,kd->bd", attn.reshape(b, -1), lp["wo"])
         if cfg.post_norms:
             attn = rms_norm(attn, lp["post_ln1"], cfg.rms_norm_eps, plus_one=True)

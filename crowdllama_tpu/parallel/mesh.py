@@ -1,9 +1,10 @@
 """Mesh construction over local TPU devices.
 
-Axes: ``dp`` (data/batch), ``ep`` (experts, MoE), ``tp`` (tensor).  A spec
-string "AxBxC" assigns dp=A, ep=B, tp=C; "AxB" means dp=A, tp=B; empty puts
-every device on tp.  ICI topology is respected via
-mesh_utils.create_device_mesh when available.
+Axes: ``dp`` (data/batch slots), ``sp`` (sequence/context — ring attention
+and sharded KV cache), ``ep`` (experts, MoE), ``tp`` (tensor).  A spec string
+maps onto the trailing axes: "A" → tp=A; "AxB" → dp=A, tp=B; "AxBxC" → dp=A,
+ep=B, tp=C; "AxBxCxD" → dp=A, sp=B, ep=C, tp=D.  ICI topology is respected
+via mesh_utils.create_device_mesh when available.
 """
 
 from __future__ import annotations
@@ -13,20 +14,22 @@ import numpy as np
 from jax.experimental import mesh_utils
 from jax.sharding import Mesh
 
-AXIS_DP, AXIS_EP, AXIS_TP = "dp", "ep", "tp"
-AXES = (AXIS_DP, AXIS_EP, AXIS_TP)
+AXIS_DP, AXIS_SP, AXIS_EP, AXIS_TP = "dp", "sp", "ep", "tp"
+AXES = (AXIS_DP, AXIS_SP, AXIS_EP, AXIS_TP)
 
 
-def parse_mesh_spec(spec: str, n_devices: int) -> tuple[int, int, int]:
+def parse_mesh_spec(spec: str, n_devices: int) -> tuple[int, int, int, int]:
     if not spec:
-        return (1, 1, n_devices)
+        return (1, 1, 1, n_devices)
     parts = [int(p) for p in spec.lower().replace("x", " ").split()]
     if len(parts) == 1:
-        shape = (1, 1, parts[0])
+        shape = (1, 1, 1, parts[0])
     elif len(parts) == 2:
-        shape = (parts[0], 1, parts[1])
+        shape = (parts[0], 1, 1, parts[1])
     elif len(parts) == 3:
-        shape = (parts[0], parts[1], parts[2])
+        shape = (parts[0], 1, parts[1], parts[2])
+    elif len(parts) == 4:
+        shape = (parts[0], parts[1], parts[2], parts[3])
     else:
         raise ValueError(f"bad mesh spec {spec!r}")
     if int(np.prod(shape)) > n_devices:
@@ -38,9 +41,10 @@ def parse_mesh_spec(spec: str, n_devices: int) -> tuple[int, int, int]:
 
 
 def choose_mesh_shape(n_devices: int, num_kv_heads: int,
-                      num_experts: int = 0) -> tuple[int, int, int]:
-    """Pick (dp, ep, tp) automatically: as much tp as kv-head divisibility
-    allows (KV cache heads are tp-sharded), spill the rest to ep (MoE) or dp."""
+                      num_experts: int = 0) -> tuple[int, int, int, int]:
+    """Pick (dp, sp, ep, tp) automatically: as much tp as kv-head divisibility
+    allows (KV cache heads are tp-sharded), spill the rest to ep (MoE) or dp.
+    sp stays 1 unless requested explicitly — it pays off only at long context."""
     tp = 1
     for cand in range(min(n_devices, num_kv_heads), 0, -1):
         if n_devices % cand == 0 and num_kv_heads % cand == 0:
@@ -48,15 +52,17 @@ def choose_mesh_shape(n_devices: int, num_kv_heads: int,
             break
     rest = n_devices // tp
     if num_experts and num_experts % rest == 0:
-        return (1, rest, tp)
-    return (rest, 1, tp)
+        return (1, 1, rest, tp)
+    return (rest, 1, 1, tp)
 
 
 def build_mesh(spec: str = "", devices: list | None = None) -> Mesh:
-    """Build a (dp, ep, tp) Mesh; a spec smaller than the device count uses a
-    prefix of the devices (e.g. benchmarking tp=4 on an 8-chip host)."""
+    """Build a (dp, sp, ep, tp) Mesh; a spec smaller than the device count
+    uses a prefix of the devices (e.g. benchmarking tp=4 on an 8-chip host)."""
     devices = devices if devices is not None else jax.devices()
     shape = parse_mesh_spec(spec, len(devices)) if isinstance(spec, str) else spec
+    if len(shape) == 3:  # legacy (dp, ep, tp)
+        shape = (shape[0], 1, shape[1], shape[2])
     devices = devices[: int(np.prod(shape))]
     try:
         dev_array = mesh_utils.create_device_mesh(shape, devices=devices)

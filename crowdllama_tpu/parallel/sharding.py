@@ -16,7 +16,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from crowdllama_tpu.models.config import ModelConfig
-from crowdllama_tpu.parallel.mesh import AXIS_DP, AXIS_EP, AXIS_TP
+from crowdllama_tpu.parallel.mesh import AXIS_DP, AXIS_EP, AXIS_SP, AXIS_TP
 
 Params = dict[str, Any]
 
@@ -55,9 +55,13 @@ def param_pspecs(cfg: ModelConfig) -> Params:
     return specs
 
 
-def cache_pspec() -> P:
-    """KV cache [L, B, S, Hkv, Dh]: kv-heads on tp, slots on dp."""
-    return P(None, AXIS_DP, None, AXIS_TP, None)
+def cache_pspec(mesh: Mesh | None = None) -> P:
+    """KV cache [L, B, S, Hkv, Dh]: slots on dp, sequence on sp (size-1 sp
+    axis makes this a no-op), kv-heads on tp.  Axes absent from ``mesh``
+    (e.g. a caller-built legacy (dp, ep, tp) mesh) are dropped."""
+    def ax(name):
+        return name if mesh is None or name in mesh.shape else None
+    return P(None, ax(AXIS_DP), ax(AXIS_SP), ax(AXIS_TP), None)
 
 
 def shard_params(params: Params, cfg: ModelConfig, mesh: Mesh) -> Params:
@@ -73,4 +77,4 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 
 def cache_sharding(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, cache_pspec())
+    return NamedSharding(mesh, cache_pspec(mesh))
