@@ -21,6 +21,7 @@ import json
 import os
 import sys
 import time
+from dataclasses import replace
 
 import jax
 import numpy as np
@@ -38,7 +39,8 @@ def main() -> None:
     ctx = int(os.environ.get("CROWDLLAMA_BENCH_CTX", "1024"))
 
     cfg = get_config(model)
-    cfg = get_config(model, max_context_length=min(cfg.max_context_length, ctx))
+    if ctx < cfg.max_context_length:
+        cfg = replace(cfg, max_context_length=ctx)
     n_chips = max(1, len(jax.devices()))
 
     print(f"# bench: model={model} slots={slots} steps={steps} "

@@ -15,8 +15,21 @@ from __future__ import annotations
 import argparse
 import asyncio
 import logging
+import os
 import signal
 import sys
+
+# Honor JAX_PLATFORMS even when the interpreter pre-imported jax (some images
+# pin a platform via sitecustomize, which makes the env var alone too late) —
+# without this a worker asked to run a CPU-simulated multi-device mesh sees
+# only the pinned single chip.  Must happen before any jax backend init.
+if os.environ.get("JAX_PLATFORMS"):
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    except Exception:  # pragma: no cover - jax absent or already initialized
+        pass
 
 from crowdllama_tpu.config import Configuration
 from crowdllama_tpu.logutil import new_app_logger

@@ -47,8 +47,8 @@ Params = dict[str, Any]
 class DecodeState:
     """Per-slot decode state (a pytree; all arrays device-resident)."""
 
-    k_cache: jnp.ndarray   # [L, B, S, Hkv, Dh]
-    v_cache: jnp.ndarray   # [L, B, S, Hkv, Dh]
+    k_cache: jnp.ndarray   # [L, B, Hkv, S, Dh] — head-major (ops/attention.py)
+    v_cache: jnp.ndarray   # [L, B, Hkv, S, Dh]
     seq_lens: jnp.ndarray  # [B] int32 — tokens in cache (last token pending)
     tokens: jnp.ndarray    # [B] int32 — last sampled token per slot
     active: jnp.ndarray    # [B] bool
@@ -119,10 +119,10 @@ class ModelRunner:
 
         self._replicated = NamedSharding(mesh, P())
         self._cache_sharding = NamedSharding(mesh, cache_pspec(mesh))
-        # Prefill KV has batch dim 1 — sequence on sp, kv-heads on tp, no dp.
+        # Prefill KV [L, 1, Hkv, T, Dh] — kv-heads on tp, sequence on sp.
         sp_ax = AXIS_SP if AXIS_SP in mesh.shape else None
         self._prefill_kv_sharding = NamedSharding(
-            mesh, P(None, None, sp_ax, "tp", None))
+            mesh, P(None, None, "tp", sp_ax, None))
         self.buckets = [b for b in prefill_buckets(self.max_seq)
                         if b % self.sp == 0]
 
@@ -148,14 +148,15 @@ class ModelRunner:
         kv_valid = (jnp.arange(t) < plen)[None, :]
         logits, ks, vs = T.prefill(params, self.cfg, tokens, positions,
                                    kv_valid=kv_valid, sp_mesh=self._sp_mesh,
-                                   sp_batch_axis=None)
+                                   sp_batch_axis=None,
+                                   n_shards=self.mesh.size)
         last = logits[0, plen - 1]  # [V]
         tok = sample_tokens(last[None, :], temperature[None], top_p[None], key)[0]
         return tok, ks, vs
 
     def _insert_impl(self, state: DecodeState, slot, ks, vs, plen, first_token,
                      temperature, top_p) -> DecodeState:
-        """Write a prefilled sequence (ks/vs [L,1,T,...]) into ``slot``."""
+        """Write a prefilled sequence (ks/vs [L,1,Hkv,T,Dh]) into ``slot``."""
         k_cache = jax.lax.dynamic_update_slice(
             state.k_cache, ks.astype(state.k_cache.dtype), (0, slot, 0, 0, 0))
         v_cache = jax.lax.dynamic_update_slice(
@@ -197,6 +198,7 @@ class ModelRunner:
                 st.k_cache, st.v_cache,
                 jnp.minimum(st.seq_lens + 1, self.max_seq),
                 sp_mesh=self._sp_mesh, dp_axis=AXIS_DP,
+                n_shards=self.mesh.size,
             )
             key, sub = jax.random.split(st.key)
             next_tokens = sample_tokens(logits, st.temperature, st.top_p, sub)
@@ -218,7 +220,7 @@ class ModelRunner:
     def init_state(self, seed: int = 0) -> DecodeState:
         l, b, s = self.cfg.num_layers, self.max_slots, self.max_seq
         hkv, dh = self.cfg.num_kv_heads, self.cfg.resolved_head_dim()
-        shape = (l, b, s, hkv, dh)
+        shape = (l, b, hkv, s, dh)
         # Two distinct buffers: device_put of one array twice may alias, and
         # aliased k/v caches break donation in the jitted insert/decode.
         return DecodeState(

@@ -165,8 +165,12 @@ def prefill(
     kv_valid: jnp.ndarray | None = None,  # [B, T] bool; False for padding
     sp_mesh=None,            # Mesh → ring attention over its "sp" axis
     sp_batch_axis: str | None = None,  # mesh axis the batch dim is sharded on
+    n_shards: int = 1,       # total mesh devices (gates pallas dispatch)
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Full-prompt forward.  Returns (logits [B,T,V], k, v [L,B,T,Hkv,Dh]).
+    """Full-prompt forward.  Returns (logits [B,T,V], k, v [L,B,Hkv,T,Dh]).
+
+    KV comes back head-major (sequence contiguous per head) — the engine's
+    cache layout (see ops/attention.py module docstring).
 
     With ``sp_mesh`` the sequence dim is sharded over the mesh's ``sp`` axis
     and attention runs as a ppermute ring (ops/ring.py) — the long-context
@@ -188,15 +192,18 @@ def prefill(
         v = jnp.einsum("btd,dk->btk", h, lp["wv"]).reshape(b, t, hkv, dh)
         q = apply_rope(q, positions, cos, sin)
         k = apply_rope(k, positions, cos, sin)
+        kh = k.transpose(0, 2, 1, 3)  # [B, Hkv, T, Dh] — cache layout
+        vh = v.transpose(0, 2, 1, 3)
         if sp_mesh is not None:
             attn = ring_prefill_attention(
                 q, k, v, positions, scale, sp_mesh,
                 softcap=cfg.attn_logit_softcap, sliding_window=window,
                 kv_valid=kv_valid, dp_axis=sp_batch_axis)
         else:
-            attn = prefill_attention(q, k, v, positions, scale,
+            attn = prefill_attention(q, kh, vh, positions, scale,
                                      softcap=cfg.attn_logit_softcap,
-                                     sliding_window=window, kv_valid=kv_valid)
+                                     sliding_window=window, kv_valid=kv_valid,
+                                     n_shards=n_shards)
         attn = jnp.einsum("btk,kd->btd", attn.reshape(b, t, -1), lp["wo"])
         if cfg.post_norms:
             attn = rms_norm(attn, lp["post_ln1"], cfg.rms_norm_eps, plus_one=True)
@@ -206,11 +213,11 @@ def prefill(
         if cfg.post_norms:
             mlp_out = rms_norm(mlp_out, lp["post_ln2"], cfg.rms_norm_eps, plus_one=True)
         x = x + mlp_out
-        return x, (k, v)
+        return x, (kh, vh)
 
     x, (ks, vs) = jax.lax.scan(body, x, (params["layers"], windows))
     logits = _unembed(params, cfg, x)
-    return logits, ks, vs  # ks/vs: [L, B, T, Hkv, Dh]
+    return logits, ks, vs  # ks/vs: [L, B, Hkv, T, Dh]
 
 
 # ------------------------------------------------------------------- decode
@@ -220,11 +227,12 @@ def decode_step(
     cfg: ModelConfig,
     tokens: jnp.ndarray,     # [B] int32 — last sampled token per slot
     positions: jnp.ndarray,  # [B] int32 — position of this token
-    k_cache: jnp.ndarray,    # [L, B, S, Hkv, Dh]
-    v_cache: jnp.ndarray,    # [L, B, S, Hkv, Dh]
+    k_cache: jnp.ndarray,    # [L, B, Hkv, S, Dh]
+    v_cache: jnp.ndarray,    # [L, B, Hkv, S, Dh]
     seq_lens: jnp.ndarray,   # [B] valid lengths AFTER appending this token
     sp_mesh=None,            # Mesh → S-sharded cache + distributed decode
     dp_axis: str | None = "dp",
+    n_shards: int = 1,       # total mesh devices (gates pallas dispatch)
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One token per slot.  Returns (logits [B,V], k_cache, v_cache).
 
@@ -242,7 +250,7 @@ def decode_step(
     slot_idx = jnp.arange(b)
 
     def body(x, scanned):
-        lp, kc, vc, window = scanned  # kc/vc: [B, S, Hkv, Dh]
+        lp, kc, vc, window = scanned  # kc/vc: [B, Hkv, S, Dh]
         h = rms_norm(x, lp["ln1"], cfg.rms_norm_eps, plus_one=cfg.family == "gemma2")
         q = jnp.einsum("bd,dk->bk", h, lp["wq"]).reshape(b, cfg.num_heads, dh)
         k = jnp.einsum("bd,dk->bk", h, lp["wk"]).reshape(b, hkv, dh)
@@ -256,11 +264,13 @@ def decode_step(
                                        softcap=cfg.attn_logit_softcap,
                                        sliding_window=window, dp_axis=dp_axis)
         else:
-            kc = kc.at[slot_idx, positions].set(k)
-            vc = vc.at[slot_idx, positions].set(v)
+            # Mixed basic/advanced indexing: the broadcast [B] index pair
+            # fronts the result, so kc[slots, :, positions] is [B, Hkv, Dh].
+            kc = kc.at[slot_idx, :, positions].set(k)
+            vc = vc.at[slot_idx, :, positions].set(v)
             attn = decode_attention(q, kc, vc, seq_lens, scale,
                                     softcap=cfg.attn_logit_softcap,
-                                    sliding_window=window)
+                                    sliding_window=window, n_shards=n_shards)
         attn = jnp.einsum("bk,kd->bd", attn.reshape(b, -1), lp["wo"])
         if cfg.post_norms:
             attn = rms_norm(attn, lp["post_ln1"], cfg.rms_norm_eps, plus_one=True)

@@ -1,0 +1,18 @@
+"""Pallas TPU kernels for the attention hot path.
+
+``flash.py`` holds the flash-attention prefill and decode kernels; the
+portable jnp implementations in ``crowdllama_tpu.ops.attention`` remain the
+reference semantics (and the CPU fallback).
+"""
+
+from crowdllama_tpu.ops.pallas.flash import (
+    flash_decode_attention,
+    flash_prefill_attention,
+    pallas_supported,
+)
+
+__all__ = [
+    "flash_decode_attention",
+    "flash_prefill_attention",
+    "pallas_supported",
+]

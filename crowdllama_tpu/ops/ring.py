@@ -35,14 +35,25 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:  # jax >= 0.8: check_rep became check_vma
+try:
     from jax import shard_map as _shard_map
-
-    def shard_map(f, mesh, in_specs, out_specs, check_rep=False):
-        return _shard_map(f, mesh=mesh, in_specs=in_specs,
-                          out_specs=out_specs, check_vma=check_rep)
 except ImportError:  # older jax
-    from jax.experimental.shard_map import shard_map
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+# The replication-check kwarg was renamed check_rep -> check_vma (jax 0.8);
+# detect what this jax accepts instead of guessing from the import location.
+import inspect as _inspect
+
+_CHECK_KW = (
+    "check_vma"
+    if "check_vma" in _inspect.signature(_shard_map).parameters
+    else "check_rep"
+)
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_rep=False):
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **{_CHECK_KW: check_rep})
 
 from crowdllama_tpu.ops.attention import NEG_INF, _softcap
 
@@ -167,19 +178,20 @@ def ring_prefill_attention(
 def _sp_update_body(k_new, v_new, positions, k_cache, v_cache, shard_starts):
     """Write one new KV per slot into the S-sharded cache, shard-locally.
 
-    k_new/v_new: [B, Hkv, Dh]; positions: [B]; caches: [B, S/sp, Hkv, Dh].
+    k_new/v_new: [B, Hkv, Dh]; positions: [B]; caches: [B, Hkv, S/sp, Dh].
     Each device writes only when the absolute position lands in its shard.
     """
-    shard_len = k_cache.shape[1]
+    shard_len = k_cache.shape[2]
     local = positions - shard_starts[0]                  # [B]
     in_range = (local >= 0) & (local < shard_len)
     idx = jnp.clip(local, 0, shard_len - 1)
     b_idx = jnp.arange(k_cache.shape[0])
     sel = in_range[:, None, None]
-    k_cache = k_cache.at[b_idx, idx].set(
-        jnp.where(sel, k_new.astype(k_cache.dtype), k_cache[b_idx, idx]))
-    v_cache = v_cache.at[b_idx, idx].set(
-        jnp.where(sel, v_new.astype(v_cache.dtype), v_cache[b_idx, idx]))
+    # kc[b, :, idx[b]] — broadcast [B] advanced pair fronts: [B, Hkv, Dh].
+    k_cache = k_cache.at[b_idx, :, idx].set(
+        jnp.where(sel, k_new.astype(k_cache.dtype), k_cache[b_idx, :, idx]))
+    v_cache = v_cache.at[b_idx, :, idx].set(
+        jnp.where(sel, v_new.astype(v_cache.dtype), v_cache[b_idx, :, idx]))
     return k_cache, v_cache
 
 
@@ -187,7 +199,7 @@ def sp_cache_update(
     k_new: jnp.ndarray,      # [B, Hkv, Dh]
     v_new: jnp.ndarray,
     positions: jnp.ndarray,  # [B] absolute positions to write
-    k_cache: jnp.ndarray,    # [B, S, Hkv, Dh] — S sharded on sp (global view)
+    k_cache: jnp.ndarray,    # [B, Hkv, S, Dh] — S sharded on sp (global view)
     v_cache: jnp.ndarray,
     mesh: Mesh,
     *,
@@ -198,11 +210,11 @@ def sp_cache_update(
     """Scatter one token's KV into the sequence-sharded cache without any
     cross-shard communication (each sp rank masks to its own range)."""
     sp = mesh.shape[axis_name]
-    s = k_cache.shape[1]
+    s = k_cache.shape[2]
     assert s % sp == 0
     starts = jnp.arange(sp, dtype=jnp.int32) * (s // sp)
     newspec = P(dp_axis, tp_axis, None)
-    cspec = P(dp_axis, axis_name, tp_axis, None)
+    cspec = P(dp_axis, tp_axis, axis_name, None)
     return shard_map(
         _sp_update_body, mesh=mesh,
         in_specs=(newspec, newspec, P(dp_axis), cspec, cspec, P(axis_name)),
@@ -216,7 +228,7 @@ def _sp_decode_body(q, k_cache, v_cache, seq_lens, shard_starts, window, *,
                     num_kv_heads: int):
     """Local flash-decoding over an S/sp KV shard, merged with psum/pmax.
 
-    q: [B, H, Dh] (replicated over sp); k/v_cache: [B, S/sp, Hkv, Dh];
+    q: [B, H, Dh] (replicated over sp); k/v_cache: [B, Hkv, S/sp, Dh];
     shard_starts: [1] — absolute position of this shard's first cache slot.
     """
     b, h, dh = q.shape
@@ -224,10 +236,10 @@ def _sp_decode_body(q, k_cache, v_cache, seq_lens, shard_starts, window, *,
     qg = q.astype(jnp.float32).reshape(b, num_kv_heads, g, dh)
 
     logits = _softcap(
-        jnp.einsum("bhgd,bkhd->bhgk", qg, k_cache.astype(jnp.float32)) * scale,
+        jnp.einsum("bhgd,bhkd->bhgk", qg, k_cache.astype(jnp.float32)) * scale,
         softcap)
 
-    kpos = shard_starts[0] + jnp.arange(k_cache.shape[1])[None, :]  # [1, S/sp]
+    kpos = shard_starts[0] + jnp.arange(k_cache.shape[2])[None, :]  # [1, S/sp]
     valid = kpos < seq_lens[:, None]
     w = jnp.asarray(window)
     valid &= (w <= 0) | (kpos > (seq_lens[:, None] - 1) - w)
@@ -237,7 +249,7 @@ def _sp_decode_body(q, k_cache, v_cache, seq_lens, shard_starts, window, *,
     m = jax.lax.pmax(m_local, axis_name)
     p = jnp.exp(logits - m[..., None])
     l = jax.lax.psum(jnp.sum(p, axis=-1), axis_name)       # [B,Hkv,G]
-    o = jnp.einsum("bhgk,bkhd->bhgd", p, v_cache.astype(jnp.float32))
+    o = jnp.einsum("bhgk,bhkd->bhgd", p, v_cache.astype(jnp.float32))
     o = jax.lax.psum(o, axis_name)
     out = o / jnp.maximum(l, 1e-20)[..., None]
     return out.reshape(b, h, dh).astype(q.dtype)
@@ -245,7 +257,7 @@ def _sp_decode_body(q, k_cache, v_cache, seq_lens, shard_starts, window, *,
 
 def sp_decode_attention(
     q: jnp.ndarray,          # [B, H, Dh]
-    k_cache: jnp.ndarray,    # [B, S, Hkv, Dh] — S sharded on sp (global view)
+    k_cache: jnp.ndarray,    # [B, Hkv, S, Dh] — S sharded on sp (global view)
     v_cache: jnp.ndarray,
     seq_lens: jnp.ndarray,   # [B]
     scale: float,
@@ -259,10 +271,10 @@ def sp_decode_attention(
 ) -> jnp.ndarray:
     """Flash-decoding with the KV cache sequence-sharded over ``axis_name``."""
     tp_size = mesh.shape[tp_axis] if tp_axis else 1
-    assert k_cache.shape[2] % tp_size == 0, "kv heads must divide tp"
-    local_kv_heads = k_cache.shape[2] // tp_size  # body sees tp-local shards
+    assert k_cache.shape[1] % tp_size == 0, "kv heads must divide tp"
+    local_kv_heads = k_cache.shape[1] // tp_size  # body sees tp-local shards
     sp = mesh.shape[axis_name]
-    s = k_cache.shape[1]
+    s = k_cache.shape[2]
     assert s % sp == 0, f"cache length {s} not divisible by sp={sp}"
     shard_len = s // sp
     # Each sp shard's first absolute position, laid out [sp] and sharded so
@@ -274,7 +286,7 @@ def sp_decode_attention(
         num_kv_heads=local_kv_heads,
     )
     qspec = P(dp_axis, tp_axis, None)
-    cspec = P(dp_axis, axis_name, tp_axis, None)
+    cspec = P(dp_axis, tp_axis, axis_name, None)
     return shard_map(
         body, mesh=mesh,
         in_specs=(qspec, cspec, cspec, P(dp_axis), P(axis_name), P()),

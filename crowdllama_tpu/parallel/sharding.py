@@ -56,12 +56,13 @@ def param_pspecs(cfg: ModelConfig) -> Params:
 
 
 def cache_pspec(mesh: Mesh | None = None) -> P:
-    """KV cache [L, B, S, Hkv, Dh]: slots on dp, sequence on sp (size-1 sp
-    axis makes this a no-op), kv-heads on tp.  Axes absent from ``mesh``
+    """KV cache [L, B, Hkv, S, Dh] (head-major: per-head sequence planes are
+    contiguous — see ops/attention.py): slots on dp, kv-heads on tp, sequence
+    on sp (size-1 sp axis makes this a no-op).  Axes absent from ``mesh``
     (e.g. a caller-built legacy (dp, ep, tp) mesh) are dropped."""
     def ax(name):
         return name if mesh is None or name in mesh.shape else None
-    return P(None, ax(AXIS_DP), ax(AXIS_SP), ax(AXIS_TP), None)
+    return P(None, ax(AXIS_DP), ax(AXIS_TP), ax(AXIS_SP), None)
 
 
 def shard_params(params: Params, cfg: ModelConfig, mesh: Mesh) -> Params:

@@ -148,7 +148,7 @@ def test_prefill_padding_invariance():
     logits, ks_exact, _ = T.prefill(r.params, cfg, jnp.asarray([prompt]), pos)
     assert int(logits[0, -1].argmax()) == tok_bucketed
     np.testing.assert_allclose(
-        np.asarray(ks_bucketed[:, :, :5], np.float32),
+        np.asarray(ks_bucketed[:, :, :, :5], np.float32),
         np.asarray(ks_exact, np.float32), atol=2e-2)
 
 

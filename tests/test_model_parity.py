@@ -41,8 +41,8 @@ def _compare(cfg, hf_model, atol=8e-3):
         ).logits[:, -1].float().numpy()
     S = SEQ + 8
     L, hkv, dh = cfg.num_layers, cfg.num_kv_heads, cfg.resolved_head_dim()
-    kc = jnp.zeros((L, B, S, hkv, dh), jnp.float32).at[:, :, :SEQ].set(ks)
-    vc = jnp.zeros((L, B, S, hkv, dh), jnp.float32).at[:, :, :SEQ].set(vs)
+    kc = jnp.zeros((L, B, hkv, S, dh), jnp.float32).at[:, :, :, :SEQ].set(ks)
+    vc = jnp.zeros((L, B, hkv, S, dh), jnp.float32).at[:, :, :, :SEQ].set(vs)
     step_logits, _, _ = T.decode_step(
         params, cfg, jnp.asarray(nxt), jnp.full((B,), SEQ),
         kc, vc, jnp.full((B,), SEQ + 1),

@@ -1,0 +1,140 @@
+"""Pallas flash-attention kernels vs the jnp reference semantics.
+
+Runs the kernels in interpret mode (CROWDLLAMA_PALLAS_INTERPRET) on the CPU
+test platform — the same numerics the Mosaic-compiled kernel executes on TPU.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from crowdllama_tpu.ops.attention import (
+    decode_attention_ref,
+    prefill_attention_ref,
+)
+from crowdllama_tpu.ops.pallas.flash import (
+    _tile,
+    flash_decode_attention,
+    flash_prefill_attention,
+)
+
+
+@pytest.fixture(autouse=True)
+def _interpret_mode():
+    os.environ["CROWDLLAMA_PALLAS_INTERPRET"] = "1"
+    yield
+    os.environ.pop("CROWDLLAMA_PALLAS_INTERPRET", None)
+
+
+def _rand_qkv(key, b, t, h, hkv, dh, dtype=jnp.float32):
+    k1, k2, k3 = jax.random.split(key, 3)
+    q = jax.random.normal(k1, (b, t, h, dh), dtype)
+    k = jax.random.normal(k2, (b, hkv, t, dh), dtype)  # head-major layout
+    v = jax.random.normal(k3, (b, hkv, t, dh), dtype)
+    return q, k, v
+
+
+def test_tile_divisibility():
+    assert _tile(1024) == 512
+    assert _tile(96) == 32
+    assert _tile(8) == 8
+    assert _tile(1) == 1
+    assert _tile(256, cap=256) == 256
+
+
+@pytest.mark.parametrize("softcap,window", [(0.0, 0), (30.0, 0), (0.0, 5)])
+def test_prefill_matches_reference(softcap, window):
+    b, t, h, hkv, dh = 2, 64, 4, 2, 16
+    q, k, v = _rand_qkv(jax.random.PRNGKey(0), b, t, h, hkv, dh)
+    positions = jnp.broadcast_to(jnp.arange(t), (b, t)).astype(jnp.int32)
+    scale = dh ** -0.5
+
+    ref = prefill_attention_ref(q, k, v, positions, scale, softcap=softcap,
+                                sliding_window=window)
+    got = flash_prefill_attention(q, k, v, positions, scale, softcap=softcap,
+                                  sliding_window=window)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_prefill_with_clamped_padding_matches_reference():
+    """The serving path: positions clamped at plen-1, kv_valid masks padding."""
+    b, t, h, hkv, dh = 1, 64, 4, 4, 8
+    plen = 37
+    q, k, v = _rand_qkv(jax.random.PRNGKey(1), b, t, h, hkv, dh)
+    positions = jnp.minimum(jnp.arange(t)[None, :], plen - 1).astype(jnp.int32)
+    kv_valid = (jnp.arange(t) < plen)[None, :]
+    scale = dh ** -0.5
+
+    ref = prefill_attention_ref(q, k, v, positions, scale, kv_valid=kv_valid)
+    got = flash_prefill_attention(q, k, v, positions, scale, kv_valid=kv_valid)
+    np.testing.assert_allclose(np.asarray(got[:, :plen]),
+                               np.asarray(ref[:, :plen]),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_prefill_traced_window_scalar():
+    """sliding_window arrives as a traced int32 scalar inside lax.scan."""
+    b, t, h, hkv, dh = 1, 32, 2, 1, 8
+    q, k, v = _rand_qkv(jax.random.PRNGKey(2), b, t, h, hkv, dh)
+    positions = jnp.arange(t)[None, :].astype(jnp.int32)
+    scale = dh ** -0.5
+
+    def f(window):
+        return flash_prefill_attention(q, k, v, positions, scale,
+                                       sliding_window=window)
+
+    got = jax.jit(f)(jnp.int32(7))
+    ref = prefill_attention_ref(q, k, v, positions, scale, sliding_window=7)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("softcap,window", [(0.0, 0), (50.0, 0), (0.0, 9)])
+def test_decode_matches_reference(softcap, window):
+    b, s, h, hkv, dh = 4, 128, 8, 2, 16
+    key = jax.random.PRNGKey(3)
+    k1, k2, k3 = jax.random.split(key, 3)
+    q = jax.random.normal(k1, (b, h, dh))
+    kc = jax.random.normal(k2, (b, hkv, s, dh))
+    vc = jax.random.normal(k3, (b, hkv, s, dh))
+    seq_lens = jnp.asarray([1, 17, 64, 128], jnp.int32)
+    scale = dh ** -0.5
+
+    ref = decode_attention_ref(q, kc, vc, seq_lens, scale, softcap=softcap,
+                               sliding_window=window)
+    got = flash_decode_attention(q, kc, vc, seq_lens, scale, softcap=softcap,
+                                 sliding_window=window)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_decode_inactive_slot_is_finite_free():
+    """seq_len=0 slots produce zeros (not NaN/Inf) from the kernel."""
+    b, s, h, hkv, dh = 2, 64, 4, 2, 8
+    key = jax.random.PRNGKey(4)
+    q = jax.random.normal(key, (b, h, dh))
+    kc = jnp.zeros((b, hkv, s, dh))
+    vc = jnp.zeros((b, hkv, s, dh))
+    seq_lens = jnp.asarray([0, 5], jnp.int32)
+    out = flash_decode_attention(q, kc, vc, seq_lens, dh ** -0.5)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_decode_bf16():
+    b, s, h, hkv, dh = 2, 64, 4, 4, 32
+    key = jax.random.PRNGKey(5)
+    k1, k2, k3 = jax.random.split(key, 3)
+    q = jax.random.normal(k1, (b, h, dh), jnp.bfloat16)
+    kc = jax.random.normal(k2, (b, hkv, s, dh), jnp.bfloat16)
+    vc = jax.random.normal(k3, (b, hkv, s, dh), jnp.bfloat16)
+    seq_lens = jnp.asarray([33, 64], jnp.int32)
+    scale = dh ** -0.5
+    ref = decode_attention_ref(q, kc, vc, seq_lens, scale)
+    got = flash_decode_attention(q, kc, vc, seq_lens, scale)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(ref, np.float32),
+        rtol=2e-2, atol=2e-2)

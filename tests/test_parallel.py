@@ -39,8 +39,8 @@ def _run(cfg, params, mesh=None):
     pos = jnp.broadcast_to(jnp.arange(SEQ), (B, SEQ))
     logits, ks, vs = jax.jit(lambda p, t, po: T.prefill(p, cfg, t, po))(params, tokens, pos)
     L, hkv, dh = cfg.num_layers, cfg.num_kv_heads, cfg.resolved_head_dim()
-    kc = jnp.zeros((L, B, S, hkv, dh), jnp.float32).at[:, :, :SEQ].set(ks)
-    vc = jnp.zeros((L, B, S, hkv, dh), jnp.float32).at[:, :, :SEQ].set(vs)
+    kc = jnp.zeros((L, B, hkv, S, dh), jnp.float32).at[:, :, :, :SEQ].set(ks)
+    vc = jnp.zeros((L, B, hkv, S, dh), jnp.float32).at[:, :, :, :SEQ].set(vs)
     if mesh is not None:
         kc = jax.device_put(kc, cache_sharding(mesh))
         vc = jax.device_put(vc, cache_sharding(mesh))

@@ -38,8 +38,11 @@ def test_ring_prefill_matches_dense(spec, h, hkv, softcap, window):
     ]))
     scale = dh ** -0.5
 
-    want = prefill_attention(q, k, v, positions, scale, softcap=softcap,
-                             sliding_window=window, kv_valid=kv_valid)
+    # Dense reference takes head-major KV; ring takes sequence-major.
+    want = prefill_attention(q, k.transpose(0, 2, 1, 3),
+                             v.transpose(0, 2, 1, 3), positions, scale,
+                             softcap=softcap, sliding_window=window,
+                             kv_valid=kv_valid)
 
     mesh = build_mesh(spec)
     got = jax.jit(
@@ -62,8 +65,8 @@ def test_sp_decode_matches_dense(spec, h, hkv, softcap, window):
     b, s, dh = 2, 32, 8
     rng = np.random.default_rng(1)
     q = jnp.asarray(rng.standard_normal((b, h, dh)), jnp.float32)
-    kc = jnp.asarray(rng.standard_normal((b, s, hkv, dh)), jnp.float32)
-    vc = jnp.asarray(rng.standard_normal((b, s, hkv, dh)), jnp.float32)
+    kc = jnp.asarray(rng.standard_normal((b, hkv, s, dh)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((b, hkv, s, dh)), jnp.float32)
     seq_lens = jnp.asarray([s, 17], jnp.int32)  # one full, one partial
     scale = dh ** -0.5
 
