@@ -32,11 +32,18 @@ from crowdllama_tpu.models import transformer as T
 from crowdllama_tpu.models.config import ModelConfig
 from crowdllama_tpu.parallel.mesh import (
     AXIS_DP,
+    AXIS_PP,
     AXIS_SP,
+    AXIS_TP,
     build_mesh,
     choose_mesh_shape,
 )
-from crowdllama_tpu.parallel.sharding import cache_pspec, shard_params
+from crowdllama_tpu.parallel.pipeline import pp_decode_step, pp_prefill
+from crowdllama_tpu.parallel.sharding import (
+    cache_pspec,
+    filter_spec,
+    shard_params,
+)
 
 log = logging.getLogger("crowdllama.engine.runner")
 
@@ -112,6 +119,15 @@ class ModelRunner:
         if self.sp > 1:
             assert self.max_seq % self.sp == 0, (
                 f"max_seq {self.max_seq} must divide by sp={self.sp}")
+        # Pipeline parallelism: pp > 1 shards the layer stack and runs the
+        # ppermute microbatch pipeline (parallel/pipeline.py).  When pp == 1
+        # the layer dim of params/cache is simply unsharded and the plain
+        # scan paths run.
+        self.pp = mesh.shape.get(AXIS_PP, 1)
+        if self.pp > 1:
+            assert self.sp == 1, "pp × sp composition not supported yet"
+            assert cfg.num_layers % self.pp == 0, (
+                f"{cfg.num_layers} layers not divisible by pp={self.pp}")
 
         if params is None:
             params = T.init_params(cfg, jax.random.PRNGKey(seed), dtype=dtype)
@@ -119,10 +135,10 @@ class ModelRunner:
 
         self._replicated = NamedSharding(mesh, P())
         self._cache_sharding = NamedSharding(mesh, cache_pspec(mesh))
-        # Prefill KV [L, 1, Hkv, T, Dh] — kv-heads on tp, sequence on sp.
-        sp_ax = AXIS_SP if AXIS_SP in mesh.shape else None
+        # Prefill KV [L, 1, Hkv, T, Dh] — layers on pp, kv-heads on tp,
+        # sequence on sp.
         self._prefill_kv_sharding = NamedSharding(
-            mesh, P(None, None, "tp", sp_ax, None))
+            mesh, filter_spec(P(AXIS_PP, None, AXIS_TP, AXIS_SP, None), mesh))
         self.buckets = [b for b in prefill_buckets(self.max_seq)
                         if b % self.sp == 0]
 
@@ -146,10 +162,15 @@ class ModelRunner:
         # attention (clamped positions would otherwise pass the causal mask).
         positions = jnp.minimum(jnp.arange(t)[None, :], plen - 1)
         kv_valid = (jnp.arange(t) < plen)[None, :]
-        logits, ks, vs = T.prefill(params, self.cfg, tokens, positions,
-                                   kv_valid=kv_valid, sp_mesh=self._sp_mesh,
-                                   sp_batch_axis=None,
-                                   n_shards=self.mesh.size)
+        if self.pp > 1:
+            logits, ks, vs = pp_prefill(params, self.cfg, tokens, positions,
+                                        self.mesh, kv_valid=kv_valid)
+        else:
+            logits, ks, vs = T.prefill(params, self.cfg, tokens, positions,
+                                       kv_valid=kv_valid,
+                                       sp_mesh=self._sp_mesh,
+                                       sp_batch_axis=None,
+                                       n_shards=self.mesh.size)
         last = logits[0, plen - 1]  # [V]
         tok = sample_tokens(last[None, :], temperature[None], top_p[None], key)[0]
         return tok, ks, vs
@@ -193,13 +214,19 @@ class ModelRunner:
 
         def step(st: DecodeState, _):
             positions = jnp.minimum(st.seq_lens, self.max_seq - 1)
-            logits, k_cache, v_cache = T.decode_step(
-                params, self.cfg, st.tokens, positions,
-                st.k_cache, st.v_cache,
-                jnp.minimum(st.seq_lens + 1, self.max_seq),
-                sp_mesh=self._sp_mesh, dp_axis=AXIS_DP,
-                n_shards=self.mesh.size,
-            )
+            lens = jnp.minimum(st.seq_lens + 1, self.max_seq)
+            if self.pp > 1:
+                logits, k_cache, v_cache = pp_decode_step(
+                    params, self.cfg, st.tokens, positions,
+                    st.k_cache, st.v_cache, lens, self.mesh,
+                )
+            else:
+                logits, k_cache, v_cache = T.decode_step(
+                    params, self.cfg, st.tokens, positions,
+                    st.k_cache, st.v_cache, lens,
+                    sp_mesh=self._sp_mesh, dp_axis=AXIS_DP,
+                    n_shards=self.mesh.size,
+                )
             key, sub = jax.random.split(st.key)
             next_tokens = sample_tokens(logits, st.temperature, st.top_p, sub)
             next_tokens = jnp.where(st.active, next_tokens, 0)

@@ -157,32 +157,27 @@ def _layer_params(layers: Params, idx_or_slice) -> Params:
 
 # ------------------------------------------------------------------ prefill
 
-def prefill(
-    params: Params,
+def scan_prefill_layers(
+    layers: Params,          # stacked layer params, leading dim = #layers
+    windows: jnp.ndarray,    # per-layer sliding windows for those layers
     cfg: ModelConfig,
-    tokens: jnp.ndarray,     # [B, T] int32, padded
-    positions: jnp.ndarray,  # [B, T] int32; padding may repeat last pos
-    kv_valid: jnp.ndarray | None = None,  # [B, T] bool; False for padding
-    sp_mesh=None,            # Mesh → ring attention over its "sp" axis
-    sp_batch_axis: str | None = None,  # mesh axis the batch dim is sharded on
-    n_shards: int = 1,       # total mesh devices (gates pallas dispatch)
+    x: jnp.ndarray,          # [B, T, D] embedded input
+    positions: jnp.ndarray,  # [B, T]
+    kv_valid: jnp.ndarray | None = None,
+    sp_mesh=None,
+    sp_batch_axis: str | None = None,
+    n_shards: int = 1,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Full-prompt forward.  Returns (logits [B,T,V], k, v [L,B,Hkv,T,Dh]).
+    """Scan the decoder-layer body over ``layers``; returns (x, ks, vs).
 
-    KV comes back head-major (sequence contiguous per head) — the engine's
-    cache layout (see ops/attention.py module docstring).
-
-    With ``sp_mesh`` the sequence dim is sharded over the mesh's ``sp`` axis
-    and attention runs as a ppermute ring (ops/ring.py) — the long-context
-    path; T must be divisible by the sp axis size.
+    Factored out of :func:`prefill` so pipeline parallelism can run it over a
+    stage's local slice of the layer stack (parallel/pipeline.py).
     """
     dh = cfg.resolved_head_dim()
     hkv = cfg.num_kv_heads
     scale = attn_scale(cfg)
     cos, sin = rope_table(cfg.max_context_length, dh, cfg.rope_theta)
-    windows = layer_sliding_windows(cfg)
-    x = _embed(params, cfg, tokens)
-    b, t = tokens.shape
+    b, t = x.shape[0], x.shape[1]
 
     def body(x, scanned):
         lp, window = scanned
@@ -215,38 +210,64 @@ def prefill(
         x = x + mlp_out
         return x, (kh, vh)
 
-    x, (ks, vs) = jax.lax.scan(body, x, (params["layers"], windows))
+    x, (ks, vs) = jax.lax.scan(body, x, (layers, windows))
+    return x, ks, vs  # ks/vs: [L, B, Hkv, T, Dh]
+
+
+def prefill(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,     # [B, T] int32, padded
+    positions: jnp.ndarray,  # [B, T] int32; padding may repeat last pos
+    kv_valid: jnp.ndarray | None = None,  # [B, T] bool; False for padding
+    sp_mesh=None,            # Mesh → ring attention over its "sp" axis
+    sp_batch_axis: str | None = None,  # mesh axis the batch dim is sharded on
+    n_shards: int = 1,       # total mesh devices (gates pallas dispatch)
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Full-prompt forward.  Returns (logits [B,T,V], k, v [L,B,Hkv,T,Dh]).
+
+    KV comes back head-major (sequence contiguous per head) — the engine's
+    cache layout (see ops/attention.py module docstring).
+
+    With ``sp_mesh`` the sequence dim is sharded over the mesh's ``sp`` axis
+    and attention runs as a ppermute ring (ops/ring.py) — the long-context
+    path; T must be divisible by the sp axis size.
+    """
+    x = _embed(params, cfg, tokens)
+    x, ks, vs = scan_prefill_layers(
+        params["layers"], layer_sliding_windows(cfg), cfg, x, positions,
+        kv_valid=kv_valid, sp_mesh=sp_mesh, sp_batch_axis=sp_batch_axis,
+        n_shards=n_shards,
+    )
     logits = _unembed(params, cfg, x)
-    return logits, ks, vs  # ks/vs: [L, B, Hkv, T, Dh]
+    return logits, ks, vs
 
 
 # ------------------------------------------------------------------- decode
 
-def decode_step(
-    params: Params,
+def scan_decode_layers(
+    layers: Params,          # stacked layer params, leading dim = #layers
+    windows: jnp.ndarray,
     cfg: ModelConfig,
-    tokens: jnp.ndarray,     # [B] int32 — last sampled token per slot
-    positions: jnp.ndarray,  # [B] int32 — position of this token
-    k_cache: jnp.ndarray,    # [L, B, Hkv, S, Dh]
-    v_cache: jnp.ndarray,    # [L, B, Hkv, S, Dh]
-    seq_lens: jnp.ndarray,   # [B] valid lengths AFTER appending this token
-    sp_mesh=None,            # Mesh → S-sharded cache + distributed decode
+    x: jnp.ndarray,          # [B, D] embedded last tokens
+    positions: jnp.ndarray,  # [B]
+    k_cache: jnp.ndarray,    # [#layers, B, Hkv, S, Dh]
+    v_cache: jnp.ndarray,
+    seq_lens: jnp.ndarray,   # [B]
+    sp_mesh=None,
     dp_axis: str | None = "dp",
-    n_shards: int = 1,       # total mesh devices (gates pallas dispatch)
+    n_shards: int = 1,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """One token per slot.  Returns (logits [B,V], k_cache, v_cache).
+    """Scan the decode-layer body over ``layers``; returns (x, kc, vc).
 
-    With ``sp_mesh`` the KV cache's sequence dim is sharded over ``sp``: the
-    new token's KV is written shard-locally and attention is flash-decoding
-    merged with pmax/psum (ops/ring.py).
+    Factored out of :func:`decode_step` for pipeline parallelism
+    (parallel/pipeline.py runs it over a stage's local layers + cache slice).
     """
     dh = cfg.resolved_head_dim()
     hkv = cfg.num_kv_heads
     scale = attn_scale(cfg)
     cos, sin = rope_table(cfg.max_context_length, dh, cfg.rope_theta)
-    windows = layer_sliding_windows(cfg)
-    x = _embed(params, cfg, tokens)  # [B, D]
-    b = tokens.shape[0]
+    b = x.shape[0]
     slot_idx = jnp.arange(b)
 
     def body(x, scanned):
@@ -283,7 +304,34 @@ def decode_step(
         return x, (kc, vc)
 
     x, (k_cache, v_cache) = jax.lax.scan(
-        body, x, (params["layers"], k_cache, v_cache, windows)
+        body, x, (layers, k_cache, v_cache, windows)
+    )
+    return x, k_cache, v_cache
+
+
+def decode_step(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,     # [B] int32 — last sampled token per slot
+    positions: jnp.ndarray,  # [B] int32 — position of this token
+    k_cache: jnp.ndarray,    # [L, B, Hkv, S, Dh]
+    v_cache: jnp.ndarray,    # [L, B, Hkv, S, Dh]
+    seq_lens: jnp.ndarray,   # [B] valid lengths AFTER appending this token
+    sp_mesh=None,            # Mesh → S-sharded cache + distributed decode
+    dp_axis: str | None = "dp",
+    n_shards: int = 1,       # total mesh devices (gates pallas dispatch)
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One token per slot.  Returns (logits [B,V], k_cache, v_cache).
+
+    With ``sp_mesh`` the KV cache's sequence dim is sharded over ``sp``: the
+    new token's KV is written shard-locally and attention is flash-decoding
+    merged with pmax/psum (ops/ring.py).
+    """
+    x = _embed(params, cfg, tokens)  # [B, D]
+    x, k_cache, v_cache = scan_decode_layers(
+        params["layers"], layer_sliding_windows(cfg), cfg, x, positions,
+        k_cache, v_cache, seq_lens, sp_mesh=sp_mesh, dp_axis=dp_axis,
+        n_shards=n_shards,
     )
     logits = _unembed(params, cfg, x)
     return logits, k_cache, v_cache

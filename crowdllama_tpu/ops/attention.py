@@ -123,14 +123,13 @@ def decode_attention(
     CROWDLLAMA_PALLAS_DECODE=1 to opt in (e.g. for compute-heavy softcap or
     window configs); a grid-tiled KV kernel is future work.
     """
-    import os
-
     from crowdllama_tpu.ops.pallas.flash import (
         flash_decode_attention,
         pallas_supported,
     )
+    from crowdllama_tpu.utils.env import env_flag
 
-    if (os.environ.get("CROWDLLAMA_PALLAS_DECODE")
+    if (env_flag("CROWDLLAMA_PALLAS_DECODE")
             and pallas_supported(k_cache.shape[2], k_cache.shape[3],
                                  k_cache.dtype.itemsize, n_shards)):
         return flash_decode_attention(

@@ -26,7 +26,6 @@ replaces that delegation.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
@@ -34,6 +33,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from crowdllama_tpu.ops.attention import NEG_INF, _softcap
+from crowdllama_tpu.utils.env import env_flag
 
 # Each (batch, head) program keeps its full K and V rows resident in VMEM
 # (BlockSpecs below); cap their combined footprint well under the ~16 MB of
@@ -49,7 +49,7 @@ def pallas_supported(seq_len: int, head_dim: int, itemsize: int = 2,
     callers stay on the XLA path until the kernels are shard_map-wrapped),
     a hardware-sized tile (≥32; odd/prime extents would degenerate), and
     K+V rows fitting the VMEM budget."""
-    if os.environ.get("CROWDLLAMA_NO_PALLAS"):
+    if env_flag("CROWDLLAMA_NO_PALLAS"):
         return False
     if not _interpret() and jax.default_backend() != "tpu":
         return False
@@ -61,7 +61,7 @@ def pallas_supported(seq_len: int, head_dim: int, itemsize: int = 2,
 
 
 def _interpret() -> bool:
-    return bool(os.environ.get("CROWDLLAMA_PALLAS_INTERPRET"))
+    return env_flag("CROWDLLAMA_PALLAS_INTERPRET")
 
 
 def _tile(extent: int, cap: int = 512) -> int:

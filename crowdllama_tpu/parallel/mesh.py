@@ -1,10 +1,13 @@
 """Mesh construction over local TPU devices.
 
-Axes: ``dp`` (data/batch slots), ``sp`` (sequence/context — ring attention
-and sharded KV cache), ``ep`` (experts, MoE), ``tp`` (tensor).  A spec string
-maps onto the trailing axes: "A" → tp=A; "AxB" → dp=A, tp=B; "AxBxC" → dp=A,
-ep=B, tp=C; "AxBxCxD" → dp=A, sp=B, ep=C, tp=D.  ICI topology is respected
-via mesh_utils.create_device_mesh when available.
+Axes: ``dp`` (data/batch slots), ``pp`` (pipeline stages — the layer stack is
+sharded on its leading axis and stages exchange activations via ppermute,
+parallel/pipeline.py), ``sp`` (sequence/context — ring attention and sharded
+KV cache), ``ep`` (experts, MoE), ``tp`` (tensor).  A spec string maps onto
+the trailing axes: "A" → tp=A; "AxB" → dp=A, tp=B; "AxBxC" → dp=A, ep=B,
+tp=C; "AxBxCxD" → dp=A, sp=B, ep=C, tp=D; "AxBxCxDxE" → dp=A, pp=B, sp=C,
+ep=D, tp=E.  ICI topology is respected via mesh_utils.create_device_mesh
+when available.
 """
 
 from __future__ import annotations
@@ -14,22 +17,25 @@ import numpy as np
 from jax.experimental import mesh_utils
 from jax.sharding import Mesh
 
-AXIS_DP, AXIS_SP, AXIS_EP, AXIS_TP = "dp", "sp", "ep", "tp"
-AXES = (AXIS_DP, AXIS_SP, AXIS_EP, AXIS_TP)
+AXIS_DP, AXIS_PP, AXIS_SP, AXIS_EP, AXIS_TP = "dp", "pp", "sp", "ep", "tp"
+AXES = (AXIS_DP, AXIS_PP, AXIS_SP, AXIS_EP, AXIS_TP)
 
 
-def parse_mesh_spec(spec: str, n_devices: int) -> tuple[int, int, int, int]:
+def parse_mesh_spec(spec: str, n_devices: int) -> tuple[int, int, int, int, int]:
+    """Parse "AxB..." into a (dp, pp, sp, ep, tp) shape."""
     if not spec:
-        return (1, 1, 1, n_devices)
+        return (1, 1, 1, 1, n_devices)
     parts = [int(p) for p in spec.lower().replace("x", " ").split()]
     if len(parts) == 1:
-        shape = (1, 1, 1, parts[0])
+        shape = (1, 1, 1, 1, parts[0])
     elif len(parts) == 2:
-        shape = (parts[0], 1, 1, parts[1])
+        shape = (parts[0], 1, 1, 1, parts[1])
     elif len(parts) == 3:
-        shape = (parts[0], 1, parts[1], parts[2])
+        shape = (parts[0], 1, 1, parts[1], parts[2])
     elif len(parts) == 4:
-        shape = (parts[0], parts[1], parts[2], parts[3])
+        shape = (parts[0], 1, parts[1], parts[2], parts[3])
+    elif len(parts) == 5:
+        shape = tuple(parts)
     else:
         raise ValueError(f"bad mesh spec {spec!r}")
     if int(np.prod(shape)) > n_devices:
@@ -41,10 +47,11 @@ def parse_mesh_spec(spec: str, n_devices: int) -> tuple[int, int, int, int]:
 
 
 def choose_mesh_shape(n_devices: int, num_kv_heads: int,
-                      num_experts: int = 0) -> tuple[int, int, int, int]:
-    """Pick (dp, sp, ep, tp) automatically: as much tp as kv-head divisibility
-    allows (KV cache heads are tp-sharded), spill the rest to ep (MoE) or dp.
-    sp stays 1 unless requested explicitly — it pays off only at long context."""
+                      num_experts: int = 0) -> tuple[int, int, int, int, int]:
+    """Pick (dp, pp, sp, ep, tp) automatically: as much tp as kv-head
+    divisibility allows (KV cache heads are tp-sharded), spill the rest to ep
+    (MoE) or dp.  pp/sp stay 1 unless requested explicitly — pipelining pays
+    off only when tp runs out of head divisibility, sp only at long context."""
     tp = 1
     for cand in range(min(n_devices, num_kv_heads), 0, -1):
         if n_devices % cand == 0 and num_kv_heads % cand == 0:
@@ -52,17 +59,26 @@ def choose_mesh_shape(n_devices: int, num_kv_heads: int,
             break
     rest = n_devices // tp
     if num_experts and num_experts % rest == 0:
-        return (1, 1, rest, tp)
-    return (rest, 1, 1, tp)
+        return (1, 1, 1, rest, tp)
+    return (rest, 1, 1, 1, tp)
+
+
+def _normalize_shape(shape) -> tuple[int, ...]:
+    """Legacy spec tuples: 3 = (dp, ep, tp), 4 = (dp, sp, ep, tp)."""
+    if len(shape) == 3:
+        return (shape[0], 1, 1, shape[1], shape[2])
+    if len(shape) == 4:
+        return (shape[0], 1, shape[1], shape[2], shape[3])
+    return tuple(shape)
 
 
 def build_mesh(spec: str = "", devices: list | None = None) -> Mesh:
-    """Build a (dp, sp, ep, tp) Mesh; a spec smaller than the device count
-    uses a prefix of the devices (e.g. benchmarking tp=4 on an 8-chip host)."""
+    """Build a (dp, pp, sp, ep, tp) Mesh; a spec smaller than the device
+    count uses a prefix of the devices (e.g. benchmarking tp=4 on an 8-chip
+    host)."""
     devices = devices if devices is not None else jax.devices()
     shape = parse_mesh_spec(spec, len(devices)) if isinstance(spec, str) else spec
-    if len(shape) == 3:  # legacy (dp, ep, tp)
-        shape = (shape[0], 1, shape[1], shape[2])
+    shape = _normalize_shape(shape)
     devices = devices[: int(np.prod(shape))]
     try:
         dev_array = mesh_utils.create_device_mesh(shape, devices=devices)

@@ -5,7 +5,10 @@ the collectives: attention QKV and MLP up/gate are column-parallel (output
 dim on ``tp``), attention output and MLP down are row-parallel (input dim on
 ``tp``) — each layer then needs exactly one psum after wo and one after
 w_down, which GSPMD derives automatically.  MoE expert banks additionally
-shard the expert dim on ``ep``.  KV caches shard kv-heads on ``tp``.
+shard the expert dim on ``ep``.  Layer-stacked params shard their leading
+layer axis on ``pp`` (pipeline stages own contiguous layer slices,
+parallel/pipeline.py).  KV caches shard kv-heads on ``tp`` and layers on
+``pp``.
 """
 
 from __future__ import annotations
@@ -16,7 +19,13 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from crowdllama_tpu.models.config import ModelConfig
-from crowdllama_tpu.parallel.mesh import AXIS_DP, AXIS_EP, AXIS_SP, AXIS_TP
+from crowdllama_tpu.parallel.mesh import (
+    AXIS_DP,
+    AXIS_EP,
+    AXIS_PP,
+    AXIS_SP,
+    AXIS_TP,
+)
 
 Params = dict[str, Any]
 
@@ -24,27 +33,27 @@ Params = dict[str, Any]
 def param_pspecs(cfg: ModelConfig) -> Params:
     """PartitionSpec pytree mirroring models.transformer.init_params."""
     layers: Params = {
-        "ln1": P(),
-        "ln2": P(),
+        "ln1": P(AXIS_PP, None),
+        "ln2": P(AXIS_PP, None),
         # [L, D, H*Dh] column-parallel
-        "wq": P(None, None, AXIS_TP),
-        "wk": P(None, None, AXIS_TP),
-        "wv": P(None, None, AXIS_TP),
+        "wq": P(AXIS_PP, None, AXIS_TP),
+        "wk": P(AXIS_PP, None, AXIS_TP),
+        "wv": P(AXIS_PP, None, AXIS_TP),
         # [L, H*Dh, D] row-parallel
-        "wo": P(None, AXIS_TP, None),
+        "wo": P(AXIS_PP, AXIS_TP, None),
     }
     if cfg.is_moe:
-        layers["router"] = P()
-        layers["w_gate"] = P(None, AXIS_EP, None, AXIS_TP)  # [L,E,D,F]
-        layers["w_up"] = P(None, AXIS_EP, None, AXIS_TP)
-        layers["w_down"] = P(None, AXIS_EP, AXIS_TP, None)  # [L,E,F,D]
+        layers["router"] = P(AXIS_PP, None, None)
+        layers["w_gate"] = P(AXIS_PP, AXIS_EP, None, AXIS_TP)  # [L,E,D,F]
+        layers["w_up"] = P(AXIS_PP, AXIS_EP, None, AXIS_TP)
+        layers["w_down"] = P(AXIS_PP, AXIS_EP, AXIS_TP, None)  # [L,E,F,D]
     else:
-        layers["w_gate"] = P(None, None, AXIS_TP)  # [L,D,F]
-        layers["w_up"] = P(None, None, AXIS_TP)
-        layers["w_down"] = P(None, AXIS_TP, None)  # [L,F,D]
+        layers["w_gate"] = P(AXIS_PP, None, AXIS_TP)  # [L,D,F]
+        layers["w_up"] = P(AXIS_PP, None, AXIS_TP)
+        layers["w_down"] = P(AXIS_PP, AXIS_TP, None)  # [L,F,D]
     if cfg.post_norms:
-        layers["post_ln1"] = P()
-        layers["post_ln2"] = P()
+        layers["post_ln1"] = P(AXIS_PP, None)
+        layers["post_ln2"] = P(AXIS_PP, None)
     specs: Params = {
         "embed": P(AXIS_TP, None),  # [V, D] vocab-sharded
         "layers": layers,
@@ -55,21 +64,28 @@ def param_pspecs(cfg: ModelConfig) -> Params:
     return specs
 
 
+def filter_spec(spec: P, mesh: Mesh | None) -> P:
+    """Drop axis names absent from ``mesh`` (legacy caller-built meshes)."""
+    if mesh is None:
+        return spec
+    return P(*(ax if ax is None or ax in mesh.shape else None for ax in spec))
+
+
 def cache_pspec(mesh: Mesh | None = None) -> P:
     """KV cache [L, B, Hkv, S, Dh] (head-major: per-head sequence planes are
-    contiguous — see ops/attention.py): slots on dp, kv-heads on tp, sequence
-    on sp (size-1 sp axis makes this a no-op).  Axes absent from ``mesh``
-    (e.g. a caller-built legacy (dp, ep, tp) mesh) are dropped."""
-    def ax(name):
-        return name if mesh is None or name in mesh.shape else None
-    return P(None, ax(AXIS_DP), ax(AXIS_TP), ax(AXIS_SP), None)
+    contiguous — see ops/attention.py): layers on pp, slots on dp, kv-heads
+    on tp, sequence on sp (size-1 axes make those no-ops).  Axes absent from
+    ``mesh`` are dropped."""
+    return filter_spec(P(AXIS_PP, AXIS_DP, AXIS_TP, AXIS_SP, None), mesh)
 
 
 def shard_params(params: Params, cfg: ModelConfig, mesh: Mesh) -> Params:
-    """Place a param pytree onto the mesh with the TP/EP partition rules."""
+    """Place a param pytree onto the mesh with the PP/TP/EP partition rules."""
     specs = param_pspecs(cfg)
     return jax.tree_util.tree_map(
-        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)), params, specs
+        lambda a, s: jax.device_put(
+            a, NamedSharding(mesh, filter_spec(s, mesh))),
+        params, specs,
     )
 
 

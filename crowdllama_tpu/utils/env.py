@@ -1,0 +1,14 @@
+"""Environment-variable helpers."""
+
+from __future__ import annotations
+
+import os
+
+_FALSY = {"", "0", "false", "no", "off"}
+
+
+def env_flag(name: str) -> bool:
+    """Boolean env flag: unset, "", "0", "false", "no", "off" are False;
+    anything else is True (so both ``FLAG=1`` and ``FLAG=0`` do what the
+    operator expects)."""
+    return os.environ.get(name, "").strip().lower() not in _FALSY

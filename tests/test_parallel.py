@@ -16,18 +16,19 @@ from crowdllama_tpu.parallel.sharding import cache_sharding, shard_params
 
 
 def test_parse_mesh_spec():
-    assert parse_mesh_spec("", 8) == (1, 1, 1, 8)
-    assert parse_mesh_spec("2x4", 8) == (2, 1, 1, 4)
-    assert parse_mesh_spec("2x2x2", 8) == (2, 1, 2, 2)
-    assert parse_mesh_spec("1x2x2x2", 8) == (1, 2, 2, 2)
+    assert parse_mesh_spec("", 8) == (1, 1, 1, 1, 8)
+    assert parse_mesh_spec("2x4", 8) == (2, 1, 1, 1, 4)
+    assert parse_mesh_spec("2x2x2", 8) == (2, 1, 1, 2, 2)
+    assert parse_mesh_spec("1x2x2x2", 8) == (1, 1, 2, 2, 2)
+    assert parse_mesh_spec("1x2x1x2x2", 8) == (1, 2, 1, 2, 2)
     with pytest.raises(ValueError):
         parse_mesh_spec("3x3", 8)
 
 
 def test_choose_mesh_shape():
-    assert choose_mesh_shape(8, num_kv_heads=8) == (1, 1, 1, 8)
-    assert choose_mesh_shape(8, num_kv_heads=2) == (4, 1, 1, 2)
-    assert choose_mesh_shape(8, num_kv_heads=2, num_experts=4) == (1, 1, 4, 2)
+    assert choose_mesh_shape(8, num_kv_heads=8) == (1, 1, 1, 1, 8)
+    assert choose_mesh_shape(8, num_kv_heads=2) == (4, 1, 1, 1, 2)
+    assert choose_mesh_shape(8, num_kv_heads=2, num_experts=4) == (1, 1, 1, 4, 2)
 
 
 def _run(cfg, params, mesh=None):
