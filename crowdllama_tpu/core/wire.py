@@ -82,3 +82,81 @@ def _recvexact(sock: socket.socket, n: int) -> bytes:
             raise WireError("stream closed mid-frame")
         buf.extend(chunk)
     return bytes(buf)
+
+
+# ----------------------------------------------------------- batch scanning
+
+def scan_frames(buf: bytes | bytearray | memoryview) -> tuple[list[bytes], int]:
+    """Extract every complete frame payload from ``buf``.
+
+    Returns (payloads, consumed_bytes); bytes past ``consumed`` are an
+    incomplete trailing frame the caller should retain.  Raises WireError on
+    a frame declaring a length over the 10 MB cap (pbwire.go:53 semantics).
+    Uses the C++ scanner (native/_src/crowdllama_native.cpp) when available.
+    """
+    data = bytes(buf)
+    from crowdllama_tpu import native as _native
+
+    lib = _native.load()
+    if lib is not None:
+        import ctypes
+
+        max_frames = max(1, len(data) // 4)
+        offs = (ctypes.c_uint32 * max_frames)()
+        sizes = (ctypes.c_uint32 * max_frames)()
+        consumed = ctypes.c_size_t(0)
+        n = lib.cl_frame_scan(data, len(data), MAX_MESSAGE_SIZE, offs, sizes,
+                              max_frames, ctypes.byref(consumed))
+        if n < 0:
+            raise WireError("frame exceeds maximum size")
+        return ([data[offs[i]:offs[i] + sizes[i]] for i in range(n)],
+                consumed.value)
+
+    payloads: list[bytes] = []
+    pos = 0
+    while pos + _LEN.size <= len(data):
+        (length,) = _LEN.unpack_from(data, pos)
+        if length > MAX_MESSAGE_SIZE:
+            raise WireError("frame exceeds maximum size")
+        if pos + _LEN.size + length > len(data):
+            break
+        payloads.append(data[pos + _LEN.size:pos + _LEN.size + length])
+        pos += _LEN.size + length
+    return payloads, pos
+
+
+class SyncFrameReader:
+    """Buffered multi-frame reader for blocking sockets: one recv can yield
+    many frames (a streaming response is one frame per token chunk), scanned
+    in a single pass instead of two recvs per frame.
+
+    The scan only runs once the header-declared first frame is complete, so
+    receiving a large frame in many small recvs stays linear (no per-recv
+    rescans of the accumulated buffer)."""
+
+    def __init__(self, sock: socket.socket, recv_size: int = 65536):
+        self._sock = sock
+        self._recv_size = recv_size
+        self._buf = bytearray()
+        self._ready: list[bytes] = []
+
+    def _first_frame_complete(self) -> bool:
+        if len(self._buf) < _LEN.size:
+            return False
+        (length,) = _LEN.unpack_from(self._buf, 0)
+        if length > MAX_MESSAGE_SIZE:
+            raise WireError("frame exceeds maximum size")
+        return len(self._buf) >= _LEN.size + length
+
+    def read_message(self) -> pb.BaseMessage:
+        while not self._ready:
+            if self._first_frame_complete():
+                payloads, consumed = scan_frames(self._buf)
+                del self._buf[:consumed]
+                self._ready.extend(payloads)
+                continue
+            chunk = self._sock.recv(self._recv_size)
+            if not chunk:
+                raise WireError("stream closed mid-frame")
+            self._buf.extend(chunk)
+        return decode_payload(self._ready.pop(0))

@@ -1,0 +1,107 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference's runtime is compiled Go; these are the framework's C++
+equivalents for the control-plane hot paths (wire frame scanning, Kademlia
+routing table — see _src/crowdllama_native.cpp).  The library is compiled
+on demand with g++ into ``_build/`` keyed by a source hash; every consumer
+falls back to pure Python when the toolchain or a prior build is
+unavailable, so the package works without a compiler.
+
+Set CROWDLLAMA_NO_NATIVE=1 to force the Python fallbacks.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import subprocess
+import threading
+from pathlib import Path
+
+from crowdllama_tpu.utils.env import env_flag
+
+log = logging.getLogger("crowdllama.native")
+
+_SRC = Path(__file__).parent / "_src" / "crowdllama_native.cpp"
+_BUILD_DIR = Path(__file__).parent / "_build"
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_load_attempted = False
+
+ID_BYTES = 32
+
+
+def _compile(src: Path, out: Path) -> None:
+    out.parent.mkdir(parents=True, exist_ok=True)
+    # Unique tmp per process: concurrent first-run compiles must not clobber
+    # each other's output mid-write (the final replace is atomic).
+    tmp = out.with_suffix(f".so.tmp.{os.getpid()}")
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", str(tmp),
+             str(src)],
+            check=True, capture_output=True, timeout=120,
+        )
+        tmp.replace(out)
+    finally:
+        tmp.unlink(missing_ok=True)
+
+
+def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.cl_frame_scan.restype = ctypes.c_long
+    lib.cl_frame_scan.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint32),
+        ctypes.c_size_t, ctypes.POINTER(ctypes.c_size_t),
+    ]
+    lib.cl_rt_new.restype = ctypes.c_void_p
+    lib.cl_rt_new.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.cl_rt_free.restype = None
+    lib.cl_rt_free.argtypes = [ctypes.c_void_p]
+    lib.cl_rt_upsert.restype = ctypes.c_int
+    lib.cl_rt_upsert.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 u8p, ctypes.POINTER(ctypes.c_int)]
+    lib.cl_rt_remove.restype = ctypes.c_int
+    lib.cl_rt_remove.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.cl_rt_size.restype = ctypes.c_long
+    lib.cl_rt_size.argtypes = [ctypes.c_void_p]
+    lib.cl_rt_closest.restype = ctypes.c_long
+    lib.cl_rt_closest.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_int, u8p]
+    lib.cl_rt_dump.restype = ctypes.c_long
+    lib.cl_rt_dump.argtypes = [ctypes.c_void_p, u8p, ctypes.c_long]
+    return lib
+
+
+def load() -> ctypes.CDLL | None:
+    """Build (if needed) and load the native library; None on any failure."""
+    global _lib, _load_attempted
+    if env_flag("CROWDLLAMA_NO_NATIVE"):
+        return None
+    with _lock:
+        if _load_attempted:
+            return _lib
+        _load_attempted = True
+        try:
+            src_hash = hashlib.sha256(_SRC.read_bytes()).hexdigest()[:16]
+            so = _BUILD_DIR / f"crowdllama_native-{src_hash}.so"
+            if not so.exists():
+                _compile(_SRC, so)
+            try:
+                _lib = _declare(ctypes.CDLL(str(so)))
+            except OSError:
+                # A corrupt cached artifact must not poison the cache
+                # forever: drop it and rebuild once.
+                so.unlink(missing_ok=True)
+                _compile(_SRC, so)
+                _lib = _declare(ctypes.CDLL(str(so)))
+            log.debug("native library loaded: %s", so.name)
+        except Exception as e:  # no g++, compile error, load error → fallback
+            log.info("native library unavailable (%s); using Python fallbacks",
+                     e.__class__.__name__)
+            _lib = None
+        return _lib

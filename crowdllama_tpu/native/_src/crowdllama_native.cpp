@@ -1,0 +1,186 @@
+// Native runtime components for crowdllama-tpu.
+//
+// The reference implementation's runtime (wire framing, Kademlia routing)
+// is compiled Go (/root/reference/pkg/crowdllama/pbwire.go, go-libp2p-kad-dht);
+// these are the TPU-framework equivalents in C++, loaded via ctypes with a
+// pure-Python fallback (crowdllama_tpu/native/__init__.py).
+//
+// Exposed C ABI:
+//   - cl_frame_scan:   batch-scan length-prefixed frames in a buffer
+//   - cl_rt_*:         256-bucket XOR-metric Kademlia routing table
+//
+// The routing table mirrors net/dht.py's semantics bit-for-bit: bucket index
+// is bit_length(xor(self, id)) - 1, buckets hold at most k entries ordered
+// least-recently-seen first, refresh moves an entry to the back, insertion
+// into a full bucket evicts the front (LRS).
+
+#include <algorithm>
+#include <array>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr int kIdBytes = 32;
+constexpr int kIdBits = kIdBytes * 8;
+
+using Id = std::array<uint8_t, kIdBytes>;
+
+Id make_id(const uint8_t* p) {
+    Id id;
+    std::memcpy(id.data(), p, kIdBytes);
+    return id;
+}
+
+Id xor_id(const Id& a, const Id& b) {
+    Id out;
+    for (int i = 0; i < kIdBytes; ++i) out[i] = a[i] ^ b[i];
+    return out;
+}
+
+// bit_length(xor) - 1, i.e. index of the highest set bit (0-based from the
+// least significant end), or 0 for a zero distance — matches
+// net/dht.py RoutingTable._bucket_index.
+int bucket_index(const Id& d) {
+    for (int byte = 0; byte < kIdBytes; ++byte) {
+        if (d[byte] != 0) {
+            int msb = 31 - __builtin_clz(static_cast<uint32_t>(d[byte]));
+            return (kIdBytes - 1 - byte) * 8 + msb;
+        }
+    }
+    return 0;
+}
+
+// Big-endian lexicographic compare == numeric compare of 256-bit ints.
+bool id_less(const Id& a, const Id& b) {
+    return std::memcmp(a.data(), b.data(), kIdBytes) < 0;
+}
+
+struct RoutingTable {
+    Id self_id;
+    int k;
+    std::vector<std::vector<Id>> buckets;
+
+    RoutingTable(const Id& self, int kk) : self_id(self), k(kk), buckets(kIdBits) {}
+};
+
+}  // namespace
+
+extern "C" {
+
+// Scan `buf[0:len)` for complete [4-byte BE length][payload] frames.
+// Writes payload offsets/sizes for up to `max_frames` frames, sets
+// `*consumed` to the total bytes of the frames returned, and returns the
+// frame count.  Returns -1 if any frame declares a length > max_size
+// (protocol violation; connection should be dropped).
+long cl_frame_scan(const uint8_t* buf, size_t len, uint32_t max_size,
+                   uint32_t* offsets, uint32_t* sizes, size_t max_frames,
+                   size_t* consumed) {
+    size_t pos = 0;
+    long n = 0;
+    while (static_cast<size_t>(n) < max_frames && pos + 4 <= len) {
+        uint32_t frame_len = (static_cast<uint32_t>(buf[pos]) << 24) |
+                             (static_cast<uint32_t>(buf[pos + 1]) << 16) |
+                             (static_cast<uint32_t>(buf[pos + 2]) << 8) |
+                             static_cast<uint32_t>(buf[pos + 3]);
+        if (frame_len > max_size) return -1;
+        if (pos + 4 + frame_len > len) break;  // incomplete frame
+        offsets[n] = static_cast<uint32_t>(pos + 4);
+        sizes[n] = frame_len;
+        pos += 4 + frame_len;
+        ++n;
+    }
+    *consumed = pos;
+    return n;
+}
+
+void* cl_rt_new(const uint8_t* self_id, int k) {
+    return new RoutingTable(make_id(self_id), k);
+}
+
+void cl_rt_free(void* h) { delete static_cast<RoutingTable*>(h); }
+
+// Insert or refresh `id`.  Returns 0 if id == self (ignored), 1 otherwise.
+// When a full bucket evicts its least-recently-seen entry, the evicted id is
+// written to evicted_out and *evicted is set to 1 (else 0).
+int cl_rt_upsert(void* h, const uint8_t* id_bytes, uint8_t* evicted_out,
+                 int* evicted) {
+    auto* rt = static_cast<RoutingTable*>(h);
+    *evicted = 0;
+    Id id = make_id(id_bytes);
+    if (id == rt->self_id) return 0;
+    auto& bucket = rt->buckets[bucket_index(xor_id(rt->self_id, id))];
+    for (size_t i = 0; i < bucket.size(); ++i) {
+        if (bucket[i] == id) {  // refresh: move to most-recently-seen
+            bucket.erase(bucket.begin() + i);
+            bucket.push_back(id);
+            return 1;
+        }
+    }
+    if (static_cast<int>(bucket.size()) >= rt->k) {
+        std::memcpy(evicted_out, bucket.front().data(), kIdBytes);
+        *evicted = 1;
+        bucket.erase(bucket.begin());
+    }
+    bucket.push_back(id);
+    return 1;
+}
+
+int cl_rt_remove(void* h, const uint8_t* id_bytes) {
+    auto* rt = static_cast<RoutingTable*>(h);
+    Id id = make_id(id_bytes);
+    auto& bucket = rt->buckets[bucket_index(xor_id(rt->self_id, id))];
+    for (size_t i = 0; i < bucket.size(); ++i) {
+        if (bucket[i] == id) {
+            bucket.erase(bucket.begin() + i);
+            return 1;
+        }
+    }
+    return 0;
+}
+
+long cl_rt_size(void* h) {
+    auto* rt = static_cast<RoutingTable*>(h);
+    long n = 0;
+    for (const auto& b : rt->buckets) n += static_cast<long>(b.size());
+    return n;
+}
+
+// Write the (up to) `k` ids closest to `target` (by XOR distance) into
+// `out` (k * 32 bytes), sorted nearest first.  Returns the count written.
+long cl_rt_closest(void* h, const uint8_t* target_bytes, int k, uint8_t* out) {
+    auto* rt = static_cast<RoutingTable*>(h);
+    Id target = make_id(target_bytes);
+
+    std::vector<std::pair<Id, Id>> all;  // (distance, id)
+    all.reserve(64);
+    for (const auto& b : rt->buckets)
+        for (const auto& id : b) all.emplace_back(xor_id(id, target), id);
+
+    size_t kk = std::min<size_t>(k, all.size());
+    std::partial_sort(all.begin(), all.begin() + kk, all.end(),
+                      [](const auto& a, const auto& b) {
+                          return id_less(a.first, b.first);
+                      });
+    for (size_t i = 0; i < kk; ++i)
+        std::memcpy(out + i * kIdBytes, all[i].second.data(), kIdBytes);
+    return static_cast<long>(kk);
+}
+
+// Dump every id (bucket order, LRS first within a bucket).  Returns count,
+// or -1 if `cap` (in ids) is too small.
+long cl_rt_dump(void* h, uint8_t* out, long cap) {
+    auto* rt = static_cast<RoutingTable*>(h);
+    long n = 0;
+    for (const auto& b : rt->buckets) {
+        for (const auto& id : b) {
+            if (n >= cap) return -1;
+            std::memcpy(out + n * kIdBytes, id.data(), kIdBytes);
+            ++n;
+        }
+    }
+    return n;
+}
+
+}  // extern "C"

@@ -44,8 +44,9 @@ def key_for(data: bytes) -> bytes:
     return hashlib.sha256(data).digest()
 
 
-class RoutingTable:
-    """256 k-buckets over XOR distance, least-recently-seen eviction."""
+class PyRoutingTable:
+    """256 k-buckets over XOR distance, least-recently-seen eviction
+    (pure-Python reference implementation)."""
 
     def __init__(self, self_id: bytes, k: int = K):
         self.self_id = self_id
@@ -88,6 +89,83 @@ class RoutingTable:
 
     def contacts(self) -> list[Contact]:
         return [c for bucket in self.buckets for _, c in bucket]
+
+
+class NativeRoutingTable:
+    """C++-backed routing table (native/_src/crowdllama_native.cpp) with
+    identical semantics to :class:`PyRoutingTable`; ids live in the native
+    table, Contacts in a side dict kept in sync via eviction reporting."""
+
+    def __init__(self, self_id: bytes, k: int = K, lib=None):
+        import ctypes
+
+        from crowdllama_tpu import native as _native
+
+        self._ct = ctypes
+        self._lib = lib if lib is not None else _native.load()
+        assert self._lib is not None
+        self.self_id = self_id
+        self.k = k
+        self._h = self._lib.cl_rt_new(self_id, k)
+        self._contacts: dict[bytes, Contact] = {}
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.cl_rt_free(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+    def update(self, contact: Contact) -> None:
+        node_id = peer_id_to_dht_id(contact.peer_id)
+        ct = self._ct
+        evicted_buf = (ct.c_uint8 * 32)()
+        evicted = ct.c_int(0)
+        if self._lib.cl_rt_upsert(self._h, node_id, evicted_buf,
+                                  ct.byref(evicted)):
+            self._contacts[node_id] = contact
+            if evicted.value:
+                self._contacts.pop(bytes(evicted_buf), None)
+
+    def remove(self, peer_id: str) -> None:
+        node_id = peer_id_to_dht_id(peer_id)
+        if self._lib.cl_rt_remove(self._h, node_id):
+            self._contacts.pop(node_id, None)
+
+    def closest(self, target: bytes, k: int | None = None) -> list[Contact]:
+        k = k or self.k
+        ct = self._ct
+        out = (ct.c_uint8 * (32 * k))()
+        n = self._lib.cl_rt_closest(self._h, target, k, out)
+        raw = bytes(out)
+        return [self._contacts[raw[i * 32:(i + 1) * 32]] for i in range(n)]
+
+    def __len__(self) -> int:
+        return int(self._lib.cl_rt_size(self._h))
+
+    def contacts(self) -> list[Contact]:
+        # Single-threaded (asyncio) mutation and every native insert/evict/
+        # remove mirrors into _contacts in the same call, so the native count
+        # always equals len(_contacts).
+        ct = self._ct
+        cap = len(self._contacts)
+        out = (ct.c_uint8 * (32 * cap))()
+        n = self._lib.cl_rt_dump(self._h, out, cap)
+        assert n == cap, f"native table out of sync: {n} != {cap}"
+        raw = bytes(out)
+        return [self._contacts[raw[i * 32:(i + 1) * 32]] for i in range(n)]
+
+
+def RoutingTable(self_id: bytes, k: int = K):
+    """Factory: native-backed table when the C++ library is available,
+    pure-Python otherwise (same interface and semantics)."""
+    from crowdllama_tpu import native as _native
+
+    lib = _native.load()
+    if lib is not None:
+        return NativeRoutingTable(self_id, k, lib=lib)
+    return PyRoutingTable(self_id, k)
 
 
 @dataclass

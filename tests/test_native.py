@@ -1,0 +1,123 @@
+"""Native C++ runtime components vs their pure-Python reference semantics.
+
+Builds the library with g++ on first use (crowdllama_tpu/native); every test
+asserting parity drives both backends with identical operation sequences.
+"""
+
+import socket
+
+import pytest
+
+from crowdllama_tpu import native
+from crowdllama_tpu.core import wire
+from crowdllama_tpu.core.llama_v1_pb2 import BaseMessage
+from crowdllama_tpu.net.dht import (
+    Contact,
+    NativeRoutingTable,
+    PyRoutingTable,
+    RoutingTable,
+    key_for,
+    peer_id_to_dht_id,
+)
+
+lib = native.load()
+needs_native = pytest.mark.skipif(lib is None, reason="no native toolchain")
+
+
+def _contact(i: int) -> Contact:
+    return Contact(peer_id=f"peer-{i:04d}", host="127.0.0.1", port=10000 + i)
+
+
+@needs_native
+def test_routing_table_parity_random_ops():
+    self_id = key_for(b"self")
+    py = PyRoutingTable(self_id, k=4)
+    nat = NativeRoutingTable(self_id, k=4, lib=lib)
+
+    import random
+
+    rng = random.Random(7)
+    contacts = [_contact(i) for i in range(200)]
+    for step in range(1000):
+        op = rng.random()
+        c = rng.choice(contacts)
+        if op < 0.7:
+            py.update(c)
+            nat.update(c)
+        else:
+            py.remove(c.peer_id)
+            nat.remove(c.peer_id)
+        if step % 100 == 0:
+            target = key_for(str(step).encode())
+            assert [c.peer_id for c in py.closest(target)] == [
+                c.peer_id for c in nat.closest(target)], f"step {step}"
+
+    assert len(py) == len(nat)
+    assert sorted(c.peer_id for c in py.contacts()) == sorted(
+        c.peer_id for c in nat.contacts())
+
+
+@needs_native
+def test_routing_table_self_insert_ignored():
+    self_id = peer_id_to_dht_id("me")
+    nat = NativeRoutingTable(self_id, k=2, lib=lib)
+    nat.update(Contact(peer_id="me", host="h", port=1))
+    assert len(nat) == 0
+
+
+def test_routing_table_factory_interface():
+    rt = RoutingTable(key_for(b"x"), k=3)
+    for i in range(10):
+        rt.update(_contact(i))
+    got = rt.closest(key_for(b"y"), k=5)
+    assert 1 <= len(got) <= 5
+    rt.remove(got[0].peer_id)
+    assert all(c.peer_id != got[0].peer_id for c in rt.contacts())
+
+
+def _frames(*payloads: bytes) -> bytes:
+    import struct
+
+    return b"".join(struct.pack(">I", len(p)) + p for p in payloads)
+
+
+def test_scan_frames_complete_and_partial():
+    buf = _frames(b"aaa", b"", b"cccc") + b"\x00\x00\x00\x05par"
+    payloads, consumed = wire.scan_frames(buf)
+    assert payloads == [b"aaa", b"", b"cccc"]
+    assert consumed == len(buf) - 7  # trailing partial frame retained
+
+
+def test_scan_frames_oversize_raises():
+    import struct
+
+    with pytest.raises(wire.WireError):
+        wire.scan_frames(struct.pack(">I", wire.MAX_MESSAGE_SIZE + 1) + b"x")
+
+
+def test_scan_frames_python_fallback_matches(monkeypatch):
+    monkeypatch.setenv("CROWDLLAMA_NO_NATIVE", "1")
+    buf = _frames(b"one", b"two") + b"\x00"
+    payloads, consumed = wire.scan_frames(buf)
+    assert payloads == [b"one", b"two"]
+    assert consumed == len(buf) - 1
+
+
+def test_sync_frame_reader_many_frames_one_recv():
+    a, b = socket.socketpair()
+    try:
+        msgs = []
+        for i in range(5):
+            m = BaseMessage()
+            m.generate_response.response = f"chunk-{i}"
+            m.generate_response.done = i == 4
+            msgs.append(m)
+        a.sendall(b"".join(wire.encode_frame(m) for m in msgs))
+        reader = wire.SyncFrameReader(b)
+        got = [reader.read_message() for _ in range(5)]
+        assert [g.generate_response.response for g in got] == [
+            f"chunk-{i}" for i in range(5)]
+        assert got[-1].generate_response.done
+    finally:
+        a.close()
+        b.close()
