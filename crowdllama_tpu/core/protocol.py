@@ -14,6 +14,10 @@ import hashlib
 CROWDLLAMA_PROTOCOL = "/crowdllama/1.0.0"
 METADATA_PROTOCOL = "/crowdllama/metadata/1.0.0"
 INFERENCE_PROTOCOL = "/crowdllama/inference/1.0.0"
+# Cross-worker model sharding: activation transfer between pipeline-stage
+# workers of a shard group (no reference counterpart — the reference routes
+# whole requests to single workers only, SURVEY §2).
+SHARD_PROTOCOL = "/crowdllama/shard/1.0.0"
 
 # DHT key namespace prefix (cf. types.go:23).
 DHT_PREFIX = "/crowdllama/peer/"

@@ -1,0 +1,334 @@
+"""Cross-worker model sharding: pipeline stages over the swarm (DCN).
+
+BASELINE config 5 capability (multi-worker sharding of one model, with
+in-worker ep/tp composing inside each stage): a model too big for one worker
+is split into contiguous layer slices; each worker in a shard group
+(core/resource.py ShardGroup, strategy "pp") serves its slice behind the
+``/crowdllama/shard/1.0.0`` stream protocol, holding per-session KV caches
+for its layers.  The group leader (shard_index 0) embeds, drives activations
+through the stages leader→stage→leader, unembeds and samples.  This is the
+swarm-level analog of the in-chip ppermute pipeline (parallel/pipeline.py):
+over ICI the stages exchange activations via collectives; over DCN they are
+DHT-discovered peers exchanging tensors on authenticated streams.
+
+The reference has nothing comparable — it routes whole requests to single
+Ollama workers (/root/reference/pkg/peermanager/manager.go:338-387); this is
+part of the TPU-native superset.
+
+Wire format per call: one JSON header frame (op, session, scalars) followed
+by zero/one tensor (dtype/shape JSON frame + raw bytes frame); replies are
+{"ok": true, ...} + optional tensor, or {"ok": false, "error": ...}.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from crowdllama_tpu.models import transformer as T
+from crowdllama_tpu.models.config import ModelConfig
+from crowdllama_tpu.net.host import (
+    Stream,
+    read_json_frame,
+    write_json_frame,
+)
+
+log = logging.getLogger("crowdllama.engine.shard")
+
+_LEN = struct.Struct(">I")
+MAX_TENSOR_BYTES = 512 * 1024 * 1024  # activations, not weights
+STAGE_CALL_TIMEOUT = 120.0
+
+
+# ------------------------------------------------------------ tensor frames
+
+async def write_tensor(writer: asyncio.StreamWriter, arr: np.ndarray) -> None:
+    arr = np.ascontiguousarray(arr)
+    await write_json_frame(
+        writer, {"dtype": str(arr.dtype), "shape": list(arr.shape)})
+    raw = arr.tobytes()
+    if len(raw) > MAX_TENSOR_BYTES:
+        raise ValueError(f"tensor too large: {len(raw)}")
+    writer.write(_LEN.pack(len(raw)) + raw)
+    await writer.drain()
+
+
+async def read_tensor(reader: asyncio.StreamReader,
+                      timeout: float | None = None) -> np.ndarray:
+    header = await read_json_frame(reader, timeout=timeout)
+    (length,) = _LEN.unpack(await reader.readexactly(_LEN.size))
+    if length > MAX_TENSOR_BYTES:
+        raise ValueError(f"tensor too large: {length}")
+    raw = await reader.readexactly(length)
+    return np.frombuffer(raw, dtype=np.dtype(header["dtype"])).reshape(
+        header["shape"])
+
+
+# ------------------------------------------------------------- stage runner
+
+class ShardStageRunner:
+    """One worker's pipeline stage: a contiguous layer slice with jitted
+    prefill/decode scans and per-session KV caches.
+
+    Sessions are leader-assigned ids; each holds this stage's KV for one
+    in-flight sequence (B=1).  The leader calls prefill once, decode per
+    token, release at the end (or the session idles out via ``sweep``).
+    """
+
+    def __init__(self, cfg: ModelConfig, params: dict,
+                 shard_index: int, shard_count: int,
+                 max_seq: int = 0, dtype=jnp.bfloat16):
+        assert cfg.num_layers % shard_count == 0, (
+            f"{cfg.num_layers} layers not divisible by {shard_count} shards")
+        self.cfg = cfg
+        self.shard_index = shard_index
+        self.shard_count = shard_count
+        self.max_seq = max_seq or cfg.max_context_length
+        self.dtype = dtype
+        l_local = cfg.num_layers // shard_count
+        lo = shard_index * l_local
+        self.layer_range = (lo, lo + l_local)
+        self.layers = jax.tree_util.tree_map(
+            lambda a: jnp.asarray(a[lo:lo + l_local], dtype), params["layers"])
+        self.windows = T.layer_sliding_windows(cfg)[lo:lo + l_local]
+        self._sessions: dict[str, dict[str, Any]] = {}
+
+        def _prefill(layers, x, positions, kv_valid):
+            return T.scan_prefill_layers(layers, self.windows, cfg, x,
+                                         positions, kv_valid=kv_valid)
+
+        def _decode(layers, x, positions, kc, vc, seq_lens):
+            return T.scan_decode_layers(layers, self.windows, cfg, x,
+                                        positions, kc, vc, seq_lens)
+
+        self._jprefill = jax.jit(_prefill)
+        self._jdecode = jax.jit(_decode, donate_argnums=(3, 4))
+
+    def prefill(self, session: str, x: np.ndarray, plen: int) -> np.ndarray:
+        """x: [1, T, D] activations entering this stage; returns [1, T, D].
+        Creates the session cache seeded with the prompt's KV."""
+        t = x.shape[1]
+        positions = jnp.minimum(jnp.arange(t)[None, :], plen - 1)
+        kv_valid = (jnp.arange(t) < plen)[None, :]
+        y, ks, vs = self._jprefill(self.layers, jnp.asarray(x, self.dtype),
+                                   positions, kv_valid)
+        l_local = self.layer_range[1] - self.layer_range[0]
+        hkv, dh = self.cfg.num_kv_heads, self.cfg.resolved_head_dim()
+        kc = jnp.zeros((l_local, 1, hkv, self.max_seq, dh), self.dtype)
+        vc = jnp.zeros_like(kc)
+        kc = jax.lax.dynamic_update_slice(
+            kc, ks.astype(self.dtype), (0, 0, 0, 0, 0))
+        vc = jax.lax.dynamic_update_slice(
+            vc, vs.astype(self.dtype), (0, 0, 0, 0, 0))
+        self._sessions[session] = {"kc": kc, "vc": vc}
+        return np.asarray(y, np.float32)
+
+    def decode(self, session: str, x: np.ndarray, position: int,
+               seq_len: int) -> np.ndarray:
+        """x: [1, D] activation of the new token; returns [1, D]."""
+        sess = self._sessions[session]
+        y, kc, vc = self._jdecode(
+            self.layers, jnp.asarray(x, self.dtype),
+            jnp.asarray([position], jnp.int32),
+            sess["kc"], sess["vc"],
+            jnp.asarray([seq_len], jnp.int32),
+        )
+        sess["kc"], sess["vc"] = kc, vc
+        return np.asarray(y, np.float32)
+
+    def release(self, session: str) -> None:
+        self._sessions.pop(session, None)
+
+    @property
+    def session_count(self) -> int:
+        return len(self._sessions)
+
+
+# ------------------------------------------------------------ service side
+
+class ShardStageService:
+    """Stream handler serving a ShardStageRunner over SHARD_PROTOCOL."""
+
+    def __init__(self, runner: ShardStageRunner):
+        self.runner = runner
+
+    async def handle(self, stream: Stream) -> None:
+        loop = asyncio.get_running_loop()
+        try:
+            while True:
+                try:
+                    header = await read_json_frame(stream.reader)
+                except (asyncio.IncompleteReadError, ConnectionResetError):
+                    break
+                op = header.get("op", "")
+                sid = header.get("session", "")
+                try:
+                    if op == "prefill":
+                        x = await read_tensor(stream.reader)
+                        y = await loop.run_in_executor(
+                            None, self.runner.prefill, sid, x,
+                            int(header["plen"]))
+                        await write_json_frame(stream.writer, {"ok": True})
+                        await write_tensor(stream.writer, y)
+                    elif op == "decode":
+                        x = await read_tensor(stream.reader)
+                        y = await loop.run_in_executor(
+                            None, self.runner.decode, sid, x,
+                            int(header["position"]), int(header["seq_len"]))
+                        await write_json_frame(stream.writer, {"ok": True})
+                        await write_tensor(stream.writer, y)
+                    elif op == "release":
+                        self.runner.release(sid)
+                        await write_json_frame(stream.writer, {"ok": True})
+                    elif op == "info":
+                        await write_json_frame(stream.writer, {
+                            "ok": True,
+                            "shard_index": self.runner.shard_index,
+                            "shard_count": self.runner.shard_count,
+                            "layer_range": list(self.runner.layer_range),
+                            "sessions": self.runner.session_count,
+                        })
+                    else:
+                        await write_json_frame(
+                            stream.writer,
+                            {"ok": False, "error": f"unknown op {op!r}"})
+                except KeyError as e:
+                    await write_json_frame(
+                        stream.writer,
+                        {"ok": False, "error": f"unknown session/field: {e}"})
+                except Exception as e:
+                    log.exception("shard op %s failed", op)
+                    await write_json_frame(
+                        stream.writer, {"ok": False, "error": str(e)})
+        finally:
+            stream.close()
+
+
+# ------------------------------------------------------------- client side
+
+class RemoteStage:
+    """Leader-side proxy for one remote pipeline stage (one stream reused
+    across the session's calls)."""
+
+    def __init__(self, stream: Stream):
+        self._stream = stream
+
+    async def _call(self, header: dict, tensor: np.ndarray | None,
+                    want_tensor: bool) -> np.ndarray | None:
+        await write_json_frame(self._stream.writer, header)
+        if tensor is not None:
+            await write_tensor(self._stream.writer, tensor)
+        reply = await read_json_frame(self._stream.reader,
+                                      timeout=STAGE_CALL_TIMEOUT)
+        if not reply.get("ok"):
+            raise RuntimeError(f"shard stage error: {reply.get('error')}")
+        if want_tensor:
+            return await read_tensor(self._stream.reader,
+                                     timeout=STAGE_CALL_TIMEOUT)
+        return None
+
+    async def prefill(self, session: str, x: np.ndarray,
+                      plen: int) -> np.ndarray:
+        return await self._call(
+            {"op": "prefill", "session": session, "plen": plen}, x, True)
+
+    async def decode(self, session: str, x: np.ndarray, position: int,
+                     seq_len: int) -> np.ndarray:
+        return await self._call(
+            {"op": "decode", "session": session, "position": position,
+             "seq_len": seq_len}, x, True)
+
+    async def release(self, session: str) -> None:
+        await self._call({"op": "release", "session": session}, None, False)
+
+    def close(self) -> None:
+        self._stream.close()
+
+
+class LocalStage:
+    """Leader-side adapter running a ShardStageRunner in-process (the leader
+    is itself stage 0)."""
+
+    def __init__(self, runner: ShardStageRunner):
+        self.runner = runner
+
+    async def prefill(self, session: str, x: np.ndarray,
+                      plen: int) -> np.ndarray:
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, self.runner.prefill, session,
+                                          x, plen)
+
+    async def decode(self, session: str, x: np.ndarray, position: int,
+                     seq_len: int) -> np.ndarray:
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, self.runner.decode, session,
+                                          x, position, seq_len)
+
+    async def release(self, session: str) -> None:
+        self.runner.release(session)
+
+    def close(self) -> None:
+        pass
+
+
+# ---------------------------------------------------------------- pipeline
+
+class SwarmPipeline:
+    """Drives a full forward pass through ordered stages (leader-side).
+
+    Owns embed/unembed (replicated on the leader) and the sampling loop;
+    stage i's activations feed stage i+1.  Greedy/temperature sampling on the
+    leader host — tiny [V] work compared to a DCN round trip.
+    """
+
+    def __init__(self, cfg: ModelConfig, params: dict, stages: list,
+                 dtype=jnp.bfloat16):
+        self.cfg = cfg
+        self.dtype = dtype
+        self.embed_params = {
+            k: v for k, v in params.items() if k != "layers"}
+        self._unembed = jax.jit(
+            lambda x: T._unembed(self.embed_params, cfg, x))
+        self._embed = jax.jit(
+            lambda tokens: T._embed(self.embed_params, cfg,
+                                    jnp.asarray(tokens)))
+        self.stages = stages
+
+    async def prefill(self, session: str, prompt_ids: list[int],
+                      bucket: int) -> np.ndarray:
+        """Returns the last position's logits [V] (fp32)."""
+        plen = len(prompt_ids)
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :plen] = prompt_ids
+        x = np.asarray(self._embed(tokens), np.float32)
+        for stage in self.stages:
+            x = await stage.prefill(session, x, plen)
+        logits = self._unembed(jnp.asarray(x, self.dtype))
+        return np.asarray(logits[0, plen - 1], np.float32)
+
+    async def decode(self, session: str, token: int, position: int,
+                     seq_len: int) -> np.ndarray:
+        """One token through all stages; returns next-token logits [V]."""
+        x = np.asarray(
+            self._embed(np.asarray([token], np.int32)), np.float32)
+        for stage in self.stages:
+            x = await stage.decode(session, x, position, seq_len)
+        logits = self._unembed(jnp.asarray(x, self.dtype))
+        return np.asarray(logits[0], np.float32)
+
+    async def release(self, session: str) -> None:
+        for stage in self.stages:
+            try:
+                await stage.release(session)
+            except Exception:
+                log.warning("stage release failed", exc_info=True)
+
+    def close(self) -> None:
+        for stage in self.stages:
+            stage.close()

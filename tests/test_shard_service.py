@@ -1,0 +1,116 @@
+"""Cross-worker pipeline sharding: swarm stages must match the dense model.
+
+A 2-stage split (leader-local stage 0 + stage 1 behind a real authenticated
+loopback stream) greedily decodes the same tokens as the single-process
+forward — the multi-worker analog of test_pipeline.py.
+"""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+
+from crowdllama_tpu.core.protocol import SHARD_PROTOCOL
+from crowdllama_tpu.engine.shard_service import (
+    LocalStage,
+    RemoteStage,
+    ShardStageRunner,
+    ShardStageService,
+    SwarmPipeline,
+)
+from crowdllama_tpu.models import transformer as T
+from crowdllama_tpu.models.config import get_config
+from crowdllama_tpu.net.host import Host
+
+
+def _dense_greedy(cfg, params, prompt, steps):
+    tokens = jnp.asarray([prompt])
+    pos = jnp.arange(len(prompt))[None, :]
+    logits, ks, vs = T.prefill(params, cfg, tokens, pos)
+    out = [int(logits[0, -1].argmax())]
+    S = cfg.max_context_length
+    L, hkv, dh = cfg.num_layers, cfg.num_kv_heads, cfg.resolved_head_dim()
+    kc = jnp.zeros((L, 1, hkv, S, dh), jnp.float32)
+    vc = jnp.zeros((L, 1, hkv, S, dh), jnp.float32)
+    kc = kc.at[:, :, :, :len(prompt)].set(ks)
+    vc = vc.at[:, :, :, :len(prompt)].set(vs)
+    n = len(prompt)
+    for _ in range(steps):
+        step_logits, kc, vc = T.decode_step(
+            params, cfg, jnp.asarray([out[-1]]), jnp.asarray([n]),
+            kc, vc, jnp.asarray([n + 1]))
+        out.append(int(step_logits[0].argmax()))
+        n += 1
+    return out
+
+
+async def test_swarm_pipeline_matches_dense():
+    cfg = get_config("tiny-test", max_context_length=32)
+    params = T.init_params(cfg, jax.random.PRNGKey(3), dtype=jnp.float32)
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+    steps = 6
+    want = _dense_greedy(cfg, params, prompt, steps)
+
+    # Stage 1 worker behind a real stream host.
+    remote_runner = ShardStageRunner(cfg, params, shard_index=1,
+                                     shard_count=2, dtype=jnp.float32)
+    service = ShardStageService(remote_runner)
+    worker_host = Host(Ed25519PrivateKey.generate(),
+                             listen_host="127.0.0.1")
+    worker_host.set_stream_handler(SHARD_PROTOCOL, service.handle)
+    await worker_host.start()
+
+    leader_host = Host(Ed25519PrivateKey.generate(),
+                             listen_host="127.0.0.1")
+    await leader_host.start()
+    try:
+        stream = await leader_host.new_stream(worker_host.contact,
+                                              SHARD_PROTOCOL)
+        stages = [
+            LocalStage(ShardStageRunner(cfg, params, shard_index=0,
+                                        shard_count=2, dtype=jnp.float32)),
+            RemoteStage(stream),
+        ]
+        pipe = SwarmPipeline(cfg, params, stages, dtype=jnp.float32)
+
+        sid = "sess-1"
+        logits = await pipe.prefill(sid, prompt, bucket=16)
+        got = [int(np.argmax(logits))]
+        n = len(prompt)
+        for _ in range(steps):
+            logits = await pipe.decode(sid, got[-1], n, n + 1)
+            got.append(int(np.argmax(logits)))
+            n += 1
+        await pipe.release(sid)
+        assert remote_runner.session_count == 0
+        assert got == want, f"swarm {got} vs dense {want}"
+    finally:
+        pipe.close()
+        await leader_host.close()
+        await worker_host.close()
+
+
+async def test_shard_service_unknown_session_reports_error():
+    cfg = get_config("tiny-test", max_context_length=32)
+    params = T.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    runner = ShardStageRunner(cfg, params, 0, 2, dtype=jnp.float32)
+    service = ShardStageService(runner)
+    host = Host(Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    host.set_stream_handler(SHARD_PROTOCOL, service.handle)
+    await host.start()
+    client = Host(Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    await client.start()
+    try:
+        stage = RemoteStage(await client.new_stream(host.contact,
+                                                    SHARD_PROTOCOL))
+        with pytest.raises(RuntimeError, match="shard stage error"):
+            await stage.decode("nope", np.zeros((1, cfg.hidden_size),
+                                                np.float32), 0, 1)
+        # The stream survives an error reply and still serves info.
+        await stage._call({"op": "info"}, None, False)
+    finally:
+        await client.close()
+        await host.close()
