@@ -34,6 +34,7 @@ import numpy as np
 from crowdllama_tpu.models import transformer as T
 from crowdllama_tpu.models.config import ModelConfig
 from crowdllama_tpu.net.host import (
+    HandshakeError,
     Stream,
     read_json_frame,
     write_json_frame,
@@ -44,6 +45,10 @@ log = logging.getLogger("crowdllama.engine.shard")
 _LEN = struct.Struct(">I")
 MAX_TENSOR_BYTES = 512 * 1024 * 1024  # activations, not weights
 STAGE_CALL_TIMEOUT = 120.0
+# A stage stream with no traffic for this long is presumed abandoned by its
+# leader and closed (also lets Host.close() shut down promptly: the read loop
+# never parks forever on a dead-but-open connection).
+STREAM_IDLE_TIMEOUT = 600.0
 
 
 # ------------------------------------------------------------ tensor frames
@@ -61,13 +66,18 @@ async def write_tensor(writer: asyncio.StreamWriter, arr: np.ndarray) -> None:
 
 async def read_tensor(reader: asyncio.StreamReader,
                       timeout: float | None = None) -> np.ndarray:
-    header = await read_json_frame(reader, timeout=timeout)
-    (length,) = _LEN.unpack(await reader.readexactly(_LEN.size))
-    if length > MAX_TENSOR_BYTES:
-        raise ValueError(f"tensor too large: {length}")
-    raw = await reader.readexactly(length)
-    return np.frombuffer(raw, dtype=np.dtype(header["dtype"])).reshape(
-        header["shape"])
+    async def _read() -> np.ndarray:
+        header = await read_json_frame(reader)
+        (length,) = _LEN.unpack(await reader.readexactly(_LEN.size))
+        if length > MAX_TENSOR_BYTES:
+            raise ValueError(f"tensor too large: {length}")
+        raw = await reader.readexactly(length)
+        return np.frombuffer(raw, dtype=np.dtype(header["dtype"])).reshape(
+            header["shape"])
+
+    if timeout is None:
+        return await _read()
+    return await asyncio.wait_for(_read(), timeout)
 
 
 # ------------------------------------------------------------- stage runner
@@ -78,7 +88,8 @@ class ShardStageRunner:
 
     Sessions are leader-assigned ids; each holds this stage's KV for one
     in-flight sequence (B=1).  The leader calls prefill once, decode per
-    token, release at the end (or the session idles out via ``sweep``).
+    token, release at the end; sessions prefilled over a stream that dies
+    are released by the service when the stream closes.
     """
 
     def __init__(self, cfg: ModelConfig, params: dict,
@@ -155,36 +166,63 @@ class ShardStageRunner:
 class ShardStageService:
     """Stream handler serving a ShardStageRunner over SHARD_PROTOCOL."""
 
-    def __init__(self, runner: ShardStageRunner):
+    def __init__(self, runner: ShardStageRunner,
+                 idle_timeout: float = STREAM_IDLE_TIMEOUT):
         self.runner = runner
+        self.idle_timeout = idle_timeout
 
     async def handle(self, stream: Stream) -> None:
         loop = asyncio.get_running_loop()
+        # Sessions prefilled over this stream: their KV caches are released
+        # when the stream dies (idle timeout / leader crash), not only on an
+        # explicit release op — otherwise an abandoned leader leaks device
+        # memory on the worker forever.
+        owned: set[str] = set()
+        # Stream-death signals: timeout, clean/unclean disconnect, or a
+        # malformed frame (HandshakeError also covers EOF mid-frame — raw
+        # readexactly inside read_tensor raises IncompleteReadError).  All of
+        # them mean the stream is desynchronized or abandoned: break, don't
+        # reply-and-continue.
+        wire_errors = (asyncio.TimeoutError, asyncio.IncompleteReadError,
+                       ConnectionResetError, HandshakeError)
+        inflight: asyncio.Future | None = None
         try:
             while True:
                 try:
-                    header = await read_json_frame(stream.reader)
-                except (asyncio.IncompleteReadError, ConnectionResetError):
+                    header = await read_json_frame(stream.reader,
+                                                   timeout=self.idle_timeout)
+                    op = header.get("op", "")
+                    sid = header.get("session", "")
+                    x = None
+                    if op in ("prefill", "decode"):
+                        x = await read_tensor(stream.reader,
+                                              timeout=self.idle_timeout)
+                except wire_errors:
                     break
-                op = header.get("op", "")
-                sid = header.get("session", "")
                 try:
                     if op == "prefill":
-                        x = await read_tensor(stream.reader)
-                        y = await loop.run_in_executor(
+                        # Register before dispatch: a cancellation landing
+                        # after the executor inserted the KV must still
+                        # release it in the finally below.
+                        owned.add(sid)
+                        inflight = loop.run_in_executor(
                             None, self.runner.prefill, sid, x,
                             int(header["plen"]))
+                        y = await inflight
+                        inflight = None
                         await write_json_frame(stream.writer, {"ok": True})
                         await write_tensor(stream.writer, y)
                     elif op == "decode":
-                        x = await read_tensor(stream.reader)
-                        y = await loop.run_in_executor(
+                        inflight = loop.run_in_executor(
                             None, self.runner.decode, sid, x,
                             int(header["position"]), int(header["seq_len"]))
+                        y = await inflight
+                        inflight = None
                         await write_json_frame(stream.writer, {"ok": True})
                         await write_tensor(stream.writer, y)
                     elif op == "release":
                         self.runner.release(sid)
+                        owned.discard(sid)
                         await write_json_frame(stream.writer, {"ok": True})
                     elif op == "info":
                         await write_json_frame(stream.writer, {
@@ -207,6 +245,17 @@ class ShardStageService:
                     await write_json_frame(
                         stream.writer, {"ok": False, "error": str(e)})
         finally:
+            # If cancellation landed while an executor op was running, the
+            # thread may insert its session KV after this point unless we let
+            # it settle first (executor futures are uncancellable once
+            # started).
+            if inflight is not None and not inflight.done():
+                try:
+                    await asyncio.shield(inflight)
+                except BaseException:
+                    pass
+            for sid in owned:
+                self.runner.release(sid)
             stream.close()
 
 

@@ -162,11 +162,25 @@ class Host:
         log.debug("host %s listening on %s:%d", self.peer_id[:8], self.listen_host, self.listen_port)
 
     async def close(self) -> None:
+        # Cancel in-flight connection handlers BEFORE wait_closed(): on
+        # Python 3.12 Server.wait_closed() waits for every handler to finish,
+        # so a handler parked in a timeout-less read (e.g. a long-lived
+        # service loop) would deadlock shutdown if cancelled after.
         if self._server is not None:
             self._server.close()
+        while True:
+            # A just-accepted handler task may exist but not yet have run its
+            # first step (where it registers in _conn_tasks); yield once so it
+            # registers, then cancel.  Loop until no handlers remain.
+            await asyncio.sleep(0)
+            tasks = list(self._conn_tasks)
+            if not tasks:
+                break
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+        if self._server is not None:
             await self._server.wait_closed()
-        for t in list(self._conn_tasks):
-            t.cancel()
 
     @property
     def contact(self) -> Contact:
