@@ -7,8 +7,13 @@ max-tokens.  This is the component the reference outsources to Ollama's
 internal server loop; here it is explicit and TPU-shaped (fixed-shape decode
 batch, prefill interleaved between steps).
 
-JAX dispatch happens on the event-loop thread but blocks only while a step is
-in flight; token host-transfer is one small [B] array per step.
+JAX dispatch runs on a dedicated single-flight executor thread, never on the
+event loop: a decode chunk or a long-prompt prefill blocks until its host
+transfer completes, and parking that wait on the loop would stall the whole
+control plane (DHT RPCs, metadata serving, health probes — the reference
+worker serves all of these concurrently via goroutines).  The scheduler
+coroutine awaits each dispatch, so device state is still mutated by exactly
+one in-flight program at a time.
 """
 
 from __future__ import annotations
@@ -17,6 +22,7 @@ import asyncio
 import itertools
 import logging
 import time
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
 import jax
@@ -59,6 +65,10 @@ class Scheduler:
         self.pending: asyncio.Queue[GenRequest] = asyncio.Queue(max_queue)
         self._wake = asyncio.Event()
         self._task: asyncio.Task | None = None
+        # Single dispatch thread: keeps device programs single-flight while
+        # freeing the event loop during blocking host transfers.
+        self._exec: ThreadPoolExecutor | None = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="jax-dispatch")
         self._rng = jax.random.PRNGKey(int(time.time()) & 0x7FFFFFFF)
         # Telemetry for Resource advertisement + /api/health.
         self.tokens_generated = 0
@@ -68,6 +78,9 @@ class Scheduler:
     # ---------------------------------------------------------------- public
 
     def start(self) -> None:
+        if self._exec is None:  # restarted after stop(): fresh dispatcher
+            self._exec = ThreadPoolExecutor(max_workers=1,
+                                            thread_name_prefix="jax-dispatch")
         if self._task is None:
             self._task = asyncio.create_task(self._loop(), name="decode-loop")
 
@@ -79,6 +92,9 @@ class Scheduler:
             except asyncio.CancelledError:
                 pass
             self._task = None
+        if self._exec is not None:
+            self._exec.shutdown(wait=False)
+            self._exec = None
 
     async def submit(self, req: GenRequest) -> None:
         if len(req.prompt_ids) >= self.runner.max_seq:
@@ -102,10 +118,12 @@ class Scheduler:
                 return i
         return None
 
-    def _admit_one(self, req: GenRequest, slot: int) -> None:
+    async def _admit_one(self, req: GenRequest, slot: int) -> None:
         self._rng, sub = jax.random.split(self._rng)
-        first, ks, vs, plen = self.runner.prefill(
-            req.prompt_ids, req.temperature, req.top_p, sub
+        loop = asyncio.get_running_loop()
+        first, ks, vs, plen = await loop.run_in_executor(
+            self._exec, self.runner.prefill,
+            req.prompt_ids, req.temperature, req.top_p, sub,
         )
         self.state = self.runner.insert(
             self.state, slot, ks, vs, plen, first, req.temperature, req.top_p
@@ -162,17 +180,29 @@ class Scheduler:
             self._wake.clear()
             await self._wake.wait()
 
-        # Admit as many pending requests as there are free slots.
+        # Admit pending requests into free slots — but at most one prefill
+        # per iteration once any slot is decoding, so a burst of long prompts
+        # interleaves with decode chunks instead of freezing token streaming
+        # for every active request until the whole queue is prefilled.
         while not self.pending.empty():
             slot = self._free_slot()
             if slot is None:
                 break
             req = self.pending.get_nowait()
             try:
-                self._admit_one(req, slot)
+                await self._admit_one(req, slot)
             except ValueError as e:  # bad request (too long, etc.)
                 log.warning("admit failed: %s", e)
                 req.out.put_nowait((_DONE, f"error: {e}"))
+                continue
+            except BaseException:
+                # Engine failure mid-admission: the popped request is in
+                # neither slots nor pending, so _loop's recovery would miss
+                # it — fail it here, then let the recovery reset state.
+                req.out.put_nowait((_DONE, "error: engine failure"))
+                raise
+            if sum(1 for s in self.slots if s is not None) > 1:
+                break
 
         if all(s is None for s in self.slots):
             return
@@ -180,7 +210,9 @@ class Scheduler:
         # A chunk of decode steps for the whole batch in one dispatch.
         k = self._chunk_size()
         t0 = time.monotonic()
-        tokens, self.state = self.runner.decode_steps(self.state, k)  # [K,B]
+        loop = asyncio.get_running_loop()
+        tokens, self.state = await loop.run_in_executor(
+            self._exec, self.runner.decode_steps, self.state, k)  # [K,B]
         dt = max(time.monotonic() - t0, 1e-6)
         emitted = 0
         for step in range(tokens.shape[0]):
