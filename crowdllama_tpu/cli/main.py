@@ -106,6 +106,10 @@ def _make_engine(cfg: Configuration, worker_mode: bool):
         return FakeEngine(models=[])
     if cfg.engine_backend == "fake":
         return FakeEngine(models=[cfg.model])
+    if cfg.shard_count > 1:
+        from crowdllama_tpu.engine.sharded import ShardedEngine
+
+        return ShardedEngine(cfg)
     return JaxEngine(cfg)
 
 

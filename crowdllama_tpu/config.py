@@ -83,6 +83,14 @@ class Configuration:
     decode_chunk: int = 8  # decode steps per device dispatch
     warmup: bool = True  # compile prefill/decode at engine start
 
+    # Multi-worker sharded serving (BASELINE config 5): a node with
+    # shard_count > 1 serves layer slice shard_index of an N-way pipeline
+    # split; shard_group names the group (same string on every member;
+    # default "<model>/pp<count>").  Index 0 is the group leader.
+    shard_group: str = ""
+    shard_index: int = 0
+    shard_count: int = 1
+
     intervals: Intervals = field(default_factory=Intervals.default)
 
     @classmethod
@@ -107,6 +115,9 @@ class Configuration:
         cfg.engine_backend = env.get("CROWDLLAMA_TPU_ENGINE", cfg.engine_backend)
         cfg.mesh_shape = env.get("CROWDLLAMA_TPU_MESH", cfg.mesh_shape)
         cfg.decode_chunk = int(env.get("CROWDLLAMA_TPU_DECODE_CHUNK", cfg.decode_chunk))
+        cfg.shard_group = env.get("CROWDLLAMA_TPU_SHARD_GROUP", cfg.shard_group)
+        cfg.shard_index = int(env.get("CROWDLLAMA_TPU_SHARD_INDEX", cfg.shard_index))
+        cfg.shard_count = int(env.get("CROWDLLAMA_TPU_SHARD_COUNT", cfg.shard_count))
         if env.get("CROWDLLAMA_TPU_WARMUP"):
             cfg.warmup = env["CROWDLLAMA_TPU_WARMUP"] in ("1", "true")
         for k, v in overrides.items():
@@ -130,6 +141,12 @@ class Configuration:
         parser.add_argument("--model-path", dest="model_path")
         parser.add_argument("--engine", dest="engine_backend")
         parser.add_argument("--mesh", dest="mesh_shape")
+        parser.add_argument("--shard-group", dest="shard_group",
+                            help="sharded-model group id (same on all members)")
+        parser.add_argument("--shard-index", dest="shard_index", type=int,
+                            help="this worker's pipeline stage (0 = leader)")
+        parser.add_argument("--shard-count", dest="shard_count", type=int,
+                            help="number of workers sharing the model")
 
     @classmethod
     def from_flags(cls, args: argparse.Namespace) -> "Configuration":
@@ -138,6 +155,7 @@ class Configuration:
             for k in (
                 "verbose", "key_path", "listen_port", "gateway_port",
                 "model", "model_path", "engine_backend", "mesh_shape",
+                "shard_group", "shard_index", "shard_count",
             )
         }
         bp = getattr(args, "bootstrap_peers", None)

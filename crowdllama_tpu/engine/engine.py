@@ -44,6 +44,10 @@ class Engine:
     async def start(self) -> None: ...
     async def stop(self) -> None: ...
 
+    def attach_peer(self, peer) -> None:
+        """Called by Peer.start() so engines that talk to the swarm (e.g.
+        ShardedEngine's group leader) can reach the host/DHT/peer manager."""
+
     def describe(self) -> dict:
         """Capability/telemetry snapshot for Resource advertisement."""
         return {"models": self.models, "throughput": 0.0, "load": 0.0}

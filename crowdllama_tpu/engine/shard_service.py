@@ -263,24 +263,27 @@ class ShardStageService:
 
 class RemoteStage:
     """Leader-side proxy for one remote pipeline stage (one stream reused
-    across the session's calls)."""
+    across calls; a lock serializes request/reply pairs so concurrent
+    sessions sharing the pooled stream cannot interleave frames)."""
 
     def __init__(self, stream: Stream):
         self._stream = stream
+        self._lock = asyncio.Lock()
 
     async def _call(self, header: dict, tensor: np.ndarray | None,
                     want_tensor: bool) -> np.ndarray | None:
-        await write_json_frame(self._stream.writer, header)
-        if tensor is not None:
-            await write_tensor(self._stream.writer, tensor)
-        reply = await read_json_frame(self._stream.reader,
-                                      timeout=STAGE_CALL_TIMEOUT)
-        if not reply.get("ok"):
-            raise RuntimeError(f"shard stage error: {reply.get('error')}")
-        if want_tensor:
-            return await read_tensor(self._stream.reader,
-                                     timeout=STAGE_CALL_TIMEOUT)
-        return None
+        async with self._lock:
+            await write_json_frame(self._stream.writer, header)
+            if tensor is not None:
+                await write_tensor(self._stream.writer, tensor)
+            reply = await read_json_frame(self._stream.reader,
+                                          timeout=STAGE_CALL_TIMEOUT)
+            if not reply.get("ok"):
+                raise RuntimeError(f"shard stage error: {reply.get('error')}")
+            if want_tensor:
+                return await read_tensor(self._stream.reader,
+                                         timeout=STAGE_CALL_TIMEOUT)
+            return None
 
     async def prefill(self, session: str, x: np.ndarray,
                       plen: int) -> np.ndarray:

@@ -1,0 +1,295 @@
+"""ShardedEngine: multi-worker sharded serving behind the Engine seam.
+
+BASELINE config 5 wired end-to-end: a node started with
+``--shard-group G --shard-index i --shard-count N`` serves layer slice i of
+an N-way pipeline split.  Every member registers the ``SHARD_PROTOCOL``
+stream service (engine/shard_service.py) and advertises a
+``ShardGroup(strategy="pp")`` in its Resource; the scheduler
+(peermanager/manager.py) routes requests for the model to the group leader
+(shard_index 0) once — and only while — the group is complete.
+
+The leader is itself stage 0: on each request it assembles the stage chain
+(LocalStage + one RemoteStage per DHT-discovered member, connections pooled
+across requests), drives SwarmPipeline prefill/decode, samples on the host,
+and streams tokens.  A member failure mid-request drops the pooled
+connections so the next request re-resolves the (possibly re-formed) group;
+the health machine marks the dead member unhealthy, which makes the group
+incomplete and the leader unroutable until it recovers.
+
+The reference routes whole requests to single Ollama workers
+(/root/reference/pkg/peermanager/manager.go:338-387) and has no model
+sharding of any kind; this is part of the TPU-native superset.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+import uuid
+from typing import AsyncIterator
+
+import numpy as np
+
+from crowdllama_tpu.config import Configuration
+from crowdllama_tpu.core.resource import ShardGroup
+from crowdllama_tpu.engine.engine import Chunk, Engine
+
+log = logging.getLogger("crowdllama.engine.sharded")
+
+
+def sample_host(logits: np.ndarray, temperature: float, top_p: float,
+                rng: np.random.Generator) -> int:
+    """Greedy / temperature / nucleus sampling on the leader host.
+
+    The pipeline returns one [V] logits vector per step; sampling here is
+    trivial work next to a DCN round trip, so there is nothing to fuse
+    on-device (contrast engine/sampling.py, which runs inside the jitted
+    decode step of the single-worker engine).
+    """
+    if temperature <= 0:
+        return int(logits.argmax())
+    x = logits.astype(np.float64) / max(temperature, 1e-6)
+    x -= x.max()
+    probs = np.exp(x)
+    probs /= probs.sum()
+    if top_p < 1.0:
+        order = np.argsort(probs)[::-1]
+        cum = np.cumsum(probs[order])
+        keep = (cum - probs[order]) < top_p
+        keep[0] = True  # always keep the top token
+        mask = np.zeros(probs.shape, bool)
+        mask[order[keep]] = True
+        probs = np.where(mask, probs, 0.0)
+        probs /= probs.sum()
+    return int(rng.choice(len(probs), p=probs))
+
+
+class ShardedEngine(Engine):
+    """One member of a pipeline-sharded model group (leader when index 0)."""
+
+    def __init__(self, config: Configuration | None = None, **overrides):
+        self.config = config or Configuration.from_environment()
+        for k, v in overrides.items():
+            setattr(self.config, k, v)
+        if self.config.shard_count < 2:
+            raise ValueError("ShardedEngine needs shard_count >= 2")
+        if not (0 <= self.config.shard_index < self.config.shard_count):
+            raise ValueError(
+                f"shard_index {self.config.shard_index} out of range for "
+                f"shard_count {self.config.shard_count}")
+        self.group_id = (self.config.shard_group
+                         or f"{self.config.model}/pp{self.config.shard_count}")
+        self.shard_index = self.config.shard_index
+        self.shard_count = self.config.shard_count
+        self.is_leader = self.shard_index == 0
+        self.models = [self.config.model]
+
+        self.shard_service = None  # registered on SHARD_PROTOCOL by Peer
+        self.runner = None
+        self.tokenizer = None
+        self._peer = None
+        self._pipeline = None  # leader: cached SwarmPipeline over pooled streams
+        self._pipeline_lock = asyncio.Lock()
+        self._sem: asyncio.Semaphore | None = None
+        self._active = 0
+        self._tput_ema = 0.0
+        self._rng = np.random.default_rng(0)
+
+    # ----------------------------------------------------------- lifecycle
+
+    async def start(self) -> None:
+        from crowdllama_tpu.engine.shard_service import (
+            ShardStageRunner,
+            ShardStageService,
+        )
+        from crowdllama_tpu.engine.tokenizer import get_tokenizer
+        from crowdllama_tpu.engine.weights import load_or_init_params
+        from crowdllama_tpu.models.config import get_config
+
+        cfg = get_config(self.config.model)
+        if self.config.max_context_length:
+            cfg = get_config(
+                self.config.model,
+                max_context_length=min(cfg.max_context_length,
+                                       self.config.max_context_length))
+        self.cfg = cfg
+        loop = asyncio.get_running_loop()
+
+        def _build():
+            # Every member loads the checkpoint and keeps only its slice
+            # (ShardStageRunner copies its layer range); the leader also
+            # keeps embed/unembed.  Same seed => identical random-init
+            # weights across members when no checkpoint is given.
+            params = load_or_init_params(cfg, self.config.model_path)
+            runner = ShardStageRunner(
+                cfg, params, self.shard_index, self.shard_count,
+                max_seq=cfg.max_context_length)
+            embed = ({k: v for k, v in params.items() if k != "layers"}
+                     if self.is_leader else None)
+            return runner, embed
+
+        self.runner, self._embed_params = await loop.run_in_executor(None, _build)
+        self.shard_service = ShardStageService(self.runner)
+        if self.is_leader:
+            self.tokenizer = get_tokenizer(self.config.model_path)
+            self._sem = asyncio.Semaphore(self.config.max_batch_slots)
+        log.info("shard stage up: group=%s index=%d/%d layers=%s%s",
+                 self.group_id, self.shard_index, self.shard_count,
+                 self.runner.layer_range,
+                 " (leader)" if self.is_leader else "")
+
+    async def stop(self) -> None:
+        async with self._pipeline_lock:
+            if self._pipeline is not None:
+                self._pipeline.close()
+                self._pipeline = None
+
+    def attach_peer(self, peer) -> None:
+        self._peer = peer
+
+    def describe(self) -> dict:
+        return {
+            "models": self.models,
+            "throughput": round(self._tput_ema, 2),
+            "load": round(self._active / max(self.config.max_batch_slots, 1), 3),
+            "shard_group": ShardGroup(
+                group_id=self.group_id,
+                model=self.config.model,
+                strategy="pp",
+                shard_index=self.shard_index,
+                shard_count=self.shard_count,
+            ),
+        }
+
+    # ------------------------------------------------------ stage assembly
+
+    async def _resolve_pipeline(self):
+        """Build (or reuse) the SwarmPipeline over the current group.
+
+        Requires the peer manager to see every shard index healthy; dials
+        each remote member's SHARD_PROTOCOL once and pools the streams.
+        """
+        from crowdllama_tpu.core.protocol import SHARD_PROTOCOL
+        from crowdllama_tpu.engine.shard_service import (
+            LocalStage,
+            RemoteStage,
+            SwarmPipeline,
+        )
+
+        async with self._pipeline_lock:
+            if self._pipeline is not None:
+                return self._pipeline
+            if self._peer is None or self._peer.peer_manager is None:
+                raise RuntimeError("shard leader not attached to a peer")
+            members = self._peer.peer_manager.group_members(self.group_id)
+            by_index = {p.resource.shard_group.shard_index: p for p in members}
+            missing = [i for i in range(1, self.shard_count) if i not in by_index]
+            if missing:
+                raise RuntimeError(
+                    f"shard group {self.group_id} incomplete: "
+                    f"missing indices {missing}")
+            stages: list = [LocalStage(self.runner)]
+            opened: list[RemoteStage] = []
+            try:
+                for i in range(1, self.shard_count):
+                    pid = by_index[i].peer_id
+                    contact = self._peer.host.peerstore.get(pid)
+                    if contact is None:
+                        contact = await self._peer.dht.find_peer(pid)
+                    if contact is None:
+                        raise RuntimeError(f"shard member {pid[:8]} not dialable")
+                    stream = await self._peer.host.new_stream(
+                        contact, SHARD_PROTOCOL)
+                    stage = RemoteStage(stream)
+                    opened.append(stage)
+                    stages.append(stage)
+            except Exception:
+                for st in opened:
+                    st.close()
+                raise
+            self._pipeline = SwarmPipeline(self.cfg, self._embed_params, stages)
+            log.info("shard group %s assembled: %d stages", self.group_id,
+                     len(stages))
+            return self._pipeline
+
+    async def _drop_pipeline(self) -> None:
+        async with self._pipeline_lock:
+            if self._pipeline is not None:
+                self._pipeline.close()
+                self._pipeline = None
+
+    # ----------------------------------------------------------- inference
+
+    async def generate(  # type: ignore[override]
+        self,
+        prompt: str,
+        model: str = "",
+        max_tokens: int = 128,
+        temperature: float = 0.0,
+        top_p: float = 1.0,
+    ) -> AsyncIterator[Chunk]:
+        if not self.is_leader:
+            raise RuntimeError(
+                f"shard member {self.shard_index} of {self.group_id} does not "
+                "serve requests; the group leader routes")
+        if model and model not in self.models:
+            raise ValueError(f"model {model!r} not served (have {self.models})")
+
+        prompt_ids = self.tokenizer.encode(prompt)
+        max_seq = self.cfg.max_context_length
+        if len(prompt_ids) >= max_seq:
+            raise ValueError(
+                f"prompt of {len(prompt_ids)} tokens exceeds context {max_seq}")
+        bucket = 16
+        while bucket < len(prompt_ids):
+            bucket *= 2
+        bucket = min(bucket, max_seq)
+        budget = min(max_tokens, max_seq - len(prompt_ids))
+
+        pipeline = await self._resolve_pipeline()
+        session = uuid.uuid4().hex
+        decoder = self.tokenizer.stream_decoder()
+        completion = 0
+        t0 = time.monotonic()
+        async with self._sem:
+            self._active += 1
+            try:
+                logits = await pipeline.prefill(session, prompt_ids, bucket)
+                token = sample_host(logits, temperature, top_p, self._rng)
+                n = len(prompt_ids)
+                reason = "length"
+                while True:
+                    completion += 1
+                    if token == self.tokenizer.eos_id:
+                        reason = "stop"
+                        break
+                    text = decoder.feed(token)
+                    if text:
+                        yield Chunk(text=text)
+                    if completion >= budget:
+                        break
+                    logits = await pipeline.decode(session, token, n, n + 1)
+                    token = sample_host(logits, temperature, top_p, self._rng)
+                    n += 1
+                dt = max(time.monotonic() - t0, 1e-6)
+                inst = completion / dt
+                self._tput_ema = (inst if self._tput_ema == 0.0
+                                  else 0.8 * self._tput_ema + 0.2 * inst)
+                yield Chunk(text="", done=True, done_reason=reason,
+                            prompt_tokens=len(prompt_ids),
+                            completion_tokens=completion)
+            except (ConnectionError, asyncio.IncompleteReadError, OSError,
+                    asyncio.TimeoutError, RuntimeError):
+                # A stage died or desynchronized: drop pooled connections so
+                # the next request re-resolves the group.
+                await self._drop_pipeline()
+                raise
+            finally:
+                self._active -= 1
+                pl = self._pipeline
+                if pl is not None:
+                    try:
+                        await pl.release(session)
+                    except Exception:
+                        log.debug("session release failed", exc_info=True)

@@ -23,6 +23,7 @@ from crowdllama_tpu.core import wire
 from crowdllama_tpu.core.protocol import (
     INFERENCE_PROTOCOL,
     METADATA_PROTOCOL,
+    SHARD_PROTOCOL,
     metadata_key,
     namespace_key,
 )
@@ -90,6 +91,11 @@ class Peer:
 
         self.host.set_stream_handler(METADATA_PROTOCOL, self._handle_metadata_stream)
         self.host.set_stream_handler(INFERENCE_PROTOCOL, self._handle_inference_stream)
+        shard_service = getattr(self.engine, "shard_service", None)
+        if shard_service is not None:
+            # Sharded-model member: serve our pipeline stage to group leaders.
+            self.host.set_stream_handler(SHARD_PROTOCOL, shard_service.handle)
+        self.engine.attach_peer(self)
 
         self.peer_manager = PeerManager(
             self_peer_id=self.host.peer_id,
