@@ -83,13 +83,17 @@ class Configuration:
     decode_chunk: int = 8  # decode steps per device dispatch
     warmup: bool = True  # compile prefill/decode at engine start
 
-    # Multi-worker sharded serving (BASELINE config 5): a node with
-    # shard_count > 1 serves layer slice shard_index of an N-way pipeline
-    # split; shard_group names the group (same string on every member;
-    # default "<model>/pp<count>").  Index 0 is the group leader.
+    # Multi-worker sharded serving (BASELINE configs 4-5): a node with
+    # shard_count > 1 serves one shard of an N-way split; shard_group names
+    # the group (same string on every member; default
+    # "<model>/<strategy><count>").  Index 0 is the group leader.
+    # strategy "pp": member i serves layer slice i (pipeline stages).
+    # strategy "ep": member i hosts experts e % count == i (MoE models);
+    # the leader runs attention/router and dispatches expert batches.
     shard_group: str = ""
     shard_index: int = 0
     shard_count: int = 1
+    shard_strategy: str = "pp"  # "pp" | "ep"
 
     intervals: Intervals = field(default_factory=Intervals.default)
 
@@ -118,6 +122,7 @@ class Configuration:
         cfg.shard_group = env.get("CROWDLLAMA_TPU_SHARD_GROUP", cfg.shard_group)
         cfg.shard_index = int(env.get("CROWDLLAMA_TPU_SHARD_INDEX", cfg.shard_index))
         cfg.shard_count = int(env.get("CROWDLLAMA_TPU_SHARD_COUNT", cfg.shard_count))
+        cfg.shard_strategy = env.get("CROWDLLAMA_TPU_SHARD_STRATEGY", cfg.shard_strategy)
         if env.get("CROWDLLAMA_TPU_WARMUP"):
             cfg.warmup = env["CROWDLLAMA_TPU_WARMUP"] in ("1", "true")
         for k, v in overrides.items():
@@ -147,6 +152,9 @@ class Configuration:
                             help="this worker's pipeline stage (0 = leader)")
         parser.add_argument("--shard-count", dest="shard_count", type=int,
                             help="number of workers sharing the model")
+        parser.add_argument("--shard-strategy", dest="shard_strategy",
+                            choices=("pp", "ep"),
+                            help="pp: layer slices; ep: MoE expert banks")
 
     @classmethod
     def from_flags(cls, args: argparse.Namespace) -> "Configuration":
@@ -155,7 +163,7 @@ class Configuration:
             for k in (
                 "verbose", "key_path", "listen_port", "gateway_port",
                 "model", "model_path", "engine_backend", "mesh_shape",
-                "shard_group", "shard_index", "shard_count",
+                "shard_group", "shard_index", "shard_count", "shard_strategy",
             )
         }
         bp = getattr(args, "bootstrap_peers", None)

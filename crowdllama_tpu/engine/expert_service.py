@@ -1,0 +1,433 @@
+"""Cross-worker expert parallelism: MoE expert banks over the swarm (DCN).
+
+BASELINE config 4 capability: Mixtral-style expert FFN banks are distributed
+round-robin over the members of a shard group (core/resource.py ShardGroup,
+strategy "ep").  Every member — the leader included — hosts
+``experts e where e % shard_count == shard_index`` for all layers and serves
+them statelessly behind ``SHARD_PROTOCOL`` (op "ffn": a batch of token
+activations tagged with global expert ids).  The group leader (shard_index 0)
+runs everything else — embed, attention (and so the whole KV cache), router,
+norms, unembed — and per MoE layer computes the top-k routing, partitions the
+(token, expert) pairs by owning member, dispatches the per-member batches
+concurrently, and combines the weighted expert outputs.
+
+This is the swarm-level analog of the in-mesh ``ep`` axis
+(parallel/sharding.py shards the expert-stacked weights over ICI): over DCN
+the expert banks are DHT-discovered peers, and the all-to-all is explicit
+token batches on authenticated streams.  The reference has no model
+parallelism of any kind (/root/reference/pkg/peermanager/manager.go:338-387
+routes whole requests); this is part of the TPU-native superset.
+
+Cost note (v1): a bank computes all of its local experts for every received
+token and masks (the same compiler-friendly dense pattern as
+models/transformer.py ``_moe``, restricted to the local expert subset) —
+exact, static-shaped, and cheap at decode batch sizes; the sort-based
+grouped dispatch is the in-mesh optimization and applies here unchanged.
+Latency is dominated by one DCN round trip per MoE layer per step, which is
+intrinsic to cross-worker EP.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from crowdllama_tpu.models import transformer as T
+from crowdllama_tpu.models.config import ModelConfig
+from crowdllama_tpu.net.host import (
+    HandshakeError,
+    Stream,
+    read_json_frame,
+    write_json_frame,
+)
+from crowdllama_tpu.ops.attention import decode_attention, prefill_attention
+from crowdllama_tpu.ops.norms import rms_norm
+from crowdllama_tpu.ops.rope import apply_rope, rope_table
+from crowdllama_tpu.engine.shard_service import (
+    STAGE_CALL_TIMEOUT,
+    STREAM_IDLE_TIMEOUT,
+    read_tensor,
+    write_tensor,
+)
+
+log = logging.getLogger("crowdllama.engine.expert")
+
+
+def assign_experts(num_experts: int, shard_count: int, shard_index: int) -> list[int]:
+    """Round-robin expert placement: expert e lives on member e % count."""
+    return [e for e in range(num_experts) if e % shard_count == shard_index]
+
+
+def _pad_bucket(n: int, lo: int = 8) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+# ------------------------------------------------------------- bank (server)
+
+class ExpertBankRunner:
+    """One member's expert FFN bank: its expert subset for every layer.
+
+    Stateless — a call is (layer, global expert id per token, activations)
+    → per-token expert outputs.  Weights are stacked [L, E_local, ...] so
+    the layer index is a traced scalar (one compile per input bucket).
+    """
+
+    def __init__(self, cfg: ModelConfig, params: dict, expert_ids: list[int],
+                 dtype=jnp.bfloat16):
+        assert cfg.is_moe, "ExpertBankRunner needs an MoE config"
+        self.cfg = cfg
+        self.expert_ids = list(expert_ids)
+        self._local = {e: i for i, e in enumerate(self.expert_ids)}
+        idx = np.asarray(self.expert_ids, np.int32)
+        lw = params["layers"]
+        self.wg = jnp.asarray(lw["w_gate"][:, idx], dtype)  # [L, El, D, F]
+        self.wu = jnp.asarray(lw["w_up"][:, idx], dtype)
+        self.wd = jnp.asarray(lw["w_down"][:, idx], dtype)  # [L, El, F, D]
+        self.dtype = dtype
+
+        def _ffn(l, local_idx, x):
+            # x: [n, D]; local_idx: [n] int32; computes every local expert
+            # for every token and selects — dense/masked like _moe
+            # (models/transformer.py:131-151) over the local subset only.
+            wg = jax.lax.dynamic_index_in_dim(self.wg, l, 0, keepdims=False)
+            wu = jax.lax.dynamic_index_in_dim(self.wu, l, 0, keepdims=False)
+            wd = jax.lax.dynamic_index_in_dim(self.wd, l, 0, keepdims=False)
+            gate = jnp.einsum("nd,edf->nef", x, wg)
+            up = jnp.einsum("nd,edf->nef", x, wu)
+            act = jax.nn.silu(gate) * up
+            per = jnp.einsum("nef,efd->ned", act, wd)  # [n, El, D]
+            oh = jax.nn.one_hot(local_idx, len(self.expert_ids),
+                                dtype=jnp.float32)
+            return jnp.einsum("ned,ne->nd", per.astype(jnp.float32), oh)
+
+        self._jffn = jax.jit(_ffn)
+
+    def ffn(self, layer: int, expert_ids: np.ndarray, x: np.ndarray) -> np.ndarray:
+        """x: [n, D] activations; expert_ids: [n] GLOBAL ids (all must be
+        local to this bank).  Returns [n, D] fp32."""
+        n = x.shape[0]
+        try:
+            local = np.asarray([self._local[int(e)] for e in expert_ids], np.int32)
+        except KeyError as e:
+            raise ValueError(f"expert {e} not hosted here "
+                             f"(have {self.expert_ids})") from None
+        b = _pad_bucket(n)
+        xp = np.zeros((b, x.shape[1]), np.float32)
+        xp[:n] = x
+        lp = np.zeros((b,), np.int32)
+        lp[:n] = local
+        y = self._jffn(jnp.int32(layer), jnp.asarray(lp),
+                       jnp.asarray(xp, self.dtype))
+        return np.asarray(y[:n], np.float32)
+
+
+class ExpertBankService:
+    """Stream handler serving an ExpertBankRunner over SHARD_PROTOCOL.
+
+    Stateless ops — no sessions to leak, so the lifecycle is simpler than
+    ShardStageService: wire errors / idle timeout just close the stream.
+    """
+
+    def __init__(self, runner: ExpertBankRunner,
+                 idle_timeout: float = STREAM_IDLE_TIMEOUT):
+        self.runner = runner
+        self.idle_timeout = idle_timeout
+
+    async def handle(self, stream: Stream) -> None:
+        loop = asyncio.get_running_loop()
+        wire_errors = (asyncio.TimeoutError, asyncio.IncompleteReadError,
+                       ConnectionResetError, HandshakeError)
+        try:
+            while True:
+                try:
+                    header = await read_json_frame(stream.reader,
+                                                   timeout=self.idle_timeout)
+                    op = header.get("op", "")
+                    x = eids = None
+                    if op == "ffn":
+                        x = await read_tensor(stream.reader,
+                                              timeout=self.idle_timeout)
+                        eids = await read_tensor(stream.reader,
+                                                 timeout=self.idle_timeout)
+                except wire_errors:
+                    break
+                try:
+                    if op == "ffn":
+                        y = await loop.run_in_executor(
+                            None, self.runner.ffn, int(header["layer"]),
+                            eids.astype(np.int64), x)
+                        await write_json_frame(stream.writer, {"ok": True})
+                        await write_tensor(stream.writer, y)
+                    elif op == "info":
+                        await write_json_frame(stream.writer, {
+                            "ok": True,
+                            "expert_ids": self.runner.expert_ids,
+                            "layers": int(self.runner.wg.shape[0]),
+                        })
+                    else:
+                        await write_json_frame(
+                            stream.writer,
+                            {"ok": False, "error": f"unknown op {op!r}"})
+                except Exception as e:
+                    log.exception("expert op %s failed", op)
+                    await write_json_frame(
+                        stream.writer, {"ok": False, "error": str(e)})
+        finally:
+            stream.close()
+
+
+# ------------------------------------------------------------ bank (client)
+
+class RemoteExpertBank:
+    """Leader-side proxy for a member's expert bank (one pooled stream; a
+    lock serializes request/reply pairs)."""
+
+    def __init__(self, stream: Stream, expert_ids: list[int]):
+        self._stream = stream
+        self.expert_ids = list(expert_ids)
+        self._lock = asyncio.Lock()
+
+    async def ffn(self, layer: int, expert_ids: np.ndarray,
+                  x: np.ndarray) -> np.ndarray:
+        async with self._lock:
+            await write_json_frame(self._stream.writer,
+                                   {"op": "ffn", "layer": layer})
+            await write_tensor(self._stream.writer, x.astype(np.float32))
+            await write_tensor(self._stream.writer,
+                               expert_ids.astype(np.int32))
+            reply = await read_json_frame(self._stream.reader,
+                                          timeout=STAGE_CALL_TIMEOUT)
+            if not reply.get("ok"):
+                raise RuntimeError(f"expert bank error: {reply.get('error')}")
+            return await read_tensor(self._stream.reader,
+                                     timeout=STAGE_CALL_TIMEOUT)
+
+    def close(self) -> None:
+        self._stream.close()
+
+
+class LocalExpertBank:
+    """Leader-side adapter for the leader's own expert subset."""
+
+    def __init__(self, runner: ExpertBankRunner):
+        self.runner = runner
+        self.expert_ids = list(runner.expert_ids)
+
+    async def ffn(self, layer: int, expert_ids: np.ndarray,
+                  x: np.ndarray) -> np.ndarray:
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, self.runner.ffn, layer,
+                                          expert_ids, x)
+
+    def close(self) -> None:
+        pass
+
+
+# ------------------------------------------------------------------ leader
+
+class EPLeaderRunner:
+    """Leader-local compute for cross-worker EP: attention + router + KV.
+
+    Per-layer jitted pieces with the layer index traced (stacked non-expert
+    weights), because the expert dispatch between attention and residual-add
+    is asynchronous host code — the layer loop cannot be a lax.scan here.
+    """
+
+    _ATTN_KEYS = ("ln1", "ln2", "wq", "wk", "wv", "wo", "router")
+
+    def __init__(self, cfg: ModelConfig, params: dict, max_seq: int = 0,
+                 dtype=jnp.bfloat16):
+        assert cfg.is_moe
+        self.cfg = cfg
+        self.dtype = dtype
+        self.max_seq = max_seq or cfg.max_context_length
+        self.layers = {k: jnp.asarray(params["layers"][k], dtype)
+                       for k in self._ATTN_KEYS}
+        self.embed_params = {k: jnp.asarray(v, dtype)
+                             for k, v in params.items() if k != "layers"}
+        self._sessions: dict[str, dict[str, Any]] = {}
+
+        dh = cfg.resolved_head_dim()
+        hkv, heads = cfg.num_kv_heads, cfg.num_heads
+        scale = T.attn_scale(cfg)
+        K = cfg.num_experts_per_tok
+        cos, sin = rope_table(cfg.max_context_length, dh, cfg.rope_theta)
+
+        def _route(lp, h):
+            router_logits = jnp.einsum("...d,de->...e", h.astype(jnp.float32),
+                                       lp["router"].astype(jnp.float32))
+            topw, topi = jax.lax.top_k(router_logits, K)
+            return jax.nn.softmax(topw, axis=-1), topi
+
+        def _prefill_layer(layers, l, x, positions, kv_valid, kc, vc):
+            lp = jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, l, 0, keepdims=False),
+                layers)
+            b, t = x.shape[0], x.shape[1]
+            h = rms_norm(x, lp["ln1"], cfg.rms_norm_eps)
+            q = jnp.einsum("btd,dk->btk", h, lp["wq"]).reshape(b, t, heads, dh)
+            k = jnp.einsum("btd,dk->btk", h, lp["wk"]).reshape(b, t, hkv, dh)
+            v = jnp.einsum("btd,dk->btk", h, lp["wv"]).reshape(b, t, hkv, dh)
+            q = apply_rope(q, positions, cos, sin)
+            k = apply_rope(k, positions, cos, sin)
+            kh = k.transpose(0, 2, 1, 3)
+            vh = v.transpose(0, 2, 1, 3)
+            attn = prefill_attention(q, kh, vh, positions, scale,
+                                     kv_valid=kv_valid)
+            x = x + jnp.einsum("btk,kd->btd", attn.reshape(b, t, -1), lp["wo"])
+            h2 = rms_norm(x, lp["ln2"], cfg.rms_norm_eps)
+            topw, topi = _route(lp, h2)
+            kc = jax.lax.dynamic_update_slice(
+                kc, kh[None].astype(dtype), (l, 0, 0, 0, 0))
+            vc = jax.lax.dynamic_update_slice(
+                vc, vh[None].astype(dtype), (l, 0, 0, 0, 0))
+            return x, h2, topw, topi, kc, vc
+
+        def _decode_layer(layers, l, x, position, seq_len, kc, vc):
+            lp = jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, l, 0, keepdims=False),
+                layers)
+            b = x.shape[0]  # 1
+            h = rms_norm(x, lp["ln1"], cfg.rms_norm_eps)
+            q = jnp.einsum("bd,dk->bk", h, lp["wq"]).reshape(b, heads, dh)
+            k = jnp.einsum("bd,dk->bk", h, lp["wk"]).reshape(b, hkv, dh)
+            v = jnp.einsum("bd,dk->bk", h, lp["wv"]).reshape(b, hkv, dh)
+            pos = position[None]  # [1]
+            q = apply_rope(q[:, None], pos[:, None], cos, sin)[:, 0]
+            k = apply_rope(k[:, None], pos[:, None], cos, sin)[:, 0]
+            kc_l = jax.lax.dynamic_index_in_dim(kc, l, 0, keepdims=False)
+            vc_l = jax.lax.dynamic_index_in_dim(vc, l, 0, keepdims=False)
+            kc_l = kc_l.at[0, :, position].set(k[0].astype(dtype))
+            vc_l = vc_l.at[0, :, position].set(v[0].astype(dtype))
+            attn = decode_attention(q, kc_l, vc_l, seq_len, scale)
+            x = x + jnp.einsum("bk,kd->bd", attn.reshape(b, -1), lp["wo"])
+            h2 = rms_norm(x, lp["ln2"], cfg.rms_norm_eps)
+            topw, topi = _route(lp, h2)
+            kc = jax.lax.dynamic_update_slice(kc, kc_l[None], (l, 0, 0, 0, 0))
+            vc = jax.lax.dynamic_update_slice(vc, vc_l[None], (l, 0, 0, 0, 0))
+            return x, h2, topw, topi, kc, vc
+
+        self._jprefill_layer = jax.jit(_prefill_layer,
+                                       donate_argnums=(5, 6))
+        self._jdecode_layer = jax.jit(_decode_layer, donate_argnums=(5, 6))
+        self._jembed = jax.jit(
+            lambda tokens: T._embed(self.embed_params, cfg, tokens))
+        self._junembed = jax.jit(
+            lambda x: T._unembed(self.embed_params, cfg, x))
+        self._jadd = jax.jit(lambda x, m: x + m.astype(x.dtype))
+
+    def new_session(self, session: str) -> None:
+        L, hkv, dh = (self.cfg.num_layers, self.cfg.num_kv_heads,
+                      self.cfg.resolved_head_dim())
+        kc = jnp.zeros((L, 1, hkv, self.max_seq, dh), self.dtype)
+        self._sessions[session] = {"kc": kc, "vc": jnp.zeros_like(kc)}
+
+    def release(self, session: str) -> None:
+        self._sessions.pop(session, None)
+
+    @property
+    def session_count(self) -> int:
+        return len(self._sessions)
+
+
+# ---------------------------------------------------------------- pipeline
+
+class EPPipeline:
+    """Drives a full forward pass with swarm-distributed experts
+    (leader-side).  Same interface as shard_service.SwarmPipeline so
+    ShardedEngine can drive either strategy."""
+
+    def __init__(self, cfg: ModelConfig, runner: EPLeaderRunner, banks: list):
+        self.cfg = cfg
+        self.runner = runner
+        self.banks = banks
+        self._owner: dict[int, Any] = {}
+        for bank in banks:
+            for e in bank.expert_ids:
+                self._owner[e] = bank
+        missing = set(range(cfg.num_experts)) - set(self._owner)
+        if missing:
+            raise RuntimeError(f"experts {sorted(missing)} unassigned")
+
+    async def _moe(self, layer: int, h: np.ndarray, topw: np.ndarray,
+                   topi: np.ndarray) -> np.ndarray:
+        """h: [n, D]; topw/topi: [n, K].  Partition (token, expert) pairs by
+        owning bank, dispatch concurrently, combine weighted outputs."""
+        n, K = topi.shape
+        flat_tok = np.repeat(np.arange(n), K)
+        flat_e = topi.reshape(-1)
+        flat_w = topw.reshape(-1).astype(np.float32)
+        calls = []
+        for bank in self.banks:
+            sel = np.isin(flat_e, np.asarray(bank.expert_ids))
+            if sel.any():
+                calls.append((bank, sel))
+        results = await asyncio.gather(*(
+            bank.ffn(layer, flat_e[sel], h[flat_tok[sel]])
+            for bank, sel in calls))
+        out = np.zeros_like(h, dtype=np.float32)
+        for (bank, sel), y in zip(calls, results):
+            np.add.at(out, flat_tok[sel], flat_w[sel, None] * y)
+        return out
+
+    async def prefill(self, session: str, prompt_ids: list[int],
+                      bucket: int) -> np.ndarray:
+        """Returns the last position's logits [V] (fp32)."""
+        loop = asyncio.get_running_loop()
+        r = self.runner
+        plen = len(prompt_ids)
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :plen] = prompt_ids
+        positions = jnp.minimum(jnp.arange(bucket)[None, :], plen - 1)
+        kv_valid = (jnp.arange(bucket) < plen)[None, :]
+        r.new_session(session)
+        sess = r._sessions[session]
+        x = await loop.run_in_executor(None, r._jembed, jnp.asarray(tokens))
+        for l in range(self.cfg.num_layers):
+            x, h2, topw, topi, sess["kc"], sess["vc"] = (
+                await loop.run_in_executor(
+                    None, r._jprefill_layer, r.layers, jnp.int32(l), x,
+                    positions, kv_valid, sess["kc"], sess["vc"]))
+            moe = await self._moe(
+                l, np.asarray(h2[0], np.float32),
+                np.asarray(topw[0], np.float32), np.asarray(topi[0]))
+            x = await loop.run_in_executor(
+                None, r._jadd, x, jnp.asarray(moe[None]))
+        logits = await loop.run_in_executor(None, r._junembed, x)
+        return np.asarray(logits[0, plen - 1], np.float32)
+
+    async def decode(self, session: str, token: int, position: int,
+                     seq_len: int) -> np.ndarray:
+        loop = asyncio.get_running_loop()
+        r = self.runner
+        sess = r._sessions[session]
+        x = await loop.run_in_executor(
+            None, r._jembed, jnp.asarray([token], jnp.int32))
+        pos = jnp.int32(position)
+        sl = jnp.asarray([seq_len], jnp.int32)
+        for l in range(self.cfg.num_layers):
+            x, h2, topw, topi, sess["kc"], sess["vc"] = (
+                await loop.run_in_executor(
+                    None, r._jdecode_layer, r.layers, jnp.int32(l), x, pos,
+                    sl, sess["kc"], sess["vc"]))
+            moe = await self._moe(
+                l, np.asarray(h2, np.float32),
+                np.asarray(topw, np.float32), np.asarray(topi))
+            x = await loop.run_in_executor(None, r._jadd, x, jnp.asarray(moe))
+        logits = await loop.run_in_executor(None, r._junembed, x)
+        return np.asarray(logits[0], np.float32)
+
+    async def release(self, session: str) -> None:
+        self.runner.release(session)
+
+    def close(self) -> None:
+        for bank in self.banks:
+            bank.close()

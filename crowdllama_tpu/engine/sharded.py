@@ -1,20 +1,29 @@
 """ShardedEngine: multi-worker sharded serving behind the Engine seam.
 
-BASELINE config 5 wired end-to-end: a node started with
-``--shard-group G --shard-index i --shard-count N`` serves layer slice i of
-an N-way pipeline split.  Every member registers the ``SHARD_PROTOCOL``
-stream service (engine/shard_service.py) and advertises a
-``ShardGroup(strategy="pp")`` in its Resource; the scheduler
-(peermanager/manager.py) routes requests for the model to the group leader
-(shard_index 0) once — and only while — the group is complete.
+BASELINE configs 4 and 5 wired end-to-end: a node started with
+``--shard-group G --shard-index i --shard-count N [--shard-strategy pp|ep]``
+serves one shard of an N-way split.  Every member registers the
+``SHARD_PROTOCOL`` stream service and advertises a ``ShardGroup`` in its
+Resource; the scheduler (peermanager/manager.py) routes requests for the
+model to the group leader (shard_index 0) once — and only while — the group
+is complete.
 
-The leader is itself stage 0: on each request it assembles the stage chain
-(LocalStage + one RemoteStage per DHT-discovered member, connections pooled
-across requests), drives SwarmPipeline prefill/decode, samples on the host,
-and streams tokens.  A member failure mid-request drops the pooled
-connections so the next request re-resolves the (possibly re-formed) group;
-the health machine marks the dead member unhealthy, which makes the group
-incomplete and the leader unroutable until it recovers.
+Strategies:
+
+- **"pp"** (config 5): member i serves layer slice i
+  (engine/shard_service.py).  The leader is itself stage 0: it assembles
+  the stage chain (LocalStage + one RemoteStage per DHT-discovered member,
+  connections pooled across requests), drives SwarmPipeline
+  prefill/decode, samples on the host, and streams tokens.
+- **"ep"** (config 4, MoE models): member i hosts experts
+  ``e % N == i`` for every layer (engine/expert_service.py).  The leader
+  runs attention/router/KV locally and dispatches per-expert token batches
+  to the banks, combining the weighted outputs.
+
+Either way, a member failure mid-request drops the pooled connections so
+the next request re-resolves the (possibly re-formed) group; the health
+machine marks the dead member unhealthy, which makes the group incomplete
+and the leader unroutable until it recovers.
 
 The reference routes whole requests to single Ollama workers
 (/root/reference/pkg/peermanager/manager.go:338-387) and has no model
@@ -78,8 +87,12 @@ class ShardedEngine(Engine):
             raise ValueError(
                 f"shard_index {self.config.shard_index} out of range for "
                 f"shard_count {self.config.shard_count}")
-        self.group_id = (self.config.shard_group
-                         or f"{self.config.model}/pp{self.config.shard_count}")
+        self.strategy = self.config.shard_strategy
+        if self.strategy not in ("pp", "ep"):
+            raise ValueError(f"unknown shard strategy {self.strategy!r}")
+        self.group_id = (
+            self.config.shard_group
+            or f"{self.config.model}/{self.strategy}{self.config.shard_count}")
         self.shard_index = self.config.shard_index
         self.shard_count = self.config.shard_count
         self.is_leader = self.shard_index == 0
@@ -99,10 +112,6 @@ class ShardedEngine(Engine):
     # ----------------------------------------------------------- lifecycle
 
     async def start(self) -> None:
-        from crowdllama_tpu.engine.shard_service import (
-            ShardStageRunner,
-            ShardStageService,
-        )
         from crowdllama_tpu.engine.tokenizer import get_tokenizer
         from crowdllama_tpu.engine.weights import load_or_init_params
         from crowdllama_tpu.models.config import get_config
@@ -113,31 +122,60 @@ class ShardedEngine(Engine):
                 self.config.model,
                 max_context_length=min(cfg.max_context_length,
                                        self.config.max_context_length))
+        if self.strategy == "ep" and not cfg.is_moe:
+            raise ValueError(
+                f"shard strategy 'ep' needs an MoE model; {cfg.name} is dense")
         self.cfg = cfg
         loop = asyncio.get_running_loop()
-
-        def _build():
-            # Every member loads the checkpoint and keeps only its slice
-            # (ShardStageRunner copies its layer range); the leader also
-            # keeps embed/unembed.  Same seed => identical random-init
-            # weights across members when no checkpoint is given.
-            params = load_or_init_params(cfg, self.config.model_path)
-            runner = ShardStageRunner(
-                cfg, params, self.shard_index, self.shard_count,
-                max_seq=cfg.max_context_length)
-            embed = ({k: v for k, v in params.items() if k != "layers"}
-                     if self.is_leader else None)
-            return runner, embed
-
-        self.runner, self._embed_params = await loop.run_in_executor(None, _build)
-        self.shard_service = ShardStageService(self.runner)
+        # Every member loads the checkpoint and keeps only its shard; the
+        # leader also keeps embed/unembed (+ attention for "ep").  Same seed
+        # => identical random-init weights across members when no checkpoint
+        # is given.
+        if self.strategy == "pp":
+            build = self._build_pp
+        else:
+            build = self._build_ep
+        await loop.run_in_executor(None, build)
         if self.is_leader:
             self.tokenizer = get_tokenizer(self.config.model_path)
             self._sem = asyncio.Semaphore(self.config.max_batch_slots)
-        log.info("shard stage up: group=%s index=%d/%d layers=%s%s",
-                 self.group_id, self.shard_index, self.shard_count,
-                 self.runner.layer_range,
-                 " (leader)" if self.is_leader else "")
+        log.info("shard member up: group=%s strategy=%s index=%d/%d%s",
+                 self.group_id, self.strategy, self.shard_index,
+                 self.shard_count, " (leader)" if self.is_leader else "")
+
+    def _build_pp(self) -> None:
+        from crowdllama_tpu.engine.shard_service import (
+            ShardStageRunner,
+            ShardStageService,
+        )
+        from crowdllama_tpu.engine.weights import load_or_init_params
+
+        params = load_or_init_params(self.cfg, self.config.model_path)
+        self.runner = ShardStageRunner(
+            self.cfg, params, self.shard_index, self.shard_count,
+            max_seq=self.cfg.max_context_length)
+        self._embed_params = (
+            {k: v for k, v in params.items() if k != "layers"}
+            if self.is_leader else None)
+        self.shard_service = ShardStageService(self.runner)
+
+    def _build_ep(self) -> None:
+        from crowdllama_tpu.engine.expert_service import (
+            EPLeaderRunner,
+            ExpertBankRunner,
+            ExpertBankService,
+            assign_experts,
+        )
+        from crowdllama_tpu.engine.weights import load_or_init_params
+
+        params = load_or_init_params(self.cfg, self.config.model_path)
+        self.expert_ids = assign_experts(
+            self.cfg.num_experts, self.shard_count, self.shard_index)
+        self.bank = ExpertBankRunner(self.cfg, params, self.expert_ids)
+        self.shard_service = ExpertBankService(self.bank)
+        self.runner = (EPLeaderRunner(self.cfg, params,
+                                      max_seq=self.cfg.max_context_length)
+                       if self.is_leader else None)
 
     async def stop(self) -> None:
         async with self._pipeline_lock:
@@ -156,21 +194,56 @@ class ShardedEngine(Engine):
             "shard_group": ShardGroup(
                 group_id=self.group_id,
                 model=self.config.model,
-                strategy="pp",
+                strategy=self.strategy,
                 shard_index=self.shard_index,
                 shard_count=self.shard_count,
+                expert_ids=list(getattr(self, "expert_ids", [])),
             ),
         }
 
     # ------------------------------------------------------ stage assembly
 
-    async def _resolve_pipeline(self):
-        """Build (or reuse) the SwarmPipeline over the current group.
-
-        Requires the peer manager to see every shard index healthy; dials
-        each remote member's SHARD_PROTOCOL once and pools the streams.
-        """
+    async def _dial_members(self) -> dict[int, "object"]:
+        """Resolve and dial every non-leader member's SHARD_PROTOCOL; returns
+        {shard_index: (PeerInfo, Stream)}.  Caller owns the streams."""
         from crowdllama_tpu.core.protocol import SHARD_PROTOCOL
+
+        if self._peer is None or self._peer.peer_manager is None:
+            raise RuntimeError("shard leader not attached to a peer")
+        members = self._peer.peer_manager.group_members(self.group_id)
+        by_index = {p.resource.shard_group.shard_index: p for p in members}
+        missing = [i for i in range(1, self.shard_count) if i not in by_index]
+        if missing:
+            raise RuntimeError(
+                f"shard group {self.group_id} incomplete: "
+                f"missing indices {missing}")
+        dialed: dict[int, tuple] = {}
+        try:
+            for i in range(1, self.shard_count):
+                info = by_index[i]
+                contact = self._peer.host.peerstore.get(info.peer_id)
+                if contact is None:
+                    contact = await self._peer.dht.find_peer(info.peer_id)
+                if contact is None:
+                    raise RuntimeError(
+                        f"shard member {info.peer_id[:8]} not dialable")
+                stream = await self._peer.host.new_stream(
+                    contact, SHARD_PROTOCOL)
+                dialed[i] = (info, stream)
+        except Exception:
+            for _, stream in dialed.values():
+                stream.close()
+            raise
+        return dialed
+
+    async def _resolve_pipeline(self):
+        """Build (or reuse) the pipeline over the current group: dials each
+        remote member's SHARD_PROTOCOL once and pools the streams."""
+        from crowdllama_tpu.engine.expert_service import (
+            EPPipeline,
+            LocalExpertBank,
+            RemoteExpertBank,
+        )
         from crowdllama_tpu.engine.shard_service import (
             LocalStage,
             RemoteStage,
@@ -180,37 +253,22 @@ class ShardedEngine(Engine):
         async with self._pipeline_lock:
             if self._pipeline is not None:
                 return self._pipeline
-            if self._peer is None or self._peer.peer_manager is None:
-                raise RuntimeError("shard leader not attached to a peer")
-            members = self._peer.peer_manager.group_members(self.group_id)
-            by_index = {p.resource.shard_group.shard_index: p for p in members}
-            missing = [i for i in range(1, self.shard_count) if i not in by_index]
-            if missing:
-                raise RuntimeError(
-                    f"shard group {self.group_id} incomplete: "
-                    f"missing indices {missing}")
-            stages: list = [LocalStage(self.runner)]
-            opened: list[RemoteStage] = []
-            try:
+            dialed = await self._dial_members()
+            if self.strategy == "pp":
+                stages: list = [LocalStage(self.runner)]
                 for i in range(1, self.shard_count):
-                    pid = by_index[i].peer_id
-                    contact = self._peer.host.peerstore.get(pid)
-                    if contact is None:
-                        contact = await self._peer.dht.find_peer(pid)
-                    if contact is None:
-                        raise RuntimeError(f"shard member {pid[:8]} not dialable")
-                    stream = await self._peer.host.new_stream(
-                        contact, SHARD_PROTOCOL)
-                    stage = RemoteStage(stream)
-                    opened.append(stage)
-                    stages.append(stage)
-            except Exception:
-                for st in opened:
-                    st.close()
-                raise
-            self._pipeline = SwarmPipeline(self.cfg, self._embed_params, stages)
-            log.info("shard group %s assembled: %d stages", self.group_id,
-                     len(stages))
+                    stages.append(RemoteStage(dialed[i][1]))
+                self._pipeline = SwarmPipeline(
+                    self.cfg, self._embed_params, stages)
+            else:
+                banks: list = [LocalExpertBank(self.bank)]
+                for i in range(1, self.shard_count):
+                    info, stream = dialed[i]
+                    advertised = list(info.resource.shard_group.expert_ids)
+                    banks.append(RemoteExpertBank(stream, advertised))
+                self._pipeline = EPPipeline(self.cfg, self.runner, banks)
+            log.info("shard group %s assembled (%s, %d members)",
+                     self.group_id, self.strategy, self.shard_count)
             return self._pipeline
 
     async def _drop_pipeline(self) -> None:

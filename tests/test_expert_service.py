@@ -1,0 +1,158 @@
+"""Cross-worker expert parallelism: swarm expert banks must match the dense
+MoE model (BASELINE config 4).
+
+The EP pipeline — leader attention/router + 2 expert banks, one behind a
+real authenticated loopback stream — greedily decodes the same tokens as
+the single-process dense forward (models/transformer.py `_moe`).  Plus the
+scheduler rule: an ep group routes to its leader only while complete.
+"""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+
+from crowdllama_tpu.core.protocol import SHARD_PROTOCOL
+from crowdllama_tpu.core.resource import Resource, ShardGroup
+from crowdllama_tpu.engine.expert_service import (
+    EPLeaderRunner,
+    EPPipeline,
+    ExpertBankRunner,
+    ExpertBankService,
+    LocalExpertBank,
+    RemoteExpertBank,
+    assign_experts,
+)
+from crowdllama_tpu.models import transformer as T
+from crowdllama_tpu.models.config import get_config
+from crowdllama_tpu.net.host import Host
+from crowdllama_tpu.peermanager.manager import PeerManager
+
+
+def test_assign_experts_partitions():
+    for n in (2, 3, 4):
+        parts = [assign_experts(8, n, i) for i in range(n)]
+        assert sorted(e for p in parts for e in p) == list(range(8))
+
+
+def test_expert_bank_matches_dense_moe_term():
+    """Bank output for (token, expert) pairs == that expert's dense FFN."""
+    cfg = get_config("tiny-test-moe", max_context_length=32)
+    params = T.init_params(cfg, jax.random.PRNGKey(7), dtype=jnp.float32)
+    bank = ExpertBankRunner(cfg, params, [1, 3], dtype=jnp.float32)
+    x = np.asarray(jax.random.normal(jax.random.PRNGKey(8), (5, cfg.hidden_size)),
+                   np.float32)
+    eids = np.asarray([1, 3, 1, 1, 3])
+    layer = 1
+    got = bank.ffn(layer, eids, x)
+    lw = params["layers"]
+    for i, e in enumerate(eids):
+        gate = x[i] @ np.asarray(lw["w_gate"][layer, e])
+        up = x[i] @ np.asarray(lw["w_up"][layer, e])
+        want = (np.asarray(jax.nn.silu(gate)) * up) @ np.asarray(lw["w_down"][layer, e])
+        np.testing.assert_allclose(got[i], want, rtol=2e-4, atol=2e-5)
+    with pytest.raises(ValueError, match="not hosted"):
+        bank.ffn(0, np.asarray([0]), x[:1])
+
+
+def _dense_greedy(cfg, params, prompt, steps):
+    tokens = jnp.asarray([prompt])
+    pos = jnp.arange(len(prompt))[None, :]
+    logits, ks, vs = T.prefill(params, cfg, tokens, pos)
+    out = [int(logits[0, -1].argmax())]
+    S = cfg.max_context_length
+    L, hkv, dh = cfg.num_layers, cfg.num_kv_heads, cfg.resolved_head_dim()
+    kc = jnp.zeros((L, 1, hkv, S, dh), jnp.float32)
+    vc = jnp.zeros((L, 1, hkv, S, dh), jnp.float32)
+    kc = kc.at[:, :, :, :len(prompt)].set(ks)
+    vc = vc.at[:, :, :, :len(prompt)].set(vs)
+    n = len(prompt)
+    for _ in range(steps):
+        step_logits, kc, vc = T.decode_step(
+            params, cfg, jnp.asarray([out[-1]]), jnp.asarray([n]),
+            kc, vc, jnp.asarray([n + 1]))
+        out.append(int(step_logits[0].argmax()))
+        n += 1
+    return out
+
+
+async def test_ep_pipeline_matches_dense():
+    cfg = get_config("tiny-test-moe", max_context_length=32)
+    params = T.init_params(cfg, jax.random.PRNGKey(3), dtype=jnp.float32)
+    prompt = [3, 1, 4, 1, 5, 9]
+    steps = 5
+    want = _dense_greedy(cfg, params, prompt, steps)
+
+    # Bank for experts {1, 3} behind a real stream host; leader keeps {0, 2}.
+    remote_runner = ExpertBankRunner(cfg, params, assign_experts(4, 2, 1),
+                                     dtype=jnp.float32)
+    service = ExpertBankService(remote_runner)
+    worker_host = Host(Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    worker_host.set_stream_handler(SHARD_PROTOCOL, service.handle)
+    await worker_host.start()
+    leader_host = Host(Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    await leader_host.start()
+    pipe = None
+    try:
+        stream = await leader_host.new_stream(worker_host.contact,
+                                              SHARD_PROTOCOL)
+        leader = EPLeaderRunner(cfg, params, max_seq=32, dtype=jnp.float32)
+        local = LocalExpertBank(
+            ExpertBankRunner(cfg, params, assign_experts(4, 2, 0),
+                             dtype=jnp.float32))
+        pipe = EPPipeline(cfg, leader, [
+            local, RemoteExpertBank(stream, remote_runner.expert_ids)])
+
+        sid = "sess-ep"
+        logits = await pipe.prefill(sid, prompt, bucket=16)
+        got = [int(np.argmax(logits))]
+        n = len(prompt)
+        for _ in range(steps):
+            logits = await pipe.decode(sid, got[-1], n, n + 1)
+            got.append(int(np.argmax(logits)))
+            n += 1
+        await pipe.release(sid)
+        assert leader.session_count == 0
+        assert got == want, f"ep swarm {got} vs dense {want}"
+    finally:
+        if pipe is not None:
+            pipe.close()
+        await leader_host.close()
+        await worker_host.close()
+
+
+def test_ep_pipeline_requires_full_expert_coverage():
+    cfg = get_config("tiny-test-moe", max_context_length=32)
+    params = T.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    leader = EPLeaderRunner(cfg, params, max_seq=32, dtype=jnp.float32)
+    bank = LocalExpertBank(ExpertBankRunner(cfg, params, [0, 2],
+                                            dtype=jnp.float32))
+    with pytest.raises(RuntimeError, match="unassigned"):
+        EPPipeline(cfg, leader, [bank])
+
+
+def _res(pid, index, count, expert_ids, model="tiny-test-moe"):
+    r = Resource(peer_id=pid, supported_models=[model], worker_mode=True,
+                 tokens_throughput=10.0, load=0.0,
+                 shard_group=ShardGroup(group_id="g-ep", model=model,
+                                        strategy="ep", shard_index=index,
+                                        shard_count=count,
+                                        expert_ids=expert_ids))
+    r.touch()
+    return r
+
+
+def test_scheduler_routes_complete_ep_group_to_leader():
+    pm = PeerManager(self_peer_id="self")
+    pm.add_or_update_peer(_res("leader", 0, 2, [0, 2]))
+    # Incomplete group: leader alone is unroutable.
+    assert pm.find_best_worker("tiny-test-moe") is None
+    pm.add_or_update_peer(_res("member", 1, 2, [1, 3]))
+    best = pm.find_best_worker("tiny-test-moe")
+    assert best is not None and best.peer_id == "leader"
+    # Member death -> incomplete again.
+    pm.remove_peer("member")
+    assert pm.find_best_worker("tiny-test-moe") is None

@@ -148,6 +148,74 @@ async def test_sharded_model_served_and_group_failure():
         await boot_host.close()
 
 
+async def test_ep_sharded_model_served_through_gateway():
+    """BASELINE config 4 end-to-end: 2 ShardedEngine(strategy=ep) workers
+    hosting Mixtral-style expert banks + gateway; /api/chat routes to the
+    leader which dispatches expert batches to the member over
+    SHARD_PROTOCOL."""
+    model, group = "tiny-test-moe", "tiny-test-moe/ep2"
+    boot_host, _ = await new_host_and_dht(
+        Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    bootstrap = f"127.0.0.1:{boot_host.listen_port}"
+
+    leader_cfg = _cfg(bootstrap, model=model, shard_group=group,
+                      shard_index=0, shard_count=2, shard_strategy="ep")
+    member_cfg = _cfg(bootstrap, model=model, shard_group=group,
+                      shard_index=1, shard_count=2, shard_strategy="ep")
+    leader_engine = ShardedEngine(leader_cfg)
+    member_engine = ShardedEngine(member_cfg)
+    await leader_engine.start()
+    await member_engine.start()
+    assert leader_engine.expert_ids == [0, 2]
+    assert member_engine.expert_ids == [1, 3]
+
+    leader = Peer(Ed25519PrivateKey.generate(), leader_cfg,
+                  engine=leader_engine, worker_mode=True)
+    member = Peer(Ed25519PrivateKey.generate(), member_cfg,
+                  engine=member_engine, worker_mode=True)
+    await leader.start()
+    await member.start()
+    consumer = Peer(Ed25519PrivateKey.generate(), _cfg(bootstrap, model=model),
+                    engine=FakeEngine(models=[]), worker_mode=False)
+    await consumer.start()
+    gateway = Gateway(consumer, port=0, host="127.0.0.1")
+    await gateway.start()
+    gw_port = gateway._runner.addresses[0][1]
+    try:
+        await _wait_for(
+            lambda: (
+                (best := consumer.peer_manager.find_best_worker(model)) is not None
+                and best.peer_id == leader.peer_id
+                and any(p.peer_id == member.peer_id
+                        for p in leader.peer_manager.group_members(group))
+            ),
+            what="complete ep group discovered",
+        )
+        # expert_ids survive the metadata round trip.
+        info = consumer.peer_manager.get_peer(member.peer_id)
+        assert info.resource.shard_group.expert_ids == [1, 3]
+
+        async with aiohttp.ClientSession() as s:
+            body = {"model": model, "options": {"num_predict": 4},
+                    "messages": [{"role": "user", "content": "hi"}]}
+            async with s.post(f"http://127.0.0.1:{gw_port}/api/chat",
+                              json=body) as resp:
+                assert resp.status == 200, await resp.text()
+                d = await resp.json()
+            assert d["done"] is True
+            assert d["worker_id"] == leader.peer_id
+            assert d["eval_count"] >= 1
+        assert leader_engine.runner.session_count == 0
+    finally:
+        await gateway.stop()
+        await consumer.stop()
+        await member.stop()
+        await leader.stop()
+        await leader_engine.stop()
+        await member_engine.stop()
+        await boot_host.close()
+
+
 async def test_sharded_engine_pipeline_matches_dense_greedy():
     """Leader+member over real streams greedily decode the same ids as the
     dense single-process forward (numeric wiring check at the engine level)."""
