@@ -8,11 +8,15 @@ figure in its tree is the hardcoded 150 tokens/sec a worker *advertises*
 measured tokens/sec/chip divided by that advertised 150 tok/s.
 
 Model defaults to TinyLlama-1.1B (BASELINE config 1, randomly initialized —
-throughput does not depend on weight values).  Overridables via env:
-  CROWDLLAMA_BENCH_MODEL   (default tinyllama-1.1b)
-  CROWDLLAMA_BENCH_SLOTS   batch slots        (default 8)
-  CROWDLLAMA_BENCH_STEPS   timed decode steps (default 128)
-  CROWDLLAMA_BENCH_CTX     max context        (default 1024)
+throughput does not depend on weight values).  Weights are int8 by default
+(weight-only, ops/quant.py) — the parity-honest configuration: the
+reference's engine (Ollama) serves quantized GGUF by default, and decode is
+bandwidth-bound either way.  Overridables via env:
+  CROWDLLAMA_BENCH_MODEL     (default tinyllama-1.1b)
+  CROWDLLAMA_BENCH_SLOTS     batch slots        (default 8)
+  CROWDLLAMA_BENCH_STEPS     timed decode steps (default 128)
+  CROWDLLAMA_BENCH_CTX       max context        (default 1024)
+  CROWDLLAMA_BENCH_QUANTIZE  "int8" | "none"    (default int8)
 """
 
 from __future__ import annotations
@@ -37,6 +41,7 @@ def main() -> None:
     slots = int(os.environ.get("CROWDLLAMA_BENCH_SLOTS", "8"))
     steps = int(os.environ.get("CROWDLLAMA_BENCH_STEPS", "128"))
     ctx = int(os.environ.get("CROWDLLAMA_BENCH_CTX", "1024"))
+    quantize = os.environ.get("CROWDLLAMA_BENCH_QUANTIZE", "int8")
 
     cfg = get_config(model)
     if ctx < cfg.max_context_length:
@@ -45,10 +50,21 @@ def main() -> None:
 
     print(f"# bench: model={model} slots={slots} steps={steps} "
           f"ctx={cfg.max_context_length} devices={n_chips} "
-          f"platform={jax.devices()[0].platform}", file=sys.stderr)
+          f"quantize={quantize} platform={jax.devices()[0].platform}",
+          file=sys.stderr)
 
     t0 = time.monotonic()
-    runner = ModelRunner(cfg, max_slots=slots, max_seq=cfg.max_context_length)
+    params = None
+    if quantize == "int8":
+        import jax.numpy as jnp
+
+        from crowdllama_tpu.models import transformer as T
+        from crowdllama_tpu.ops.quant import quantize_params
+
+        params = quantize_params(
+            T.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16))
+    runner = ModelRunner(cfg, params=params, max_slots=slots,
+                         max_seq=cfg.max_context_length)
     state = runner.init_state()
 
     # Fill every slot with a short prompt so the decode batch is saturated.

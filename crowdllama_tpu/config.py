@@ -82,6 +82,7 @@ class Configuration:
     mesh_shape: str = ""  # e.g. "1x8" → (dp=1, tp=8); empty = all devices on tp
     decode_chunk: int = 8  # decode steps per device dispatch
     warmup: bool = True  # compile prefill/decode at engine start
+    quantize: str = ""  # "" (bf16) | "int8" weight-only (ops/quant.py)
 
     # Multi-worker sharded serving (BASELINE configs 4-5): a node with
     # shard_count > 1 serves one shard of an N-way split; shard_group names
@@ -123,6 +124,7 @@ class Configuration:
         cfg.shard_index = int(env.get("CROWDLLAMA_TPU_SHARD_INDEX", cfg.shard_index))
         cfg.shard_count = int(env.get("CROWDLLAMA_TPU_SHARD_COUNT", cfg.shard_count))
         cfg.shard_strategy = env.get("CROWDLLAMA_TPU_SHARD_STRATEGY", cfg.shard_strategy)
+        cfg.quantize = env.get("CROWDLLAMA_TPU_QUANTIZE", cfg.quantize)
         if env.get("CROWDLLAMA_TPU_WARMUP"):
             cfg.warmup = env["CROWDLLAMA_TPU_WARMUP"] in ("1", "true")
         for k, v in overrides.items():
@@ -155,6 +157,9 @@ class Configuration:
         parser.add_argument("--shard-strategy", dest="shard_strategy",
                             choices=("pp", "ep"),
                             help="pp: layer slices; ep: MoE expert banks")
+        parser.add_argument("--quantize", dest="quantize",
+                            choices=("", "int8"),
+                            help="weight-only quantization for the engine")
 
     @classmethod
     def from_flags(cls, args: argparse.Namespace) -> "Configuration":
@@ -164,6 +169,7 @@ class Configuration:
                 "verbose", "key_path", "listen_port", "gateway_port",
                 "model", "model_path", "engine_backend", "mesh_shape",
                 "shard_group", "shard_index", "shard_count", "shard_strategy",
+                "quantize",
             )
         }
         bp = getattr(args, "bootstrap_peers", None)

@@ -152,6 +152,10 @@ class JaxEngine(Engine):
 
         def _build():
             params = load_or_init_params(cfg, self.config.model_path)
+            if self.config.quantize == "int8":
+                from crowdllama_tpu.ops.quant import quantize_params
+
+                params = quantize_params(params)
             return ModelRunner(
                 cfg,
                 params=params,

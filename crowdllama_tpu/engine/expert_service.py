@@ -396,11 +396,17 @@ class EPPipeline:
                 await loop.run_in_executor(
                     None, r._jprefill_layer, r.layers, jnp.int32(l), x,
                     positions, kv_valid, sess["kc"], sess["vc"]))
+            # Dispatch only the real prompt rows — padding tokens would
+            # otherwise be routed and FFN-computed remotely for every layer
+            # (up to ~2x wasted DCN bytes at worst-case bucket fill).
             moe = await self._moe(
-                l, np.asarray(h2[0], np.float32),
-                np.asarray(topw[0], np.float32), np.asarray(topi[0]))
+                l, np.asarray(h2[0], np.float32)[:plen],
+                np.asarray(topw[0], np.float32)[:plen],
+                np.asarray(topi[0])[:plen])
+            full = np.zeros((bucket, moe.shape[-1]), np.float32)
+            full[:plen] = moe
             x = await loop.run_in_executor(
-                None, r._jadd, x, jnp.asarray(moe[None]))
+                None, r._jadd, x, jnp.asarray(full[None]))
         logits = await loop.run_in_executor(None, r._junembed, x)
         return np.asarray(logits[0, plen - 1], np.float32)
 

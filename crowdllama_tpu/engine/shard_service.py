@@ -105,8 +105,15 @@ class ShardStageRunner:
         l_local = cfg.num_layers // shard_count
         lo = shard_index * l_local
         self.layer_range = (lo, lo + l_local)
-        self.layers = jax.tree_util.tree_map(
-            lambda a: jnp.asarray(a[lo:lo + l_local], dtype), params["layers"])
+
+        def _slice(a):
+            # Preserve integer dtypes: int8 leaves of quantized weights
+            # (ops/quant.py QTensor.q) must not be upcast to the compute
+            # dtype or the memory halving is lost.
+            out_dtype = dtype if jnp.issubdtype(a.dtype, jnp.floating) else a.dtype
+            return jnp.asarray(a[lo:lo + l_local], out_dtype)
+
+        self.layers = jax.tree_util.tree_map(_slice, params["layers"])
         self.windows = T.layer_sliding_windows(cfg)[lo:lo + l_local]
         self._sessions: dict[str, dict[str, Any]] = {}
 

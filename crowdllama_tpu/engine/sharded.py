@@ -125,6 +125,11 @@ class ShardedEngine(Engine):
         if self.strategy == "ep" and not cfg.is_moe:
             raise ValueError(
                 f"shard strategy 'ep' needs an MoE model; {cfg.name} is dense")
+        if self.strategy == "ep" and self.config.quantize:
+            # Expert banks slice raw weight arrays; int8 there is future work
+            # — reject loudly rather than silently serving bf16.
+            raise ValueError("quantize is not supported with shard strategy "
+                             "'ep' yet (use 'pp' or unsharded)")
         self.cfg = cfg
         loop = asyncio.get_running_loop()
         # Every member loads the checkpoint and keeps only its shard; the
@@ -151,6 +156,10 @@ class ShardedEngine(Engine):
         from crowdllama_tpu.engine.weights import load_or_init_params
 
         params = load_or_init_params(self.cfg, self.config.model_path)
+        if self.config.quantize == "int8":
+            from crowdllama_tpu.ops.quant import quantize_params
+
+            params = quantize_params(params)
         self.runner = ShardStageRunner(
             self.cfg, params, self.shard_index, self.shard_count,
             max_seq=self.cfg.max_context_length)
@@ -254,19 +263,26 @@ class ShardedEngine(Engine):
             if self._pipeline is not None:
                 return self._pipeline
             dialed = await self._dial_members()
-            if self.strategy == "pp":
-                stages: list = [LocalStage(self.runner)]
-                for i in range(1, self.shard_count):
-                    stages.append(RemoteStage(dialed[i][1]))
-                self._pipeline = SwarmPipeline(
-                    self.cfg, self._embed_params, stages)
-            else:
-                banks: list = [LocalExpertBank(self.bank)]
-                for i in range(1, self.shard_count):
-                    info, stream = dialed[i]
-                    advertised = list(info.resource.shard_group.expert_ids)
-                    banks.append(RemoteExpertBank(stream, advertised))
-                self._pipeline = EPPipeline(self.cfg, self.runner, banks)
+            try:
+                if self.strategy == "pp":
+                    stages: list = [LocalStage(self.runner)]
+                    for i in range(1, self.shard_count):
+                        stages.append(RemoteStage(dialed[i][1]))
+                    self._pipeline = SwarmPipeline(
+                        self.cfg, self._embed_params, stages)
+                else:
+                    banks: list = [LocalExpertBank(self.bank)]
+                    for i in range(1, self.shard_count):
+                        info, stream = dialed[i]
+                        advertised = list(info.resource.shard_group.expert_ids)
+                        banks.append(RemoteExpertBank(stream, advertised))
+                    self._pipeline = EPPipeline(self.cfg, self.runner, banks)
+            except Exception:
+                # e.g. EPPipeline's expert-coverage check on a stale
+                # advertisement — don't leak the freshly dialed streams.
+                for _, stream in dialed.values():
+                    stream.close()
+                raise
             log.info("shard group %s assembled (%s, %d members)",
                      self.group_id, self.strategy, self.shard_count)
             return self._pipeline
@@ -345,9 +361,11 @@ class ShardedEngine(Engine):
                 raise
             finally:
                 self._active -= 1
-                pl = self._pipeline
-                if pl is not None:
-                    try:
-                        await pl.release(session)
-                    except Exception:
-                        log.debug("session release failed", exc_info=True)
+                # Release on the pipeline this request ran on (NOT
+                # self._pipeline, which a failure just nulled): local-stage /
+                # leader KV sessions must be freed even when remote stages
+                # are already gone, or failed requests leak device memory.
+                try:
+                    await pipeline.release(session)
+                except Exception:
+                    log.debug("session release failed", exc_info=True)

@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from crowdllama_tpu.models.config import ModelConfig
+from crowdllama_tpu.ops.quant import dequant
 from crowdllama_tpu.ops.attention import decode_attention, prefill_attention
 from crowdllama_tpu.ops.norms import rms_norm
 from crowdllama_tpu.ops.ring import (
@@ -114,7 +115,7 @@ def _unembed(params: Params, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
                             params["embed"].astype(jnp.float32))
     else:
         logits = jnp.einsum("...d,dv->...v", x.astype(jnp.float32),
-                            params["lm_head"].astype(jnp.float32))
+                            dequant(params["lm_head"]).astype(jnp.float32))
     if cfg.final_logit_softcap > 0:
         logits = cfg.final_logit_softcap * jnp.tanh(logits / cfg.final_logit_softcap)
     return logits
@@ -122,10 +123,10 @@ def _unembed(params: Params, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
 
 def _mlp(lp: Params, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
     """Dense SwiGLU (Llama) / GeGLU-tanh (Gemma) MLP. x: [..., D]."""
-    gate = jnp.einsum("...d,df->...f", x, lp["w_gate"])
-    up = jnp.einsum("...d,df->...f", x, lp["w_up"])
+    gate = jnp.einsum("...d,df->...f", x, dequant(lp["w_gate"]))
+    up = jnp.einsum("...d,df->...f", x, dequant(lp["w_up"]))
     act = jax.nn.gelu(gate, approximate=True) if cfg.family == "gemma2" else jax.nn.silu(gate)
-    return jnp.einsum("...f,fd->...d", act * up, lp["w_down"])
+    return jnp.einsum("...f,fd->...d", act * up, dequant(lp["w_down"]))
 
 
 def _moe(lp: Params, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
@@ -143,10 +144,10 @@ def _moe(lp: Params, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
     one_hot = jax.nn.one_hot(topi, cfg.num_experts, dtype=jnp.float32)  # [...,K,E]
     weights = jnp.einsum("...ke,...k->...e", one_hot, topw)
 
-    gate = jnp.einsum("...d,edf->...ef", x, lp["w_gate"])
-    up = jnp.einsum("...d,edf->...ef", x, lp["w_up"])
+    gate = jnp.einsum("...d,edf->...ef", x, dequant(lp["w_gate"]))
+    up = jnp.einsum("...d,edf->...ef", x, dequant(lp["w_up"]))
     act = jax.nn.silu(gate) * up
-    per_expert = jnp.einsum("...ef,efd->...ed", act, lp["w_down"])  # [..., E, D]
+    per_expert = jnp.einsum("...ef,efd->...ed", act, dequant(lp["w_down"]))  # [..., E, D]
     out = jnp.einsum("...ed,...e->...d", per_expert.astype(jnp.float32), weights)
     return out.astype(x.dtype)
 
@@ -182,9 +183,9 @@ def scan_prefill_layers(
     def body(x, scanned):
         lp, window = scanned
         h = rms_norm(x, lp["ln1"], cfg.rms_norm_eps, plus_one=cfg.family == "gemma2")
-        q = jnp.einsum("btd,dk->btk", h, lp["wq"]).reshape(b, t, cfg.num_heads, dh)
-        k = jnp.einsum("btd,dk->btk", h, lp["wk"]).reshape(b, t, hkv, dh)
-        v = jnp.einsum("btd,dk->btk", h, lp["wv"]).reshape(b, t, hkv, dh)
+        q = jnp.einsum("btd,dk->btk", h, dequant(lp["wq"])).reshape(b, t, cfg.num_heads, dh)
+        k = jnp.einsum("btd,dk->btk", h, dequant(lp["wk"])).reshape(b, t, hkv, dh)
+        v = jnp.einsum("btd,dk->btk", h, dequant(lp["wv"])).reshape(b, t, hkv, dh)
         q = apply_rope(q, positions, cos, sin)
         k = apply_rope(k, positions, cos, sin)
         kh = k.transpose(0, 2, 1, 3)  # [B, Hkv, T, Dh] — cache layout
@@ -199,7 +200,7 @@ def scan_prefill_layers(
                                      softcap=cfg.attn_logit_softcap,
                                      sliding_window=window, kv_valid=kv_valid,
                                      n_shards=n_shards)
-        attn = jnp.einsum("btk,kd->btd", attn.reshape(b, t, -1), lp["wo"])
+        attn = jnp.einsum("btk,kd->btd", attn.reshape(b, t, -1), dequant(lp["wo"]))
         if cfg.post_norms:
             attn = rms_norm(attn, lp["post_ln1"], cfg.rms_norm_eps, plus_one=True)
         x = x + attn
@@ -273,9 +274,9 @@ def scan_decode_layers(
     def body(x, scanned):
         lp, kc, vc, window = scanned  # kc/vc: [B, Hkv, S, Dh]
         h = rms_norm(x, lp["ln1"], cfg.rms_norm_eps, plus_one=cfg.family == "gemma2")
-        q = jnp.einsum("bd,dk->bk", h, lp["wq"]).reshape(b, cfg.num_heads, dh)
-        k = jnp.einsum("bd,dk->bk", h, lp["wk"]).reshape(b, hkv, dh)
-        v = jnp.einsum("bd,dk->bk", h, lp["wv"]).reshape(b, hkv, dh)
+        q = jnp.einsum("bd,dk->bk", h, dequant(lp["wq"])).reshape(b, cfg.num_heads, dh)
+        k = jnp.einsum("bd,dk->bk", h, dequant(lp["wk"])).reshape(b, hkv, dh)
+        v = jnp.einsum("bd,dk->bk", h, dequant(lp["wv"])).reshape(b, hkv, dh)
         q = apply_rope(q[:, None], positions[:, None], cos, sin)[:, 0]
         k = apply_rope(k[:, None], positions[:, None], cos, sin)[:, 0]
         if sp_mesh is not None:
@@ -292,7 +293,7 @@ def scan_decode_layers(
             attn = decode_attention(q, kc, vc, seq_lens, scale,
                                     softcap=cfg.attn_logit_softcap,
                                     sliding_window=window, n_shards=n_shards)
-        attn = jnp.einsum("bk,kd->bd", attn.reshape(b, -1), lp["wo"])
+        attn = jnp.einsum("bk,kd->bd", attn.reshape(b, -1), dequant(lp["wo"]))
         if cfg.post_norms:
             attn = rms_norm(attn, lp["post_ln1"], cfg.rms_norm_eps, plus_one=True)
         x = x + attn

@@ -80,12 +80,28 @@ def cache_pspec(mesh: Mesh | None = None) -> P:
 
 
 def shard_params(params: Params, cfg: ModelConfig, mesh: Mesh) -> Params:
-    """Place a param pytree onto the mesh with the PP/TP/EP partition rules."""
+    """Place a param pytree onto the mesh with the PP/TP/EP partition rules.
+
+    Quantized leaves (ops.quant.QTensor) shard ``q`` with the original
+    weight's spec and ``s`` with that spec minus the input dim."""
+    from crowdllama_tpu.ops.quant import QTensor, drop_input_axis_spec
+
     specs = param_pspecs(cfg)
+
+    def place(a, s):
+        if isinstance(a, QTensor):
+            return QTensor(
+                q=jax.device_put(
+                    a.q, NamedSharding(mesh, filter_spec(s, mesh))),
+                s=jax.device_put(
+                    a.s, NamedSharding(mesh, filter_spec(
+                        drop_input_axis_spec(s, a.q.ndim), mesh))),
+            )
+        return jax.device_put(a, NamedSharding(mesh, filter_spec(s, mesh)))
+
     return jax.tree_util.tree_map(
-        lambda a, s: jax.device_put(
-            a, NamedSharding(mesh, filter_spec(s, mesh))),
-        params, specs,
+        place, params, specs,
+        is_leaf=lambda x: isinstance(x, QTensor),
     )
 
 

@@ -1,0 +1,118 @@
+"""Weight-only int8 quantization (ops/quant.py): numerics, engine
+integration, and mesh sharding of QTensor leaves."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from crowdllama_tpu.models import transformer as T
+from crowdllama_tpu.models.config import get_config
+from crowdllama_tpu.ops.quant import (
+    QTensor,
+    dequant,
+    quantize_params,
+    quantize_weight,
+)
+
+
+def test_quantize_roundtrip_error_small():
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 128), jnp.float32)
+    qt = quantize_weight(w)
+    assert qt.q.dtype == jnp.int8 and qt.q.shape == w.shape
+    assert qt.s.shape == (128,)
+    back = np.asarray(dequant(qt), np.float32)
+    # Per-channel int8: max error scale/2 per element, plus bf16 rounding of
+    # the scale itself (~0.4% relative).
+    scale = np.asarray(qt.s, np.float32)
+    err = np.abs(back - np.asarray(w))
+    bound = scale[None, :] * 0.51 + np.abs(np.asarray(w)) * 0.01 + 1e-6
+    assert (err <= bound).all()
+
+
+def test_dequant_passthrough_plain_arrays():
+    w = jnp.ones((4, 4))
+    assert dequant(w) is w
+
+
+def test_quantized_model_logits_close_all_families():
+    for name in ("tiny-test", "tiny-test-moe", "tiny-test-gemma"):
+        cfg = get_config(name, max_context_length=32)
+        params = T.init_params(cfg, jax.random.PRNGKey(1), dtype=jnp.float32)
+        qparams = quantize_params(params)
+        tokens = jnp.asarray([[257, 104, 105, 32, 119]])
+        pos = jnp.arange(5)[None, :]
+        ref, _, _ = T.prefill(params, cfg, tokens, pos)
+        got, _, _ = T.prefill(qparams, cfg, tokens, pos)
+        a = np.asarray(ref, np.float64).ravel()
+        b = np.asarray(got, np.float64).ravel()
+        corr = np.corrcoef(a, b)[0, 1]
+        assert corr > 0.995, f"{name}: logits corr {corr}"
+
+
+def test_quantized_params_shard_onto_mesh():
+    from crowdllama_tpu.parallel.mesh import build_mesh
+    from crowdllama_tpu.parallel.sharding import shard_params
+
+    cfg = get_config("tiny-test", max_context_length=32)
+    qparams = quantize_params(
+        T.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16))
+    mesh = build_mesh("2x2x1x1x2")  # dp=2, pp=2, sp=1, ep=1, tp=2
+    sharded = shard_params(qparams, cfg, mesh)
+    wq = sharded["layers"]["wq"]
+    assert isinstance(wq, QTensor)
+    # q keeps the weight's (pp, -, tp) layout; s drops the input dim.
+    assert wq.q.sharding.spec == jax.sharding.PartitionSpec("pp", None, "tp")
+    assert wq.s.sharding.spec == jax.sharding.PartitionSpec("pp", "tp")
+    # And the sharded quantized model still runs a forward pass.
+    tokens = jnp.asarray([[1, 2, 3]])
+    pos = jnp.arange(3)[None, :]
+    logits, _, _ = T.prefill(sharded, cfg, tokens, pos)
+    assert logits.shape == (1, 3, cfg.vocab_size)
+
+
+async def test_quantized_shard_stage_keeps_int8():
+    """pp-sharded stages of a quantized model keep int8 slices and match the
+    quantized dense forward."""
+    from crowdllama_tpu.engine.shard_service import (
+        LocalStage,
+        ShardStageRunner,
+        SwarmPipeline,
+    )
+
+    cfg = get_config("tiny-test", max_context_length=32)
+    params = T.init_params(cfg, jax.random.PRNGKey(2), dtype=jnp.float32)
+    qparams = quantize_params(params)
+    prompt = [3, 1, 4, 1, 5]
+    tokens = jnp.asarray([prompt])
+    pos = jnp.arange(len(prompt))[None, :]
+    ref, _, _ = T.prefill(qparams, cfg, tokens, pos)
+    want = int(ref[0, -1].argmax())
+
+    stages = [
+        LocalStage(ShardStageRunner(cfg, qparams, 0, 2, max_seq=32,
+                                    dtype=jnp.float32)),
+        LocalStage(ShardStageRunner(cfg, qparams, 1, 2, max_seq=32,
+                                    dtype=jnp.float32)),
+    ]
+    assert stages[0].runner.layers["wq"].q.dtype == jnp.int8
+    pipe = SwarmPipeline(cfg, {k: v for k, v in qparams.items()
+                               if k != "layers"}, stages, dtype=jnp.float32)
+    logits = await pipe.prefill("s", prompt, bucket=16)
+    assert int(np.argmax(logits)) == want
+    await pipe.release("s")
+
+
+def test_quantized_runner_decodes():
+    from crowdllama_tpu.engine.runner import ModelRunner
+
+    cfg = get_config("tiny-test", max_context_length=64)
+    params = quantize_params(
+        T.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16))
+    runner = ModelRunner(cfg, params=params, max_slots=2, max_seq=64)
+    state = runner.init_state()
+    tok, ks, vs, plen = runner.prefill([1, 2, 3], 0.0, 1.0,
+                                       jax.random.PRNGKey(0))
+    state = runner.insert(state, 0, ks, vs, plen, tok, 0.0, 1.0)
+    toks, state = runner.decode_steps(state, 4)
+    assert toks.shape == (4, runner.max_slots)
+    assert (toks[:, 0] >= 0).all()
