@@ -1,0 +1,159 @@
+"""Swarm scaling benchmark: gateway throughput as workers grow 1 -> 16
+(BASELINE metric 3 of 3).
+
+All-in-one-process topology on loopback (the reference's integration-test
+strategy, /root/reference/test/integration_test.go): DHT bootstrap + N
+FakeEngine workers + consumer/gateway.  For each swarm size the bench fires
+concurrent /api/chat requests and measures sustained requests/sec plus how
+long discovery took to see all N workers.  FakeEngine isolates the
+control-plane cost — discovery, scheduling, stream dial/handshake, PB codec
+— which is exactly what "swarm scaling" measures (engine throughput is
+bench.py's job).
+
+Prints ONE JSON line; value is requests/sec at the largest swarm, extra
+holds the full scaling curve.
+
+Env overrides:
+  CROWDLLAMA_BENCH_SIZES       comma list        (default "1,2,4,8,16")
+  CROWDLLAMA_BENCH_REQUESTS    requests per size (default 60)
+  CROWDLLAMA_BENCH_CONCURRENCY in-flight cap     (default 8)
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))  # repo root
+
+# Honor JAX_PLATFORMS even when the interpreter pre-imported jax pinned to
+# another platform (see cli/main.py) — must run before any backend init.
+import os
+
+if os.environ.get("JAX_PLATFORMS"):
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    except Exception:
+        pass
+
+import asyncio
+import json
+import os
+import time
+
+
+async def run() -> dict:
+    import aiohttp
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+    )
+
+    from crowdllama_tpu.config import Configuration, Intervals
+    from crowdllama_tpu.engine.engine import FakeEngine
+    from crowdllama_tpu.gateway.gateway import Gateway
+    from crowdllama_tpu.net.discovery import new_host_and_dht
+    from crowdllama_tpu.peer.peer import Peer
+
+    sizes = [int(x) for x in os.environ.get(
+        "CROWDLLAMA_BENCH_SIZES", "1,2,4,8,16").split(",")]
+    n_requests = int(os.environ.get("CROWDLLAMA_BENCH_REQUESTS", "60"))
+    concurrency = int(os.environ.get("CROWDLLAMA_BENCH_CONCURRENCY", "8"))
+    model = "bench-model"
+
+    def cfg(**kw):
+        c = Configuration(listen_host="127.0.0.1", model=model,
+                          intervals=Intervals.default())
+        for k, v in kw.items():
+            setattr(c, k, v)
+        return c
+
+    boot_host, _ = await new_host_and_dht(
+        Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    bootstrap = f"127.0.0.1:{boot_host.listen_port}"
+
+    consumer = Peer(Ed25519PrivateKey.generate(),
+                    cfg(bootstrap_peers=[bootstrap]),
+                    engine=FakeEngine(models=[]), worker_mode=False)
+    await consumer.start()
+    gateway = Gateway(consumer, port=0, host="127.0.0.1")
+    await gateway.start()
+    gw_port = gateway._runner.addresses[0][1]
+    url = f"http://127.0.0.1:{gw_port}/api/chat"
+    body = {"model": model,
+            "messages": [{"role": "user", "content": "scale test"}]}
+
+    workers: list[Peer] = []
+    curve = []
+    try:
+        async with aiohttp.ClientSession() as session:
+            for size in sizes:
+                t_grow = time.monotonic()
+                while len(workers) < size:
+                    w = Peer(Ed25519PrivateKey.generate(),
+                             cfg(bootstrap_peers=[bootstrap]),
+                             engine=FakeEngine(models=[model]),
+                             worker_mode=True)
+                    await w.start()
+                    workers.append(w)
+                # Wait until the gateway's manager sees all of them.
+                deadline = time.monotonic() + 60
+                while time.monotonic() < deadline:
+                    healthy = {p.peer_id for p in
+                               consumer.peer_manager.get_healthy_peers()
+                               if p.is_worker}
+                    if len(healthy) >= size:
+                        break
+                    await asyncio.sleep(0.1)
+                else:
+                    raise RuntimeError(f"discovery stalled at size {size}")
+                discovery_s = time.monotonic() - t_grow
+
+                sem = asyncio.Semaphore(concurrency)
+                hits: dict[str, int] = {}
+
+                async def one():
+                    async with sem:
+                        async with session.post(url, json=body) as resp:
+                            assert resp.status == 200, await resp.text()
+                            d = await resp.json()
+                            hits[d["worker_id"]] = hits.get(d["worker_id"], 0) + 1
+
+                t0 = time.monotonic()
+                await asyncio.gather(*(one() for _ in range(n_requests)))
+                dt = time.monotonic() - t0
+                curve.append({
+                    "workers": size,
+                    "requests_per_sec": round(n_requests / dt, 1),
+                    "discovery_s": round(discovery_s, 2),
+                    "distinct_workers_hit": len(hits),
+                })
+                print(f"# size={size}: {n_requests/dt:.1f} req/s, "
+                      f"discovery {discovery_s:.2f}s, "
+                      f"{len(hits)} workers hit", file=sys.stderr)
+    finally:
+        await gateway.stop()
+        await consumer.stop()
+        for w in workers:
+            await w.stop()
+        await boot_host.close()
+
+    return {
+        "metric": f"swarm scaling 1->{sizes[-1]} workers, gateway requests/sec",
+        "value": curve[-1]["requests_per_sec"],
+        "unit": "requests/sec",
+        "vs_baseline": None,  # reference publishes no scaling numbers
+        "extra": {"curve": curve, "concurrency": concurrency},
+    }
+
+
+def main() -> None:
+    os.environ.setdefault("CROWDLLAMA_TPU_TEST_MODE", "1")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    result = asyncio.run(run())
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,142 @@
+"""Gateway TTFT benchmark: p50/p95 time-to-first-token through the full
+stack (BASELINE metric 2 of 3).
+
+Topology on loopback, all real sockets: DHT bootstrap node + worker
+(JaxEngine, streaming) + consumer peer + gateway.  Each request POSTs
+/api/chat with stream=true and times the first NDJSON frame — the true TTFT
+a client observes, crossing HTTP -> scheduler/prefill -> stream protocol ->
+HTTP chunk.  The reference cannot measure this at all: its stream flag is a
+no-op, so TTFT == total latency there (SURVEY §3.3).
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline", "extra"}.
+vs_baseline is null: the reference publishes no TTFT number (BASELINE.md).
+
+Env overrides:
+  CROWDLLAMA_BENCH_MODEL     engine model      (default tiny-test on cpu,
+                             tinyllama-1.1b when a TPU is attached)
+  CROWDLLAMA_BENCH_REQUESTS  timed requests    (default 20)
+  CROWDLLAMA_BENCH_PROMPT    prompt length chars (default 128)
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))  # repo root
+
+# Honor JAX_PLATFORMS even when the interpreter pre-imported jax pinned to
+# another platform (see cli/main.py) — must run before any backend init.
+import os
+
+if os.environ.get("JAX_PLATFORMS"):
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    except Exception:
+        pass
+
+import asyncio
+import json
+import os
+import statistics
+import time
+
+
+async def run() -> dict:
+    import aiohttp
+    import jax
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+    )
+
+    from crowdllama_tpu.config import Configuration, Intervals
+    from crowdllama_tpu.engine.engine import FakeEngine, JaxEngine
+    from crowdllama_tpu.gateway.gateway import Gateway
+    from crowdllama_tpu.net.discovery import new_host_and_dht
+    from crowdllama_tpu.peer.peer import Peer
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    model = os.environ.get(
+        "CROWDLLAMA_BENCH_MODEL", "tinyllama-1.1b" if on_tpu else "tiny-test")
+    n_requests = int(os.environ.get("CROWDLLAMA_BENCH_REQUESTS", "20"))
+    prompt = "benchmark " * (int(os.environ.get("CROWDLLAMA_BENCH_PROMPT", "128")) // 10)
+
+    def cfg(**kw):
+        c = Configuration(listen_host="127.0.0.1", model=model,
+                          intervals=Intervals.default())
+        for k, v in kw.items():
+            setattr(c, k, v)
+        return c
+
+    boot_host, _ = await new_host_and_dht(
+        Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    bootstrap = f"127.0.0.1:{boot_host.listen_port}"
+
+    engine = JaxEngine(cfg(), max_context_length=1024,
+                       quantize="int8" if on_tpu else "")
+    await engine.start()
+    worker = Peer(Ed25519PrivateKey.generate(), cfg(bootstrap_peers=[bootstrap]),
+                  engine=engine, worker_mode=True)
+    await worker.start()
+    consumer = Peer(Ed25519PrivateKey.generate(), cfg(bootstrap_peers=[bootstrap]),
+                    engine=FakeEngine(models=[]), worker_mode=False)
+    await consumer.start()
+    gateway = Gateway(consumer, port=0, host="127.0.0.1")
+    await gateway.start()
+    gw_port = gateway._runner.addresses[0][1]
+
+    # Wait for discovery.
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if consumer.peer_manager.find_best_worker(model) is not None:
+            break
+        await asyncio.sleep(0.1)
+    else:
+        raise RuntimeError("worker never discovered")
+
+    body = {"model": model, "stream": True, "options": {"num_predict": 4},
+            "messages": [{"role": "user", "content": prompt}]}
+    url = f"http://127.0.0.1:{gw_port}/api/chat"
+    ttfts: list[float] = []
+    async with aiohttp.ClientSession() as s:
+        # Warmup (compiles prefill buckets).
+        async with s.post(url, json=body) as resp:
+            await resp.read()
+        for _ in range(n_requests):
+            t0 = time.monotonic()
+            async with s.post(url, json=body) as resp:
+                assert resp.status == 200, await resp.text()
+                async for _ in resp.content:  # first NDJSON frame
+                    ttfts.append((time.monotonic() - t0) * 1000)
+                    break
+                await resp.read()
+
+    await gateway.stop()
+    await consumer.stop()
+    await worker.stop()
+    await engine.stop()
+    await boot_host.close()
+
+    ttfts.sort()
+    p50 = statistics.median(ttfts)
+    p95 = ttfts[max(0, int(len(ttfts) * 0.95) - 1)]
+    return {
+        "metric": f"{model} gateway TTFT p50",
+        "value": round(p50, 1),
+        "unit": "ms",
+        "vs_baseline": None,  # reference publishes no TTFT (BASELINE.md)
+        "extra": {"p95_ms": round(p95, 1), "requests": n_requests,
+                  "platform": "tpu" if on_tpu else "cpu"},
+    }
+
+
+def main() -> None:
+    os.environ.setdefault("CROWDLLAMA_TPU_TEST_MODE", "1")
+    result = asyncio.run(run())
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

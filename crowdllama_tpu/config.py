@@ -19,6 +19,17 @@ def is_test_mode() -> bool:
     return os.environ.get("CROWDLLAMA_TPU_TEST_MODE", "") == "1"
 
 
+def _norm_quantize(value: str) -> str:
+    """Normalize quantize spellings; reject unknown modes loudly (a typo
+    must not silently serve bf16)."""
+    v = (value or "").strip().lower()
+    if v in ("", "none", "off", "0", "false"):
+        return ""
+    if v == "int8":
+        return "int8"
+    raise ValueError(f"unknown quantize mode {value!r} (want '' or 'int8')")
+
+
 @dataclass
 class Intervals:
     """Every background cadence in one place, test-mode aware.
@@ -124,7 +135,8 @@ class Configuration:
         cfg.shard_index = int(env.get("CROWDLLAMA_TPU_SHARD_INDEX", cfg.shard_index))
         cfg.shard_count = int(env.get("CROWDLLAMA_TPU_SHARD_COUNT", cfg.shard_count))
         cfg.shard_strategy = env.get("CROWDLLAMA_TPU_SHARD_STRATEGY", cfg.shard_strategy)
-        cfg.quantize = env.get("CROWDLLAMA_TPU_QUANTIZE", cfg.quantize)
+        cfg.quantize = _norm_quantize(
+            env.get("CROWDLLAMA_TPU_QUANTIZE", cfg.quantize))
         if env.get("CROWDLLAMA_TPU_WARMUP"):
             cfg.warmup = env["CROWDLLAMA_TPU_WARMUP"] in ("1", "true")
         for k, v in overrides.items():

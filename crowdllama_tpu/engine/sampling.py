@@ -22,7 +22,8 @@ TOPK_WINDOW = 64
 def sample_tokens(
     logits: jnp.ndarray,        # [B, V] fp32
     temperature: jnp.ndarray,   # [B] — 0 means greedy
-    top_p: jnp.ndarray,         # [B] — 1 means no nucleus filtering
+    top_p: jnp.ndarray,         # [B] — 1 means no nucleus filter beyond the
+                                #      top-`window` truncation (see module doc)
     key: jax.Array,
     window: int = TOPK_WINDOW,
 ) -> jnp.ndarray:

@@ -54,24 +54,29 @@ def sample_host(logits: np.ndarray, temperature: float, top_p: float,
     The pipeline returns one [V] logits vector per step; sampling here is
     trivial work next to a DCN round trip, so there is nothing to fuse
     on-device (contrast engine/sampling.py, which runs inside the jitted
-    decode step of the single-worker engine).
+    decode step of the single-worker engine).  Matches that sampler's
+    distribution: nucleus over the top-`TOPK_WINDOW` logits (greedy exact),
+    so a request samples identically whether it lands on a sharded leader
+    or an unsharded worker.
     """
+    from crowdllama_tpu.engine.sampling import TOPK_WINDOW
+
     if temperature <= 0:
         return int(logits.argmax())
-    x = logits.astype(np.float64) / max(temperature, 1e-6)
+    w = min(TOPK_WINDOW, logits.shape[-1])
+    top = np.argpartition(logits, -w)[-w:]
+    top = top[np.argsort(logits[top])[::-1]]  # descending
+    x = logits[top].astype(np.float64) / max(temperature, 1e-6)
     x -= x.max()
     probs = np.exp(x)
     probs /= probs.sum()
     if top_p < 1.0:
-        order = np.argsort(probs)[::-1]
-        cum = np.cumsum(probs[order])
-        keep = (cum - probs[order]) < top_p
-        keep[0] = True  # always keep the top token
-        mask = np.zeros(probs.shape, bool)
-        mask[order[keep]] = True
-        probs = np.where(mask, probs, 0.0)
+        cum = np.cumsum(probs)
+        keep = (cum - probs) < top_p
+        keep[0] = True  # the top token always survives
+        probs = np.where(keep, probs, 0.0)
         probs /= probs.sum()
-    return int(rng.choice(len(probs), p=probs))
+    return int(top[rng.choice(w, p=probs)])
 
 
 class ShardedEngine(Engine):
