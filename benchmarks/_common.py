@@ -1,0 +1,21 @@
+"""Shared benchmark bootstrap: repo-root import path + JAX platform re-pin.
+
+Imported for its side effects at the top of every benchmark script —
+keeping the platform-override workaround in exactly one place.
+"""
+
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))  # repo root
+
+# Honor JAX_PLATFORMS even when the interpreter pre-imported jax pinned to
+# another platform (see cli/main.py) — must run before any backend init.
+if os.environ.get("JAX_PLATFORMS"):
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    except Exception:  # pragma: no cover - jax absent or already initialized
+        pass

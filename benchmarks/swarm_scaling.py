@@ -24,19 +24,8 @@ from __future__ import annotations
 import sys
 from pathlib import Path
 
-sys.path.insert(0, str(Path(__file__).resolve().parent.parent))  # repo root
-
-# Honor JAX_PLATFORMS even when the interpreter pre-imported jax pinned to
-# another platform (see cli/main.py) — must run before any backend init.
-import os
-
-if os.environ.get("JAX_PLATFORMS"):
-    try:
-        import jax
-
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-    except Exception:
-        pass
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+import _common  # noqa: F401,E402 - repo path + JAX platform bootstrap
 
 import asyncio
 import json

@@ -23,19 +23,8 @@ from __future__ import annotations
 import sys
 from pathlib import Path
 
-sys.path.insert(0, str(Path(__file__).resolve().parent.parent))  # repo root
-
-# Honor JAX_PLATFORMS even when the interpreter pre-imported jax pinned to
-# another platform (see cli/main.py) — must run before any backend init.
-import os
-
-if os.environ.get("JAX_PLATFORMS"):
-    try:
-        import jax
-
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-    except Exception:
-        pass
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+import _common  # noqa: F401,E402 - repo path + JAX platform bootstrap
 
 import asyncio
 import json
@@ -87,37 +76,38 @@ async def run() -> dict:
     await gateway.start()
     gw_port = gateway._runner.addresses[0][1]
 
-    # Wait for discovery.
-    deadline = time.monotonic() + 30
-    while time.monotonic() < deadline:
-        if consumer.peer_manager.find_best_worker(model) is not None:
-            break
-        await asyncio.sleep(0.1)
-    else:
-        raise RuntimeError("worker never discovered")
+    try:
+        # Wait for discovery.
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if consumer.peer_manager.find_best_worker(model) is not None:
+                break
+            await asyncio.sleep(0.1)
+        else:
+            raise RuntimeError("worker never discovered")
 
-    body = {"model": model, "stream": True, "options": {"num_predict": 4},
-            "messages": [{"role": "user", "content": prompt}]}
-    url = f"http://127.0.0.1:{gw_port}/api/chat"
-    ttfts: list[float] = []
-    async with aiohttp.ClientSession() as s:
-        # Warmup (compiles prefill buckets).
-        async with s.post(url, json=body) as resp:
-            await resp.read()
-        for _ in range(n_requests):
-            t0 = time.monotonic()
+        body = {"model": model, "stream": True, "options": {"num_predict": 4},
+                "messages": [{"role": "user", "content": prompt}]}
+        url = f"http://127.0.0.1:{gw_port}/api/chat"
+        ttfts: list[float] = []
+        async with aiohttp.ClientSession() as s:
+            # Warmup (compiles prefill buckets).
             async with s.post(url, json=body) as resp:
-                assert resp.status == 200, await resp.text()
-                async for _ in resp.content:  # first NDJSON frame
-                    ttfts.append((time.monotonic() - t0) * 1000)
-                    break
                 await resp.read()
-
-    await gateway.stop()
-    await consumer.stop()
-    await worker.stop()
-    await engine.stop()
-    await boot_host.close()
+            for _ in range(n_requests):
+                t0 = time.monotonic()
+                async with s.post(url, json=body) as resp:
+                    assert resp.status == 200, await resp.text()
+                    async for _ in resp.content:  # first NDJSON frame
+                        ttfts.append((time.monotonic() - t0) * 1000)
+                        break
+                    await resp.read()
+    finally:
+        await gateway.stop()
+        await consumer.stop()
+        await worker.stop()
+        await engine.stop()
+        await boot_host.close()
 
     ttfts.sort()
     p50 = statistics.median(ttfts)

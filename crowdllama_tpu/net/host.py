@@ -1,4 +1,4 @@
-"""Asyncio TCP stream host with authenticated protocol-tagged streams.
+"""Asyncio TCP stream host with authenticated, encrypted protocol streams.
 
 Plays the role libp2p's host plays in the reference
 (/root/reference/internal/discovery/discovery.go:48-84): a node listens on one
@@ -6,9 +6,14 @@ TCP port; every logical *stream* is a fresh TCP connection opened with a
 signed hello naming a protocol ID, and is dispatched to the handler registered
 for that protocol (cf. peer.go:177-182 setupStreamHandler).  Identity is an
 Ed25519 key; peer IDs are derived from the public key so a forged hello fails
-signature or ID verification.  (The reference gets transport security from
-libp2p's noise/TLS defaults; here the hello authenticates the peer, payload
-encryption is a non-goal for the control plane v0.)
+signature or ID verification.
+
+Transport security matches the reference's libp2p noise/TLS defaults: each
+signed hello carries an ephemeral X25519 key (covered by the signature, so
+it is identity-bound), the ECDH secret is HKDF'd into directional
+ChaCha20-Poly1305 keys, and everything after the handshake crosses the wire
+as AEAD frames (net/secure.py).  Streams refuse peers that do not offer
+encryption — there is no plaintext fallback.
 """
 
 from __future__ import annotations
@@ -27,7 +32,18 @@ from cryptography.hazmat.primitives.asymmetric.ed25519 import (
     Ed25519PrivateKey,
     Ed25519PublicKey,
 )
+from cryptography.hazmat.primitives.asymmetric.x25519 import X25519PrivateKey
+from cryptography.hazmat.primitives.serialization import (
+    Encoding,
+    PublicFormat,
+)
 
+from crowdllama_tpu.net.secure import (
+    SecureReader,
+    SecureWriter,
+    derive_keys,
+    ecdh,
+)
 from crowdllama_tpu.utils.keys import peer_id_from_public_key
 
 _LEN = struct.Struct(">I")
@@ -92,13 +108,16 @@ class Contact:
 
 @dataclass
 class Stream:
-    """An open protocol-tagged byte stream to an authenticated remote peer."""
+    """An open protocol-tagged byte stream to an authenticated remote peer.
+
+    reader/writer are the AEAD adapters (net/secure.py) exposing the
+    asyncio Stream{Reader,Writer} surface."""
 
     protocol: str
     remote_peer_id: str
     remote_contact: Contact | None  # None when the remote is not listening
-    reader: asyncio.StreamReader
-    writer: asyncio.StreamWriter
+    reader: "asyncio.StreamReader"
+    writer: "asyncio.StreamWriter"
 
     def close(self) -> None:
         try:
@@ -114,16 +133,19 @@ class Stream:
 
 
 def _hello_signing_bytes(
-    proto: str, peer_id: str, ts: float, nonce: str, listen_port: int
+    proto: str, peer_id: str, ts: float, nonce: str, listen_port: int,
+    eph_hex: str,
 ) -> bytes:
     """Bytes covered by a hello/ack signature.
 
     ``nonce`` is the *remote* side's fresh challenge, making hellos
     non-replayable; ``listen_port`` is covered so an observer cannot rewrite
-    the advertised dial-back address.
+    the advertised dial-back address; ``eph_hex`` (the X25519 ephemeral
+    public key) is covered so a middleman cannot substitute its own key —
+    the signature binds the encryption channel to the peer identity.
     """
     return b"crowdllama-tpu-hello|" + "|".join(
-        [proto, peer_id, f"{ts:.3f}", nonce, str(listen_port)]
+        [proto, peer_id, f"{ts:.3f}", nonce, str(listen_port), eph_hex]
     ).encode()
 
 
@@ -228,9 +250,13 @@ class Host:
             if not server_nonce:
                 raise HandshakeError("missing server nonce")
 
+            eph = X25519PrivateKey.generate()
+            eph_hex = eph.public_key().public_bytes(
+                Encoding.Raw, PublicFormat.Raw).hex()
             ts = time.time()
             sig = self.key.sign(
-                _hello_signing_bytes(protocol, self.peer_id, ts, server_nonce, self.listen_port)
+                _hello_signing_bytes(protocol, self.peer_id, ts, server_nonce,
+                                     self.listen_port, eph_hex)
             )
             await write_json_frame(
                 writer,
@@ -241,24 +267,29 @@ class Host:
                     "ts": ts,
                     "sig": sig.hex(),
                     "listen_port": self.listen_port,
+                    "eph": eph_hex,
                 },
             )
             ack = await read_json_frame(reader, timeout)
             if not ack.get("ok"):
                 raise HandshakeError(f"remote rejected stream: {ack.get('error', 'unknown')}")
-            remote_id = _verify_hello(ack, protocol, my_nonce)
+            remote_id, remote_eph = _verify_hello(ack, protocol, my_nonce)
             if expect_id is not None and remote_id != expect_id:
                 raise HandshakeError(
                     f"peer identity mismatch: expected {expect_id[:8]} got {remote_id[:8]}"
                 )
+            # Encrypt everything after the handshake (we are the client).
+            c2s, s2c = derive_keys(
+                ecdh(eph, remote_eph), protocol, self.peer_id, remote_id,
+                my_nonce, server_nonce)
             remote_contact = Contact(remote_id, host, port)
             self.peerstore[remote_id] = remote_contact
             return Stream(
                 protocol=protocol,
                 remote_peer_id=remote_id,
                 remote_contact=remote_contact,
-                reader=reader,
-                writer=writer,
+                reader=SecureReader(reader, s2c),
+                writer=SecureWriter(writer, c2s),
             )
         except Exception:
             writer.close()
@@ -286,7 +317,7 @@ class Host:
             hello = await read_json_frame(reader, HANDSHAKE_TIMEOUT)
             if str(hello.get("proto", "")) != proto:
                 raise HandshakeError("protocol changed mid-handshake")
-            remote_id = _verify_hello(hello, proto, my_nonce)
+            remote_id, remote_eph = _verify_hello(hello, proto, my_nonce)
 
             # Learn a dialable contact for the remote: observed source host +
             # its advertised listening port.
@@ -297,9 +328,13 @@ class Host:
                 remote_contact = Contact(remote_id, peername[0], lport)
                 self.peerstore[remote_id] = remote_contact
 
+            eph = X25519PrivateKey.generate()
+            eph_hex = eph.public_key().public_bytes(
+                Encoding.Raw, PublicFormat.Raw).hex()
             ts = time.time()
             sig = self.key.sign(
-                _hello_signing_bytes(proto, self.peer_id, ts, client_nonce, self.listen_port)
+                _hello_signing_bytes(proto, self.peer_id, ts, client_nonce,
+                                     self.listen_port, eph_hex)
             )
             await write_json_frame(
                 writer,
@@ -311,14 +346,19 @@ class Host:
                     "ts": ts,
                     "sig": sig.hex(),
                     "listen_port": self.listen_port,
+                    "eph": eph_hex,
                 },
             )
+            # Encrypt everything after the handshake (we are the server).
+            c2s, s2c = derive_keys(
+                ecdh(eph, remote_eph), proto, remote_id, self.peer_id,
+                client_nonce, my_nonce)
             stream = Stream(
                 protocol=proto,
                 remote_peer_id=remote_id,
                 remote_contact=remote_contact,
-                reader=reader,
-                writer=writer,
+                reader=SecureReader(reader, c2s),
+                writer=SecureWriter(writer, s2c),
             )
             await handler(stream)
         except (HandshakeError, json.JSONDecodeError, asyncio.TimeoutError) as e:
@@ -341,14 +381,20 @@ class Host:
         ).hex()
 
 
-def _verify_hello(hello: dict, proto: str, expected_nonce: str) -> str:
-    """Verify a signed hello/ack against our challenge; returns the peer ID."""
+def _verify_hello(hello: dict, proto: str, expected_nonce: str) -> tuple[str, bytes]:
+    """Verify a signed hello/ack against our challenge; returns
+    (peer ID, ephemeral X25519 public key bytes).  A hello without an
+    identity-bound ephemeral key is rejected: there is no plaintext mode."""
     try:
         peer_id = str(hello["peer_id"])
         pubkey_raw = bytes.fromhex(str(hello["pubkey"]))
         ts = float(hello["ts"])
         listen_port = int(hello.get("listen_port", 0))
         sig = bytes.fromhex(str(hello["sig"]))
+        eph_hex = str(hello["eph"])
+        eph_raw = bytes.fromhex(eph_hex)
+        if len(eph_raw) != 32:
+            raise ValueError("bad ephemeral key length")
     except (KeyError, ValueError, TypeError) as e:
         raise HandshakeError(f"malformed hello: {e}") from e
     if abs(time.time() - ts) > HELLO_MAX_SKEW:
@@ -356,10 +402,11 @@ def _verify_hello(hello: dict, proto: str, expected_nonce: str) -> str:
     try:
         pub = Ed25519PublicKey.from_public_bytes(pubkey_raw)
         pub.verify(
-            sig, _hello_signing_bytes(proto, peer_id, ts, expected_nonce, listen_port)
+            sig, _hello_signing_bytes(proto, peer_id, ts, expected_nonce,
+                                      listen_port, eph_hex)
         )
     except (InvalidSignature, ValueError) as e:
         raise HandshakeError("hello signature verification failed") from e
     if peer_id_from_public_key(pub) != peer_id:
         raise HandshakeError("peer id does not match public key")
-    return peer_id
+    return peer_id, eph_raw

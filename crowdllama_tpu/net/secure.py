@@ -1,0 +1,160 @@
+"""Authenticated encryption for host streams (X25519 + ChaCha20-Poly1305).
+
+The reference gets transport security for free from libp2p's noise/TLS
+defaults (/root/reference/pkg/dht/dht.go:91-98,
+internal/discovery/discovery.go:48-84); this module is the counterpart for
+the asyncio host.  The existing signed-nonce handshake (net/host.py) gains
+an ephemeral X25519 key in each signed hello — the Ed25519 signature binds
+the ephemeral key to the peer identity, so a middleman cannot substitute its
+own — and both sides HKDF the ECDH secret into two directional
+ChaCha20-Poly1305 keys.  Every byte after the handshake crosses the wire as
+AEAD frames: ``4-byte BE ciphertext length || ciphertext``, nonce = 96-bit
+big-endian frame counter per direction.  Tampering, truncation mid-frame,
+and replay (counter reuse) all fail the AEAD tag and surface as
+``TamperError`` — a ``ConnectionResetError`` subclass so every existing
+wire-error handler treats it as a dead stream.
+
+The adapters expose the asyncio Stream{Reader,Writer} surface the protocol
+code actually uses (readexactly / read / write / drain / write_eof / close /
+wait_closed / get_extra_info), so json frames, length-prefixed protobuf and
+tensor frames work unchanged on top.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from cryptography.exceptions import InvalidTag
+from cryptography.hazmat.primitives.asymmetric.x25519 import (
+    X25519PrivateKey,
+    X25519PublicKey,
+)
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+from cryptography.hazmat.primitives.hashes import SHA256
+from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+
+MAX_FRAME = 1 * 1024 * 1024  # ciphertext cap per frame (plaintext chunks 256K)
+CHUNK = 256 * 1024
+
+
+class TamperError(ConnectionResetError):
+    """AEAD verification failed: modified, truncated or replayed traffic.
+
+    Subclasses ConnectionResetError so every existing wire-error handler
+    (stream services, discovery, health probes) already treats it as a dead
+    stream — which is the only safe response."""
+
+
+def derive_keys(
+    shared: bytes, proto: str, client_id: str, server_id: str,
+    client_nonce: str, server_nonce: str,
+) -> tuple[bytes, bytes]:
+    """(client→server key, server→client key) from the ECDH secret, bound to
+    the protocol, both identities and both handshake nonces."""
+    info = "|".join(["crowdllama-tpu-secure", proto, client_id, server_id,
+                     client_nonce, server_nonce]).encode()
+    okm = HKDF(algorithm=SHA256(), length=64,
+               salt=b"crowdllama-tpu-hkdf-salt", info=info).derive(shared)
+    return okm[:32], okm[32:]
+
+
+def ecdh(private: X25519PrivateKey, peer_public_raw: bytes) -> bytes:
+    return private.exchange(X25519PublicKey.from_public_bytes(peer_public_raw))
+
+
+class SecureWriter:
+    """Encrypting adapter over an asyncio StreamWriter."""
+
+    def __init__(self, writer: asyncio.StreamWriter, key: bytes):
+        self._w = writer
+        self._aead = ChaCha20Poly1305(key)
+        self._ctr = 0
+
+    def write(self, data: bytes) -> None:
+        data = bytes(data)
+        for off in range(0, len(data), CHUNK):
+            chunk = data[off:off + CHUNK]
+            nonce = self._ctr.to_bytes(12, "big")
+            self._ctr += 1
+            ct = self._aead.encrypt(nonce, chunk, None)
+            self._w.write(len(ct).to_bytes(4, "big") + ct)
+
+    async def drain(self) -> None:
+        await self._w.drain()
+
+    def write_eof(self) -> None:
+        self._w.write_eof()
+
+    def can_write_eof(self) -> bool:
+        return self._w.can_write_eof()
+
+    def close(self) -> None:
+        self._w.close()
+
+    def is_closing(self) -> bool:
+        return self._w.is_closing()
+
+    async def wait_closed(self) -> None:
+        await self._w.wait_closed()
+
+    def get_extra_info(self, name, default=None):
+        return self._w.get_extra_info(name, default)
+
+
+class SecureReader:
+    """Decrypting adapter over an asyncio StreamReader."""
+
+    def __init__(self, reader: asyncio.StreamReader, key: bytes):
+        self._r = reader
+        self._aead = ChaCha20Poly1305(key)
+        self._ctr = 0
+        self._buf = bytearray()
+        self._eof = False
+
+    async def _fill(self) -> None:
+        """Read and decrypt one frame into the plaintext buffer."""
+        try:
+            header = await self._r.readexactly(4)
+        except asyncio.IncompleteReadError as e:
+            if e.partial:
+                raise TamperError("stream cut mid-frame header") from e
+            self._eof = True  # clean EOF at a frame boundary
+            return
+        length = int.from_bytes(header, "big")
+        if not 16 <= length <= MAX_FRAME:
+            raise TamperError(f"bad frame length {length}")
+        try:
+            ct = await self._r.readexactly(length)
+        except asyncio.IncompleteReadError as e:
+            raise TamperError("stream cut mid-frame") from e
+        nonce = self._ctr.to_bytes(12, "big")
+        self._ctr += 1
+        try:
+            self._buf += self._aead.decrypt(nonce, ct, None)
+        except InvalidTag as e:
+            raise TamperError("frame failed authentication") from e
+
+    async def readexactly(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            if self._eof:
+                raise asyncio.IncompleteReadError(bytes(self._buf), n)
+            await self._fill()
+        out = bytes(self._buf[:n])
+        del self._buf[:n]
+        return out
+
+    async def read(self, n: int = -1) -> bytes:
+        if n < 0:
+            while not self._eof:
+                await self._fill()
+            out = bytes(self._buf)
+            self._buf.clear()
+            return out
+        while not self._buf and not self._eof:
+            await self._fill()
+        out = bytes(self._buf[:n])
+        del self._buf[:n]
+        return out
+
+    def at_eof(self) -> bool:
+        return self._eof and not self._buf
