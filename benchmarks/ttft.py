@@ -103,11 +103,12 @@ async def run() -> dict:
                         break
                     await resp.read()
     finally:
-        await gateway.stop()
-        await consumer.stop()
-        await worker.stop()
-        await engine.stop()
-        await boot_host.close()
+        for stop in (gateway.stop, consumer.stop, worker.stop, engine.stop,
+                     boot_host.close):
+            try:
+                await stop()
+            except Exception:
+                pass  # teardown must not mask the benchmark's real error
 
     ttfts.sort()
     p50 = statistics.median(ttfts)

@@ -39,6 +39,15 @@ class ModelConfig:
     # MoE specifics (family="mixtral")
     num_experts: int = 0  # 0 → dense MLP
     num_experts_per_tok: int = 2
+    # "sorted": grouped-GEMM dispatch via lax.ragged_dot (E/K FLOP saving,
+    # exact); "dense": compute-all-experts reference semantics.
+    moe_dispatch: str = "sorted"
+
+    def __post_init__(self) -> None:
+        if self.moe_dispatch not in ("sorted", "dense"):
+            raise ValueError(
+                f"moe_dispatch must be 'sorted' or 'dense', "
+                f"got {self.moe_dispatch!r}")
 
     def resolved_head_dim(self) -> int:
         return self.head_dim or self.hidden_size // self.num_heads

@@ -129,17 +129,19 @@ def _mlp(lp: Params, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
     return jnp.einsum("...f,fd->...d", act * up, dequant(lp["w_down"]))
 
 
-def _moe(lp: Params, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
-    """Mixtral top-k MoE.  x: [..., D].
-
-    v0 computes every expert and masks by router weight — correct and
-    compiler-friendly; a sort-based token-grouping dispatch (and EP sharding
-    of the expert axis) is the planned optimization.
-    """
+def _route_topk(lp: Params, cfg: ModelConfig, x: jnp.ndarray):
+    """Router top-k: returns (weights [..., K] fp32 softmaxed, ids [..., K])."""
     router_logits = jnp.einsum("...d,de->...e", x.astype(jnp.float32),
                                lp["router"].astype(jnp.float32))
     topw, topi = jax.lax.top_k(router_logits, cfg.num_experts_per_tok)
-    topw = jax.nn.softmax(topw, axis=-1)  # [..., K]
+    return jax.nn.softmax(topw, axis=-1), topi
+
+
+def _moe_dense(lp: Params, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
+    """Reference-semantics MoE: computes every expert and masks by router
+    weight.  Exact, compiler-friendly, ~E/K x wasted FLOPs — kept as the
+    parity oracle for `_moe_sorted` and for debugging."""
+    topw, topi = _route_topk(lp, cfg, x)
     # Scatter top-k probs back to a dense per-expert weighting [..., E].
     one_hot = jax.nn.one_hot(topi, cfg.num_experts, dtype=jnp.float32)  # [...,K,E]
     weights = jnp.einsum("...ke,...k->...e", one_hot, topw)
@@ -150,6 +152,50 @@ def _moe(lp: Params, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
     per_expert = jnp.einsum("...ef,efd->...ed", act, dequant(lp["w_down"]))  # [..., E, D]
     out = jnp.einsum("...ed,...e->...d", per_expert.astype(jnp.float32), weights)
     return out.astype(x.dtype)
+
+
+def _moe_sorted(lp: Params, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
+    """Sorted token-grouping MoE dispatch (grouped GEMM).
+
+    Flatten the top-k (token, expert) pairs, sort by expert, and run the
+    expert FFNs as `lax.ragged_dot` grouped matmuls — each token row is
+    computed for exactly its K experts instead of all E, an E/K FLOP saving
+    (4x for Mixtral E=8 K=2) with no capacity factor and no token dropping:
+    results are numerically the per-expert terms of `_moe_dense`, combined
+    with the same fp32 router weights.  All shapes are static (NK = N*K);
+    only the group boundaries are data-dependent, which XLA's ragged dot
+    handles on the MXU.
+    """
+    orig_shape = x.shape
+    d = orig_shape[-1]
+    xf = x.reshape(-1, d)
+    n = xf.shape[0]
+    k = cfg.num_experts_per_tok
+    topw, topi = _route_topk(lp, cfg, xf)  # [N, K]
+
+    e_flat = topi.reshape(-1)                      # [NK]
+    t_flat = jnp.repeat(jnp.arange(n), k)          # [NK]
+    w_flat = topw.reshape(-1)                      # [NK] fp32
+    order = jnp.argsort(e_flat)                    # group rows by expert
+    xs = jnp.take(xf, t_flat[order], axis=0)       # [NK, D]
+    group_sizes = jnp.bincount(e_flat, length=cfg.num_experts)
+
+    gate = jax.lax.ragged_dot(xs, dequant(lp["w_gate"]), group_sizes)
+    up = jax.lax.ragged_dot(xs, dequant(lp["w_up"]), group_sizes)
+    act = jax.nn.silu(gate) * up
+    ys = jax.lax.ragged_dot(act.astype(xs.dtype), dequant(lp["w_down"]),
+                            group_sizes)           # [NK, D]
+
+    contrib = ys.astype(jnp.float32) * w_flat[order][:, None]
+    out = jnp.zeros((n, d), jnp.float32).at[t_flat[order]].add(contrib)
+    return out.reshape(orig_shape).astype(x.dtype)
+
+
+def _moe(lp: Params, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
+    """Mixtral top-k MoE.  x: [..., D].  Dispatches per cfg.moe_dispatch."""
+    if cfg.moe_dispatch == "dense":
+        return _moe_dense(lp, cfg, x)
+    return _moe_sorted(lp, cfg, x)
 
 
 def _layer_params(layers: Params, idx_or_slice) -> Params:

@@ -70,19 +70,26 @@ class SecureWriter:
         self._aead = ChaCha20Poly1305(key)
         self._ctr = 0
 
+    def _frame(self, chunk: bytes) -> None:
+        nonce = self._ctr.to_bytes(12, "big")
+        self._ctr += 1
+        ct = self._aead.encrypt(nonce, chunk, None)
+        self._w.write(len(ct).to_bytes(4, "big") + ct)
+
     def write(self, data: bytes) -> None:
         data = bytes(data)
         for off in range(0, len(data), CHUNK):
-            chunk = data[off:off + CHUNK]
-            nonce = self._ctr.to_bytes(12, "big")
-            self._ctr += 1
-            ct = self._aead.encrypt(nonce, chunk, None)
-            self._w.write(len(ct).to_bytes(4, "big") + ct)
+            self._frame(data[off:off + CHUNK])
 
     async def drain(self) -> None:
         await self._w.drain()
 
     def write_eof(self) -> None:
+        # Authenticated close: an empty-plaintext frame marks intentional
+        # end-of-stream.  A bare TCP FIN (which an on-path attacker can
+        # inject at a frame boundary) is then distinguishable from a
+        # legitimate end by read-to-EOF consumers.
+        self._frame(b"")
         self._w.write_eof()
 
     def can_write_eof(self) -> bool:
@@ -110,6 +117,7 @@ class SecureReader:
         self._ctr = 0
         self._buf = bytearray()
         self._eof = False
+        self._authenticated_eof = False  # saw the empty close frame
 
     async def _fill(self) -> None:
         """Read and decrypt one frame into the plaintext buffer."""
@@ -118,7 +126,7 @@ class SecureReader:
         except asyncio.IncompleteReadError as e:
             if e.partial:
                 raise TamperError("stream cut mid-frame header") from e
-            self._eof = True  # clean EOF at a frame boundary
+            self._eof = True  # bare FIN at a frame boundary (unauthenticated)
             return
         length = int.from_bytes(header, "big")
         if not 16 <= length <= MAX_FRAME:
@@ -130,9 +138,14 @@ class SecureReader:
         nonce = self._ctr.to_bytes(12, "big")
         self._ctr += 1
         try:
-            self._buf += self._aead.decrypt(nonce, ct, None)
+            pt = self._aead.decrypt(nonce, ct, None)
         except InvalidTag as e:
             raise TamperError("frame failed authentication") from e
+        if not pt:  # authenticated close marker (SecureWriter.write_eof)
+            self._eof = True
+            self._authenticated_eof = True
+            return
+        self._buf += pt
 
     async def readexactly(self, n: int) -> bytes:
         while len(self._buf) < n:
@@ -147,11 +160,20 @@ class SecureReader:
         if n < 0:
             while not self._eof:
                 await self._fill()
+            if not self._authenticated_eof:
+                # An attacker can inject a FIN at a frame boundary; a
+                # read-to-EOF consumer must not accept the prefix as the
+                # complete message unless the peer sent the signed close.
+                raise TamperError("stream ended without authenticated close")
             out = bytes(self._buf)
             self._buf.clear()
             return out
         while not self._buf and not self._eof:
             await self._fill()
+        if not self._buf and self._eof and not self._authenticated_eof:
+            # Bounded-read loops (read(n) until b"") are also read-to-EOF
+            # consumers — same truncation rule as read(-1).
+            raise TamperError("stream ended without authenticated close")
         out = bytes(self._buf[:n])
         del self._buf[:n]
         return out

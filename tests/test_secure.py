@@ -251,6 +251,52 @@ async def test_secure_pair_roundtrip_and_truncation():
         await sr3.readexactly(5)
 
 
+async def test_unauthenticated_fin_rejected_for_read_to_eof():
+    """A TCP FIN injected at a frame boundary (no authenticated close frame)
+    must not let a read-to-EOF consumer accept the prefix as complete."""
+    key = bytes(range(32))
+    buf = bytearray()
+
+    class _W:
+        def write(self, data):
+            buf.extend(data)
+
+    sw = SecureWriter(_W(), key)
+    sw.write(b"partial metadata")
+    # NO write_eof(): simulate the attacker cutting the stream here.
+    r = asyncio.StreamReader()
+    r.feed_data(bytes(buf))
+    r.feed_eof()
+    sr = SecureReader(r, key)
+    with pytest.raises(TamperError, match="authenticated close"):
+        await sr.read(-1)
+
+    # Same data WITH the authenticated close is accepted.
+    buf.clear()
+    sw2 = SecureWriter(_W(), key)
+    sw2._w.write = buf.extend
+    sw2.write(b"partial metadata")
+    sw2._frame(b"")  # close frame without the underlying write_eof
+    r2 = asyncio.StreamReader()
+    r2.feed_data(bytes(buf))
+    r2.feed_eof()
+    sr2 = SecureReader(r2, key)
+    assert await sr2.read(-1) == b"partial metadata"
+
+    # Bounded-read loop consumers get the same protection.
+    r3 = asyncio.StreamReader()
+    buf2 = bytearray()
+    sw3 = SecureWriter(_W(), key)
+    sw3._w.write = buf2.extend
+    sw3.write(b"x" * 10)
+    r3.feed_data(bytes(buf2))
+    r3.feed_eof()
+    sr3 = SecureReader(r3, key)
+    assert await sr3.read(10) == b"x" * 10
+    with pytest.raises(TamperError, match="authenticated close"):
+        await sr3.read(10)
+
+
 def test_directional_keys_differ():
     c2s, s2c = derive_keys(b"s" * 32, "/p/1", "alice", "bob", "n1", "n2")
     assert c2s != s2c
