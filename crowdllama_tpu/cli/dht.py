@@ -52,9 +52,17 @@ def main(argv: list[str] | None = None) -> int:
 
 
 async def run_server(host: str, port: int, key_path: str) -> None:
+    from crowdllama_tpu.config import Intervals
+
     km = KeyManager(key_path or None)
     key = km.get_or_create_private_key("dht")
     h, dht = await new_host_and_dht(key, listen_host=host, listen_port=port)
+    iv = Intervals.default()
+    # Liveness probes evict crashed providers promptly — the counterpart of
+    # the reference bootstrap server's disconnect-driven removal
+    # (/root/reference/pkg/dht/dht.go:370-383).
+    dht.start_maintenance(provider_check=iv.dht_provider_check,
+                          bucket_refresh=iv.dht_bucket_refresh)
     log.info("dht server %s listening on %s:%d (%s)",
              h.peer_id[:12], host, h.listen_port, version_string())
 
@@ -74,6 +82,7 @@ async def run_server(host: str, port: int, key_path: str) -> None:
         await stop.wait()
     finally:
         stats.cancel()
+        await dht.stop_maintenance()
         await h.close()
 
 

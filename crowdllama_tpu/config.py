@@ -54,6 +54,8 @@ class Intervals:
     stream_read_timeout: float = 5.0
     backoff_base: float = 10.0
     max_failed_attempts: int = 3
+    dht_provider_check: float = 60.0
+    dht_bucket_refresh: float = 600.0
 
     @classmethod
     def default(cls) -> "Intervals":
@@ -68,6 +70,8 @@ class Intervals:
                 cleanup=5.0,
                 quarantine=30.0,
                 backoff_base=0.5,
+                dht_provider_check=2.0,
+                dht_bucket_refresh=5.0,
             )
         return cls()
 

@@ -172,18 +172,25 @@ def RoutingTable(self_id: bytes, k: int = K):
 class _ProviderRecord:
     contact: Contact
     expires_at: float
+    last_verified: float
+    failed_probes: int = 0
 
 
 class ProviderStore:
-    """TTL'd provider records (libp2p providers-store analog)."""
+    """TTL'd provider records (libp2p providers-store analog), with
+    peer-keyed eviction so dead peers can be dropped the moment any layer
+    learns they are gone — the counterpart of the reference bootstrap
+    server's disconnect-driven removal (/root/reference/pkg/dht/dht.go:370-383),
+    which a per-RPC transport has no TCP-FIN signal for."""
 
     def __init__(self, ttl: float = PROVIDER_TTL):
         self.ttl = ttl
         self._records: dict[bytes, dict[str, _ProviderRecord]] = {}
 
     def add(self, key: bytes, contact: Contact) -> None:
+        now = time.time()
         self._records.setdefault(key, {})[contact.peer_id] = _ProviderRecord(
-            contact=contact, expires_at=time.time() + self.ttl
+            contact=contact, expires_at=now + self.ttl, last_verified=now
         )
 
     def get(self, key: bytes) -> list[Contact]:
@@ -196,6 +203,67 @@ class ProviderStore:
             else:
                 self._records.pop(key, None)
         return [r.contact for r in live.values()]
+
+    def remove_peer(self, peer_id: str) -> int:
+        """Drop every record advertised by ``peer_id``; returns the count."""
+        n = 0
+        for key in list(self._records):
+            recs = self._records[key]
+            if recs.pop(peer_id, None) is not None:
+                n += 1
+            if not recs:
+                del self._records[key]
+        return n
+
+    def stale_providers(self, older_than: float) -> list[Contact]:
+        """Distinct live providers not verified within ``older_than`` s."""
+        now = time.time()
+        out: dict[str, Contact] = {}
+        for recs in self._records.values():
+            for pid, r in recs.items():
+                if r.expires_at > now and now - r.last_verified > older_than:
+                    out[pid] = r.contact
+        return list(out.values())
+
+    def mark_verified(self, peer_id: str) -> None:
+        """Record a successful liveness probe.  Does NOT extend expires_at:
+        the TTL is the deregistration mechanism for providers that stopped
+        re-announcing (a live-but-departed peer must still age out); only
+        add() — i.e. a real re-announce — renews it."""
+        now = time.time()
+        for recs in self._records.values():
+            r = recs.get(peer_id)
+            if r is not None:
+                r.last_verified = now
+                r.failed_probes = 0
+
+    def mark_probe_failed(self, peer_id: str,
+                          threshold: int = 2) -> bool:
+        """Count a failed liveness probe; True once the peer crossed
+        ``threshold`` consecutive failures (probe cadence gives a busy
+        worker a second chance before delisting, cf. the health machine's
+        3-strikes)."""
+        tripped = False
+        for recs in self._records.values():
+            r = recs.get(peer_id)
+            if r is not None:
+                r.failed_probes += 1
+                if r.failed_probes >= threshold:
+                    tripped = True
+        return tripped
+
+    def sweep_expired(self) -> None:
+        now = time.time()
+        for key in list(self._records):
+            live = {p: r for p, r in self._records[key].items()
+                    if r.expires_at > now}
+            if live:
+                self._records[key] = live
+            else:
+                del self._records[key]
+
+    def __len__(self) -> int:
+        return sum(len(r) for r in self._records.values())
 
 
 @dataclass
@@ -215,7 +283,81 @@ class DHTNode:
         self.providers = ProviderStore()
         self.server_mode = server_mode
         self.bootstrap_addrs: list[str] = []
+        self._maintenance: list[asyncio.Task] = []
         host.set_stream_handler(KAD_PROTOCOL, self._handle_stream)
+
+    # ------------------------------------------------------------- liveness
+
+    def evict_peer(self, peer_id: str) -> None:
+        """Drop a peer from the routing table AND its provider records.
+
+        The transport is per-RPC (no persistent connection to watch for a
+        FIN), so eviction is driven by whoever learns of the death first:
+        a failed RPC here, the health machine (peermanager), or the
+        maintenance liveness probe below — the functional counterpart of
+        the reference's instant disconnect removal (dht.go:370-383)."""
+        self.table.remove(peer_id)
+        n = self.providers.remove_peer(peer_id)
+        if n:
+            log.info("evicted dead peer %s (%d provider records)",
+                     peer_id[:8], n)
+
+    async def _probe_stale_providers(self, older_than: float,
+                                     max_probes: int = 8) -> None:
+        """Ping providers not verified recently; evict the unresponsive.
+
+        This bounds how long a crashed worker stays in find_providers
+        results to ~the probe interval instead of the full record TTL."""
+        stale = self.providers.stale_providers(older_than)[:max_probes]
+        if not stale:
+            return
+        results = await asyncio.gather(
+            *(self._rpc(c, {"op": "ping"}) for c in stale))
+        for contact, resp in zip(stale, results):
+            if resp and resp.get("ok"):
+                self.providers.mark_verified(contact.peer_id)
+            elif self.providers.mark_probe_failed(contact.peer_id):
+                # Two consecutive failed probes: presumed dead (one missed
+                # ping from a briefly-saturated worker is forgiven).
+                self.evict_peer(contact.peer_id)
+        self.providers.sweep_expired()
+
+    async def _refresh_buckets(self) -> None:
+        """Random-target lookup + self-lookup to keep buckets populated
+        (classic Kademlia bucket refresh; libp2p does this every 10 min)."""
+        import os as _os
+
+        await self.lookup(_os.urandom(32))
+        await self.lookup(self.node_id)
+
+    def start_maintenance(self, *, provider_check: float = 60.0,
+                          bucket_refresh: float = 600.0) -> None:
+        """Start background liveness/refresh loops (idempotent)."""
+        from crowdllama_tpu.utils.aio import run_every
+
+        if self._maintenance:
+            return
+        self._maintenance = [
+            asyncio.create_task(
+                run_every(provider_check,
+                          lambda: self._probe_stale_providers(provider_check),
+                          log, logging.DEBUG),
+                name="dht-provider-liveness"),
+            asyncio.create_task(
+                run_every(bucket_refresh, self._refresh_buckets, log,
+                          logging.DEBUG),
+                name="dht-bucket-refresh"),
+        ]
+
+    async def stop_maintenance(self) -> None:
+        for t in self._maintenance:
+            t.cancel()
+        for t in self._maintenance:
+            try:
+                await t
+            except asyncio.CancelledError:
+                pass
+        self._maintenance = []
 
     # ------------------------------------------------------------------ RPC
 
@@ -277,6 +419,10 @@ class DHTNode:
             return resp
         except Exception as e:
             if isinstance(contact, Contact):
+                # One failed RPC drops the routing entry (cheap to re-learn)
+                # but NOT provider records — delisting a worker needs the
+                # liveness probe's consecutive-failure threshold or the
+                # health machine's 3 strikes (see evict_peer callers).
                 self.table.remove(contact.peer_id)
             log.debug("rpc %s to %s failed: %s", payload.get("op"), contact, e)
             return None

@@ -51,7 +51,11 @@ def derive_keys(
 ) -> tuple[bytes, bytes]:
     """(client→server key, server→client key) from the ECDH secret, bound to
     the protocol, both identities and both handshake nonces."""
-    info = "|".join(["crowdllama-tpu-secure", proto, client_id, server_id,
+    # v2: authenticated close frames (empty-plaintext EOF marker).  The
+    # version lives in the KDF info so a mixed-version pair fails at the
+    # first frame (garbage keys) instead of mid-stream with a confusing
+    # TamperError on every legitimate EOF.
+    info = "|".join(["crowdllama-tpu-secure-v2", proto, client_id, server_id,
                      client_nonce, server_nonce]).encode()
     okm = HKDF(algorithm=SHA256(), length=64,
                salt=b"crowdllama-tpu-hkdf-salt", info=info).derive(shared)

@@ -102,6 +102,9 @@ class Peer:
             config=PeerHealthConfig(intervals=self.config.intervals),
             metadata_fetcher=self._fetch_peer_metadata,
             discovery=self._run_discovery,
+            # Health-machine eviction also drops the dead peer's provider
+            # records / routing entry from our DHT view immediately.
+            on_peer_removed=self.dht.evict_peer,
         )
 
         if self.config.bootstrap_peers:
@@ -110,6 +113,8 @@ class Peer:
 
         self.peer_manager.start()
         iv = self.config.intervals
+        self.dht.start_maintenance(provider_check=iv.dht_provider_check,
+                                   bucket_refresh=iv.dht_bucket_refresh)
         self._tasks = [
             asyncio.create_task(
                 run_every(iv.metadata_refresh, self._refresh_metadata, log, logging.DEBUG),
@@ -137,6 +142,8 @@ class Peer:
         self._tasks = []
         if self.peer_manager is not None:
             await self.peer_manager.stop()
+        if self.dht is not None:
+            await self.dht.stop_maintenance()
         if self.host is not None:
             await self.host.close()
 

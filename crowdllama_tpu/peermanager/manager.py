@@ -77,11 +77,15 @@ class PeerManager:
         config: PeerHealthConfig | None = None,
         metadata_fetcher: MetadataFetcher | None = None,
         discovery: DiscoveryFunc | None = None,
+        on_peer_removed: Callable[[str], None] | None = None,
     ):
         self.self_peer_id = self_peer_id
         self.config = config or PeerHealthConfig()
         self.metadata_fetcher = metadata_fetcher
         self.discovery = discovery
+        # Fired on eviction so other layers (e.g. the local DHT's provider
+        # store, net/dht.py evict_peer) drop the dead peer immediately.
+        self.on_peer_removed = on_peer_removed
         self.peers: dict[str, PeerInfo] = {}
         self.recently_removed: dict[str, float] = {}  # peer_id -> removed_at
         self._tasks: list[asyncio.Task] = []
@@ -108,8 +112,14 @@ class PeerManager:
             info.is_healthy = True
 
     def remove_peer(self, peer_id: str, quarantine: bool = True) -> None:
-        if self.peers.pop(peer_id, None) is not None and quarantine:
-            self.recently_removed[peer_id] = time.monotonic()
+        if self.peers.pop(peer_id, None) is not None:
+            if quarantine:
+                self.recently_removed[peer_id] = time.monotonic()
+            if self.on_peer_removed is not None:
+                try:
+                    self.on_peer_removed(peer_id)
+                except Exception:
+                    log.debug("on_peer_removed callback failed", exc_info=True)
 
     def mark_seen(self, peer_id: str) -> None:
         info = self.peers.get(peer_id)
