@@ -39,19 +39,58 @@ log = logging.getLogger("crowdllama.peer")
 
 
 def _tpu_capabilities() -> dict:
-    """Real accelerator capabilities from the JAX runtime."""
+    """Real accelerator capabilities introspected from the JAX runtime.
+
+    HBM comes from ``device.memory_stats()['bytes_limit']`` (the runtime's
+    actual allocatable budget); the ICI topology from device coords when the
+    platform exposes them.  Nothing is hardcoded — the reference advertises
+    a fake RTX 4090 (peer.go:320-343); a capability the runtime cannot
+    report is reported as 0/unknown, not invented.
+    """
     try:
         import jax
 
         devs = jax.devices()
-        kind = devs[0].device_kind if devs else "cpu"
+        if not devs:
+            raise RuntimeError("no devices")
+        d0 = devs[0]
+        kind = getattr(d0, "device_kind", "cpu") or "cpu"
         n = len(devs)
+
+        hbm_gb = 0.0
+        try:
+            stats = d0.memory_stats() or {}
+            limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+            if limit:
+                hbm_gb = round(limit / (1 << 30), 1)
+        except Exception:
+            pass  # platform without memory_stats (e.g. some CPU builds)
+
+        # Physical mesh extent per axis from device coordinates; fall back
+        # to a flat 1xN when the platform has no coords (CPU), a backend's
+        # coords accessor misbehaves, or the extents don't cover the
+        # device count.
+        topology = f"1x{n}"
+        try:
+            coords = [getattr(d, "coords", None) for d in devs]
+            if coords and all(c is not None for c in coords):
+                dims = [max(c[i] for c in coords) - min(c[i] for c in coords) + 1
+                        for i in range(len(coords[0]))]
+                dims = [d for d in dims if d > 1]
+                prod = 1
+                for d in dims:
+                    prod *= d
+                if dims and prod == n:
+                    topology = "x".join(str(d) for d in dims) if len(dims) > 1 \
+                        else f"1x{dims[0]}"
+        except Exception:
+            pass  # keep the 1xN fallback; kind/count/HBM are already known
+
         return {
             "accelerator": kind.lower().replace(" ", "-"),
             "tpu_chip_count": n,
-            # v5e: 16 GiB HBM per chip; report 0 when unknown.
-            "hbm_gb_per_chip": 16.0 if "tpu" in kind.lower() else 0.0,
-            "ici_topology": f"1x{n}",
+            "hbm_gb_per_chip": hbm_gb,
+            "ici_topology": topology,
         }
     except Exception:  # pragma: no cover - jax always importable here
         return {"accelerator": "unknown", "tpu_chip_count": 0,
