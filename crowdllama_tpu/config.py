@@ -98,6 +98,12 @@ class Configuration:
     decode_chunk: int = 8  # decode steps per device dispatch
     warmup: bool = True  # compile prefill/decode at engine start
     quantize: str = ""  # "" (bf16) | "int8" weight-only (ops/quant.py)
+    # KV cache layout: "contiguous" [L,B,Hkv,S,Dh] per slot, or "paged"
+    # (engine/paged.py): page pool + slot page tables; kv_pool_tokens 0 =
+    # full capacity (no overcommit), else total pooled tokens.
+    kv_layout: str = "contiguous"
+    kv_page_size: int = 128
+    kv_pool_tokens: int = 0
 
     # Multi-worker sharded serving (BASELINE configs 4-5): a node with
     # shard_count > 1 serves one shard of an N-way split; shard_group names
@@ -139,13 +145,30 @@ class Configuration:
         cfg.shard_index = int(env.get("CROWDLLAMA_TPU_SHARD_INDEX", cfg.shard_index))
         cfg.shard_count = int(env.get("CROWDLLAMA_TPU_SHARD_COUNT", cfg.shard_count))
         cfg.shard_strategy = env.get("CROWDLLAMA_TPU_SHARD_STRATEGY", cfg.shard_strategy)
-        cfg.quantize = _norm_quantize(
-            env.get("CROWDLLAMA_TPU_QUANTIZE", cfg.quantize))
+        cfg.quantize = env.get("CROWDLLAMA_TPU_QUANTIZE", cfg.quantize)
+        cfg.kv_layout = env.get("CROWDLLAMA_TPU_KV_LAYOUT", cfg.kv_layout)
+        cfg.kv_page_size = int(env.get("CROWDLLAMA_TPU_KV_PAGE_SIZE",
+                                       cfg.kv_page_size))
+        cfg.kv_pool_tokens = int(env.get("CROWDLLAMA_TPU_KV_POOL_TOKENS",
+                                         cfg.kv_pool_tokens))
         if env.get("CROWDLLAMA_TPU_WARMUP"):
             cfg.warmup = env["CROWDLLAMA_TPU_WARMUP"] in ("1", "true")
         for k, v in overrides.items():
             if v is not None:
                 setattr(cfg, k, v)
+        # Validate AFTER overrides so programmatic/flag values are checked
+        # too (and a valid override can correct a bad env value).
+        cfg.quantize = _norm_quantize(cfg.quantize)
+        cfg.kv_layout = (cfg.kv_layout or "contiguous").strip().lower()
+        if cfg.kv_layout not in ("contiguous", "paged"):
+            raise ValueError(f"unknown kv layout {cfg.kv_layout!r} "
+                             "(want 'contiguous' or 'paged')")
+        if cfg.kv_page_size <= 0:
+            raise ValueError(f"kv_page_size must be positive, "
+                             f"got {cfg.kv_page_size}")
+        if cfg.kv_pool_tokens < 0:
+            raise ValueError(f"kv_pool_tokens must be >= 0, "
+                             f"got {cfg.kv_pool_tokens}")
         return cfg
 
     @staticmethod
@@ -176,6 +199,12 @@ class Configuration:
         parser.add_argument("--quantize", dest="quantize",
                             choices=("", "int8"),
                             help="weight-only quantization for the engine")
+        parser.add_argument("--kv-layout", dest="kv_layout",
+                            choices=("contiguous", "paged"),
+                            help="KV cache layout (paged: shared page pool)")
+        parser.add_argument("--kv-pool-tokens", dest="kv_pool_tokens",
+                            type=int,
+                            help="paged pool size in tokens (0 = no overcommit)")
 
     @classmethod
     def from_flags(cls, args: argparse.Namespace) -> "Configuration":
@@ -185,7 +214,7 @@ class Configuration:
                 "verbose", "key_path", "listen_port", "gateway_port",
                 "model", "model_path", "engine_backend", "mesh_shape",
                 "shard_group", "shard_index", "shard_count", "shard_strategy",
-                "quantize",
+                "quantize", "kv_layout", "kv_pool_tokens",
             )
         }
         bp = getattr(args, "bootstrap_peers", None)

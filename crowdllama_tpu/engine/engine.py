@@ -156,13 +156,19 @@ class JaxEngine(Engine):
                 from crowdllama_tpu.ops.quant import quantize_params
 
                 params = quantize_params(params)
-            return ModelRunner(
-                cfg,
+            kwargs = dict(
                 params=params,
                 mesh_spec=self.config.mesh_shape,
                 max_slots=self.config.max_batch_slots,
                 max_seq=cfg.max_context_length,
             )
+            if self.config.kv_layout == "paged":
+                from crowdllama_tpu.engine.paged import PagedModelRunner
+
+                return PagedModelRunner(
+                    cfg, page_size=self.config.kv_page_size,
+                    pool_tokens=self.config.kv_pool_tokens, **kwargs)
+            return ModelRunner(cfg, **kwargs)
 
         self._runner = await loop.run_in_executor(None, _build)
         if self.config.warmup:

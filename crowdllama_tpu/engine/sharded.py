@@ -135,6 +135,11 @@ class ShardedEngine(Engine):
             # — reject loudly rather than silently serving bf16.
             raise ValueError("quantize is not supported with shard strategy "
                              "'ep' yet (use 'pp' or unsharded)")
+        if self.config.kv_layout == "paged":
+            # Shard stages hold per-session B=1 caches, not slot pools; a
+            # requested-but-ignored layout must fail loudly.
+            raise ValueError("kv_layout='paged' is not supported by sharded "
+                             "engines yet (use the unsharded engine)")
         self.cfg = cfg
         loop = asyncio.get_running_loop()
         # Every member loads the checkpoint and keeps only its shard; the
