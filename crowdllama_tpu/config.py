@@ -104,6 +104,9 @@ class Configuration:
     kv_layout: str = "contiguous"
     kv_page_size: int = 128
     kv_pool_tokens: int = 0
+    # Directory for jax.profiler traces; empty disables the profile surface
+    # (SURVEY §5: "TPU build: JAX profiler traces + per-request timing").
+    profile_dir: str = ""
 
     # Multi-worker sharded serving (BASELINE configs 4-5): a node with
     # shard_count > 1 serves one shard of an N-way split; shard_group names
@@ -151,6 +154,7 @@ class Configuration:
                                        cfg.kv_page_size))
         cfg.kv_pool_tokens = int(env.get("CROWDLLAMA_TPU_KV_POOL_TOKENS",
                                          cfg.kv_pool_tokens))
+        cfg.profile_dir = env.get("CROWDLLAMA_TPU_PROFILE_DIR", cfg.profile_dir)
         if env.get("CROWDLLAMA_TPU_WARMUP"):
             cfg.warmup = env["CROWDLLAMA_TPU_WARMUP"] in ("1", "true")
         for k, v in overrides.items():
@@ -202,9 +206,13 @@ class Configuration:
         parser.add_argument("--kv-layout", dest="kv_layout",
                             choices=("contiguous", "paged"),
                             help="KV cache layout (paged: shared page pool)")
+        parser.add_argument("--kv-page-size", dest="kv_page_size", type=int,
+                            help="paged KV page size in tokens")
         parser.add_argument("--kv-pool-tokens", dest="kv_pool_tokens",
                             type=int,
                             help="paged pool size in tokens (0 = no overcommit)")
+        parser.add_argument("--profile-dir", dest="profile_dir",
+                            help="enable jax.profiler captures into this dir")
 
     @classmethod
     def from_flags(cls, args: argparse.Namespace) -> "Configuration":
@@ -214,7 +222,8 @@ class Configuration:
                 "verbose", "key_path", "listen_port", "gateway_port",
                 "model", "model_path", "engine_backend", "mesh_shape",
                 "shard_group", "shard_index", "shard_count", "shard_strategy",
-                "quantize", "kv_layout", "kv_pool_tokens",
+                "quantize", "kv_layout", "kv_page_size", "kv_pool_tokens",
+                "profile_dir",
             )
         }
         bp = getattr(args, "bootstrap_peers", None)

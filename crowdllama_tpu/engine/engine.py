@@ -207,6 +207,31 @@ class JaxEngine(Engine):
             d["load"] = round(self.scheduler.load, 3)
         return d
 
+    async def capture_profile(self, seconds: float = 3.0) -> str:
+        """Capture a jax.profiler trace of live serving activity.
+
+        Requires ``profile_dir`` in config (SURVEY §5's profiler hook).  The
+        trace window spans whatever the scheduler dispatches during it —
+        decode chunks, prefills — because the profiler session is global
+        across threads.  Returns the trace directory (TensorBoard-loadable).
+        """
+        if not self.config.profile_dir:
+            raise RuntimeError("profiling disabled: set profile_dir "
+                               "(--profile-dir / CROWDLLAMA_TPU_PROFILE_DIR)")
+        seconds = min(max(float(seconds), 0.1), 60.0)
+        loop = asyncio.get_running_loop()
+
+        def _trace() -> str:
+            import time as _time
+
+            import jax
+
+            with jax.profiler.trace(self.config.profile_dir):
+                _time.sleep(seconds)
+            return self.config.profile_dir
+
+        return await loop.run_in_executor(None, _trace)
+
     async def generate(  # type: ignore[override]
         self,
         prompt: str,

@@ -40,8 +40,7 @@ from crowdllama_tpu.engine.runner import ModelRunner
 from crowdllama_tpu.engine.sampling import sample_tokens
 from crowdllama_tpu.models import transformer as T
 from crowdllama_tpu.ops.attention import decode_attention
-from crowdllama_tpu.ops.norms import rms_norm
-from crowdllama_tpu.ops.rope import apply_rope, rope_table
+from crowdllama_tpu.ops.rope import rope_table
 
 log = logging.getLogger("crowdllama.engine.paged")
 
@@ -73,12 +72,26 @@ jax.tree_util.register_dataclass(
 class PagedModelRunner(ModelRunner):
     """ModelRunner with the paged KV layout (same serving surface)."""
 
-    def __init__(self, *args, page_size: int = 128, pool_tokens: int = 0,
+    def __init__(self, cfg, *args, page_size: int = 128, pool_tokens: int = 0,
                  **kwargs):
-        super().__init__(*args, **kwargs)
-        assert self.sp == 1 and self.pp == 1, (
-            "paged KV composes with plain/tp meshes only (sp/pp use the "
-            "contiguous layout)")
+        # Default mesh: tp-only.  The auto-chooser spills spare devices to
+        # dp, but the shared page pool cannot shard over dp (pages belong
+        # to no fixed slot), so unrequested dp would just replicate it.
+        if kwargs.get("mesh") is None and not kwargs.get("mesh_spec"):
+            n = len(jax.devices())
+            tp = 1
+            for cand in range(min(n, cfg.num_kv_heads), 0, -1):
+                if n % cand == 0 and cfg.num_kv_heads % cand == 0:
+                    tp = cand
+                    break
+            kwargs["mesh_spec"] = f"1x{tp}"
+        super().__init__(cfg, *args, **kwargs)
+        from crowdllama_tpu.parallel.mesh import AXIS_DP
+
+        assert (self.sp == 1 and self.pp == 1
+                and self.mesh.shape.get(AXIS_DP, 1) == 1), (
+            "paged KV composes with plain/tp meshes only (the shared page "
+            "pool cannot shard over dp; sp/pp use the contiguous layout)")
         self.page_size = page_size
         self.max_pages_per_slot = math.ceil(self.max_seq / page_size)
         total_tokens = pool_tokens or self.max_slots * self.max_seq
@@ -158,7 +171,6 @@ class PagedModelRunner(ModelRunner):
         b = self.max_slots
         dh = cfg.resolved_head_dim()
         hkv = cfg.num_kv_heads
-        heads = cfg.num_heads
         scale = T.attn_scale(cfg)
         cos, sin = rope_table(cfg.max_context_length, dh, cfg.rope_theta)
         windows = T.layer_sliding_windows(cfg)
@@ -178,42 +190,24 @@ class PagedModelRunner(ModelRunner):
 
             def body(x, scanned):
                 lp, pk, pv, window = scanned  # pk/pv: [P, Hkv, page, Dh]
-                from crowdllama_tpu.ops.quant import dequant
+                pool = {}
 
-                h = rms_norm(x, lp["ln1"], cfg.rms_norm_eps,
-                             plus_one=cfg.family == "gemma2")
-                q = jnp.einsum("bd,dk->bk", h, dequant(lp["wq"])).reshape(
-                    b, heads, dh)
-                k = jnp.einsum("bd,dk->bk", h, dequant(lp["wk"])).reshape(
-                    b, hkv, dh)
-                v = jnp.einsum("bd,dk->bk", h, dequant(lp["wv"])).reshape(
-                    b, hkv, dh)
-                q = apply_rope(q[:, None], positions[:, None], cos, sin)[:, 0]
-                k = apply_rope(k[:, None], positions[:, None], cos, sin)[:, 0]
-                pk = pk.at[cur_page, :, offset].set(k.astype(pk.dtype))
-                pv = pv.at[cur_page, :, offset].set(v.astype(pv.dtype))
-                # Virtual-contiguous view of this slot's pages.
-                kc = pk[page_table].transpose(0, 2, 1, 3, 4).reshape(
-                    b, hkv, view_len, dh)
-                vc = pv[page_table].transpose(0, 2, 1, 3, 4).reshape(
-                    b, hkv, view_len, dh)
-                attn = decode_attention(q, kc, vc, lens, scale,
-                                        softcap=cfg.attn_logit_softcap,
-                                        sliding_window=window)
-                attn = jnp.einsum("bk,kd->bd", attn.reshape(b, -1),
-                                  dequant(lp["wo"]))
-                if cfg.post_norms:
-                    attn = rms_norm(attn, lp["post_ln1"], cfg.rms_norm_eps,
-                                    plus_one=True)
-                x = x + attn
-                h = rms_norm(x, lp["ln2"], cfg.rms_norm_eps,
-                             plus_one=cfg.family == "gemma2")
-                mlp_out = T._moe(lp, cfg, h) if cfg.is_moe else T._mlp(lp, cfg, h)
-                if cfg.post_norms:
-                    mlp_out = rms_norm(mlp_out, lp["post_ln2"],
-                                       cfg.rms_norm_eps, plus_one=True)
-                x = x + mlp_out
-                return x, (pk, pv)
+                def attn_fn(q, k, v):
+                    pk2 = pk.at[cur_page, :, offset].set(k.astype(pk.dtype))
+                    pv2 = pv.at[cur_page, :, offset].set(v.astype(pv.dtype))
+                    pool["pk"], pool["pv"] = pk2, pv2
+                    # Virtual-contiguous view of each slot's pages.
+                    kc = pk2[page_table].transpose(0, 2, 1, 3, 4).reshape(
+                        b, hkv, view_len, dh)
+                    vc = pv2[page_table].transpose(0, 2, 1, 3, 4).reshape(
+                        b, hkv, view_len, dh)
+                    return decode_attention(q, kc, vc, lens, scale,
+                                            softcap=cfg.attn_logit_softcap,
+                                            sliding_window=window)
+
+                x = T.decode_layer_body(lp, cfg, x, positions, cos, sin,
+                                        attn_fn)
+                return x, (pool["pk"], pool["pv"])
 
             x, (pool_k, pool_v) = jax.lax.scan(
                 body, x, (params["layers"], st.pool_k, st.pool_v, windows))
@@ -235,18 +229,29 @@ class PagedModelRunner(ModelRunner):
     # ------------------------------------------------------------------ API
 
     def init_state(self, seed: int = 0) -> PagedDecodeState:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from crowdllama_tpu.parallel.mesh import AXIS_TP
+        from crowdllama_tpu.parallel.sharding import filter_spec
+
         l = self.cfg.num_layers
         hkv, dh = self.cfg.num_kv_heads, self.cfg.resolved_head_dim()
         # +1: reserved dump page absorbing inactive slots' decode writes.
         shape = (l, self.total_pages + 1, hkv, self.page_size, dh)
+        # KV heads shard over tp like the contiguous cache (runner.py
+        # cache_pspec); the page dim stays unsharded — pages are shared by
+        # all slots, so dp cannot partition them.
+        pool_sharding = NamedSharding(
+            self.mesh, filter_spec(P(None, None, AXIS_TP, None, None),
+                                   self.mesh))
         self._free_pages = list(range(self.total_pages))
         self._slot_pages = {}
         self._host_seq[:] = 0
         self.page_table[:] = 0
         b = self.max_slots
         return PagedDecodeState(
-            pool_k=jnp.zeros(shape, self.dtype),
-            pool_v=jnp.zeros(shape, self.dtype),
+            pool_k=jax.device_put(jnp.zeros(shape, self.dtype), pool_sharding),
+            pool_v=jax.device_put(jnp.zeros(shape, self.dtype), pool_sharding),
             seq_lens=jnp.zeros((b,), jnp.int32),
             tokens=jnp.zeros((b,), jnp.int32),
             active=jnp.zeros((b,), bool),
@@ -278,16 +283,33 @@ class PagedModelRunner(ModelRunner):
         self._free(slot)
         return self._release_paged(state, jnp.int32(slot))
 
+    def _ensure_slot(self, slot: int, steps: int) -> None:
+        """Grow one slot's page table to cover ``steps`` more tokens."""
+        pages = self._slot_pages[slot]
+        needed_tokens = min(int(self._host_seq[slot]) + steps + 1,
+                            self.max_seq)
+        needed = math.ceil(needed_tokens / self.page_size)
+        if needed > len(pages):
+            new = self._alloc(needed - len(pages))
+            self.page_table[slot, len(pages):len(pages) + len(new)] = new
+            pages.extend(new)
+
+    def pre_decode_check(self, steps: int) -> list[int]:
+        """Scheduler hook: grow every live slot for the coming chunk; slots
+        an overcommitted pool cannot grow are returned for forced
+        length-finish (their pages free at release) — one starved request
+        ends instead of the whole engine failing."""
+        starved = []
+        for slot in list(self._slot_pages):
+            try:
+                self._ensure_slot(slot, steps)
+            except PagesExhausted:
+                starved.append(slot)
+        return starved
+
     def _ensure_capacity(self, steps: int) -> None:
-        """Grow page tables so every live slot can append ``steps`` tokens."""
-        for slot, pages in self._slot_pages.items():
-            needed_tokens = min(int(self._host_seq[slot]) + steps + 1,
-                                self.max_seq)
-            needed = math.ceil(needed_tokens / self.page_size)
-            if needed > len(pages):
-                new = self._alloc(needed - len(pages))
-                self.page_table[slot, len(pages):len(pages) + len(new)] = new
-                pages.extend(new)
+        for slot in list(self._slot_pages):
+            self._ensure_slot(slot, steps)
 
     def decode_steps(self, state: PagedDecodeState, num_steps: int = 1):
         self._ensure_capacity(num_steps)

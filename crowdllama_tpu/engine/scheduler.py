@@ -209,6 +209,22 @@ class Scheduler:
 
         # A chunk of decode steps for the whole batch in one dispatch.
         k = self._chunk_size()
+        # Paged-KV runners grow page tables before the chunk; slots an
+        # overcommitted pool cannot grow finish with "length" (their pages
+        # free on release) instead of failing the whole engine.
+        check = getattr(self.runner, "pre_decode_check", None)
+        if check is not None:
+            for slot in check(k):
+                info = self.slots[slot]
+                if info is None:
+                    continue
+                log.warning("kv pool exhausted: finishing slot %d early", slot)
+                info.req.out.put_nowait((_DONE, "length"))
+                self.slots[slot] = None
+                self.state = self.runner.release(self.state, slot)
+                self.requests_served += 1
+            if all(s is None for s in self.slots):
+                return
         t0 = time.monotonic()
         loop = asyncio.get_running_loop()
         tokens, self.state = await loop.run_in_executor(

@@ -130,6 +130,21 @@ class IPCServer:
                 })
             except Exception as e:
                 await self._send_json(writer, {"type": "error", "error": str(e)})
+        elif mtype == "profile":
+            # Capture a jax.profiler trace of live engine activity (worker
+            # nodes with --profile-dir; SURVEY §5 profiler hook).
+            capture = getattr(self.engine, "capture_profile", None)
+            if capture is None:
+                await self._send_json(writer, {
+                    "type": "error", "error": "engine does not support profiling"})
+            else:
+                try:
+                    path = await capture(float(obj.get("seconds", 3.0)))
+                    await self._send_json(writer, {"type": "profile",
+                                                   "trace_dir": path})
+                except Exception as e:
+                    await self._send_json(writer, {"type": "error",
+                                                   "error": str(e)})
         elif mtype == "status":
             workers = []
             if self.peer is not None and self.peer.peer_manager is not None:

@@ -292,6 +292,43 @@ def prefill(
 
 # ------------------------------------------------------------------- decode
 
+def decode_layer_body(
+    lp: Params,              # ONE layer's params
+    cfg: ModelConfig,
+    x: jnp.ndarray,          # [B, D] residual stream
+    positions: jnp.ndarray,  # [B]
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    attn_fn,                 # (q [B,H,Dh], k [B,Hkv,Dh], v) -> attn [B,H,Dh]
+) -> jnp.ndarray:
+    """One decoder layer's decode-step math, minus the KV-cache policy.
+
+    The cache write + attention read live behind ``attn_fn`` so every cache
+    layout (contiguous slots, paged pool, sp-sharded — engine/runner.py,
+    engine/paged.py, ops/ring.py callers) shares ONE source of truth for
+    norms/projections/rope/residuals/MLP: a change to layer semantics cannot
+    ship in one layout and silently miss another.
+    """
+    b = x.shape[0]
+    dh = cfg.resolved_head_dim()
+    h = rms_norm(x, lp["ln1"], cfg.rms_norm_eps, plus_one=cfg.family == "gemma2")
+    q = jnp.einsum("bd,dk->bk", h, dequant(lp["wq"])).reshape(b, cfg.num_heads, dh)
+    k = jnp.einsum("bd,dk->bk", h, dequant(lp["wk"])).reshape(b, cfg.num_kv_heads, dh)
+    v = jnp.einsum("bd,dk->bk", h, dequant(lp["wv"])).reshape(b, cfg.num_kv_heads, dh)
+    q = apply_rope(q[:, None], positions[:, None], cos, sin)[:, 0]
+    k = apply_rope(k[:, None], positions[:, None], cos, sin)[:, 0]
+    attn = attn_fn(q, k, v)
+    attn = jnp.einsum("bk,kd->bd", attn.reshape(b, -1), dequant(lp["wo"]))
+    if cfg.post_norms:
+        attn = rms_norm(attn, lp["post_ln1"], cfg.rms_norm_eps, plus_one=True)
+    x = x + attn
+    h = rms_norm(x, lp["ln2"], cfg.rms_norm_eps, plus_one=cfg.family == "gemma2")
+    mlp_out = _moe(lp, cfg, h) if cfg.is_moe else _mlp(lp, cfg, h)
+    if cfg.post_norms:
+        mlp_out = rms_norm(mlp_out, lp["post_ln2"], cfg.rms_norm_eps, plus_one=True)
+    return x + mlp_out
+
+
 def scan_decode_layers(
     layers: Params,          # stacked layer params, leading dim = #layers
     windows: jnp.ndarray,
@@ -311,7 +348,6 @@ def scan_decode_layers(
     (parallel/pipeline.py runs it over a stage's local layers + cache slice).
     """
     dh = cfg.resolved_head_dim()
-    hkv = cfg.num_kv_heads
     scale = attn_scale(cfg)
     cos, sin = rope_table(cfg.max_context_length, dh, cfg.rope_theta)
     b = x.shape[0]
@@ -319,36 +355,31 @@ def scan_decode_layers(
 
     def body(x, scanned):
         lp, kc, vc, window = scanned  # kc/vc: [B, Hkv, S, Dh]
-        h = rms_norm(x, lp["ln1"], cfg.rms_norm_eps, plus_one=cfg.family == "gemma2")
-        q = jnp.einsum("bd,dk->bk", h, dequant(lp["wq"])).reshape(b, cfg.num_heads, dh)
-        k = jnp.einsum("bd,dk->bk", h, dequant(lp["wk"])).reshape(b, hkv, dh)
-        v = jnp.einsum("bd,dk->bk", h, dequant(lp["wv"])).reshape(b, hkv, dh)
-        q = apply_rope(q[:, None], positions[:, None], cos, sin)[:, 0]
-        k = apply_rope(k[:, None], positions[:, None], cos, sin)[:, 0]
-        if sp_mesh is not None:
-            kc, vc = sp_cache_update(k, v, positions, kc, vc, sp_mesh,
-                                     dp_axis=dp_axis)
-            attn = sp_decode_attention(q, kc, vc, seq_lens, scale, sp_mesh,
-                                       softcap=cfg.attn_logit_softcap,
-                                       sliding_window=window, dp_axis=dp_axis)
-        else:
-            # Mixed basic/advanced indexing: the broadcast [B] index pair
-            # fronts the result, so kc[slots, :, positions] is [B, Hkv, Dh].
-            kc = kc.at[slot_idx, :, positions].set(k)
-            vc = vc.at[slot_idx, :, positions].set(v)
-            attn = decode_attention(q, kc, vc, seq_lens, scale,
-                                    softcap=cfg.attn_logit_softcap,
-                                    sliding_window=window, n_shards=n_shards)
-        attn = jnp.einsum("bk,kd->bd", attn.reshape(b, -1), dequant(lp["wo"]))
-        if cfg.post_norms:
-            attn = rms_norm(attn, lp["post_ln1"], cfg.rms_norm_eps, plus_one=True)
-        x = x + attn
-        h = rms_norm(x, lp["ln2"], cfg.rms_norm_eps, plus_one=cfg.family == "gemma2")
-        mlp_out = _moe(lp, cfg, h) if cfg.is_moe else _mlp(lp, cfg, h)
-        if cfg.post_norms:
-            mlp_out = rms_norm(mlp_out, lp["post_ln2"], cfg.rms_norm_eps, plus_one=True)
-        x = x + mlp_out
-        return x, (kc, vc)
+        cache = {}
+
+        def attn_fn(q, k, v):
+            if sp_mesh is not None:
+                kc2, vc2 = sp_cache_update(k, v, positions, kc, vc, sp_mesh,
+                                           dp_axis=dp_axis)
+                attn = sp_decode_attention(q, kc2, vc2, seq_lens, scale,
+                                           sp_mesh,
+                                           softcap=cfg.attn_logit_softcap,
+                                           sliding_window=window,
+                                           dp_axis=dp_axis)
+            else:
+                # Mixed basic/advanced indexing: the broadcast [B] index pair
+                # fronts the result, so kc[slots, :, positions] is [B,Hkv,Dh].
+                kc2 = kc.at[slot_idx, :, positions].set(k)
+                vc2 = vc.at[slot_idx, :, positions].set(v)
+                attn = decode_attention(q, kc2, vc2, seq_lens, scale,
+                                        softcap=cfg.attn_logit_softcap,
+                                        sliding_window=window,
+                                        n_shards=n_shards)
+            cache["kc"], cache["vc"] = kc2, vc2
+            return attn
+
+        x = decode_layer_body(lp, cfg, x, positions, cos, sin, attn_fn)
+        return x, (cache["kc"], cache["vc"])
 
     x, (k_cache, v_cache) = jax.lax.scan(
         body, x, (layers, k_cache, v_cache, windows)

@@ -83,6 +83,41 @@ def test_paged_overcommit_exhaustion_raises_cleanly():
     assert issubclass(PagesExhausted, ValueError)
 
 
+async def test_paged_overcommit_starves_one_slot_not_engine():
+    """When an overcommitted pool runs dry mid-decode, the scheduler
+    finishes the starved slot with 'length' and the other request
+    completes normally (no engine-wide failure)."""
+    from crowdllama_tpu.config import Configuration, Intervals
+    from crowdllama_tpu.engine.engine import JaxEngine
+
+    cfg = Configuration(model="tiny-test", max_context_length=512,
+                        kv_layout="paged", kv_page_size=32,
+                        kv_pool_tokens=512,  # clamps to 16 pages
+                        max_batch_slots=2, warmup=False,
+                        intervals=Intervals.default())
+    engine = JaxEngine(cfg)
+    await engine.start()
+    try:
+        async def run_one(n):
+            reasons = []
+            async for chunk in engine.generate("grow " * 20, max_tokens=n):
+                if chunk.done:
+                    reasons.append(chunk.done_reason)
+            return reasons[0]
+
+        # Two big requests racing for 16 pages: at least one must finish
+        # (stop/length), neither may error, and the engine survives.
+        r1, r2 = await asyncio.gather(run_one(400), run_one(400))
+        assert r1 in ("stop", "length") and r2 in ("stop", "length")
+        runner = engine.scheduler.runner
+        assert len(runner._free_pages) == runner.total_pages
+        # Engine still serves after the squeeze.
+        r3 = await run_one(4)
+        assert r3 in ("stop", "length")
+    finally:
+        await engine.stop()
+
+
 async def test_paged_engine_end_to_end():
     """JaxEngine with kv_layout=paged serves concurrent mixed-length
     requests through the scheduler."""

@@ -1,0 +1,62 @@
+"""jax.profiler hooks (SURVEY §5): engine trace capture + IPC surface."""
+
+import asyncio
+import json
+from pathlib import Path
+
+import pytest
+
+from crowdllama_tpu.config import Configuration, Intervals
+from crowdllama_tpu.engine.engine import JaxEngine
+from crowdllama_tpu.ipc.server import IPCServer
+
+
+async def test_capture_profile_writes_trace(tmp_path):
+    cfg = Configuration(model="tiny-test", max_context_length=64,
+                        max_batch_slots=2, warmup=False,
+                        profile_dir=str(tmp_path / "traces"),
+                        intervals=Intervals.default())
+    engine = JaxEngine(cfg)
+    await engine.start()
+    try:
+        async def generate():
+            async for _ in engine.generate("profile me", max_tokens=24):
+                pass
+
+        gen = asyncio.create_task(generate())
+        trace_dir = await engine.capture_profile(seconds=0.5)
+        await gen
+        files = list(Path(trace_dir).rglob("*"))
+        assert any(f.is_file() for f in files), "no trace artifacts written"
+    finally:
+        await engine.stop()
+
+
+async def test_capture_profile_requires_config():
+    cfg = Configuration(model="tiny-test", intervals=Intervals.default())
+    engine = JaxEngine(cfg)  # not started; capture checks config first
+    with pytest.raises(RuntimeError, match="profiling disabled"):
+        await engine.capture_profile()
+
+
+async def test_ipc_profile_op(tmp_path):
+    cfg = Configuration(model="tiny-test", max_context_length=64,
+                        max_batch_slots=2, warmup=False,
+                        profile_dir=str(tmp_path / "traces"),
+                        intervals=Intervals.default())
+    engine = JaxEngine(cfg)
+    await engine.start()
+    sock = str(tmp_path / "ipc.sock")
+    server = IPCServer(sock, engine)
+    await server.start()
+    try:
+        reader, writer = await asyncio.open_unix_connection(sock)
+        writer.write(json.dumps({"type": "profile", "seconds": 0.2}).encode() + b"\n")
+        await writer.drain()
+        reply = json.loads(await asyncio.wait_for(reader.readline(), 30))
+        assert reply["type"] == "profile", reply
+        assert Path(reply["trace_dir"]).exists()
+        writer.close()
+    finally:
+        await server.stop()
+        await engine.stop()
