@@ -74,8 +74,11 @@ async def run_server(host: str, port: int, key_path: str) -> None:
     async def stats_loop() -> None:
         while True:
             await asyncio.sleep(15)
-            log.info("routing table: %d peers | namespace providers: %d",
-                     len(dht.table), len(dht.providers.get(namespace_key())))
+            log.info("routing table: %d peers | namespace providers: %d | "
+                     "streams in=%d out=%d rejected=%d | by proto: %s",
+                     len(dht.table), len(dht.providers.get(namespace_key())),
+                     h.stats["streams_in"], h.stats["streams_out"],
+                     h.stats["rejected"], dict(h.stats_by_protocol))
 
     stats = asyncio.create_task(stats_loop())
     try:

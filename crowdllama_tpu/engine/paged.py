@@ -19,9 +19,11 @@ paged attention as the north star).  Here KV lives in a pool of fixed
   at this stage.
 
 Page exhaustion under an overcommitted pool surfaces at admission as a
-ValueError (the scheduler fails that request cleanly); growth during a
-decode chunk of an overcommitted pool raises, which the scheduler treats
-as an engine failure — size overcommitted pools with chunk headroom.
+ValueError (the scheduler fails that request cleanly); when growth runs
+dry mid-serving, the scheduler's ``pre_decode_check`` hook finishes
+starved slots one at a time with done_reason "length" (each release frees
+pages that often let the remaining slots continue) — the engine itself
+never fails on exhaustion.
 
 Single-mesh path only (sp/pp compose with the contiguous layout).
 """
@@ -78,13 +80,10 @@ class PagedModelRunner(ModelRunner):
         # dp, but the shared page pool cannot shard over dp (pages belong
         # to no fixed slot), so unrequested dp would just replicate it.
         if kwargs.get("mesh") is None and not kwargs.get("mesh_spec"):
-            n = len(jax.devices())
-            tp = 1
-            for cand in range(min(n, cfg.num_kv_heads), 0, -1):
-                if n % cand == 0 and cfg.num_kv_heads % cand == 0:
-                    tp = cand
-                    break
-            kwargs["mesh_spec"] = f"1x{tp}"
+            from crowdllama_tpu.parallel.mesh import largest_tp
+
+            kwargs["mesh_spec"] = (
+                f"1x{largest_tp(len(jax.devices()), cfg.num_kv_heads)}")
         super().__init__(cfg, *args, **kwargs)
         from crowdllama_tpu.parallel.mesh import AXIS_DP
 

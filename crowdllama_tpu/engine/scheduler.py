@@ -211,18 +211,24 @@ class Scheduler:
         k = self._chunk_size()
         # Paged-KV runners grow page tables before the chunk; slots an
         # overcommitted pool cannot grow finish with "length" (their pages
-        # free on release) instead of failing the whole engine.
+        # free on release) instead of failing the whole engine.  One slot is
+        # released at a time and the check re-run: the freed pages often let
+        # the remaining starved slots continue.
         check = getattr(self.runner, "pre_decode_check", None)
         if check is not None:
-            for slot in check(k):
+            while True:
+                starved = check(k)
+                if not starved:
+                    break
+                slot = starved[0]
                 info = self.slots[slot]
-                if info is None:
-                    continue
-                log.warning("kv pool exhausted: finishing slot %d early", slot)
-                info.req.out.put_nowait((_DONE, "length"))
-                self.slots[slot] = None
+                if info is not None:
+                    log.warning("kv pool exhausted: finishing slot %d early",
+                                slot)
+                    info.req.out.put_nowait((_DONE, "length"))
+                    self.slots[slot] = None
+                    self.requests_served += 1
                 self.state = self.runner.release(self.state, slot)
-                self.requests_served += 1
             if all(s is None for s in self.slots):
                 return
         t0 = time.monotonic()

@@ -56,6 +56,9 @@ class Gateway:
         self.app.router.add_post("/api/generate", self.handle_generate)
         self.app.router.add_get("/api/health", self.handle_health)
         self.app.router.add_get("/api/tags", self.handle_tags)
+        self.app.router.add_get("/api/version", self.handle_version)
+        self.app.router.add_post("/api/show", self.handle_show)
+        self.app.router.add_get("/api/ps", self.handle_ps)
 
     # ----------------------------------------------------------- lifecycle
 
@@ -153,6 +156,77 @@ class Gateway:
                     continue
                 for m in p.resource.supported_models:
                     models.setdefault(m, {"name": m, "model": m})
+        return web.json_response({"models": list(models.values())})
+
+    async def handle_version(self, request: web.Request) -> web.Response:
+        """GET /api/version — Ollama client handshake."""
+        from crowdllama_tpu.version import VERSION
+
+        return web.json_response({"version": VERSION})
+
+    async def handle_show(self, request: web.Request) -> web.Response:
+        """POST /api/show — model details (registry config + swarm view)."""
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"error": "invalid JSON"}, status=400)
+        name = body.get("model") or body.get("name") or ""
+        if not name:
+            return web.json_response({"error": "model is required"}, status=400)
+        pm = self.peer.peer_manager
+        serving = [p.peer_id for p in (pm.get_healthy_peers() if pm else [])
+                   if p.is_worker and name in p.resource.supported_models]
+        details: dict = {"format": "safetensors"}
+        model_info: dict = {}
+        try:
+            from crowdllama_tpu.models.config import get_config
+
+            cfg = get_config(name)
+            details.update({
+                "family": cfg.family,
+                "families": [cfg.family],
+                "parameter_size": f"{cfg.param_count() / 1e9:.1f}B",
+            })
+            model_info = {
+                "general.architecture": cfg.family,
+                "general.parameter_count": cfg.param_count(),
+                f"{cfg.family}.context_length": cfg.max_context_length,
+                f"{cfg.family}.embedding_length": cfg.hidden_size,
+                f"{cfg.family}.block_count": cfg.num_layers,
+                f"{cfg.family}.attention.head_count": cfg.num_heads,
+                f"{cfg.family}.attention.head_count_kv": cfg.num_kv_heads,
+                f"{cfg.family}.vocab_size": cfg.vocab_size,
+            }
+            if cfg.is_moe:
+                model_info[f"{cfg.family}.expert_count"] = cfg.num_experts
+                model_info[f"{cfg.family}.expert_used_count"] = (
+                    cfg.num_experts_per_tok)
+        except KeyError:
+            if not serving:
+                return web.json_response(
+                    {"error": f"model {name!r} not found"}, status=404)
+        return web.json_response({
+            "model": name,
+            "details": details,
+            "model_info": model_info,
+            "workers_serving": serving,
+        })
+
+    async def handle_ps(self, request: web.Request) -> web.Response:
+        """GET /api/ps — models currently loaded across the swarm."""
+        pm = self.peer.peer_manager
+        models: dict[str, dict] = {}
+        if pm is not None:
+            for p in pm.get_healthy_peers():
+                if not p.is_worker:
+                    continue
+                for m in p.resource.supported_models:
+                    entry = models.setdefault(m, {
+                        "name": m, "model": m, "workers": 0,
+                        "tokens_throughput": 0.0,
+                    })
+                    entry["workers"] += 1
+                    entry["tokens_throughput"] += p.resource.tokens_throughput
         return web.json_response({"models": list(models.values())})
 
     # -------------------------------------------------------------- routing

@@ -52,6 +52,20 @@ class ModelConfig:
     def resolved_head_dim(self) -> int:
         return self.head_dim or self.hidden_size // self.num_heads
 
+    def param_count(self) -> int:
+        """Total parameters (matches models.transformer.init_params)."""
+        d, f, v = self.hidden_size, self.intermediate_size, self.vocab_size
+        dh = self.resolved_head_dim()
+        attn = d * self.num_heads * dh + 2 * d * self.num_kv_heads * dh \
+            + self.num_heads * dh * d
+        if self.is_moe:
+            mlp = self.num_experts * 3 * d * f + d * self.num_experts
+        else:
+            mlp = 3 * d * f
+        norms = 2 * d + (2 * d if self.post_norms else 0)
+        head = 0 if self.tie_word_embeddings else d * v
+        return self.num_layers * (attn + mlp + norms) + v * d + head + d
+
     @property
     def is_moe(self) -> bool:
         return self.num_experts > 0

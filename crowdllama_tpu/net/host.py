@@ -173,6 +173,13 @@ class Host:
         # peerstore: peer_id -> Contact learned from hellos / DHT results
         self.peerstore: dict[str, Contact] = {}
         self._conn_tasks: set[asyncio.Task] = set()
+        # Connection statistics (the reference's dht server logs per-
+        # connection-type stats, dht.go:398-423; over plain TCP the useful
+        # classification is per-protocol stream counts + rejections).
+        self.stats: dict[str, int] = {
+            "streams_in": 0, "streams_out": 0, "rejected": 0,
+        }
+        self.stats_by_protocol: dict[str, int] = {}
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -284,6 +291,7 @@ class Host:
                 my_nonce, server_nonce)
             remote_contact = Contact(remote_id, host, port)
             self.peerstore[remote_id] = remote_contact
+            self.stats["streams_out"] += 1
             return Stream(
                 protocol=protocol,
                 remote_peer_id=remote_id,
@@ -302,6 +310,7 @@ class Host:
         if task is not None:
             self._conn_tasks.add(task)
             task.add_done_callback(self._conn_tasks.discard)
+        handshaked = False
         try:
             # Nonce exchange first (see new_stream).
             opening = await read_json_frame(reader, HANDSHAKE_TIMEOUT)
@@ -309,6 +318,7 @@ class Host:
             client_nonce = str(opening.get("nonce", ""))
             handler = self._handlers.get(proto)
             if handler is None:
+                self.stats["rejected"] += 1
                 await write_json_frame(writer, {"error": f"unknown protocol {proto!r}"})
                 return
             my_nonce = os.urandom(16).hex()
@@ -360,8 +370,16 @@ class Host:
                 reader=SecureReader(reader, c2s),
                 writer=SecureWriter(writer, s2c),
             )
+            self.stats["streams_in"] += 1
+            self.stats_by_protocol[proto] = (
+                self.stats_by_protocol.get(proto, 0) + 1)
+            handshaked = True
             await handler(stream)
         except (HandshakeError, json.JSONDecodeError, asyncio.TimeoutError) as e:
+            # Only handshake-phase failures are "rejections"; a stream that
+            # authenticated and then errored in its handler was accepted.
+            if not handshaked:
+                self.stats["rejected"] += 1
             log.debug("inbound stream rejected: %s", e)
         except asyncio.CancelledError:  # host shutting down
             raise

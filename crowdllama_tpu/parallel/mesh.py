@@ -46,17 +46,22 @@ def parse_mesh_spec(spec: str, n_devices: int) -> tuple[int, int, int, int, int]
     return shape
 
 
+def largest_tp(n_devices: int, num_kv_heads: int) -> int:
+    """Largest tensor-parallel degree dividing both the device count and the
+    kv-head count (the KV cache shards heads over tp)."""
+    for cand in range(min(n_devices, num_kv_heads), 0, -1):
+        if n_devices % cand == 0 and num_kv_heads % cand == 0:
+            return cand
+    return 1
+
+
 def choose_mesh_shape(n_devices: int, num_kv_heads: int,
                       num_experts: int = 0) -> tuple[int, int, int, int, int]:
     """Pick (dp, pp, sp, ep, tp) automatically: as much tp as kv-head
     divisibility allows (KV cache heads are tp-sharded), spill the rest to ep
     (MoE) or dp.  pp/sp stay 1 unless requested explicitly — pipelining pays
     off only when tp runs out of head divisibility, sp only at long context."""
-    tp = 1
-    for cand in range(min(n_devices, num_kv_heads), 0, -1):
-        if n_devices % cand == 0 and num_kv_heads % cand == 0:
-            tp = cand
-            break
+    tp = largest_tp(n_devices, num_kv_heads)
     rest = n_devices // tp
     if num_experts and num_experts % rest == 0:
         return (1, 1, 1, rest, tp)

@@ -169,3 +169,42 @@ async def test_worker_death_detected():
                 assert resp.status == 503
     finally:
         await teardown()
+
+
+async def test_ollama_surface_endpoints():
+    """/api/version, /api/show, /api/ps complete the Ollama client surface."""
+    worker, consumer, gateway, gw_port, teardown = await _topology()
+    try:
+        await _wait_for(
+            lambda: any(
+                p.peer_id == worker.peer_id
+                for p in consumer.peer_manager.get_healthy_peers()
+            ),
+            what="consumer discovering worker",
+        )
+        base = f"http://127.0.0.1:{gw_port}"
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{base}/api/version") as resp:
+                assert resp.status == 200
+                assert (await resp.json())["version"]
+
+            async with s.get(f"{base}/api/ps") as resp:
+                ps = await resp.json()
+            assert any(m["model"] == "tiny-test" and m["workers"] == 1
+                       for m in ps["models"])
+
+            # Registry model: full details.
+            async with s.post(f"{base}/api/show",
+                              json={"model": "tiny-test"}) as resp:
+                assert resp.status == 200
+                d = await resp.json()
+            assert d["details"]["family"] == "llama"
+            assert d["model_info"]["general.parameter_count"] > 0
+            assert worker.peer_id in d["workers_serving"]
+
+            # Unknown model -> 404.
+            async with s.post(f"{base}/api/show",
+                              json={"model": "nope"}) as resp:
+                assert resp.status == 404
+    finally:
+        await teardown()
