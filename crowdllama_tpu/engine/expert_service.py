@@ -246,6 +246,15 @@ class EPLeaderRunner:
     def __init__(self, cfg: ModelConfig, params: dict, max_seq: int = 0,
                  dtype=jnp.bfloat16):
         assert cfg.is_moe
+        if cfg.attn_qkv_bias or cfg.qk_norm:
+            # The leader keeps its own per-layer attention (the expert hop
+            # between attention and residual-add is async host code, so it
+            # cannot share the scan bodies in models/transformer.py) and does
+            # not apply qkv biases / qk-norms.  Fail loudly rather than
+            # silently dropping checkpoint tensors.
+            raise NotImplementedError(
+                "cross-worker EP leader does not support attn_qkv_bias/"
+                "qk_norm configs yet")
         self.cfg = cfg
         self.dtype = dtype
         self.max_seq = max_seq or cfg.max_context_length

@@ -311,13 +311,21 @@ class PagedModelRunner(ModelRunner):
             self._ensure_slot(slot, steps)
 
     def decode_steps(self, state: PagedDecodeState, num_steps: int = 1):
+        tokens, new_state = self.decode_steps_device(state, num_steps)
+        return np.asarray(tokens), new_state
+
+    def decode_steps_device(self, state: PagedDecodeState, num_steps: int = 1):
+        # Page-table growth and _host_seq advance are dispatch-time host
+        # bookkeeping, so chained device-side chunks stay consistent without
+        # waiting for earlier chunks to finish (see ModelRunner
+        # .decode_steps_device on why pipelining matters).
         self._ensure_capacity(num_steps)
         tokens, new_state = self._decode_paged(
             self.params, state, jnp.asarray(self.page_table), num_steps)
         for slot in self._slot_pages:
             self._host_seq[slot] = min(self._host_seq[slot] + num_steps,
                                        self.max_seq)
-        return np.asarray(tokens), new_state
+        return tokens, new_state
 
     # -------------------------------------------------------------- buckets
 

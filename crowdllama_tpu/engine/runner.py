@@ -297,3 +297,14 @@ class ModelRunner:
         """Run ``num_steps`` decode steps; returns (tokens [K, B] np, state)."""
         tokens, new_state = self._decode(self.params, state, num_steps)
         return np.asarray(tokens), new_state
+
+    def decode_steps_device(self, state: DecodeState, num_steps: int = 1):
+        """Like :meth:`decode_steps` but the token block stays on device.
+
+        No host readback: chained calls pipeline — the next chunk dispatches
+        while the previous one executes, so only the final readback pays the
+        host↔device round trip (material when the chip sits behind a network
+        tunnel: ~70 ms RTT vs ~5 ms/step of compute).  The scheduler and
+        bench.py read tokens back with ``np.asarray`` when they need them.
+        """
+        return self._decode(self.params, state, num_steps)

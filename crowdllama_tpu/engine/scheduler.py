@@ -14,6 +14,14 @@ control plane (DHT RPCs, metadata serving, health probes — the reference
 worker serves all of these concurrently via goroutines).  The scheduler
 coroutine awaits each dispatch, so device state is still mutated by exactly
 one in-flight program at a time.
+
+Decode is double-buffered: chunk k+1 is dispatched (async, device-side)
+before chunk k's tokens are read back, so the host↔device readback and the
+Python emit loop overlap the next chunk's compute instead of serializing
+with it.  Each chunk carries a snapshot of the slots it was dispatched for;
+emission checks slot identity against the snapshot, so a slot retired (or
+retired-and-readmitted) between dispatch and readback never receives
+another chunk's tokens.
 """
 
 from __future__ import annotations
@@ -26,6 +34,7 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
 import jax
+import numpy as np
 
 from crowdllama_tpu.engine.runner import ModelRunner
 
@@ -55,6 +64,15 @@ class _SlotInfo:
     generated: int = 0
 
 
+@dataclass
+class _InFlightChunk:
+    """A dispatched-but-not-yet-read-back decode chunk."""
+
+    tokens_dev: object                  # device array [K, B]
+    snapshot: list["_SlotInfo | None"]  # slot infos at dispatch time
+    dispatched_at: float
+
+
 class Scheduler:
     def __init__(self, runner: ModelRunner, max_queue: int = 256,
                  decode_chunk: int = 8):
@@ -70,6 +88,8 @@ class Scheduler:
         self._exec: ThreadPoolExecutor | None = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="jax-dispatch")
         self._rng = jax.random.PRNGKey(int(time.time()) & 0x7FFFFFFF)
+        self._inflight: _InFlightChunk | None = None
+        self._last_retire_at = 0.0
         # Telemetry for Resource advertisement + /api/health.
         self.tokens_generated = 0
         self.throughput_ema = 0.0  # tokens/sec across the batch
@@ -165,6 +185,7 @@ class Scheduler:
                 # A failed dispatch must not silently kill serving: fail every
                 # in-flight request, reset device state, keep the loop alive.
                 log.exception("decode loop error; failing in-flight requests")
+                self._inflight = None  # its slots are failed below anyway
                 for i, info in enumerate(self.slots):
                     if info is not None:
                         info.req.out.put_nowait((_DONE, "error: engine failure"))
@@ -175,8 +196,9 @@ class Scheduler:
                 self.state = self.runner.init_state()
 
     async def _loop_once(self) -> None:
-        # Idle: wait for work.
-        if all(s is None for s in self.slots) and self.pending.empty():
+        # Idle: wait for work (an undrained in-flight chunk is work).
+        if (all(s is None for s in self.slots) and self.pending.empty()
+                and self._inflight is None):
             self._wake.clear()
             await self._wake.wait()
 
@@ -204,53 +226,81 @@ class Scheduler:
             if sum(1 for s in self.slots if s is not None) > 1:
                 break
 
-        if all(s is None for s in self.slots):
+        if all(s is None for s in self.slots) and self._inflight is None:
             return
 
-        # A chunk of decode steps for the whole batch in one dispatch.
-        k = self._chunk_size()
-        # Paged-KV runners grow page tables before the chunk; slots an
-        # overcommitted pool cannot grow finish with "length" (their pages
-        # free on release) instead of failing the whole engine.  One slot is
-        # released at a time and the check re-run: the freed pages often let
-        # the remaining starved slots continue.
-        check = getattr(self.runner, "pre_decode_check", None)
-        if check is not None:
-            while True:
-                starved = check(k)
-                if not starved:
-                    break
-                slot = starved[0]
-                info = self.slots[slot]
-                if info is not None:
-                    log.warning("kv pool exhausted: finishing slot %d early",
-                                slot)
-                    info.req.out.put_nowait((_DONE, "length"))
-                    self.slots[slot] = None
-                    self.requests_served += 1
-                self.state = self.runner.release(self.state, slot)
-            if all(s is None for s in self.slots):
-                return
-        t0 = time.monotonic()
         loop = asyncio.get_running_loop()
-        tokens, self.state = await loop.run_in_executor(
-            self._exec, self.runner.decode_steps, self.state, k)  # [K,B]
-        dt = max(time.monotonic() - t0, 1e-6)
+
+        # Dispatch the NEXT chunk before reading back the previous one: the
+        # dispatch is async (device-side queue), so the previous chunk's
+        # readback + emit below overlap this chunk's compute.
+        dispatched: _InFlightChunk | None = None
+        if any(s is not None for s in self.slots):
+            k = self._chunk_size()
+            # Paged-KV runners grow page tables before the chunk; slots an
+            # overcommitted pool cannot grow finish with "length" (their
+            # pages free on release) instead of failing the whole engine.
+            # One slot is released at a time and the check re-run: the freed
+            # pages often let the remaining starved slots continue.
+            check = getattr(self.runner, "pre_decode_check", None)
+            if check is not None:
+                starved = check(k)
+                if starved and self._inflight is not None:
+                    # Drain the in-flight chunk first: force-finishing a
+                    # starved slot now would drop its already-generated
+                    # tokens, and retirement can itself free pages (EOS).
+                    await self._retire_inflight(loop)
+                    starved = check(k)
+                while starved:
+                    slot = starved[0]
+                    info = self.slots[slot]
+                    if info is not None:
+                        log.warning(
+                            "kv pool exhausted: finishing slot %d early", slot)
+                        info.req.out.put_nowait((_DONE, "length"))
+                        self.slots[slot] = None
+                        self.requests_served += 1
+                    self.state = self.runner.release(self.state, slot)
+                    starved = check(k)
+            if any(s is not None for s in self.slots):
+                tokens_dev, self.state = await loop.run_in_executor(
+                    self._exec, self.runner.decode_steps_device,
+                    self.state, k)  # [K,B] on device
+                dispatched = _InFlightChunk(
+                    tokens_dev=tokens_dev, snapshot=list(self.slots),
+                    dispatched_at=time.monotonic())
+
+        # Retire the PREVIOUS chunk (readback overlaps the new dispatch).
+        await self._retire_inflight(loop)
+        self._inflight = dispatched
+        # Yield so submitters/streamers run between chunks.
+        await asyncio.sleep(0)
+
+    async def _retire_inflight(self, loop) -> None:
+        """Read back and emit the in-flight chunk, if any."""
+        if self._inflight is None:
+            return
+        fl, self._inflight = self._inflight, None
+        tokens = await loop.run_in_executor(
+            self._exec, np.asarray, fl.tokens_dev)  # [K,B] host
+        now = time.monotonic()
+        dt = max(now - max(self._last_retire_at, fl.dispatched_at), 1e-6)
+        self._last_retire_at = now
         emitted = 0
         for step in range(tokens.shape[0]):
-            # _emit may retire a slot mid-chunk; later steps for that slot
-            # are EOS overshoot and are discarded by the snapshot below.
-            live = [(i, s) for i, s in enumerate(self.slots) if s is not None]
-            for i, info in live:
-                self._emit(info.req, int(tokens[step, i]), info)
-                emitted += 1
+            for i, info in enumerate(fl.snapshot):
+                # Identity check: emit only to slots still owned by the
+                # request they were dispatched for — a slot retired
+                # mid-chunk (EOS overshoot) or retired-and-readmitted
+                # since dispatch is skipped.
+                if info is not None and self.slots[i] is info:
+                    self._emit(info.req, int(tokens[step, i]), info)
+                    emitted += 1
         rate = emitted / dt
         self.throughput_ema = (
             rate if self.throughput_ema == 0.0
             else 0.9 * self.throughput_ema + 0.1 * rate
         )
-        # Yield so submitters/streamers run between chunks.
-        await asyncio.sleep(0)
 
 
 DONE = _DONE

@@ -79,7 +79,9 @@ def config_from_hf_dir(path: str | Path) -> ModelConfig:
     d = json.loads((Path(path) / "config.json").read_text())
     arch = (d.get("architectures") or [""])[0].lower()
     family = ("gemma2" if "gemma2" in arch
-              else "mixtral" if "mixtral" in arch else "llama")
+              else "mixtral" if "mixtral" in arch
+              else "qwen3" if "qwen3" in arch
+              else "qwen2" if "qwen2" in arch else "llama")
     return ModelConfig(
         name=d.get("_name_or_path", "hf-model"),
         family=family,
@@ -102,4 +104,6 @@ def config_from_hf_dir(path: str | Path) -> ModelConfig:
         embedding_multiplier=(d["hidden_size"] ** 0.5) if family == "gemma2" else 0.0,
         num_experts=d.get("num_local_experts", 0),
         num_experts_per_tok=d.get("num_experts_per_tok", 2),
+        attn_qkv_bias=family == "qwen2" or bool(d.get("attention_bias")),
+        qk_norm=family == "qwen3",
     )

@@ -15,7 +15,7 @@ from dataclasses import dataclass, replace
 @dataclass(frozen=True)
 class ModelConfig:
     name: str = "custom"
-    family: str = "llama"  # "llama" | "gemma2" | "mixtral"
+    family: str = "llama"  # "llama" | "gemma2" | "mixtral" | "qwen2" | "qwen3"
     vocab_size: int = 32000
     hidden_size: int = 2048
     intermediate_size: int = 5632
@@ -35,6 +35,10 @@ class ModelConfig:
     sliding_window: int = 0  # 0 → all layers global; else even layers sliding
     post_norms: bool = False  # post-attention/post-mlp RMSNorms (Gemma-2)
     embedding_multiplier: float = 0.0  # 0 → disabled (Gemma scales by sqrt(D))
+
+    # Qwen specifics
+    attn_qkv_bias: bool = False  # Qwen2/2.5: bias on q/k/v projections
+    qk_norm: bool = False  # Qwen3: per-head RMSNorm on q and k before rope
 
     # MoE specifics (family="mixtral")
     num_experts: int = 0  # 0 → dense MLP
@@ -58,6 +62,10 @@ class ModelConfig:
         dh = self.resolved_head_dim()
         attn = d * self.num_heads * dh + 2 * d * self.num_kv_heads * dh \
             + self.num_heads * dh * d
+        if self.attn_qkv_bias:
+            attn += self.num_heads * dh + 2 * self.num_kv_heads * dh
+        if self.qk_norm:
+            attn += 2 * dh
         if self.is_moe:
             mlp = self.num_experts * 3 * d * f + d * self.num_experts
         else:
@@ -101,6 +109,18 @@ TINY_TEST_GEMMA = _register(ModelConfig(
     max_context_length=256, rms_norm_eps=1e-6,
 ))
 
+TINY_TEST_QWEN2 = _register(ModelConfig(
+    name="tiny-test-qwen2", family="qwen2", vocab_size=512, hidden_size=64,
+    intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=2,
+    attn_qkv_bias=True, rms_norm_eps=1e-6, max_context_length=256,
+))
+
+TINY_TEST_QWEN3 = _register(ModelConfig(
+    name="tiny-test-qwen3", family="qwen3", vocab_size=512, hidden_size=64,
+    intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=2,
+    head_dim=32, qk_norm=True, rms_norm_eps=1e-6, max_context_length=256,
+))
+
 # ---- production models (BASELINE.json configs) ----------------------------
 
 TINYLLAMA_1_1B = _register(ModelConfig(
@@ -125,6 +145,20 @@ MIXTRAL_8X7B = _register(ModelConfig(
     name="mixtral-8x7b", family="mixtral", vocab_size=32000, hidden_size=4096,
     intermediate_size=14336, num_layers=32, num_heads=32, num_kv_heads=8,
     rope_theta=1000000.0, num_experts=8, num_experts_per_tok=2,
+    max_context_length=32768,
+))
+
+QWEN25_7B = _register(ModelConfig(
+    name="qwen2.5-7b", family="qwen2", vocab_size=152064, hidden_size=3584,
+    intermediate_size=18944, num_layers=28, num_heads=28, num_kv_heads=4,
+    rope_theta=1000000.0, rms_norm_eps=1e-6, attn_qkv_bias=True,
+    max_context_length=32768,
+))
+
+QWEN3_8B = _register(ModelConfig(
+    name="qwen3-8b", family="qwen3", vocab_size=151936, hidden_size=4096,
+    intermediate_size=12288, num_layers=36, num_heads=32, num_kv_heads=8,
+    head_dim=128, rope_theta=1000000.0, rms_norm_eps=1e-6, qk_norm=True,
     max_context_length=32768,
 ))
 

@@ -51,6 +51,13 @@ def params_from_hf(cfg: ModelConfig, get: TensorSource, dtype=jnp.bfloat16) -> d
         "wo": stack("model.layers.{i}.self_attn.o_proj.weight"),
         "ln1": stack("model.layers.{i}.input_layernorm.weight", transpose=False),
     }
+    if cfg.attn_qkv_bias:  # Qwen2/2.5
+        layers["bq"] = stack("model.layers.{i}.self_attn.q_proj.bias", transpose=False)
+        layers["bk"] = stack("model.layers.{i}.self_attn.k_proj.bias", transpose=False)
+        layers["bv"] = stack("model.layers.{i}.self_attn.v_proj.bias", transpose=False)
+    if cfg.qk_norm:  # Qwen3
+        layers["q_norm"] = stack("model.layers.{i}.self_attn.q_norm.weight", transpose=False)
+        layers["k_norm"] = stack("model.layers.{i}.self_attn.k_norm.weight", transpose=False)
 
     if cfg.family == "gemma2":
         layers["post_ln1"] = stack(

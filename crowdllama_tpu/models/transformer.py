@@ -54,6 +54,13 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
         "wv": dense(next(keys), nl, d, hkv * dh, fan_in=d),
         "wo": dense(next(keys), nl, h * dh, d, fan_in=h * dh),
     }
+    if cfg.attn_qkv_bias:  # Qwen2/2.5
+        layers["bq"] = jnp.zeros((nl, h * dh), dtype)
+        layers["bk"] = jnp.zeros((nl, hkv * dh), dtype)
+        layers["bv"] = jnp.zeros((nl, hkv * dh), dtype)
+    if cfg.qk_norm:  # Qwen3
+        layers["q_norm"] = jnp.ones((nl, dh), dtype)
+        layers["k_norm"] = jnp.ones((nl, dh), dtype)
     if cfg.is_moe:
         e = cfg.num_experts
         layers["router"] = dense(next(keys), nl, d, e, fan_in=d)
@@ -229,9 +236,17 @@ def scan_prefill_layers(
     def body(x, scanned):
         lp, window = scanned
         h = rms_norm(x, lp["ln1"], cfg.rms_norm_eps, plus_one=cfg.family == "gemma2")
-        q = jnp.einsum("btd,dk->btk", h, dequant(lp["wq"])).reshape(b, t, cfg.num_heads, dh)
-        k = jnp.einsum("btd,dk->btk", h, dequant(lp["wk"])).reshape(b, t, hkv, dh)
-        v = jnp.einsum("btd,dk->btk", h, dequant(lp["wv"])).reshape(b, t, hkv, dh)
+        q = jnp.einsum("btd,dk->btk", h, dequant(lp["wq"]))
+        k = jnp.einsum("btd,dk->btk", h, dequant(lp["wk"]))
+        v = jnp.einsum("btd,dk->btk", h, dequant(lp["wv"]))
+        if "bq" in lp:  # Qwen2 qkv bias
+            q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+        q = q.reshape(b, t, cfg.num_heads, dh)
+        k = k.reshape(b, t, hkv, dh)
+        v = v.reshape(b, t, hkv, dh)
+        if "q_norm" in lp:  # Qwen3 per-head qk-norm
+            q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
+            k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
         q = apply_rope(q, positions, cos, sin)
         k = apply_rope(k, positions, cos, sin)
         kh = k.transpose(0, 2, 1, 3)  # [B, Hkv, T, Dh] — cache layout
@@ -312,9 +327,17 @@ def decode_layer_body(
     b = x.shape[0]
     dh = cfg.resolved_head_dim()
     h = rms_norm(x, lp["ln1"], cfg.rms_norm_eps, plus_one=cfg.family == "gemma2")
-    q = jnp.einsum("bd,dk->bk", h, dequant(lp["wq"])).reshape(b, cfg.num_heads, dh)
-    k = jnp.einsum("bd,dk->bk", h, dequant(lp["wk"])).reshape(b, cfg.num_kv_heads, dh)
-    v = jnp.einsum("bd,dk->bk", h, dequant(lp["wv"])).reshape(b, cfg.num_kv_heads, dh)
+    q = jnp.einsum("bd,dk->bk", h, dequant(lp["wq"]))
+    k = jnp.einsum("bd,dk->bk", h, dequant(lp["wk"]))
+    v = jnp.einsum("bd,dk->bk", h, dequant(lp["wv"]))
+    if "bq" in lp:  # Qwen2 qkv bias
+        q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+    q = q.reshape(b, cfg.num_heads, dh)
+    k = k.reshape(b, cfg.num_kv_heads, dh)
+    v = v.reshape(b, cfg.num_kv_heads, dh)
+    if "q_norm" in lp:  # Qwen3 per-head qk-norm
+        q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
+        k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
     q = apply_rope(q[:, None], positions[:, None], cos, sin)[:, 0]
     k = apply_rope(k[:, None], positions[:, None], cos, sin)[:, 0]
     attn = attn_fn(q, k, v)

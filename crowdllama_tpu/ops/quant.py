@@ -18,6 +18,7 @@ quantizes offline in formats the swarm layer never sees).
 
 from __future__ import annotations
 
+import math
 from dataclasses import dataclass
 from typing import Any
 
@@ -87,6 +88,48 @@ def quantize_params(params: Params, extra_keys: tuple[str, ...] = ("lm_head",)) 
         return out
 
     return jax.jit(_quantize)(params)
+
+
+def random_quantized_params(cfg, key: jax.Array, dtype=jnp.bfloat16) -> Params:
+    """Random parameter pytree with the matmul weights *born* int8.
+
+    Structurally (and throughput-) equivalent to
+    ``quantize_params(transformer.init_params(cfg, key))``, but the bf16
+    tree is never materialized: each leaf is allocated independently, so
+    peak device memory is the int8 tree plus one leaf.  That is what lets
+    an 8B model (16 GB bf16 — a whole v5e chip) initialize for benchmarking
+    on the same chip it serves from.  Weight values are random; for
+    benchmarks and capacity probes, not for serving real checkpoints.
+    """
+    from crowdllama_tpu.models import transformer as T
+
+    shapes = jax.eval_shape(lambda k: T.init_params(cfg, k, dtype), key)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(shapes)
+    leaf_keys = jax.random.split(key, len(flat))
+
+    norm_names = ("ln1", "ln2", "post_ln1", "post_ln2", "q_norm", "k_norm",
+                  "final_norm")
+
+    def build(path, sds, k):
+        name = path[-1].key
+        if name in QUANT_KEYS or name == "lm_head":
+            d_in = sds.shape[-2]
+            q = jax.random.randint(k, sds.shape, -127, 128, dtype=jnp.int8)
+            s = jnp.full(sds.shape[:-2] + (sds.shape[-1],),
+                         1.0 / (127.0 * math.sqrt(d_in)), dtype)
+            return QTensor(q=q, s=s)
+        if name in norm_names:  # gains are ones, incl. [nl, d] stacked ones
+            return jnp.ones(sds.shape, sds.dtype)
+        if name in ("bq", "bk", "bv"):  # qkv biases init to zero
+            return jnp.zeros(sds.shape, sds.dtype)
+        if sds.ndim >= 2:  # embeddings / router / any remaining dense weight
+            fan = sds.shape[-2]
+            return (jax.random.normal(k, sds.shape, jnp.float32)
+                    / math.sqrt(fan)).astype(sds.dtype)
+        return jnp.ones(sds.shape, sds.dtype)
+
+    leaves = [build(path, sds, k) for (path, sds), k in zip(flat, leaf_keys)]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
 def drop_input_axis_spec(spec, ndim: int):

@@ -42,6 +42,13 @@ def param_pspecs(cfg: ModelConfig) -> Params:
         # [L, H*Dh, D] row-parallel
         "wo": P(AXIS_PP, AXIS_TP, None),
     }
+    if cfg.attn_qkv_bias:  # [L, H*Dh] — follows the column-parallel output dim
+        layers["bq"] = P(AXIS_PP, AXIS_TP)
+        layers["bk"] = P(AXIS_PP, AXIS_TP)
+        layers["bv"] = P(AXIS_PP, AXIS_TP)
+    if cfg.qk_norm:  # [L, Dh] per-head norm gains, replicated across heads
+        layers["q_norm"] = P(AXIS_PP, None)
+        layers["k_norm"] = P(AXIS_PP, None)
     if cfg.is_moe:
         layers["router"] = P(AXIS_PP, None, None)
         layers["w_gate"] = P(AXIS_PP, AXIS_EP, None, AXIS_TP)  # [L,E,D,F]

@@ -177,7 +177,7 @@ async def test_event_loop_free_during_dispatch():
         def release(self, state, slot):
             return state
 
-        def decode_steps(self, state, k):
+        def decode_steps_device(self, state, k):
             time.sleep(0.6)  # blocking device wait
             return np.zeros((k, self.max_slots), np.int32), state
 
@@ -193,6 +193,12 @@ async def test_event_loop_free_during_dispatch():
             max_gap = max(max_gap, now - last)
             last = now
         assert max_gap < 0.25, f"event loop stalled {max_gap:.2f}s"
+        # Guard against the decode path silently erroring out (a fake that
+        # doesn't match the runner protocol would make this test vacuous):
+        # the request must have actually received tokens.
+        assert not req.out.empty(), "no tokens emitted — decode never ran"
+        tok, reason = req.out.get_nowait()
+        assert reason == "" and isinstance(tok, int)
     finally:
         await sched.stop()
 

@@ -78,6 +78,41 @@ def test_mixtral_parity():
     _compare(cfg, transformers.MixtralForCausalLM(hf_cfg))
 
 
+def test_qwen2_parity():
+    cfg = get_config("tiny-test-qwen2")
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size, num_hidden_layers=cfg.num_layers,
+        num_attention_heads=cfg.num_heads, num_key_value_heads=cfg.num_kv_heads,
+        rms_norm_eps=cfg.rms_norm_eps, rope_theta=cfg.rope_theta,
+        max_position_embeddings=cfg.max_context_length, tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    model = transformers.Qwen2ForCausalLM(hf_cfg)
+    # HF zero-inits projection biases; randomize them so the bias path is
+    # actually load-bearing in the comparison.
+    with torch.no_grad():
+        for layer in model.model.layers:
+            for proj in (layer.self_attn.q_proj, layer.self_attn.k_proj,
+                         layer.self_attn.v_proj):
+                proj.bias.normal_(0.0, 0.5)
+    _compare(cfg, model)
+
+
+def test_qwen3_parity():
+    cfg = get_config("tiny-test-qwen3")
+    hf_cfg = transformers.Qwen3Config(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size, num_hidden_layers=cfg.num_layers,
+        num_attention_heads=cfg.num_heads, num_key_value_heads=cfg.num_kv_heads,
+        head_dim=cfg.resolved_head_dim(), rms_norm_eps=cfg.rms_norm_eps,
+        rope_theta=cfg.rope_theta, max_position_embeddings=cfg.max_context_length,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    _compare(cfg, transformers.Qwen3ForCausalLM(hf_cfg))
+
+
 def test_gemma2_parity():
     cfg = get_config("tiny-test-gemma")
     hf_cfg = transformers.Gemma2Config(

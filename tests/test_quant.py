@@ -12,6 +12,7 @@ from crowdllama_tpu.ops.quant import (
     dequant,
     quantize_params,
     quantize_weight,
+    random_quantized_params,
 )
 
 
@@ -100,6 +101,29 @@ async def test_quantized_shard_stage_keeps_int8():
     logits = await pipe.prefill("s", prompt, bucket=16)
     assert int(np.argmax(logits)) == want
     await pipe.release("s")
+
+
+def test_random_quantized_params_matches_quantize_params_structure():
+    """The leaf-by-leaf int8 initializer (used by bench.py so 8B models fit
+    a 16 GB chip) must be tree-identical to the quantize-after-init path."""
+    for name in ("tiny-test", "tiny-test-moe", "tiny-test-gemma",
+                 "tiny-test-qwen2", "tiny-test-qwen3"):
+        cfg = get_config(name, max_context_length=32)
+        ref = quantize_params(T.init_params(cfg, jax.random.PRNGKey(0)))
+        got = random_quantized_params(cfg, jax.random.PRNGKey(0))
+        assert (jax.tree_util.tree_structure(ref)
+                == jax.tree_util.tree_structure(got)), name
+        for (pa, a), (pb, b) in zip(
+                jax.tree_util.tree_leaves_with_path(ref),
+                jax.tree_util.tree_leaves_with_path(got)):
+            assert a.shape == b.shape and a.dtype == b.dtype, (name, pa)
+    # And the tree actually serves: finite logits from a real forward.
+    cfg = get_config("tiny-test", max_context_length=32)
+    p = random_quantized_params(cfg, jax.random.PRNGKey(1))
+    tokens = jnp.asarray([[1, 2, 3, 4]])
+    pos = jnp.arange(4)[None, :]
+    logits, _, _ = T.prefill(p, cfg, tokens, pos)
+    assert np.isfinite(np.asarray(logits, np.float32)).all()
 
 
 def test_quantized_runner_decodes():
