@@ -17,6 +17,7 @@ bandwidth-bound either way.  Overridables via env:
   CROWDLLAMA_BENCH_STEPS     timed decode steps (default 512)
   CROWDLLAMA_BENCH_CTX       max context        (default 1024)
   CROWDLLAMA_BENCH_QUANTIZE  "int8" | "none"    (default int8)
+  CROWDLLAMA_BENCH_KV        "bf16" | "int8"    KV cache dtype (default bf16)
 """
 
 from __future__ import annotations
@@ -42,6 +43,7 @@ def main() -> None:
     steps = int(os.environ.get("CROWDLLAMA_BENCH_STEPS", "512"))
     ctx = int(os.environ.get("CROWDLLAMA_BENCH_CTX", "1024"))
     quantize = os.environ.get("CROWDLLAMA_BENCH_QUANTIZE", "int8")
+    kv_dtype = os.environ.get("CROWDLLAMA_BENCH_KV", "bf16")
 
     cfg = get_config(model)
     if ctx < cfg.max_context_length:
@@ -50,7 +52,8 @@ def main() -> None:
 
     print(f"# bench: model={model} slots={slots} steps={steps} "
           f"ctx={cfg.max_context_length} devices={n_chips} "
-          f"quantize={quantize} platform={jax.devices()[0].platform}",
+          f"quantize={quantize} kv={kv_dtype} "
+          f"platform={jax.devices()[0].platform}",
           file=sys.stderr)
 
     t0 = time.monotonic()
@@ -63,7 +66,7 @@ def main() -> None:
         # from.  Throughput-identical to quantize_params(init_params(...)).
         params = random_quantized_params(cfg, jax.random.PRNGKey(0))
     runner = ModelRunner(cfg, params=params, max_slots=slots,
-                         max_seq=cfg.max_context_length)
+                         max_seq=cfg.max_context_length, kv_dtype=kv_dtype)
     state = runner.init_state()
 
     # Fill every slot with a short prompt so the decode batch is saturated.
@@ -87,7 +90,7 @@ def main() -> None:
     # chunk to what is a pure device-throughput metric.
     t0 = time.monotonic()
     done = 0
-    while done + chunk <= steps:  # equal chunks: one compiled program
+    while chunk > 0 and done + chunk <= steps:  # equal chunks: one program
         tokens, state = runner.decode_steps_device(state, chunk)
         done += chunk
     tokens = np.asarray(tokens)  # sync

@@ -104,6 +104,7 @@ class Configuration:
     kv_layout: str = "contiguous"
     kv_page_size: int = 128
     kv_pool_tokens: int = 0
+    kv_dtype: str = "bf16"  # "bf16" | "int8" quantized KV cache (contiguous)
     # Directory for jax.profiler traces; empty disables the profile surface
     # (SURVEY §5: "TPU build: JAX profiler traces + per-request timing").
     profile_dir: str = ""
@@ -154,6 +155,7 @@ class Configuration:
                                        cfg.kv_page_size))
         cfg.kv_pool_tokens = int(env.get("CROWDLLAMA_TPU_KV_POOL_TOKENS",
                                          cfg.kv_pool_tokens))
+        cfg.kv_dtype = env.get("CROWDLLAMA_TPU_KV_DTYPE", cfg.kv_dtype)
         cfg.profile_dir = env.get("CROWDLLAMA_TPU_PROFILE_DIR", cfg.profile_dir)
         if env.get("CROWDLLAMA_TPU_WARMUP"):
             cfg.warmup = env["CROWDLLAMA_TPU_WARMUP"] in ("1", "true")
@@ -173,6 +175,12 @@ class Configuration:
         if cfg.kv_pool_tokens < 0:
             raise ValueError(f"kv_pool_tokens must be >= 0, "
                              f"got {cfg.kv_pool_tokens}")
+        cfg.kv_dtype = (cfg.kv_dtype or "bf16").strip().lower()
+        if cfg.kv_dtype not in ("bf16", "int8"):
+            raise ValueError(f"unknown kv dtype {cfg.kv_dtype!r} "
+                             "(want 'bf16' or 'int8')")
+        if cfg.kv_dtype == "int8" and cfg.kv_layout == "paged":
+            raise ValueError("int8 KV cache is contiguous-layout only")
         return cfg
 
     @staticmethod
@@ -211,6 +219,10 @@ class Configuration:
         parser.add_argument("--kv-pool-tokens", dest="kv_pool_tokens",
                             type=int,
                             help="paged pool size in tokens (0 = no overcommit)")
+        parser.add_argument("--kv-dtype", dest="kv_dtype",
+                            choices=("bf16", "int8"),
+                            help="KV cache dtype (int8: quantized cache, "
+                                 "contiguous layout only)")
         parser.add_argument("--profile-dir", dest="profile_dir",
                             help="enable jax.profiler captures into this dir")
 
@@ -223,7 +235,7 @@ class Configuration:
                 "model", "model_path", "engine_backend", "mesh_shape",
                 "shard_group", "shard_index", "shard_count", "shard_strategy",
                 "quantize", "kv_layout", "kv_page_size", "kv_pool_tokens",
-                "profile_dir",
+                "kv_dtype", "profile_dir",
             )
         }
         bp = getattr(args, "bootstrap_peers", None)

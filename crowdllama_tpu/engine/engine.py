@@ -168,7 +168,7 @@ class JaxEngine(Engine):
                 return PagedModelRunner(
                     cfg, page_size=self.config.kv_page_size,
                     pool_tokens=self.config.kv_pool_tokens, **kwargs)
-            return ModelRunner(cfg, **kwargs)
+            return ModelRunner(cfg, kv_dtype=self.config.kv_dtype, **kwargs)
 
         self._runner = await loop.run_in_executor(None, _build)
         if self.config.warmup:

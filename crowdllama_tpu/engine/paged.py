@@ -84,6 +84,10 @@ class PagedModelRunner(ModelRunner):
 
             kwargs["mesh_spec"] = (
                 f"1x{largest_tp(len(jax.devices()), cfg.num_kv_heads)}")
+        if kwargs.get("kv_dtype", "bf16") != "bf16":
+            raise NotImplementedError(
+                "int8 KV cache is contiguous-layout only for now "
+                "(paged pages stay bf16)")
         super().__init__(cfg, *args, **kwargs)
         from crowdllama_tpu.parallel.mesh import AXIS_DP
 

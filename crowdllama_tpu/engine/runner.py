@@ -62,12 +62,17 @@ class DecodeState:
     temperature: jnp.ndarray  # [B] fp32
     top_p: jnp.ndarray     # [B] fp32
     key: jax.Array         # PRNG carry
+    # int8 KV cache only (kv_dtype="int8"): per-(position, kv-head) scales;
+    # None for the bf16 cache (None is an empty pytree — same treedef works
+    # for both layouts).
+    k_scale: jnp.ndarray | None = None  # [L, B, Hkv, S]
+    v_scale: jnp.ndarray | None = None
 
 
 jax.tree_util.register_dataclass(
     DecodeState,
     data_fields=["k_cache", "v_cache", "seq_lens", "tokens", "active",
-                 "temperature", "top_p", "key"],
+                 "temperature", "top_p", "key", "k_scale", "v_scale"],
     meta_fields=[],
 )
 
@@ -92,11 +97,15 @@ class ModelRunner:
         max_seq: int = 0,
         dtype=jnp.bfloat16,
         seed: int = 0,
+        kv_dtype: str = "bf16",  # "bf16" | "int8" (quantized KV cache)
     ):
         self.cfg = cfg
         self.max_slots = max_slots
         self.max_seq = max_seq or cfg.max_context_length
         self.dtype = dtype
+        if kv_dtype not in ("bf16", "int8"):
+            raise ValueError(f"kv_dtype must be 'bf16' or 'int8', got {kv_dtype!r}")
+        self.kv_dtype = kv_dtype
 
         if mesh is None:
             n = len(jax.devices())
@@ -128,6 +137,9 @@ class ModelRunner:
             assert self.sp == 1, "pp × sp composition not supported yet"
             assert cfg.num_layers % self.pp == 0, (
                 f"{cfg.num_layers} layers not divisible by pp={self.pp}")
+        if self.kv_dtype == "int8":
+            assert self.sp == 1 and self.pp == 1, (
+                "int8 KV cache does not compose with sp/pp meshes yet")
 
         if params is None:
             params = T.init_params(cfg, jax.random.PRNGKey(seed), dtype=dtype)
@@ -178,6 +190,16 @@ class ModelRunner:
     def _insert_impl(self, state: DecodeState, slot, ks, vs, plen, first_token,
                      temperature, top_p) -> DecodeState:
         """Write a prefilled sequence (ks/vs [L,1,Hkv,T,Dh]) into ``slot``."""
+        k_scale, v_scale = state.k_scale, state.v_scale
+        if self.kv_dtype == "int8":
+            from crowdllama_tpu.ops.quant import quantize_kv
+
+            ks, k_sc = quantize_kv(ks, scale_dtype=k_scale.dtype)
+            vs, v_sc = quantize_kv(vs, scale_dtype=v_scale.dtype)
+            k_scale = jax.lax.dynamic_update_slice(
+                k_scale, k_sc, (0, slot, 0, 0))
+            v_scale = jax.lax.dynamic_update_slice(
+                v_scale, v_sc, (0, slot, 0, 0))
         k_cache = jax.lax.dynamic_update_slice(
             state.k_cache, ks.astype(state.k_cache.dtype), (0, slot, 0, 0, 0))
         v_cache = jax.lax.dynamic_update_slice(
@@ -191,6 +213,7 @@ class ModelRunner:
             temperature=state.temperature.at[slot].set(temperature),
             top_p=state.top_p.at[slot].set(top_p),
             key=state.key,
+            k_scale=k_scale, v_scale=v_scale,
         )
 
     def _release_impl(self, state: DecodeState, slot) -> DecodeState:
@@ -200,6 +223,7 @@ class ModelRunner:
             tokens=state.tokens.at[slot].set(0),
             active=state.active.at[slot].set(False),
             temperature=state.temperature, top_p=state.top_p, key=state.key,
+            k_scale=state.k_scale, v_scale=state.v_scale,
         )
 
     def _decode_impl(self, params, state: DecodeState, num_steps: int):
@@ -215,10 +239,18 @@ class ModelRunner:
         def step(st: DecodeState, _):
             positions = jnp.minimum(st.seq_lens, self.max_seq - 1)
             lens = jnp.minimum(st.seq_lens + 1, self.max_seq)
+            k_scale = v_scale = None
             if self.pp > 1:
                 logits, k_cache, v_cache = pp_decode_step(
                     params, self.cfg, st.tokens, positions,
                     st.k_cache, st.v_cache, lens, self.mesh,
+                )
+            elif self.kv_dtype == "int8":
+                logits, k_cache, v_cache, k_scale, v_scale = T.decode_step(
+                    params, self.cfg, st.tokens, positions,
+                    st.k_cache, st.v_cache, lens,
+                    n_shards=self.mesh.size,
+                    k_scale=st.k_scale, v_scale=st.v_scale,
                 )
             else:
                 logits, k_cache, v_cache = T.decode_step(
@@ -236,6 +268,7 @@ class ModelRunner:
                 tokens=next_tokens,
                 active=st.active,
                 temperature=st.temperature, top_p=st.top_p, key=key,
+                k_scale=k_scale, v_scale=v_scale,
             )
             return new_state, next_tokens
 
@@ -248,12 +281,17 @@ class ModelRunner:
         l, b, s = self.cfg.num_layers, self.max_slots, self.max_seq
         hkv, dh = self.cfg.num_kv_heads, self.cfg.resolved_head_dim()
         shape = (l, b, hkv, s, dh)
+        quantized = self.kv_dtype == "int8"
+        cache_dtype = jnp.int8 if quantized else self.dtype
+        scale_sharding = NamedSharding(
+            self.mesh,
+            filter_spec(P(AXIS_PP, AXIS_DP, AXIS_TP, AXIS_SP), self.mesh))
         # Two distinct buffers: device_put of one array twice may alias, and
         # aliased k/v caches break donation in the jitted insert/decode.
         return DecodeState(
-            k_cache=jax.device_put(jnp.zeros(shape, self.dtype),
+            k_cache=jax.device_put(jnp.zeros(shape, cache_dtype),
                                    self._cache_sharding),
-            v_cache=jax.device_put(jnp.zeros(shape, self.dtype),
+            v_cache=jax.device_put(jnp.zeros(shape, cache_dtype),
                                    self._cache_sharding),
             seq_lens=jnp.zeros((b,), jnp.int32),
             tokens=jnp.zeros((b,), jnp.int32),
@@ -261,6 +299,10 @@ class ModelRunner:
             temperature=jnp.zeros((b,), jnp.float32),
             top_p=jnp.ones((b,), jnp.float32),
             key=jax.random.PRNGKey(seed),
+            k_scale=(jax.device_put(jnp.zeros(shape[:-1], jnp.bfloat16),
+                                    scale_sharding) if quantized else None),
+            v_scale=(jax.device_put(jnp.zeros(shape[:-1], jnp.bfloat16),
+                                    scale_sharding) if quantized else None),
         )
 
     def bucket_for(self, n: int) -> int:

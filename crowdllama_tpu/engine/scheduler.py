@@ -296,6 +296,10 @@ class Scheduler:
                 if info is not None and self.slots[i] is info:
                     self._emit(info.req, int(tokens[step, i]), info)
                     emitted += 1
+        if emitted == 0:
+            # Pure-overshoot chunk (dispatched before its slots' EOS was
+            # discovered): not a throughput sample, don't drag the EMA down.
+            return
         rate = emitted / dt
         self.throughput_ema = (
             rate if self.throughput_ema == 0.0

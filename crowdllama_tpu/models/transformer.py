@@ -20,8 +20,12 @@ import jax
 import jax.numpy as jnp
 
 from crowdllama_tpu.models.config import ModelConfig
-from crowdllama_tpu.ops.quant import dequant
-from crowdllama_tpu.ops.attention import decode_attention, prefill_attention
+from crowdllama_tpu.ops.quant import dequant, quantize_kv
+from crowdllama_tpu.ops.attention import (
+    decode_attention,
+    decode_attention_q,
+    prefill_attention,
+)
 from crowdllama_tpu.ops.norms import rms_norm
 from crowdllama_tpu.ops.ring import (
     ring_prefill_attention,
@@ -364,12 +368,23 @@ def scan_decode_layers(
     sp_mesh=None,
     dp_axis: str | None = "dp",
     n_shards: int = 1,
-) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Scan the decode-layer body over ``layers``; returns (x, kc, vc).
+    k_scale: jnp.ndarray | None = None,  # [#layers, B, Hkv, S] → int8 cache
+    v_scale: jnp.ndarray | None = None,
+):
+    """Scan the decode-layer body over ``layers``; returns (x, kc, vc) —
+    plus (k_scale, v_scale) when the cache is int8-quantized.
 
     Factored out of :func:`decode_step` for pipeline parallelism
     (parallel/pipeline.py runs it over a stage's local layers + cache slice).
+
+    With ``k_scale``/``v_scale`` the caches are int8 with per-(position,
+    kv-head) scales: new KV entries are quantized on write and attention
+    runs over the int8 cache (ops.attention.decode_attention_q), halving
+    the cache bytes streamed per step.  Incompatible with sp_mesh.
     """
+    quantized = k_scale is not None
+    if quantized:
+        assert sp_mesh is None, "int8 KV cache does not compose with sp yet"
     dh = cfg.resolved_head_dim()
     scale = attn_scale(cfg)
     cos, sin = rope_table(cfg.max_context_length, dh, cfg.rope_theta)
@@ -377,11 +392,30 @@ def scan_decode_layers(
     slot_idx = jnp.arange(b)
 
     def body(x, scanned):
-        lp, kc, vc, window = scanned  # kc/vc: [B, Hkv, S, Dh]
+        if quantized:
+            lp, kc, vc, ks, vs, window = scanned
+        else:
+            lp, kc, vc, window = scanned  # kc/vc: [B, Hkv, S, Dh]
+            ks = vs = None
         cache = {}
 
         def attn_fn(q, k, v):
-            if sp_mesh is not None:
+            if quantized:
+                kq, k_sc = quantize_kv(k)  # [B,Hkv,Dh] int8, [B,Hkv]
+                vq, v_sc = quantize_kv(v)
+                # Mixed basic/advanced indexing: the broadcast [B] index
+                # pair fronts the result, so kc[slots, :, positions] is
+                # [B,Hkv,Dh] (and ks[slots, :, positions] is [B,Hkv]).
+                kc2 = kc.at[slot_idx, :, positions].set(kq)
+                vc2 = vc.at[slot_idx, :, positions].set(vq)
+                ks2 = ks.at[slot_idx, :, positions].set(k_sc.astype(ks.dtype))
+                vs2 = vs.at[slot_idx, :, positions].set(v_sc.astype(vs.dtype))
+                attn = decode_attention_q(q, kc2, ks2, vc2, vs2, seq_lens,
+                                          scale,
+                                          softcap=cfg.attn_logit_softcap,
+                                          sliding_window=window)
+                cache["ks"], cache["vs"] = ks2, vs2
+            elif sp_mesh is not None:
                 kc2, vc2 = sp_cache_update(k, v, positions, kc, vc, sp_mesh,
                                            dp_axis=dp_axis)
                 attn = sp_decode_attention(q, kc2, vc2, seq_lens, scale,
@@ -390,8 +424,6 @@ def scan_decode_layers(
                                            sliding_window=window,
                                            dp_axis=dp_axis)
             else:
-                # Mixed basic/advanced indexing: the broadcast [B] index pair
-                # fronts the result, so kc[slots, :, positions] is [B,Hkv,Dh].
                 kc2 = kc.at[slot_idx, :, positions].set(k)
                 vc2 = vc.at[slot_idx, :, positions].set(v)
                 attn = decode_attention(q, kc2, vc2, seq_lens, scale,
@@ -402,8 +434,15 @@ def scan_decode_layers(
             return attn
 
         x = decode_layer_body(lp, cfg, x, positions, cos, sin, attn_fn)
+        if quantized:
+            return x, (cache["kc"], cache["vc"], cache["ks"], cache["vs"])
         return x, (cache["kc"], cache["vc"])
 
+    if quantized:
+        x, (k_cache, v_cache, k_scale, v_scale) = jax.lax.scan(
+            body, x, (layers, k_cache, v_cache, k_scale, v_scale, windows)
+        )
+        return x, k_cache, v_cache, k_scale, v_scale
     x, (k_cache, v_cache) = jax.lax.scan(
         body, x, (layers, k_cache, v_cache, windows)
     )
@@ -421,14 +460,25 @@ def decode_step(
     sp_mesh=None,            # Mesh → S-sharded cache + distributed decode
     dp_axis: str | None = "dp",
     n_shards: int = 1,       # total mesh devices (gates pallas dispatch)
-) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """One token per slot.  Returns (logits [B,V], k_cache, v_cache).
+    k_scale: jnp.ndarray | None = None,  # [L, B, Hkv, S] → int8 KV cache
+    v_scale: jnp.ndarray | None = None,
+):
+    """One token per slot.  Returns (logits [B,V], k_cache, v_cache), plus
+    (k_scale, v_scale) when the cache is int8 (scales passed in).
 
     With ``sp_mesh`` the KV cache's sequence dim is sharded over ``sp``: the
     new token's KV is written shard-locally and attention is flash-decoding
     merged with pmax/psum (ops/ring.py).
     """
     x = _embed(params, cfg, tokens)  # [B, D]
+    if k_scale is not None:
+        x, k_cache, v_cache, k_scale, v_scale = scan_decode_layers(
+            params["layers"], layer_sliding_windows(cfg), cfg, x, positions,
+            k_cache, v_cache, seq_lens, sp_mesh=sp_mesh, dp_axis=dp_axis,
+            n_shards=n_shards, k_scale=k_scale, v_scale=v_scale,
+        )
+        logits = _unembed(params, cfg, x)
+        return logits, k_cache, v_cache, k_scale, v_scale
     x, k_cache, v_cache = scan_decode_layers(
         params["layers"], layer_sliding_windows(cfg), cfg, x, positions,
         k_cache, v_cache, seq_lens, sp_mesh=sp_mesh, dp_axis=dp_axis,

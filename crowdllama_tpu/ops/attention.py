@@ -140,6 +140,55 @@ def decode_attention(
                                 sliding_window=sliding_window)
 
 
+def _decode_probs(logits: jnp.ndarray, seq_lens: jnp.ndarray, s: int,
+                  sliding_window) -> jnp.ndarray:
+    """Shared decode masking + softmax: logits [B,Hkv,G,S] → probs.
+
+    THE source of decode mask semantics (validity by seq_len, sliding
+    window relative to the newest position) — both the bf16 and int8 cache
+    paths call this, so a boundary fix cannot ship in one and miss the
+    other."""
+    kpos = jnp.arange(s)[None, :]  # [1,S]
+    valid = kpos < seq_lens[:, None]  # [B,S]
+    window = jnp.asarray(sliding_window)
+    valid &= (window <= 0) | (kpos > (seq_lens[:, None] - 1) - window)
+    logits = jnp.where(valid[:, None, None, :], logits, NEG_INF)
+    probs = jnp.exp(logits - jnp.max(logits, axis=-1, keepdims=True))
+    return probs / jnp.sum(probs, axis=-1, keepdims=True)
+
+
+def decode_attention_q(
+    q: jnp.ndarray,        # [B, H, Dh]
+    k_cache: jnp.ndarray,  # [B, Hkv, S, Dh] int8
+    k_scale: jnp.ndarray,  # [B, Hkv, S] per-position scales
+    v_cache: jnp.ndarray,  # [B, Hkv, S, Dh] int8
+    v_scale: jnp.ndarray,  # [B, Hkv, S]
+    seq_lens: jnp.ndarray,
+    scale: float,
+    softcap: float = 0.0,
+    sliding_window: int = 0,
+) -> jnp.ndarray:
+    """Decode attention over an int8 KV cache (per-position scales).
+
+    The cache reads — the bandwidth-bound bytes of decode — stay int8 all
+    the way into the dot's operand conversion; scales are applied on the
+    [B,Hkv,G,S] score plane (K) and folded into the probs (V), so no bf16
+    dequantized [B,Hkv,S,Dh] tensor ever materializes in HBM.  Semantics
+    (masking, softcap, sliding window) match decode_attention_ref.
+    """
+    num_kv = k_cache.shape[1]
+    b, h, d = q.shape
+    qg = q.reshape(b, num_kv, h // num_kv, d)  # [B,Hkv,G,Dh]
+    logits = jnp.einsum(
+        "bhgd,bhkd->bhgk", qg.astype(jnp.float32), k_cache.astype(jnp.float32)
+    ) * k_scale[:, :, None, :].astype(jnp.float32) * scale
+    logits = _softcap(logits, softcap)
+    probs = _decode_probs(logits, seq_lens, k_cache.shape[2], sliding_window)
+    pv = probs * v_scale[:, :, None, :].astype(jnp.float32)  # fold V scales
+    out = jnp.einsum("bhgk,bhkd->bhgd", pv, v_cache.astype(jnp.float32))
+    return out.reshape(b, h, d).astype(q.dtype)
+
+
 def decode_attention_ref(
     q: jnp.ndarray,
     k_cache: jnp.ndarray,
@@ -157,13 +206,6 @@ def decode_attention_ref(
         "bhgd,bhkd->bhgk", qg.astype(jnp.float32), k_cache.astype(jnp.float32)
     ) * scale
     logits = _softcap(logits, softcap)
-
-    kpos = jnp.arange(k_cache.shape[2])[None, :]  # [1,S]
-    valid = kpos < seq_lens[:, None]  # [B,S]
-    window = jnp.asarray(sliding_window)
-    valid &= (window <= 0) | (kpos > (seq_lens[:, None] - 1) - window)
-    logits = jnp.where(valid[:, None, None, :], logits, NEG_INF)
-    probs = jnp.exp(logits - jnp.max(logits, axis=-1, keepdims=True))
-    probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    probs = _decode_probs(logits, seq_lens, k_cache.shape[2], sliding_window)
     out = jnp.einsum("bhgk,bhkd->bhgd", probs, v_cache.astype(jnp.float32))
     return out.reshape(b, h, d).astype(q.dtype)

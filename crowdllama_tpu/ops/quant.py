@@ -90,6 +90,19 @@ def quantize_params(params: Params, extra_keys: tuple[str, ...] = ("lm_head",)) 
     return jax.jit(_quantize)(params)
 
 
+def quantize_kv(x: jnp.ndarray, scale_dtype=jnp.bfloat16):
+    """Per-vector symmetric int8 over the last axis (head_dim).
+
+    For KV-cache entries: each (position, kv-head) vector gets one scale, so
+    RoPE'd key magnitude drift across positions can't smear one position's
+    range onto another.  Returns (q int8 same shape, scales shape[:-1]).
+    """
+    a = jnp.asarray(x, jnp.float32)
+    s = jnp.max(jnp.abs(a), axis=-1, keepdims=True) / 127.0 + 1e-12
+    q = jnp.clip(jnp.round(a / s), -127, 127).astype(jnp.int8)
+    return q, s.squeeze(-1).astype(scale_dtype)
+
+
 def random_quantized_params(cfg, key: jax.Array, dtype=jnp.bfloat16) -> Params:
     """Random parameter pytree with the matmul weights *born* int8.
 

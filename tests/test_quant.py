@@ -126,6 +126,42 @@ def test_random_quantized_params_matches_quantize_params_structure():
     assert np.isfinite(np.asarray(logits, np.float32)).all()
 
 
+def test_int8_kv_cache_matches_bf16_cache():
+    """int8-KV decode must track the bf16-cache decode: same greedy tokens
+    over a multi-step rollout, per family (incl. Gemma softcap/sliding)."""
+    from crowdllama_tpu.engine.runner import ModelRunner
+
+    for name in ("tiny-test", "tiny-test-gemma"):
+        cfg = get_config(name, max_context_length=64)
+        params = T.init_params(cfg, jax.random.PRNGKey(3), dtype=jnp.float32)
+        runners = {
+            kv: ModelRunner(cfg, params=params, max_slots=2, max_seq=64,
+                            dtype=jnp.float32, kv_dtype=kv)
+            for kv in ("bf16", "int8")
+        }
+        toks = {}
+        for kv, r in runners.items():
+            state = r.init_state()
+            first, ks, vs, plen = r.prefill([5, 3, 8, 2], 0.0, 1.0,
+                                            jax.random.PRNGKey(0))
+            state = r.insert(state, 0, ks, vs, plen, first, 0.0, 1.0)
+            out, state = r.decode_steps(state, 12)
+            toks[kv] = [first] + [int(t) for t in out[:, 0]]
+        match = np.mean([a == b for a, b in zip(toks["bf16"], toks["int8"])])
+        assert match >= 0.9, f"{name}: int8-KV diverged ({toks})"
+
+
+def test_int8_kv_cache_state_shapes():
+    from crowdllama_tpu.engine.runner import ModelRunner
+
+    cfg = get_config("tiny-test", max_context_length=64)
+    r = ModelRunner(cfg, max_slots=2, max_seq=64, kv_dtype="int8")
+    state = r.init_state()
+    assert state.k_cache.dtype == jnp.int8
+    assert state.k_scale.shape == state.k_cache.shape[:-1]
+    assert state.k_scale.dtype == jnp.bfloat16
+
+
 def test_quantized_runner_decodes():
     from crowdllama_tpu.engine.runner import ModelRunner
 
