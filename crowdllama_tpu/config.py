@@ -105,6 +105,7 @@ class Configuration:
     kv_page_size: int = 128
     kv_pool_tokens: int = 0
     kv_dtype: str = "bf16"  # "bf16" | "int8" quantized KV cache (contiguous)
+    kv_prefix_cache: bool = True  # paged layout: share prompt-prefix pages
     # Directory for jax.profiler traces; empty disables the profile surface
     # (SURVEY §5: "TPU build: JAX profiler traces + per-request timing").
     profile_dir: str = ""
@@ -156,6 +157,9 @@ class Configuration:
         cfg.kv_pool_tokens = int(env.get("CROWDLLAMA_TPU_KV_POOL_TOKENS",
                                          cfg.kv_pool_tokens))
         cfg.kv_dtype = env.get("CROWDLLAMA_TPU_KV_DTYPE", cfg.kv_dtype)
+        if env.get("CROWDLLAMA_TPU_KV_PREFIX_CACHE"):
+            cfg.kv_prefix_cache = env["CROWDLLAMA_TPU_KV_PREFIX_CACHE"] in (
+                "1", "true")
         cfg.profile_dir = env.get("CROWDLLAMA_TPU_PROFILE_DIR", cfg.profile_dir)
         if env.get("CROWDLLAMA_TPU_WARMUP"):
             cfg.warmup = env["CROWDLLAMA_TPU_WARMUP"] in ("1", "true")

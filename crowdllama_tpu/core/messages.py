@@ -78,6 +78,42 @@ def extract_generate_response(msg: pb.BaseMessage) -> pb.GenerateResponse:
     return msg.generate_response
 
 
+def create_embed_request(model: str, inputs: Iterable[str],
+                         truncate: bool = True) -> pb.BaseMessage:
+    req = pb.EmbedRequest(model=model, truncate=truncate)
+    req.input.extend(inputs)
+    return pb.BaseMessage(embed_request=req)
+
+
+def create_embed_response(
+    model: str,
+    embeddings: Iterable[Iterable[float]],
+    worker_id: str = "",
+    total_duration_ns: int = 0,
+    prompt_tokens: int = 0,
+    error: str = "",
+) -> pb.BaseMessage:
+    resp = pb.EmbedResponse(
+        model=model, worker_id=worker_id, total_duration=total_duration_ns,
+        prompt_tokens=prompt_tokens, error=error,
+    )
+    for vec in embeddings:
+        resp.embeddings.append(pb.Embedding(values=list(vec)))
+    return pb.BaseMessage(embed_response=resp)
+
+
+def extract_embed_request(msg: pb.BaseMessage) -> pb.EmbedRequest:
+    if msg.WhichOneof("message") != "embed_request":
+        raise ValueError("message does not contain an EmbedRequest")
+    return msg.embed_request
+
+
+def extract_embed_response(msg: pb.BaseMessage) -> pb.EmbedResponse:
+    if msg.WhichOneof("message") != "embed_response":
+        raise ValueError("message does not contain an EmbedResponse")
+    return msg.embed_response
+
+
 def flatten_chat(messages: Iterable[Mapping[str, str]]) -> str:
     """Flatten Ollama-style chat messages into a single prompt string.
 

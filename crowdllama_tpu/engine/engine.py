@@ -19,7 +19,9 @@ from typing import AsyncIterator
 from crowdllama_tpu.config import Configuration
 from crowdllama_tpu.core import pb
 from crowdllama_tpu.core.messages import (
+    create_embed_response,
     create_generate_response,
+    extract_embed_request,
     extract_generate_request,
     flatten_chat,
 )
@@ -62,10 +64,28 @@ class Engine:
     ) -> AsyncIterator[Chunk]:
         raise NotImplementedError
 
+    async def embed(self, texts: list[str], model: str = "",
+                    truncate: bool = True) -> tuple[list[list[float]], int]:
+        """Embed texts → (one vector per text, total prompt tokens).
+
+        ``truncate=False`` must raise instead of silently clipping an input
+        that exceeds the context window (Ollama semantics)."""
+        raise NotImplementedError
+
     # ---- the UnifiedAPIHandler seam (api.go:19) --------------------------
 
     async def handle(self, msg: pb.BaseMessage, worker_id: str = "") -> pb.BaseMessage:
         """Blocking BaseMessage → BaseMessage (reference semantics)."""
+        if msg.WhichOneof("message") == "embed_request":
+            ereq = extract_embed_request(msg)
+            t0 = time.monotonic_ns()
+            vectors, n_tokens = await self.embed(
+                list(ereq.input), model=ereq.model, truncate=ereq.truncate)
+            return create_embed_response(
+                model=ereq.model, embeddings=vectors, worker_id=worker_id,
+                total_duration_ns=time.monotonic_ns() - t0,
+                prompt_tokens=n_tokens,
+            )
         req = extract_generate_request(msg)
         t0 = time.monotonic_ns()
         text_parts: list[str] = []
@@ -167,7 +187,8 @@ class JaxEngine(Engine):
 
                 return PagedModelRunner(
                     cfg, page_size=self.config.kv_page_size,
-                    pool_tokens=self.config.kv_pool_tokens, **kwargs)
+                    pool_tokens=self.config.kv_pool_tokens,
+                    prefix_cache=self.config.kv_prefix_cache, **kwargs)
             return ModelRunner(cfg, kv_dtype=self.config.kv_dtype, **kwargs)
 
         self._runner = await loop.run_in_executor(None, _build)
@@ -205,6 +226,12 @@ class JaxEngine(Engine):
         if self.scheduler is not None:
             d["throughput"] = round(self.scheduler.throughput_ema, 2)
             d["load"] = round(self.scheduler.load, 3)
+        if self._runner is not None and hasattr(self._runner, "prefix_hits"):
+            d["prefix_cache"] = {
+                "hits": self._runner.prefix_hits,
+                "misses": self._runner.prefix_misses,
+                "tokens_reused": self._runner.prefix_tokens_reused,
+            }
         return d
 
     async def capture_profile(self, seconds: float = 3.0) -> str:
@@ -275,6 +302,35 @@ class JaxEngine(Engine):
             if text:
                 yield Chunk(text=text)
 
+    async def embed(self, texts: list[str], model: str = "",
+                    truncate: bool = True) -> tuple[list[list[float]], int]:
+        """Mean-pooled final-hidden-state embeddings (runner.embed_prompt).
+
+        Dispatches on the scheduler's single-flight executor thread so
+        embedding forwards serialize with decode chunks instead of racing
+        them (and never block the event loop)."""
+        if self.scheduler is None:
+            raise RuntimeError("engine not started")
+        if model and model not in self.models:
+            raise ValueError(f"model {model!r} not served (have {self.models})")
+        max_len = self._runner.max_seq - 1
+        loop = asyncio.get_running_loop()
+        out, n_tokens = [], 0
+        for text in texts:
+            ids = self.tokenizer.encode(text)
+            if len(ids) > max_len:
+                if not truncate:
+                    raise ValueError(
+                        f"input of {len(ids)} tokens exceeds context length "
+                        f"{max_len} and truncate=false")
+                ids = ids[:max_len]
+            ids = ids or [0]
+            n_tokens += len(ids)
+            vec = await loop.run_in_executor(
+                self.scheduler._exec, self._runner.embed_prompt, ids)
+            out.append([float(v) for v in vec])
+        return out, n_tokens
+
 
 class FakeEngine(Engine):
     """Echo engine for tests (the engine-seam mock, cf. MockOllamaServer)."""
@@ -305,3 +361,18 @@ class FakeEngine(Engine):
             yield Chunk(text=w + " ")
         yield Chunk(text=words[-1], done=True, done_reason="stop",
                     prompt_tokens=len(prompt.split()), completion_tokens=len(words))
+
+    async def embed(self, texts: list[str], model: str = "",
+                    truncate: bool = True) -> tuple[list[list[float]], int]:
+        """Deterministic unit vectors keyed by text hash (test double)."""
+        import hashlib
+        import math
+
+        self.calls += 1
+        out = []
+        for text in texts:
+            h = hashlib.sha256(text.encode()).digest()
+            vec = [b / 255.0 - 0.5 for b in h[:8]]
+            norm = math.sqrt(sum(v * v for v in vec)) or 1.0
+            out.append([v / norm for v in vec])
+        return out, sum(len(t.split()) for t in texts)

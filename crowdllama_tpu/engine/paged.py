@@ -75,7 +75,7 @@ class PagedModelRunner(ModelRunner):
     """ModelRunner with the paged KV layout (same serving surface)."""
 
     def __init__(self, cfg, *args, page_size: int = 128, pool_tokens: int = 0,
-                 **kwargs):
+                 prefix_cache: bool = True, **kwargs):
         # Default mesh: tp-only.  The auto-chooser spills spare devices to
         # dp, but the shared page pool cannot shard over dp (pages belong
         # to no fixed slot), so unrequested dp would just replicate it.
@@ -106,6 +106,21 @@ class PagedModelRunner(ModelRunner):
         self._host_seq = np.zeros((self.max_slots,), np.int64)
         self.page_table = np.zeros(
             (self.max_slots, self.max_pages_per_slot), np.int32)
+        # Prefix cache (vLLM-style automatic prefix caching): full prompt
+        # pages are content-addressed by a chain hash; a later prompt sharing
+        # the prefix reuses those pages as attention *context* and only the
+        # suffix is prefilled.  Pages are refcounted across slots; pages held
+        # only by the index are evicted LRU under pool pressure.
+        self.prefix_cache = prefix_cache
+        self._prefix_index: dict[bytes, int] = {}  # chain hash -> page id
+        self._page_key: dict[int, bytes] = {}      # reverse map
+        self._page_refs: dict[int, int] = {}       # live slot refs per page
+        self._index_lru: dict[bytes, int] = {}     # key -> last-use counter
+        self._lru_tick = 0
+        self._pending_match: tuple[list[bytes], list[int]] | None = None
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.prefix_tokens_reused = 0
 
         self._insert_paged = jax.jit(self._insert_paged_impl,
                                      donate_argnums=(0,))
@@ -113,19 +128,43 @@ class PagedModelRunner(ModelRunner):
                                      donate_argnums=(1,), static_argnums=(3,))
         self._release_paged = jax.jit(self._release_paged_impl,
                                       donate_argnums=(0,))
+        self._prefill_ctx = jax.jit(self._prefill_ctx_impl)
 
     # ------------------------------------------------------------ allocator
 
     def _alloc(self, n: int) -> list[int]:
         if len(self._free_pages) < n:
+            self._evict_cached(n - len(self._free_pages))
+        if len(self._free_pages) < n:
             raise PagesExhausted(
                 f"kv pool exhausted: need {n} pages, "
                 f"{len(self._free_pages)} free (pool={self.total_pages})")
-        pages = [self._free_pages.pop() for _ in range(n)]
-        return pages
+        return [self._free_pages.pop() for _ in range(n)]
+
+    def _evict_cached(self, n: int) -> None:
+        """Drop up to ``n`` LRU prefix-cache pages no live slot references."""
+        for key, _tick in sorted(self._index_lru.items(), key=lambda kv: kv[1]):
+            if n <= 0:
+                break
+            page = self._prefix_index[key]
+            if self._page_refs.get(page, 0) == 0:
+                self._deindex(key)
+                self._free_pages.append(page)
+                n -= 1
+
+    def _deindex(self, key: bytes) -> None:
+        page = self._prefix_index.pop(key)
+        self._page_key.pop(page, None)
+        self._index_lru.pop(key, None)
 
     def _free(self, slot: int) -> None:
-        self._free_pages.extend(self._slot_pages.pop(slot, []))
+        for page in self._slot_pages.pop(slot, []):
+            refs = self._page_refs.get(page, 1) - 1
+            self._page_refs[page] = refs
+            if refs <= 0 and page not in self._page_key:
+                # Unshared, unindexed: back to the free list.  Indexed pages
+                # stay allocated (prefix cache) until evicted under pressure.
+                self._free_pages.append(page)
         self._host_seq[slot] = 0
         self.page_table[slot] = 0
 
@@ -166,6 +205,122 @@ class PagedModelRunner(ModelRunner):
             active=state.active.at[slot].set(False),
             temperature=state.temperature, top_p=state.top_p, key=state.key,
         )
+
+    def _prefill_ctx_impl(self, params, tokens, slen, ctx_len, pool_k, pool_v,
+                          pages, temperature, top_p, key):
+        """Suffix prefill attending over cached prefix pages.
+
+        tokens [1, bucket] suffix; pages [max_pages_per_slot] pool pages
+        (dump-page padded — ``ctx_len`` masks the tail), so there is ONE
+        compile per suffix bucket instead of one per (bucket, #matched).
+        """
+        cfg = self.cfg
+        pg = self.page_size
+        l, hkv, dh = cfg.num_layers, cfg.num_kv_heads, cfg.resolved_head_dim()
+        t = tokens.shape[1]
+        c = pages.shape[0] * pg
+        # [L, n, Hkv, pg, Dh] -> [L, 1, Hkv, n*pg, Dh] virtual-contiguous ctx
+        ck = pool_k[:, pages].transpose(0, 2, 1, 3, 4).reshape(
+            l, 1, hkv, c, dh)
+        cv = pool_v[:, pages].transpose(0, 2, 1, 3, 4).reshape(
+            l, 1, hkv, c, dh)
+        ctx_valid = (jnp.arange(c) < ctx_len)[None, :]
+        positions = ctx_len + jnp.minimum(jnp.arange(t)[None, :], slen - 1)
+        kv_valid = (jnp.arange(t) < slen)[None, :]
+        logits, ks, vs = T.prefill(params, cfg, tokens, positions,
+                                   kv_valid=kv_valid,
+                                   ctx_k=ck, ctx_v=cv, ctx_valid=ctx_valid)
+        last = logits[0, slen - 1]
+        tok = sample_tokens(last[None, :], temperature[None], top_p[None],
+                            key)[0]
+        return tok, ks, vs
+
+    def _clear_pending(self) -> None:
+        """Release an unconsumed prefill match (its insert never happened)."""
+        if self._pending_match is not None:
+            _, shared = self._pending_match
+            for p in shared:
+                self._page_refs[p] = self._page_refs.get(p, 1) - 1
+            self._pending_match = None
+
+    def _chain_keys(self, prompt_ids: list[int], n: int) -> list[bytes]:
+        """Chain hashes of the first ``n`` full pages: key i commits to ALL
+        tokens in pages 0..i, so equal keys ⇒ equal full prefix."""
+        import hashlib
+
+        keys, h = [], hashlib.sha256()
+        pg = self.page_size
+        for i in range(n):
+            h.update(np.asarray(prompt_ids[i * pg:(i + 1) * pg],
+                                np.int32).tobytes())
+            keys.append(h.digest())
+        return keys
+
+    def prefill(self, prompt_ids: list[int], temperature: float, top_p: float,
+                key, state: PagedDecodeState | None = None):
+        """Bucketed prefill with automatic prefix caching.
+
+        With ``state`` (the scheduler passes its live decode state) the
+        prompt's full pages are looked up in the prefix index; on a hit only
+        the suffix is prefilled, attending over the cached pages as context.
+        The match is stashed for the paired :meth:`insert` (admissions are
+        serialized by the scheduler, so one pending match is enough).
+        """
+        self._clear_pending()
+        pg = self.page_size
+        plen = len(prompt_ids)
+        if not self.prefix_cache:
+            return super().prefill(prompt_ids, temperature, top_p, key)
+        # Index keys for every full prompt page; matching is capped one page
+        # earlier so at least one suffix token remains to produce logits.
+        keys = self._chain_keys(prompt_ids, plen // pg)
+        if state is None:
+            self._pending_match = (keys, [])
+            return super().prefill(prompt_ids, temperature, top_p, key)
+        matched: list[int] = []
+        for k in keys[:max(0, (plen - 1) // pg)]:
+            page = self._prefix_index.get(k)
+            if page is None:
+                break
+            matched.append(page)
+            self._lru_tick += 1
+            self._index_lru[k] = self._lru_tick
+        # Suffix buckets round up: shrink the match until shared pages +
+        # suffix-bucket pages fit the slot's page table.
+        while matched:
+            suffix_bucket = self.bucket_for(plen - len(matched) * pg)
+            if len(matched) + suffix_bucket // pg <= self.max_pages_per_slot:
+                break
+            matched.pop()
+        if not matched:
+            self.prefix_misses += 1
+            self._pending_match = (keys, [])
+            return super().prefill(prompt_ids, temperature, top_p, key)
+        self.prefix_hits += 1
+        # Pin the matched pages NOW: their refcount may be 0 (only the index
+        # holds them), and the paired insert's _alloc could otherwise evict
+        # and re-hand them out as fresh suffix pages — the suffix scatter
+        # would then overwrite the very prefix KV this slot attends over.
+        # The pin becomes the slot's reference at insert; _clear_pending
+        # releases it if the insert never happens.
+        for p in matched:
+            self._page_refs[p] = self._page_refs.get(p, 0) + 1
+        ctx_len = len(matched) * pg
+        self.prefix_tokens_reused += ctx_len
+        suffix = prompt_ids[ctx_len:]
+        bucket = self.bucket_for(len(suffix))
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :len(suffix)] = suffix
+        pages = np.full((self.max_pages_per_slot,), self.total_pages, np.int32)
+        pages[:len(matched)] = matched  # dump-page padded
+        tok, ks, vs = self._prefill_ctx(
+            self.params, jnp.asarray(tokens), jnp.int32(len(suffix)),
+            jnp.int32(ctx_len), state.pool_k, state.pool_v,
+            jnp.asarray(pages), jnp.float32(temperature),
+            jnp.float32(top_p), key,
+        )
+        self._pending_match = (keys, matched)
+        return int(tok), ks, vs, plen
 
     def _decode_paged_impl(self, params, state: PagedDecodeState,
                            page_table, num_steps: int):
@@ -251,6 +406,11 @@ class PagedModelRunner(ModelRunner):
         self._slot_pages = {}
         self._host_seq[:] = 0
         self.page_table[:] = 0
+        self._prefix_index.clear()
+        self._page_key.clear()
+        self._page_refs.clear()
+        self._index_lru.clear()
+        self._pending_match = None
         b = self.max_slots
         return PagedDecodeState(
             pool_k=jax.device_put(jnp.zeros(shape, self.dtype), pool_sharding),
@@ -265,19 +425,47 @@ class PagedModelRunner(ModelRunner):
 
     def insert(self, state: PagedDecodeState, slot: int, ks, vs, plen: int,
                first_token: int, temperature: float, top_p: float):
+        """Place a prefilled sequence: shared prefix pages (from the paired
+        prefill's match, refcounted) + freshly scattered suffix pages."""
         bucket = ks.shape[3]
-        if bucket % self.page_size != 0:
+        pg = self.page_size
+        if bucket % pg != 0:
             raise ValueError(
                 f"prefill bucket {bucket} not a multiple of page size "
-                f"{self.page_size} (align buckets to pages)")
+                f"{pg} (align buckets to pages)")
+        keys, shared = self._pending_match or ([], [])
+        self._pending_match = None
         self._free(slot)  # defensive: slot must not leak prior pages
-        pages = self._alloc(bucket // self.page_size)
+        try:
+            fresh = self._alloc(bucket // pg)
+        except PagesExhausted:
+            for p in shared:  # release the prefill-time pins
+                self._page_refs[p] = self._page_refs.get(p, 1) - 1
+            raise
+        pages = list(shared) + fresh
+        # Shared pages carry the pin taken at prefill-match time (it becomes
+        # this slot's reference); only fresh pages gain a new reference.
+        for p in fresh:
+            self._page_refs[p] = self._page_refs.get(p, 0) + 1
         self._slot_pages[slot] = pages
         self._host_seq[slot] = plen
         self.page_table[slot] = 0
         self.page_table[slot, :len(pages)] = pages
+        if self.prefix_cache:
+            # Index every fresh page fully covered by prompt tokens (decode
+            # writes start at plen, which lies beyond them — immutable).
+            ctx_len = len(shared) * pg
+            for i, page in enumerate(fresh):
+                ki = len(shared) + i
+                if ctx_len + (i + 1) * pg > plen or ki >= len(keys):
+                    break
+                if keys[ki] not in self._prefix_index:
+                    self._prefix_index[keys[ki]] = page
+                    self._page_key[page] = keys[ki]
+                    self._lru_tick += 1
+                    self._index_lru[keys[ki]] = self._lru_tick
         return self._insert_paged(
-            state, jnp.asarray(pages, jnp.int32), ks, vs, jnp.int32(slot),
+            state, jnp.asarray(fresh, jnp.int32), ks, vs, jnp.int32(slot),
             jnp.int32(plen), jnp.int32(first_token),
             jnp.float32(temperature), jnp.float32(top_p),
         )

@@ -312,8 +312,12 @@ class ModelRunner:
         raise ValueError(f"prompt length {n} exceeds max_seq {self.max_seq}")
 
     def prefill(self, prompt_ids: list[int], temperature: float, top_p: float,
-                key: jax.Array):
-        """Run bucketed prefill; returns (first_token, ks, vs, plen)."""
+                key: jax.Array, state: DecodeState | None = None):
+        """Run bucketed prefill; returns (first_token, ks, vs, plen).
+
+        ``state`` is accepted (and ignored) so the scheduler can pass its
+        live decode state uniformly; the paged runner uses it for prefix-
+        cache context gathers."""
         plen = len(prompt_ids)
         bucket = self.bucket_for(plen)
         tokens = np.zeros((1, bucket), np.int32)
@@ -323,6 +327,35 @@ class ModelRunner:
             jnp.float32(temperature), jnp.float32(top_p), key,
         )
         return int(tok), ks, vs, plen
+
+    def embed_prompt(self, prompt_ids: list[int]) -> np.ndarray:
+        """Mean-pooled, L2-normalized embedding of a prompt ([D] fp32).
+
+        Bucketed like :meth:`prefill` (bounded compile count); padding
+        positions are excluded from both attention and the pooling mask."""
+        if self.pp > 1 or self.sp > 1:
+            raise NotImplementedError(
+                "embeddings are not implemented on pp/sp meshes yet "
+                "(the plain layer scan assumes an unsharded layer stack)")
+        plen = len(prompt_ids)
+        bucket = self.bucket_for(plen)
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :plen] = prompt_ids
+        vec = self._embed_fwd(self.params, jnp.asarray(tokens), jnp.int32(plen))
+        return np.asarray(vec, np.float32)
+
+    @partial(jax.jit, static_argnums=0)
+    def _embed_fwd(self, params, tokens, plen):
+        t = tokens.shape[1]
+        positions = jnp.minimum(jnp.arange(t)[None, :], plen - 1)
+        kv_valid = (jnp.arange(t) < plen)[None, :]
+        h = T.hidden_states(params, self.cfg, tokens, positions,
+                            kv_valid=kv_valid,
+                            n_shards=self.mesh.size)  # [1, T, D]
+        mask = kv_valid[0, :, None].astype(jnp.float32)  # [T, 1]
+        pooled = jnp.sum(h[0].astype(jnp.float32) * mask, axis=0) / jnp.maximum(
+            jnp.sum(mask), 1.0)
+        return pooled / jnp.maximum(jnp.linalg.norm(pooled), 1e-9)
 
     def insert(self, state: DecodeState, slot: int, ks, vs, plen: int,
                first_token: int, temperature: float, top_p: float) -> DecodeState:

@@ -139,11 +139,14 @@ class Scheduler:
         return None
 
     async def _admit_one(self, req: GenRequest, slot: int) -> None:
+        import functools
+
         self._rng, sub = jax.random.split(self._rng)
         loop = asyncio.get_running_loop()
         first, ks, vs, plen = await loop.run_in_executor(
-            self._exec, self.runner.prefill,
-            req.prompt_ids, req.temperature, req.top_p, sub,
+            self._exec, functools.partial(
+                self.runner.prefill, req.prompt_ids, req.temperature,
+                req.top_p, sub, state=self.state),
         )
         self.state = self.runner.insert(
             self.state, slot, ks, vs, plen, first, req.temperature, req.top_p

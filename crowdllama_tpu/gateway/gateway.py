@@ -25,7 +25,12 @@ import time
 from aiohttp import web
 
 from crowdllama_tpu.core import wire
-from crowdllama_tpu.core.messages import create_generate_request, extract_generate_response
+from crowdllama_tpu.core.messages import (
+    create_embed_request,
+    create_generate_request,
+    extract_embed_response,
+    extract_generate_response,
+)
 from crowdllama_tpu.core.protocol import INFERENCE_PROTOCOL
 from crowdllama_tpu.peer.peer import Peer
 
@@ -59,6 +64,8 @@ class Gateway:
         self.app.router.add_get("/api/version", self.handle_version)
         self.app.router.add_post("/api/show", self.handle_show)
         self.app.router.add_get("/api/ps", self.handle_ps)
+        self.app.router.add_post("/api/embed", self.handle_embed)
+        self.app.router.add_post("/api/embeddings", self.handle_embeddings)
 
     # ----------------------------------------------------------- lifecycle
 
@@ -229,6 +236,90 @@ class Gateway:
                     entry["tokens_throughput"] += p.resource.tokens_throughput
         return web.json_response({"models": list(models.values())})
 
+    async def handle_embed(self, request: web.Request) -> web.Response:
+        """POST /api/embed — Ollama embeddings API: {model, input: str|[str]}
+        → {model, embeddings: [[...]]}.  The reference delegates this surface
+        to Ollama wholesale; here it routes over the swarm like chat."""
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"error": "invalid JSON"}, status=400)
+        model = body.get("model", "")
+        inputs = body.get("input", "")
+        if isinstance(inputs, str):
+            inputs = [inputs]
+        if not model or not isinstance(inputs, list) or not inputs \
+                or not all(isinstance(t, str) for t in inputs):
+            return web.json_response(
+                {"error": "model and input are required"}, status=400)
+        truncate = bool(body.get("truncate", True))
+        resp, status = await self._route_embed(model, inputs, truncate)
+        return web.json_response(resp, status=status)
+
+    async def handle_embeddings(self, request: web.Request) -> web.Response:
+        """POST /api/embeddings — legacy Ollama surface: {model, prompt}
+        → {embedding: [...]}."""
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"error": "invalid JSON"}, status=400)
+        model = body.get("model", "")
+        prompt = body.get("prompt", "")
+        if not model or not prompt or not isinstance(prompt, str):
+            return web.json_response(
+                {"error": "model and prompt (a string) are required"},
+                status=400)
+        resp, status = await self._route_embed(
+            model, [prompt], bool(body.get("truncate", True)))
+        if status == 200:
+            resp = {"embedding": resp["embeddings"][0]}
+        return web.json_response(resp, status=status)
+
+    async def _route_embed(self, model: str, inputs: list[str],
+                           truncate: bool = True) -> tuple[dict, int]:
+        msg = create_embed_request(model, inputs, truncate=truncate)
+        tried: set[str] = set()
+        last_err = "no workers available for model"
+        for _attempt in range(2):  # retry once on next-best worker
+            worker = self._find_worker(model, exclude=tried)
+            if worker is None:
+                break
+            tried.add(worker.peer_id)
+            try:
+                reply = await self._roundtrip(worker.peer_id, msg)
+                resp = extract_embed_response(reply)
+                if resp.error.startswith("invalid:"):
+                    # Deterministic client error (e.g. truncate=false input
+                    # over the context window): 400, no retry.
+                    return {"error": resp.error[len("invalid:"):].strip(),
+                            "model": model}, 400
+                if resp.error:
+                    raise RuntimeError(resp.error)
+                return {
+                    "model": model,
+                    "embeddings": [list(e.values) for e in resp.embeddings],
+                    "total_duration": resp.total_duration,
+                    "prompt_eval_count": resp.prompt_tokens,
+                    "worker_id": resp.worker_id,
+                }, 200
+            except Exception as e:
+                last_err = str(e)
+                log.warning("embed via %s failed: %s", worker.peer_id[:8], e)
+        return {"error": f"embeddings failed: {last_err}",
+                "model": model}, 503
+
+    async def _roundtrip(self, worker_id: str, msg, timeout: float = 600):
+        """One-shot request/reply over a fresh inference stream."""
+        contact = await self.peer.dht.find_peer(worker_id)
+        if contact is None:
+            raise LookupError(f"worker {worker_id[:8]} not resolvable")
+        s = await self.peer.host.new_stream(contact, INFERENCE_PROTOCOL)
+        try:
+            await wire.write_length_prefixed_pb(s.writer, msg)
+            return await wire.read_length_prefixed_pb(s.reader, timeout=timeout)
+        finally:
+            s.close()
+
     # -------------------------------------------------------------- routing
 
     def _find_worker(self, model: str, exclude: set[str] = frozenset()):
@@ -271,19 +362,19 @@ class Gateway:
                        chat: bool) -> web.StreamResponse:
         """Open an inference stream to the worker and relay the reply
         (gateway.go:243-298)."""
+        if not stream:
+            reply = await self._roundtrip(worker_id, msg)
+            resp = extract_generate_response(reply)
+            if resp.done_reason == "error":
+                raise RuntimeError(resp.response)
+            return web.json_response(self._ollama_json(resp, chat, final=True))
+
         contact = await self.peer.dht.find_peer(worker_id)
         if contact is None:
             raise LookupError(f"worker {worker_id[:8]} not resolvable")
         s = await self.peer.host.new_stream(contact, INFERENCE_PROTOCOL)
         try:
             await wire.write_length_prefixed_pb(s.writer, msg)
-            if not stream:
-                reply = await wire.read_length_prefixed_pb(s.reader, timeout=600)
-                resp = extract_generate_response(reply)
-                if resp.done_reason == "error":
-                    raise RuntimeError(resp.response)
-                return web.json_response(self._ollama_json(resp, chat, final=True))
-
             # NDJSON streaming: one line per chunk, like Ollama.  Read the
             # FIRST frame before sending headers, so a worker that dies
             # immediately is still retryable by _route.

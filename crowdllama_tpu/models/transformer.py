@@ -25,6 +25,7 @@ from crowdllama_tpu.ops.attention import (
     decode_attention,
     decode_attention_q,
     prefill_attention,
+    prefill_attention_ctx,
 )
 from crowdllama_tpu.ops.norms import rms_norm
 from crowdllama_tpu.ops.ring import (
@@ -225,12 +226,23 @@ def scan_prefill_layers(
     sp_mesh=None,
     sp_batch_axis: str | None = None,
     n_shards: int = 1,
+    ctx_k: jnp.ndarray | None = None,   # [L, B, Hkv, C, Dh] cached prefix KV
+    ctx_v: jnp.ndarray | None = None,
+    ctx_valid: jnp.ndarray | None = None,  # [B, C]
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Scan the decoder-layer body over ``layers``; returns (x, ks, vs).
 
     Factored out of :func:`prefill` so pipeline parallelism can run it over a
     stage's local slice of the layer stack (parallel/pipeline.py).
+
+    With ``ctx_k``/``ctx_v`` the batch is a *suffix* continuing a cached
+    prefix (prefix cache): queries attend jointly over the per-layer context
+    KV and the causal suffix (ops.attention.prefill_attention_ctx), and the
+    returned ks/vs cover the suffix only.  Incompatible with sp_mesh.
     """
+    has_ctx = ctx_k is not None
+    if has_ctx:
+        assert sp_mesh is None, "prefix-context prefill does not compose with sp"
     dh = cfg.resolved_head_dim()
     hkv = cfg.num_kv_heads
     scale = attn_scale(cfg)
@@ -238,7 +250,10 @@ def scan_prefill_layers(
     b, t = x.shape[0], x.shape[1]
 
     def body(x, scanned):
-        lp, window = scanned
+        if has_ctx:
+            lp, ck, cv, window = scanned
+        else:
+            lp, window = scanned
         h = rms_norm(x, lp["ln1"], cfg.rms_norm_eps, plus_one=cfg.family == "gemma2")
         q = jnp.einsum("btd,dk->btk", h, dequant(lp["wq"]))
         k = jnp.einsum("btd,dk->btk", h, dequant(lp["wk"]))
@@ -255,7 +270,12 @@ def scan_prefill_layers(
         k = apply_rope(k, positions, cos, sin)
         kh = k.transpose(0, 2, 1, 3)  # [B, Hkv, T, Dh] — cache layout
         vh = v.transpose(0, 2, 1, 3)
-        if sp_mesh is not None:
+        if has_ctx:
+            attn = prefill_attention_ctx(
+                q, kh, vh, positions, ck, cv, ctx_valid, scale,
+                softcap=cfg.attn_logit_softcap, sliding_window=window,
+                kv_valid=kv_valid)
+        elif sp_mesh is not None:
             attn = ring_prefill_attention(
                 q, k, v, positions, scale, sp_mesh,
                 softcap=cfg.attn_logit_softcap, sliding_window=window,
@@ -276,7 +296,10 @@ def scan_prefill_layers(
         x = x + mlp_out
         return x, (kh, vh)
 
-    x, (ks, vs) = jax.lax.scan(body, x, (layers, windows))
+    if has_ctx:
+        x, (ks, vs) = jax.lax.scan(body, x, (layers, ctx_k, ctx_v, windows))
+    else:
+        x, (ks, vs) = jax.lax.scan(body, x, (layers, windows))
     return x, ks, vs  # ks/vs: [L, B, Hkv, T, Dh]
 
 
@@ -289,6 +312,9 @@ def prefill(
     sp_mesh=None,            # Mesh → ring attention over its "sp" axis
     sp_batch_axis: str | None = None,  # mesh axis the batch dim is sharded on
     n_shards: int = 1,       # total mesh devices (gates pallas dispatch)
+    ctx_k: jnp.ndarray | None = None,   # [L, B, Hkv, C, Dh] cached prefix KV
+    ctx_v: jnp.ndarray | None = None,
+    ctx_valid: jnp.ndarray | None = None,  # [B, C]
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Full-prompt forward.  Returns (logits [B,T,V], k, v [L,B,Hkv,T,Dh]).
 
@@ -298,15 +324,44 @@ def prefill(
     With ``sp_mesh`` the sequence dim is sharded over the mesh's ``sp`` axis
     and attention runs as a ppermute ring (ops/ring.py) — the long-context
     path; T must be divisible by the sp axis size.
+
+    With ``ctx_k``/``ctx_v`` the tokens are a suffix continuing a cached
+    prefix (prefix cache); positions must be absolute (prefix length +
+    offset) and the returned logits/KV cover the suffix only.
     """
     x = _embed(params, cfg, tokens)
     x, ks, vs = scan_prefill_layers(
         params["layers"], layer_sliding_windows(cfg), cfg, x, positions,
         kv_valid=kv_valid, sp_mesh=sp_mesh, sp_batch_axis=sp_batch_axis,
+        ctx_k=ctx_k, ctx_v=ctx_v, ctx_valid=ctx_valid,
         n_shards=n_shards,
     )
     logits = _unembed(params, cfg, x)
     return logits, ks, vs
+
+
+def hidden_states(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,     # [B, T] int32, padded
+    positions: jnp.ndarray,  # [B, T]
+    kv_valid: jnp.ndarray | None = None,
+    n_shards: int = 1,       # total mesh devices (gates pallas dispatch)
+) -> jnp.ndarray:
+    """Final-norm hidden states [B, T, D] — the embeddings forward.
+
+    Same layer stack as :func:`prefill` but skips the unembed matmul (the
+    vocab projection is the single most expensive op at embedding batch
+    sizes and its output is unused for /api/embed).  ``n_shards`` must be
+    the mesh size at the call site — like prefill, the Pallas kernel cannot
+    run over GSPMD-sharded operands."""
+    x = _embed(params, cfg, tokens)
+    x, _, _ = scan_prefill_layers(
+        params["layers"], layer_sliding_windows(cfg), cfg, x, positions,
+        kv_valid=kv_valid, n_shards=n_shards,
+    )
+    return rms_norm(x, params["final_norm"], cfg.rms_norm_eps,
+                    plus_one=cfg.family == "gemma2")
 
 
 # ------------------------------------------------------------------- decode

@@ -104,6 +104,62 @@ def prefill_attention_ref(
     return out.reshape(b, t, hkv * g, d).astype(q.dtype)
 
 
+def prefill_attention_ctx(
+    q: jnp.ndarray,          # [B, T, H, Dh] suffix queries
+    k: jnp.ndarray,          # [B, Hkv, T, Dh] suffix keys (head-major)
+    v: jnp.ndarray,          # [B, Hkv, T, Dh]
+    positions: jnp.ndarray,  # [B, T] absolute positions of suffix tokens
+    ctx_k: jnp.ndarray,      # [B, Hkv, C, Dh] cached prefix keys
+    ctx_v: jnp.ndarray,      # [B, Hkv, C, Dh]
+    ctx_valid: jnp.ndarray,  # [B, C] bool — False beyond the prefix length
+    scale: float,
+    softcap: float = 0.0,
+    sliding_window: int = 0,
+    kv_valid: jnp.ndarray | None = None,  # [B, T] suffix padding mask
+) -> jnp.ndarray:
+    """Causal prefill attention with a cached-prefix context (prefix cache).
+
+    Suffix queries attend jointly over the prefix KV (absolute positions
+    0..C-1, all before every valid suffix position) and the causal suffix
+    self-attention; softmax is over the concatenated key axis, so logits
+    are identical to a from-scratch prefill of prefix+suffix.
+    """
+    num_kv = k.shape[1]
+    qg = _grouped(q, num_kv)  # [B,T,Hkv,G,Dh]
+    qf = qg.astype(jnp.float32)
+
+    # Context block: every context key precedes every suffix query.
+    lc = jnp.einsum("bqhgd,bhcd->bhgqc", qf,
+                    ctx_k.astype(jnp.float32)) * scale
+    lc = _softcap(lc, softcap)
+    cpos = jnp.arange(ctx_k.shape[2])[None, None, :]     # [1,1,C]
+    qpos = positions[:, :, None]                         # [B,T,1]
+    window = jnp.asarray(sliding_window)
+    cmask = ctx_valid[:, None, :] & (
+        (window <= 0) | (cpos > qpos - window))          # [B,T,C]
+    lc = jnp.where(cmask[:, None, None, :, :], lc, NEG_INF)
+
+    # Suffix self block: standard causal (+window, +padding).
+    ls = jnp.einsum("bqhgd,bhkd->bhgqk", qf, k.astype(jnp.float32)) * scale
+    ls = _softcap(ls, softcap)
+    kpos = positions[:, None, :]                         # [B,1,T]
+    smask = (kpos <= qpos) & ((window <= 0) | (kpos > qpos - window))
+    if kv_valid is not None:
+        smask &= kv_valid[:, None, :]
+    ls = jnp.where(smask[:, None, None, :, :], ls, NEG_INF)
+
+    logits = jnp.concatenate([lc, ls], axis=-1)          # [B,Hkv,G,T,C+T]
+    probs = jnp.exp(logits - jnp.max(logits, axis=-1, keepdims=True))
+    probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    c = ctx_k.shape[2]
+    out = jnp.einsum("bhgqc,bhcd->bqhgd", probs[..., :c],
+                     ctx_v.astype(jnp.float32))
+    out += jnp.einsum("bhgqk,bhkd->bqhgd", probs[..., c:],
+                      v.astype(jnp.float32))
+    b, t, hkv, g, d = out.shape
+    return out.reshape(b, t, hkv * g, d).astype(q.dtype)
+
+
 def decode_attention(
     q: jnp.ndarray,  # [B, H, Dh] (one new token per slot)
     k_cache: jnp.ndarray,  # [B, Hkv, S, Dh]

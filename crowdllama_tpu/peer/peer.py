@@ -256,8 +256,13 @@ class Peer:
             log.debug("inference stream read failed: %s", e)
             return
         try:
+            which = msg.WhichOneof("message")
+            if which == "embed_request":
+                reply = await self.engine.handle(msg, worker_id=self.peer_id)
+                await wire.write_length_prefixed_pb(stream.writer, reply)
+                return
             req = msg.generate_request
-            if msg.WhichOneof("message") != "generate_request":
+            if which != "generate_request":
                 raise ValueError("expected GenerateRequest")
             if req.stream:
                 async for frame in self.engine.handle_streaming(msg, worker_id=self.peer_id):
@@ -268,15 +273,28 @@ class Peer:
         except Exception as e:
             # Synthesize an error response (peer.go:233-243).
             log.warning("inference failed: %s", e)
-            from crowdllama_tpu.core.messages import create_generate_response
-
-            err = create_generate_response(
-                model=msg.generate_request.model if msg.generate_request else "",
-                response=f"error: {e}",
-                worker_id=self.peer_id,
-                done=True,
-                done_reason="error",
+            from crowdllama_tpu.core.messages import (
+                create_embed_response,
+                create_generate_response,
             )
+
+            if msg.WhichOneof("message") == "embed_request":
+                # "invalid:" marks deterministic client errors (bad input)
+                # so the gateway returns 400 without burning a retry on
+                # another worker that would fail identically.
+                prefix = "invalid: " if isinstance(e, ValueError) else ""
+                err = create_embed_response(
+                    model=msg.embed_request.model, embeddings=[],
+                    worker_id=self.peer_id, error=prefix + str(e),
+                )
+            else:
+                err = create_generate_response(
+                    model=msg.generate_request.model if msg.generate_request else "",
+                    response=f"error: {e}",
+                    worker_id=self.peer_id,
+                    done=True,
+                    done_reason="error",
+                )
             try:
                 await wire.write_length_prefixed_pb(stream.writer, err)
             except Exception:

@@ -1,0 +1,116 @@
+"""Embeddings surface: runner pooling numerics, engine seam, and the
+gateway /api/embed + /api/embeddings endpoints over a real loopback swarm.
+
+The reference exposes Ollama's embeddings API only by delegation; here it is
+a first-class path (hidden-state forward without the unembed matmul).
+"""
+
+import asyncio
+
+import aiohttp
+import jax
+import jax.numpy as jnp
+import numpy as np
+from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+
+from crowdllama_tpu.models import transformer as T
+from crowdllama_tpu.models.config import get_config
+
+
+def test_embed_prompt_matches_unpadded_pooling():
+    """Bucket padding must not leak into the pooled embedding."""
+    from crowdllama_tpu.engine.runner import ModelRunner
+
+    cfg = get_config("tiny-test", max_context_length=64)
+    r = ModelRunner(cfg, max_slots=2, max_seq=64, dtype=jnp.float32)
+    prompt = [7, 3, 11, 2, 9]  # len 5 → bucket 32 (27 padding positions)
+    got = r.embed_prompt(prompt)
+    assert got.shape == (cfg.hidden_size,)
+    np.testing.assert_allclose(np.linalg.norm(got), 1.0, atol=1e-5)
+    # Reference: exact-length forward, no padding anywhere.
+    tokens = jnp.asarray([prompt])
+    pos = jnp.arange(len(prompt))[None, :]
+    h = T.hidden_states(r.params, cfg, tokens, pos)
+    ref = np.asarray(h[0], np.float32).mean(axis=0)
+    ref = ref / np.linalg.norm(ref)
+    np.testing.assert_allclose(got, ref, atol=2e-3)
+    # Deterministic.
+    np.testing.assert_array_equal(got, r.embed_prompt(prompt))
+
+
+async def test_jax_engine_embed_seam():
+    from crowdllama_tpu.core import messages
+    from crowdllama_tpu.engine.engine import JaxEngine
+
+    eng = JaxEngine(model="tiny-test", max_slots=2)
+    await eng.start()
+    try:
+        vecs, n_tokens = await eng.embed(
+            ["hello world", "hello world", "different"])
+        assert len(vecs) == 3
+        assert n_tokens > 0
+        assert vecs[0] == vecs[1]  # deterministic
+        assert vecs[0] != vecs[2]
+        # truncate=False must reject an over-length input, not clip it.
+        too_long = "x" * (eng._runner.max_seq * 4)
+        try:
+            await eng.embed([too_long], truncate=False)
+            raise AssertionError("expected ValueError for truncate=false")
+        except ValueError:
+            pass
+        # Through the BaseMessage seam (what the peer stream handler calls).
+        msg = messages.create_embed_request("tiny-test", ["swarm"])
+        reply = await eng.handle(msg, worker_id="w1")
+        resp = messages.extract_embed_response(reply)
+        assert not resp.error
+        assert len(resp.embeddings) == 1
+        assert len(resp.embeddings[0].values) == get_config("tiny-test").hidden_size
+        assert resp.worker_id == "w1"
+        assert resp.total_duration > 0
+        assert resp.prompt_tokens > 0
+    finally:
+        await eng.stop()
+
+
+async def test_gateway_embed_endpoints():
+    """Full loopback swarm: /api/embed and /api/embeddings route to a worker
+    and return Ollama-shaped JSON (FakeEngine's deterministic vectors)."""
+    from tests.test_integration import _topology, _wait_for
+
+    worker, consumer, gateway, gw_port, teardown = await _topology()
+    try:
+        await _wait_for(
+            lambda: any(
+                p.peer_id == worker.peer_id
+                for p in consumer.peer_manager.get_healthy_peers()
+            ),
+            what="consumer discovering worker",
+        )
+        base = f"http://127.0.0.1:{gw_port}"
+        async with aiohttp.ClientSession() as http:
+            # /api/embed with a list input.
+            async with http.post(f"{base}/api/embed", json={
+                "model": "tiny-test", "input": ["a", "b", "a"],
+            }) as resp:
+                assert resp.status == 200, await resp.text()
+                body = await resp.json()
+            assert body["model"] == "tiny-test"
+            embs = body["embeddings"]
+            assert len(embs) == 3 and embs[0] == embs[2] != embs[1]
+
+            # Legacy /api/embeddings with a single prompt.
+            async with http.post(f"{base}/api/embeddings", json={
+                "model": "tiny-test", "prompt": "a",
+            }) as resp:
+                assert resp.status == 200, await resp.text()
+                legacy = await resp.json()
+            np.testing.assert_allclose(legacy["embedding"], embs[0], atol=1e-6)
+
+            # Unknown model → 503 with error JSON, not a hang.
+            async with http.post(f"{base}/api/embed", json={
+                "model": "nope", "input": "x",
+            }) as resp:
+                assert resp.status == 503
+                assert "error" in await resp.json()
+    finally:
+        await teardown()

@@ -167,7 +167,7 @@ async def test_event_loop_free_during_dispatch():
         def init_state(self):
             return {}
 
-        def prefill(self, ids, temp, top_p, key):
+        def prefill(self, ids, temp, top_p, key, state=None):
             time.sleep(0.4)  # blocking device wait
             return 5, None, None, len(ids)
 

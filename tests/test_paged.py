@@ -13,6 +13,15 @@ from crowdllama_tpu.engine.runner import ModelRunner
 from crowdllama_tpu.models.config import get_config
 
 
+def _assert_all_pages_accounted(runner):
+    """After every slot retires, each page is either free or held ONLY by
+    the prefix cache (indexed, refcount 0) — nothing leaks."""
+    cached = sum(1 for p in runner._page_key
+                 if runner._page_refs.get(p, 0) == 0)
+    assert len(runner._free_pages) + cached == runner.total_pages, (
+        len(runner._free_pages), cached, runner.total_pages)
+
+
 def _fill(pr, cr, prompts, key):
     ps, cs = pr.init_state(), cr.init_state()
     for slot, prompt in enumerate(prompts):
@@ -110,7 +119,7 @@ async def test_paged_overcommit_starves_one_slot_not_engine():
         r1, r2 = await asyncio.gather(run_one(400), run_one(400))
         assert r1 in ("stop", "length") and r2 in ("stop", "length")
         runner = engine.scheduler.runner
-        assert len(runner._free_pages) == runner.total_pages
+        _assert_all_pages_accounted(runner)
         # Engine still serves after the squeeze.
         r3 = await run_one(4)
         assert r3 in ("stop", "length")
@@ -145,6 +154,6 @@ async def test_paged_engine_end_to_end():
         assert len(outs) == 2
         # All pages returned after both requests retired.
         runner = engine.scheduler.runner
-        assert len(runner._free_pages) == runner.total_pages
+        _assert_all_pages_accounted(runner)
     finally:
         await engine.stop()

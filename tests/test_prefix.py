@@ -1,0 +1,149 @@
+"""Automatic prefix caching (engine/paged.py): shared-prefix prompts reuse
+cached KV pages as attention context; only the suffix is prefilled.
+
+The reference has no equivalent (Ollama-side concern); this is the vLLM-style
+TTFT optimization for chat workloads with shared system prompts.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from crowdllama_tpu.engine.paged import PagedModelRunner
+from crowdllama_tpu.models import transformer as T
+from crowdllama_tpu.models.config import get_config
+
+PG = 32
+
+
+def _runner(**kw):
+    cfg = get_config("tiny-test", max_context_length=256)
+    params = T.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    return PagedModelRunner(cfg, params=params, max_slots=4, max_seq=256,
+                            dtype=jnp.float32, page_size=PG, **kw)
+
+
+def _serve(runner, state, slot, prompt, steps=6):
+    """prefill → insert → decode; returns (tokens, state)."""
+    first, ks, vs, plen = runner.prefill(prompt, 0.0, 1.0,
+                                         jax.random.PRNGKey(1), state=state)
+    state = runner.insert(state, slot, ks, vs, plen, first, 0.0, 1.0)
+    out, state = runner.decode_steps(state, steps)
+    return [first] + [int(t) for t in out[:, slot]], state
+
+
+def test_prefix_hit_reuses_pages_and_matches_cold_tokens():
+    rng = np.random.default_rng(0)
+    prefix = rng.integers(1, 500, 2 * PG).tolist()  # two full shareable pages
+    a = prefix + rng.integers(1, 500, 10).tolist()
+    b = prefix + rng.integers(1, 500, 7).tolist()   # same prefix, new tail
+
+    # Cold reference: a fresh runner (no cache) serving b directly.
+    cold = _runner(prefix_cache=False)
+    cold_state = cold.init_state()
+    cold_tokens, _ = _serve(cold, cold_state, 0, b)
+
+    warm = _runner()
+    state = warm.init_state()
+    tokens_a, state = _serve(warm, state, 0, a)
+    assert warm.prefix_hits == 0 and warm.prefix_misses == 1
+
+    free_before = len(warm._free_pages)
+    tokens_b, state = _serve(warm, state, 1, b)
+    assert warm.prefix_hits == 1
+    assert warm.prefix_tokens_reused == 2 * PG
+    # The shared pages were not re-allocated: b consumed only suffix pages.
+    consumed = free_before - len(warm._free_pages)
+    assert consumed == warm.bucket_for(len(b) - 2 * PG) // PG
+    # Greedy tokens must equal the cold (uncached) serve exactly.
+    assert tokens_b == cold_tokens, (tokens_b, cold_tokens)
+
+
+def test_prefix_pages_survive_release_and_refcount():
+    rng = np.random.default_rng(1)
+    prefix = rng.integers(1, 500, PG).tolist()
+    a = prefix + rng.integers(1, 500, 5).tolist()
+
+    warm = _runner()
+    state = warm.init_state()
+    _, state = _serve(warm, state, 0, a)
+    state = warm.release(state, 0)
+    # The indexed prefix page stays cached after release (refcount 0 but
+    # indexed), so a new request still hits.
+    _, state = _serve(warm, state, 1, prefix + rng.integers(1, 500, 4).tolist())
+    assert warm.prefix_hits == 1
+
+
+def test_divergent_prompts_share_only_common_prefix():
+    rng = np.random.default_rng(2)
+    common = rng.integers(1, 500, PG).tolist()
+    a = common + rng.integers(1, 500, PG + 5).tolist()
+    b = common + rng.integers(1, 500, PG + 5).tolist()  # diverges after page 1
+
+    warm = _runner()
+    state = warm.init_state()
+    _, state = _serve(warm, state, 0, a)
+    _, state = _serve(warm, state, 1, b)
+    assert warm.prefix_hits == 1
+    assert warm.prefix_tokens_reused == PG  # only the common page
+
+
+def test_cache_eviction_under_pool_pressure():
+    """A small overcommitted pool evicts LRU cached pages instead of failing."""
+    rng = np.random.default_rng(3)
+    runner = _runner(pool_tokens=8 * PG)  # 8 pages total
+    state = runner.init_state()
+    # Fill the cache with two distinct 1-page prefixes, releasing each slot.
+    for i in range(2):
+        p = rng.integers(1, 500, PG).tolist()
+        _, state = _serve(runner, state, 0, p + [1, 2, 3], steps=2)
+        state = runner.release(state, 0)
+    assert len(runner._prefix_index) >= 2
+    # Now demand most of the pool at once: eviction must free cached pages.
+    big = rng.integers(1, 500, 5 * PG + 3).tolist()
+    toks, state = _serve(runner, state, 0, big, steps=2)
+    assert len(toks) == 3
+
+
+def test_eviction_never_steals_matched_pages():
+    """Pool pressure during a prefix-hit insert must evict OTHER cached
+    pages, never the just-matched (pinned) ones — the suffix scatter would
+    overwrite the prefix KV the slot attends over."""
+    rng = np.random.default_rng(5)
+    runner = _runner(pool_tokens=8 * PG)  # 8-page pool
+    state = runner.init_state()
+    prefixes = [rng.integers(1, 500, PG).tolist() for _ in range(3)]
+    for p in prefixes:  # cache three 1-page prefixes (refcount 0 after)
+        _, state = _serve(runner, state, 0, p + [1, 2], steps=1)
+        state = runner.release(state, 0)
+    assert len(runner._prefix_index) == 3
+    # A live slot holds 2 pages; 3 free remain.
+    long_live = rng.integers(1, 500, 60).tolist()
+    _, state = _serve(runner, state, 0, long_live, steps=1)
+
+    # Hit on prefix[0]; the 96-token suffix needs 4 fresh pages with only 3
+    # free → one cached page must be evicted, and it must NOT be the match.
+    b = prefixes[0] + rng.integers(1, 500, 96).tolist()
+    cold = _runner(prefix_cache=False)
+    cold_tokens, _ = _serve(cold, cold.init_state(), 0, b)
+
+    tokens, state = _serve(runner, state, 1, b)
+    assert runner.prefix_hits == 1
+    assert tokens == cold_tokens, (tokens, cold_tokens)
+    # The matched prefix page survived the eviction pass...
+    assert runner._chain_keys(prefixes[0], 1)[0] in runner._prefix_index
+    # ...and at least one of the other cached prefixes was evicted to make
+    # room (3 free + 3 cached, 4 fresh needed).
+    surviving = sum(runner._chain_keys(p, 1)[0] in runner._prefix_index
+                    for p in prefixes[1:])
+    assert surviving < 2
+
+
+def test_prefix_cache_state_resets():
+    runner = _runner()
+    state = runner.init_state()
+    rng = np.random.default_rng(4)
+    _, state = _serve(runner, state, 0, rng.integers(1, 500, PG + 4).tolist())
+    assert runner._prefix_index
+    runner.init_state()
+    assert not runner._prefix_index and not runner._page_refs
