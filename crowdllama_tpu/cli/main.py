@@ -50,6 +50,15 @@ def build_parser() -> argparse.ArgumentParser:
     sub.add_parser("version", help="print version")
     status = sub.add_parser("network-status", help="probe a gateway's health endpoint")
     status.add_argument("--gateway", default="http://127.0.0.1:9001")
+    run = sub.add_parser(
+        "run", help="chat with a model through a gateway (ollama-run style)")
+    run.add_argument("model", help="model name (see /api/tags)")
+    run.add_argument("prompt", nargs="?", default="",
+                     help="one-shot prompt; omit for an interactive REPL")
+    run.add_argument("--gateway", default="http://127.0.0.1:9001")
+    run.add_argument("--temperature", type=float, default=0.7)
+    run.add_argument("--top-p", type=float, default=0.95)
+    run.add_argument("--max-tokens", type=int, default=0)
     return p
 
 
@@ -60,6 +69,12 @@ def main(argv: list[str] | None = None) -> int:
         return 0
     if args.command == "network-status":
         return asyncio.run(_network_status(args.gateway))
+    if args.command == "run":
+        try:
+            return asyncio.run(_run_chat(args))
+        except KeyboardInterrupt:
+            print(file=sys.stderr)
+            return 0
     if args.command == "start":
         cfg = Configuration.from_flags(args)
         new_app_logger("crowdllama", cfg.verbose)
@@ -95,6 +110,107 @@ async def _network_status(gateway: str) -> int:
         print(f"  {pid[:12]} [{mark}] models={','.join(w.get('supported_models', []))} "
               f"tput={w.get('tokens_throughput', 0)} accel={w.get('accelerator', '?')}")
     return 0
+
+
+async def _run_chat(args) -> int:
+    """``run <model>`` — the ollama-run-style chat client.
+
+    The reference gets this surface by embedding the Ollama CLI
+    (main.go:49-78); here it is a thin NDJSON client of the gateway's
+    /api/chat, streaming tokens as they arrive.  One-shot with a prompt
+    argument, REPL without."""
+    import json
+
+    import aiohttp
+
+    history: list[dict] = []
+    options = {"temperature": args.temperature, "top_p": args.top_p}
+    if args.max_tokens:
+        options["num_predict"] = args.max_tokens
+
+    async def turn(http: aiohttp.ClientSession, content: str) -> bool:
+        history.append({"role": "user", "content": content})
+        try:
+            async with http.post(
+                f"{args.gateway}/api/chat",
+                json={"model": args.model, "messages": history,
+                      "stream": True, "options": options},
+                timeout=aiohttp.ClientTimeout(total=600),
+            ) as resp:
+                if resp.status != 200:
+                    body = await resp.text()
+                    print(f"error: {body.strip()}", file=sys.stderr)
+                    history.pop()
+                    return False
+                parts = []
+                async for line in resp.content:
+                    if not line.strip():
+                        continue
+                    frame = json.loads(line)
+                    if frame.get("done_reason") == "error":
+                        print(f"\nerror: {frame.get('error', 'worker failed')}",
+                              file=sys.stderr)
+                        history.pop()
+                        return False
+                    text = frame.get("message", {}).get("content", "")
+                    if text:
+                        parts.append(text)
+                        print(text, end="", flush=True)
+                    if frame.get("done"):
+                        break
+                print()
+                history.append({"role": "assistant",
+                                "content": "".join(parts)})
+                return True
+        except (aiohttp.ClientError, asyncio.TimeoutError,
+                json.JSONDecodeError) as e:
+            print(f"gateway error: {e or type(e).__name__}", file=sys.stderr)
+            history.pop()
+            return False
+
+    async with aiohttp.ClientSession() as http:
+        if args.prompt:
+            return 0 if await turn(http, args.prompt) else 1
+        print(f"chatting with {args.model} via {args.gateway} "
+              "(/bye or Ctrl-D to exit)", file=sys.stderr)
+        # Read stdin on a dedicated DAEMON thread, one line per turn (the
+        # event gates it so ">>> " never interleaves with streamed tokens).
+        # The default executor would hang Ctrl-C: asyncio.run joins its
+        # threads on shutdown, and one would still be blocked in input().
+        import threading
+
+        loop = asyncio.get_running_loop()
+        lines: asyncio.Queue[str | None] = asyncio.Queue()
+        ready = threading.Event()
+
+        def reader() -> None:
+            while True:
+                ready.wait()
+                ready.clear()
+                try:
+                    line = input(">>> ")
+                except (EOFError, KeyboardInterrupt):
+                    loop.call_soon_threadsafe(lines.put_nowait, None)
+                    return
+                loop.call_soon_threadsafe(lines.put_nowait, line)
+
+        threading.Thread(target=reader, daemon=True).start()
+        while True:
+            ready.set()
+            try:
+                line = await lines.get()
+            except (KeyboardInterrupt, asyncio.CancelledError):
+                print(file=sys.stderr)
+                return 0
+            if line is None:
+                print(file=sys.stderr)
+                return 0
+            line = line.strip()
+            if line in ("/bye", "/exit", "/quit"):
+                return 0
+            if not line:
+                continue
+            await turn(http, line)
 
 
 def _make_engine(cfg: Configuration, worker_mode: bool):

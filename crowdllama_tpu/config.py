@@ -106,6 +106,8 @@ class Configuration:
     kv_pool_tokens: int = 0
     kv_dtype: str = "bf16"  # "bf16" | "int8" quantized KV cache (contiguous)
     kv_prefix_cache: bool = True  # paged layout: share prompt-prefix pages
+    spec_decode: str = ""  # "" | "ngram" speculative decode (engine/spec.py)
+    spec_draft: int = 4  # draft tokens per verify step
     # Directory for jax.profiler traces; empty disables the profile surface
     # (SURVEY §5: "TPU build: JAX profiler traces + per-request timing").
     profile_dir: str = ""
@@ -160,6 +162,10 @@ class Configuration:
         if env.get("CROWDLLAMA_TPU_KV_PREFIX_CACHE"):
             cfg.kv_prefix_cache = env["CROWDLLAMA_TPU_KV_PREFIX_CACHE"] in (
                 "1", "true")
+        cfg.spec_decode = env.get("CROWDLLAMA_TPU_SPEC_DECODE",
+                                  cfg.spec_decode)
+        cfg.spec_draft = int(env.get("CROWDLLAMA_TPU_SPEC_DRAFT",
+                                     cfg.spec_draft))
         cfg.profile_dir = env.get("CROWDLLAMA_TPU_PROFILE_DIR", cfg.profile_dir)
         if env.get("CROWDLLAMA_TPU_WARMUP"):
             cfg.warmup = env["CROWDLLAMA_TPU_WARMUP"] in ("1", "true")
@@ -185,6 +191,16 @@ class Configuration:
                              "(want 'bf16' or 'int8')")
         if cfg.kv_dtype == "int8" and cfg.kv_layout == "paged":
             raise ValueError("int8 KV cache is contiguous-layout only")
+        cfg.spec_decode = (cfg.spec_decode or "").strip().lower()
+        if cfg.spec_decode not in ("", "ngram"):
+            raise ValueError(f"unknown spec_decode {cfg.spec_decode!r} "
+                             "(want '' or 'ngram')")
+        if cfg.spec_decode:
+            if cfg.kv_layout != "contiguous" or cfg.kv_dtype != "bf16":
+                raise ValueError("spec_decode requires the contiguous bf16 "
+                                 "KV cache")
+            if cfg.spec_draft < 1:
+                raise ValueError("spec_draft must be >= 1")
         return cfg
 
     @staticmethod
@@ -227,6 +243,11 @@ class Configuration:
                             choices=("bf16", "int8"),
                             help="KV cache dtype (int8: quantized cache, "
                                  "contiguous layout only)")
+        parser.add_argument("--spec-decode", dest="spec_decode",
+                            choices=("", "ngram"),
+                            help="speculative decoding (ngram prompt lookup)")
+        parser.add_argument("--spec-draft", dest="spec_draft", type=int,
+                            help="draft tokens per speculative verify step")
         parser.add_argument("--profile-dir", dest="profile_dir",
                             help="enable jax.profiler captures into this dir")
 
@@ -239,7 +260,7 @@ class Configuration:
                 "model", "model_path", "engine_backend", "mesh_shape",
                 "shard_group", "shard_index", "shard_count", "shard_strategy",
                 "quantize", "kv_layout", "kv_page_size", "kv_pool_tokens",
-                "kv_dtype", "profile_dir",
+                "kv_dtype", "spec_decode", "spec_draft", "profile_dir",
             )
         }
         bp = getattr(args, "bootstrap_peers", None)

@@ -189,6 +189,11 @@ class JaxEngine(Engine):
                     cfg, page_size=self.config.kv_page_size,
                     pool_tokens=self.config.kv_pool_tokens,
                     prefix_cache=self.config.kv_prefix_cache, **kwargs)
+            if self.config.spec_decode == "ngram":
+                from crowdllama_tpu.engine.spec import SpecModelRunner
+
+                return SpecModelRunner(
+                    cfg, draft_len=self.config.spec_draft, **kwargs)
             return ModelRunner(cfg, kv_dtype=self.config.kv_dtype, **kwargs)
 
         self._runner = await loop.run_in_executor(None, _build)
@@ -205,9 +210,13 @@ class JaxEngine(Engine):
 
     def _warmup(self) -> None:
         """Compile the hot paths before serving (smallest prefill bucket,
-        decode chunks of 1 and decode_chunk) so the first request doesn't pay
-        30-40 s of XLA compilation in its TTFT."""
+        decode chunks of 1 and decode_chunk, the smallest-bucket ctx-prefill
+        when the prefix cache is on, the embeddings forward) so the first
+        request of each kind doesn't pay 30-40 s of XLA compilation in its
+        latency."""
         import jax
+        import jax.numpy as jnp
+        import numpy as np
 
         r = self._runner
         state = r.init_state()
@@ -215,6 +224,20 @@ class JaxEngine(Engine):
         state = r.insert(state, 0, ks, vs, plen, tok, 0.0, 1.0)
         for k in {1, self.config.decode_chunk}:
             _, state = r.decode_steps(state, k)
+        if getattr(r, "prefix_cache", False):
+            # ctx_len=0 compiles the same program a real hit uses (the
+            # context tensor shape is fixed; ctx_len only masks) for the
+            # smallest suffix bucket.
+            pages = np.full((r.max_pages_per_slot,), r.total_pages, np.int32)
+            r._prefill_ctx(r.params, jnp.zeros((1, r.buckets[0]), jnp.int32),
+                           jnp.int32(1), jnp.int32(0), state.pool_k,
+                           state.pool_v, jnp.asarray(pages), jnp.float32(0.0),
+                           jnp.float32(1.0), jax.random.PRNGKey(0))
+        try:
+            r.embed_prompts([[1, 2, 3]])
+        except NotImplementedError:  # pp/sp meshes have no embeddings path
+            pass
+        state = r.release(state, 0)
         log.info("warmup compile done")
 
     async def stop(self) -> None:
@@ -315,7 +338,7 @@ class JaxEngine(Engine):
             raise ValueError(f"model {model!r} not served (have {self.models})")
         max_len = self._runner.max_seq - 1
         loop = asyncio.get_running_loop()
-        out, n_tokens = [], 0
+        prompts, n_tokens = [], 0
         for text in texts:
             ids = self.tokenizer.encode(text)
             if len(ids) > max_len:
@@ -326,9 +349,18 @@ class JaxEngine(Engine):
                 ids = ids[:max_len]
             ids = ids or [0]
             n_tokens += len(ids)
-            vec = await loop.run_in_executor(
-                self.scheduler._exec, self._runner.embed_prompt, ids)
-            out.append([float(v) for v in vec])
+            prompts.append(ids)
+        # One executor submission per padded batch (not per text, not the
+        # whole list): same-bucket texts still share a forward, but decode
+        # chunks get to interleave between batches instead of stalling
+        # behind a bulk embed of hundreds of texts.
+        out: list[list[float]] = []
+        chunk_size = self._runner._EMBED_BATCH[-1]
+        for i in range(0, len(prompts), chunk_size):
+            vecs = await loop.run_in_executor(
+                self.scheduler._exec, self._runner.embed_prompts,
+                prompts[i:i + chunk_size])
+            out.extend([float(v) for v in vec] for vec in vecs)
         return out, n_tokens
 
 

@@ -116,6 +116,7 @@ class PagedModelRunner(ModelRunner):
         self._page_key: dict[int, bytes] = {}      # reverse map
         self._page_refs: dict[int, int] = {}       # live slot refs per page
         self._index_lru: dict[bytes, int] = {}     # key -> last-use counter
+        self._key_children: dict[bytes, set[bytes]] = {}  # chain structure
         self._lru_tick = 0
         self._pending_match: tuple[list[bytes], list[int]] | None = None
         self.prefix_hits = 0
@@ -142,20 +143,37 @@ class PagedModelRunner(ModelRunner):
         return [self._free_pages.pop() for _ in range(n)]
 
     def _evict_cached(self, n: int) -> None:
-        """Drop up to ``n`` LRU prefix-cache pages no live slot references."""
+        """Drop LRU prefix-cache pages no live slot references until ``n``
+        pages are freed.  Evicting a chain key cascades to its descendants:
+        matching stops at the first missing key, so a descendant whose
+        ancestor is gone can never hit again — freeing it too keeps the
+        cache free of unreachable dead entries."""
         for key, _tick in sorted(self._index_lru.items(), key=lambda kv: kv[1]):
             if n <= 0:
                 break
-            page = self._prefix_index[key]
-            if self._page_refs.get(page, 0) == 0:
-                self._deindex(key)
-                self._free_pages.append(page)
-                n -= 1
+            if key not in self._prefix_index:
+                continue  # already cascaded away by an ancestor's eviction
+            if self._page_refs.get(self._prefix_index[key], 0) == 0:
+                n -= self._deindex(key)
 
-    def _deindex(self, key: bytes) -> None:
-        page = self._prefix_index.pop(key)
-        self._page_key.pop(page, None)
-        self._index_lru.pop(key, None)
+    def _deindex(self, key: bytes) -> int:
+        """Remove ``key`` and its whole descendant chain from the index;
+        returns how many pages went back to the free list (refcount-0 only —
+        pages still held by live slots stay allocated, just unmatchable)."""
+        freed = 0
+        stack = [key]
+        while stack:
+            k = stack.pop()
+            page = self._prefix_index.pop(k, None)
+            if page is None:
+                continue
+            self._page_key.pop(page, None)
+            self._index_lru.pop(k, None)
+            stack.extend(self._key_children.pop(k, ()))
+            if self._page_refs.get(page, 0) == 0:
+                self._free_pages.append(page)
+                freed += 1
+        return freed
 
     def _free(self, slot: int) -> None:
         for page in self._slot_pages.pop(slot, []):
@@ -410,6 +428,7 @@ class PagedModelRunner(ModelRunner):
         self._page_key.clear()
         self._page_refs.clear()
         self._index_lru.clear()
+        self._key_children.clear()
         self._pending_match = None
         b = self.max_slots
         return PagedDecodeState(
@@ -424,7 +443,8 @@ class PagedModelRunner(ModelRunner):
         )
 
     def insert(self, state: PagedDecodeState, slot: int, ks, vs, plen: int,
-               first_token: int, temperature: float, top_p: float):
+               first_token: int, temperature: float, top_p: float,
+               prompt_tokens: list[int] | None = None):
         """Place a prefilled sequence: shared prefix pages (from the paired
         prefill's match, refcounted) + freshly scattered suffix pages."""
         bucket = ks.shape[3]
@@ -464,6 +484,9 @@ class PagedModelRunner(ModelRunner):
                     self._page_key[page] = keys[ki]
                     self._lru_tick += 1
                     self._index_lru[keys[ki]] = self._lru_tick
+                    if ki > 0:  # chain edge for cascade eviction
+                        self._key_children.setdefault(
+                            keys[ki - 1], set()).add(keys[ki])
         return self._insert_paged(
             state, jnp.asarray(fresh, jnp.int32), ks, vs, jnp.int32(slot),
             jnp.int32(plen), jnp.int32(first_token),

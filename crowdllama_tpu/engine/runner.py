@@ -67,12 +67,16 @@ class DecodeState:
     # for both layouts).
     k_scale: jnp.ndarray | None = None  # [L, B, Hkv, S]
     v_scale: jnp.ndarray | None = None
+    # Speculative decoding only (engine/spec.py): device-side token history
+    # [B, S] — the n-gram draft source.  None otherwise.
+    hist: jnp.ndarray | None = None
 
 
 jax.tree_util.register_dataclass(
     DecodeState,
     data_fields=["k_cache", "v_cache", "seq_lens", "tokens", "active",
-                 "temperature", "top_p", "key", "k_scale", "v_scale"],
+                 "temperature", "top_p", "key", "k_scale", "v_scale",
+                 "hist"],
     meta_fields=[],
 )
 
@@ -214,6 +218,7 @@ class ModelRunner:
             top_p=state.top_p.at[slot].set(top_p),
             key=state.key,
             k_scale=k_scale, v_scale=v_scale,
+            hist=state.hist,
         )
 
     def _release_impl(self, state: DecodeState, slot) -> DecodeState:
@@ -223,7 +228,7 @@ class ModelRunner:
             tokens=state.tokens.at[slot].set(0),
             active=state.active.at[slot].set(False),
             temperature=state.temperature, top_p=state.top_p, key=state.key,
-            k_scale=state.k_scale, v_scale=state.v_scale,
+            k_scale=state.k_scale, v_scale=state.v_scale, hist=state.hist,
         )
 
     def _decode_impl(self, params, state: DecodeState, num_steps: int):
@@ -268,7 +273,7 @@ class ModelRunner:
                 tokens=next_tokens,
                 active=st.active,
                 temperature=st.temperature, top_p=st.top_p, key=key,
-                k_scale=k_scale, v_scale=v_scale,
+                k_scale=k_scale, v_scale=v_scale, hist=st.hist,
             )
             return new_state, next_tokens
 
@@ -328,38 +333,63 @@ class ModelRunner:
         )
         return int(tok), ks, vs, plen
 
-    def embed_prompt(self, prompt_ids: list[int]) -> np.ndarray:
-        """Mean-pooled, L2-normalized embedding of a prompt ([D] fp32).
+    _EMBED_BATCH = (1, 2, 4, 8)  # padded batch sizes (bounds compile count)
 
-        Bucketed like :meth:`prefill` (bounded compile count); padding
-        positions are excluded from both attention and the pooling mask."""
+    def embed_prompt(self, prompt_ids: list[int]) -> np.ndarray:
+        """Mean-pooled, L2-normalized embedding of one prompt ([D] fp32)."""
+        return self.embed_prompts([prompt_ids])[0]
+
+    def embed_prompts(self, prompts: list[list[int]]) -> np.ndarray:
+        """Embeddings for many prompts ([N, D] fp32), batched per bucket.
+
+        Same-bucket prompts share one forward (padded to 1/2/4/8 rows) —
+        bulk /api/embed costs ~N/8 dispatches instead of N.  Sequence
+        padding is excluded from attention and the pooling mask."""
         if self.pp > 1 or self.sp > 1:
             raise NotImplementedError(
                 "embeddings are not implemented on pp/sp meshes yet "
                 "(the plain layer scan assumes an unsharded layer stack)")
-        plen = len(prompt_ids)
-        bucket = self.bucket_for(plen)
-        tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, :plen] = prompt_ids
-        vec = self._embed_fwd(self.params, jnp.asarray(tokens), jnp.int32(plen))
-        return np.asarray(vec, np.float32)
+        out = np.zeros((len(prompts), self.cfg.hidden_size), np.float32)
+        groups: dict[int, list[int]] = {}
+        for i, ids in enumerate(prompts):
+            groups.setdefault(self.bucket_for(len(ids)), []).append(i)
+        for bucket, idxs in groups.items():
+            for pos in range(0, len(idxs), self._EMBED_BATCH[-1]):
+                chunk = idxs[pos:pos + self._EMBED_BATCH[-1]]
+                bs = next(b for b in self._EMBED_BATCH if b >= len(chunk))
+                tokens = np.zeros((bs, bucket), np.int32)
+                plens = np.ones((bs,), np.int32)
+                for row, i in enumerate(chunk):
+                    tokens[row, :len(prompts[i])] = prompts[i]
+                    plens[row] = len(prompts[i])
+                vecs = np.asarray(self._embed_fwd(
+                    self.params, jnp.asarray(tokens), jnp.asarray(plens)),
+                    np.float32)
+                for row, i in enumerate(chunk):
+                    out[i] = vecs[row]
+        return out
 
     @partial(jax.jit, static_argnums=0)
-    def _embed_fwd(self, params, tokens, plen):
+    def _embed_fwd(self, params, tokens, plens):
         t = tokens.shape[1]
-        positions = jnp.minimum(jnp.arange(t)[None, :], plen - 1)
-        kv_valid = (jnp.arange(t) < plen)[None, :]
+        positions = jnp.minimum(jnp.arange(t)[None, :], plens[:, None] - 1)
+        kv_valid = jnp.arange(t)[None, :] < plens[:, None]  # [B, T]
         h = T.hidden_states(params, self.cfg, tokens, positions,
                             kv_valid=kv_valid,
-                            n_shards=self.mesh.size)  # [1, T, D]
-        mask = kv_valid[0, :, None].astype(jnp.float32)  # [T, 1]
-        pooled = jnp.sum(h[0].astype(jnp.float32) * mask, axis=0) / jnp.maximum(
-            jnp.sum(mask), 1.0)
-        return pooled / jnp.maximum(jnp.linalg.norm(pooled), 1e-9)
+                            n_shards=self.mesh.size)  # [B, T, D]
+        mask = kv_valid[..., None].astype(jnp.float32)  # [B, T, 1]
+        pooled = jnp.sum(h.astype(jnp.float32) * mask, axis=1) / jnp.maximum(
+            jnp.sum(mask, axis=1), 1.0)
+        return pooled / jnp.maximum(
+            jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9)
 
     def insert(self, state: DecodeState, slot: int, ks, vs, plen: int,
-               first_token: int, temperature: float, top_p: float) -> DecodeState:
-        # KV buckets shorter than max_seq: pad via dynamic slice into cache
+               first_token: int, temperature: float, top_p: float,
+               prompt_tokens: list[int] | None = None) -> DecodeState:
+        # KV buckets shorter than max_seq: pad via dynamic slice into cache.
+        # ``prompt_tokens`` is accepted (and ignored) so the scheduler can
+        # pass the prompt uniformly; the spec runner needs it for its
+        # n-gram history (engine/spec.py).
         return self._insert(
             state, jnp.int32(slot), ks, vs, jnp.int32(plen),
             jnp.int32(first_token), jnp.float32(temperature), jnp.float32(top_p),

@@ -149,7 +149,8 @@ class Scheduler:
                 req.top_p, sub, state=self.state),
         )
         self.state = self.runner.insert(
-            self.state, slot, ks, vs, plen, first, req.temperature, req.top_p
+            self.state, slot, ks, vs, plen, first, req.temperature,
+            req.top_p, prompt_tokens=req.prompt_ids,
         )
         info = _SlotInfo(req=req, prompt_len=plen)
         self.slots[slot] = info
@@ -296,7 +297,18 @@ class Scheduler:
                 # request they were dispatched for — a slot retired
                 # mid-chunk (EOS overshoot) or retired-and-readmitted
                 # since dispatch is skipped.
-                if info is not None and self.slots[i] is info:
+                if info is None or self.slots[i] is not info:
+                    continue
+                if tokens.ndim == 3:
+                    # Speculative packed layout [K, 1+J, B] (engine/spec.py):
+                    # row 0 = emit count, rows 1.. = tokens for this step.
+                    for jj in range(int(tokens[step, 0, i])):
+                        if self.slots[i] is not info:  # retired mid-step
+                            break
+                        self._emit(info.req, int(tokens[step, 1 + jj, i]),
+                                   info)
+                        emitted += 1
+                else:
                     self._emit(info.req, int(tokens[step, i]), info)
                     emitted += 1
         if emitted == 0:

@@ -1,0 +1,185 @@
+"""Speculative decoding via n-gram prompt lookup (no draft model).
+
+Each decode step verifies ``1 + draft_len`` tokens in ONE forward: the
+pending token plus drafts proposed by matching the trailing bigram against
+the sequence's own history (prompt + generated so far).  Decode streams the
+full parameter set per dispatch either way — it is HBM-bandwidth-bound — so
+verifying J tokens costs roughly one step but can emit up to J tokens when
+drafts are accepted.  Repetitive workloads (summarization, code edits,
+retrieval-augmented chat) accept often; worst case degrades to normal
+decode throughput.
+
+Exactness: greedy slots emit exactly the tokens ordinary greedy decode
+would (drafts only decide how MANY emit per dispatch, never WHAT).  Sampled
+slots (temperature > 0) take one token per step from the same logits
+ordinary decode computes — no distribution drift, just no speedup.
+
+The verify forward is models.transformer.prefill with the KV cache as
+attention *context* (the machinery prefix caching introduced): suffix
+queries attend jointly over cache entries (< seq_len) and the causal
+speculative window; KV for all J positions is scattered into the cache, and
+rejected positions are simply masked by seq_lens until overwritten.
+
+The reference has no speculation anywhere (its engine is Ollama).
+"""
+
+from __future__ import annotations
+
+import logging
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from crowdllama_tpu.engine.runner import DecodeState, ModelRunner
+from crowdllama_tpu.engine.sampling import sample_tokens
+from crowdllama_tpu.models import transformer as T
+
+log = logging.getLogger("crowdllama.engine.spec")
+
+
+class SpecModelRunner(ModelRunner):
+    """ModelRunner with n-gram speculative decode (contiguous KV only).
+
+    ``decode_steps_device`` returns a PACKED int32 block [K, 1+J, B]: row 0
+    is the per-slot emit count for that verify step, rows 1..J the emitted
+    tokens (valid up to the count).  The scheduler detects the 3-D layout.
+    """
+
+    def __init__(self, cfg, *args, draft_len: int = 4, **kwargs):
+        super().__init__(cfg, *args, **kwargs)
+        assert self.sp == 1 and self.pp == 1, (
+            "speculative decode does not compose with sp/pp meshes yet")
+        assert self.kv_dtype == "bf16", (
+            "speculative decode requires the bf16 KV cache (the verify "
+            "forward reads the cache as bf16 attention context)")
+        self.draft_len = max(1, draft_len)
+        self._spec_decode = jax.jit(self._spec_decode_impl,
+                                    donate_argnums=(1,), static_argnums=(2,))
+        self._set_hist = jax.jit(self._set_hist_impl, donate_argnums=(0,))
+
+    # ------------------------------------------------------------------ state
+
+    def init_state(self, seed: int = 0) -> DecodeState:
+        state = super().init_state(seed)
+        state.hist = jnp.zeros((self.max_slots, self.max_seq), jnp.int32)
+        return state
+
+    def _set_hist_impl(self, state: DecodeState, slot, row) -> DecodeState:
+        state.hist = state.hist.at[slot].set(row)
+        return state
+
+    def insert(self, state, slot, ks, vs, plen, first_token, temperature,
+               top_p, prompt_tokens: list[int] | None = None):
+        state = super().insert(state, slot, ks, vs, plen, first_token,
+                               temperature, top_p)
+        row = np.zeros((self.max_seq,), np.int32)
+        if prompt_tokens:
+            row[:plen] = prompt_tokens[:plen]
+        if plen < self.max_seq:
+            row[plen] = first_token  # the pending token's sequence position
+        return self._set_hist(state, jnp.int32(slot), jnp.asarray(row))
+
+    # ---------------------------------------------------------------- drafts
+
+    @partial(jax.jit, static_argnums=0)
+    def _propose(self, hist, seq_lens):
+        """Bigram prompt-lookup drafts [B, draft_len].
+
+        For each slot: find the LATEST j with hist[j] == hist[cur-1] and
+        hist[j+1] == hist[cur] (cur = seq_lens, the pending token's
+        position), j+1 < cur; draft the k tokens that followed it.  No
+        match → garbage drafts (first verify comparison rejects them)."""
+        k = self.draft_len
+        s = self.max_seq
+
+        def one(row, cur):
+            idx = jnp.arange(s)
+            prev = row[jnp.maximum(cur - 1, 0)]
+            pend = row[cur]
+            m = (row == prev) & (jnp.roll(row, -1) == pend)
+            m &= (idx + 1 < cur) & (cur >= 1)
+            j = jnp.max(jnp.where(m, idx, -1))
+            start = jnp.where(j >= 0, j + 2, cur + 1)
+            return jax.lax.dynamic_slice(row, (jnp.clip(start, 0, s - k),),
+                                         (k,))
+
+        cur = jnp.minimum(seq_lens, s - 1)
+        return jax.vmap(one)(hist, cur)
+
+    # ---------------------------------------------------------------- decode
+
+    def _spec_decode_impl(self, params, state: DecodeState, num_steps: int):
+        """``num_steps`` verify steps; returns (packed [K, 1+J, B], state)."""
+        cfg = self.cfg
+        b = self.max_slots
+        j = 1 + self.draft_len
+        s_max = self.max_seq
+        bidx = jnp.arange(b)
+
+        def step(st: DecodeState, _):
+            drafts = self._propose(st.hist, st.seq_lens)        # [B, k]
+            seq_tok = jnp.concatenate([st.tokens[:, None], drafts], 1)  # [B,J]
+            positions = jnp.minimum(st.seq_lens[:, None] + jnp.arange(j),
+                                    s_max - 1)                  # [B, J]
+            ctx_valid = jnp.arange(s_max)[None, :] < st.seq_lens[:, None]
+            logits, ks, vs = T.prefill(
+                params, cfg, seq_tok, positions,
+                ctx_k=st.k_cache, ctx_v=st.v_cache, ctx_valid=ctx_valid,
+            )  # logits [B, J, V]; ks/vs [L, B, Hkv, J, Dh]
+            # Scatter the J new KV entries; rejected tail entries stay
+            # masked by seq_lens until a later step overwrites them.
+            k_cache = st.k_cache.at[:, bidx[:, None], :, positions].set(
+                ks.transpose(1, 3, 0, 2, 4).astype(st.k_cache.dtype))
+            v_cache = st.v_cache.at[:, bidx[:, None], :, positions].set(
+                vs.transpose(1, 3, 0, 2, 4).astype(st.v_cache.dtype))
+
+            model_next = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B,J]
+            greedy = st.temperature <= 0.0
+            match = (drafts == model_next[:, :-1]) & greedy[:, None]
+            accepted = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
+                               axis=1)                          # [B] 0..k
+            # Don't speculate past the context window: emitted tokens beyond
+            # max_seq-1 would clamp-overwrite the last cache position.
+            room = jnp.maximum(s_max - 1 - st.seq_lens, 0)
+            accepted = jnp.minimum(accepted, room)
+
+            key, sub = jax.random.split(st.key)
+            sampled0 = sample_tokens(logits[:, 0], st.temperature, st.top_p,
+                                     sub)
+            emit = model_next.at[:, 0].set(
+                jnp.where(greedy, model_next[:, 0], sampled0))  # [B, J]
+            emit = jnp.where(st.active[:, None], emit, 0)
+            counts = jnp.where(st.active, accepted + 1, 0)      # [B]
+            pending = jnp.take_along_axis(
+                emit, accepted[:, None], axis=1)[:, 0]          # [B]
+
+            # History: token at sequence position seq_lens+1+i is emit[i].
+            hpos = jnp.minimum(st.seq_lens[:, None] + 1 + jnp.arange(j),
+                               s_max - 1)
+            hist = st.hist.at[bidx[:, None], hpos].set(
+                jnp.where(jnp.arange(j)[None, :] <= accepted[:, None],
+                          emit, st.hist[bidx[:, None], hpos]))
+
+            new_state = DecodeState(
+                k_cache=k_cache, v_cache=v_cache,
+                seq_lens=st.seq_lens + counts,
+                tokens=jnp.where(st.active, pending, st.tokens),
+                active=st.active,
+                temperature=st.temperature, top_p=st.top_p, key=key,
+                hist=hist,
+            )
+            packed = jnp.concatenate(
+                [counts[None, :], emit.T], axis=0)              # [1+J, B]
+            return new_state, packed
+
+        new_state, packed = jax.lax.scan(step, state, length=num_steps)
+        return packed, new_state  # packed [K, 1+J, B]
+
+    def decode_steps(self, state: DecodeState, num_steps: int = 1):
+        tokens, new_state = self._spec_decode(self.params, state, num_steps)
+        return np.asarray(tokens), new_state
+
+    def decode_steps_device(self, state: DecodeState, num_steps: int = 1):
+        return self._spec_decode(self.params, state, num_steps)

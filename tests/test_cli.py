@@ -53,3 +53,34 @@ def test_env_layering(monkeypatch):
 def test_network_status_unreachable(capsys):
     assert main(["network-status", "--gateway", "http://127.0.0.1:1"]) == 1
     assert "unreachable" in capsys.readouterr().err
+
+
+async def test_run_chat_one_shot_and_history(capsys):
+    """``run`` streams a chat turn through a live gateway (FakeEngine echo)
+    and keeps multi-turn history."""
+    import argparse
+
+    from crowdllama_tpu.cli.main import _run_chat
+    from tests.test_integration import _topology, _wait_for
+
+    worker, consumer, gateway, gw_port, teardown = await _topology()
+    try:
+        await _wait_for(
+            lambda: any(p.peer_id == worker.peer_id
+                        for p in consumer.peer_manager.get_healthy_peers()),
+            what="discovery",
+        )
+        args = argparse.Namespace(
+            model="tiny-test", prompt="hello swarm",
+            gateway=f"http://127.0.0.1:{gw_port}",
+            temperature=0.0, top_p=1.0, max_tokens=0,
+        )
+        assert await _run_chat(args) == 0
+        out = capsys.readouterr().out
+        assert "echo:" in out and "hello swarm" in out
+
+        # Unknown model: clean failure, non-zero exit.
+        args.model = "missing-model"
+        assert await _run_chat(args) == 1
+    finally:
+        await teardown()

@@ -171,7 +171,8 @@ async def test_event_loop_free_during_dispatch():
             time.sleep(0.4)  # blocking device wait
             return 5, None, None, len(ids)
 
-        def insert(self, state, slot, ks, vs, plen, tok, t, p):
+        def insert(self, state, slot, ks, vs, plen, tok, t, p,
+                   prompt_tokens=None):
             return state
 
         def release(self, state, slot):
@@ -199,6 +200,49 @@ async def test_event_loop_free_during_dispatch():
         assert not req.out.empty(), "no tokens emitted — decode never ran"
         tok, reason = req.out.get_nowait()
         assert reason == "" and isinstance(tok, int)
+    finally:
+        await sched.stop()
+
+
+async def test_scheduler_churn_no_token_crosstalk():
+    """Double-buffered decode under churn: many concurrent requests with
+    mixed lengths and early EOS must each get a self-consistent stream —
+    no request may receive tokens dispatched for another slot's occupant
+    (the retire/readmit race the chunk snapshots exist to prevent)."""
+    import jax
+
+    from crowdllama_tpu.engine.runner import ModelRunner
+    from crowdllama_tpu.engine.scheduler import DONE, GenRequest, Scheduler
+    from crowdllama_tpu.models.config import get_config
+
+    cfg = get_config("tiny-test", max_context_length=128)
+    runner = ModelRunner(cfg, max_slots=2, max_seq=128)
+    sched = Scheduler(runner, decode_chunk=4)
+    sched.start()
+    try:
+        async def one(i):
+            req = GenRequest(prompt_ids=[1 + i, 2, 3 + i],
+                             max_tokens=3 + (i % 5), eos_id=-1)
+            await sched.submit(req)
+            toks = []
+            while True:
+                tok, reason = await asyncio.wait_for(req.out.get(), 30)
+                if tok is DONE:
+                    return toks, reason
+                toks.append(tok)
+
+        results = await asyncio.gather(*(one(i) for i in range(12)))
+        for i, (toks, reason) in enumerate(results):
+            want = 3 + (i % 5)
+            assert reason in ("stop", "length"), reason
+            # Exactly the budgeted number of tokens: crosstalk or dropped
+            # chunks would show up as over- or under-emission.
+            assert len(toks) == want, (i, len(toks), want)
+        assert sched.requests_served == 12
+        # All slots drained; scheduler is idle and reusable.
+        assert all(s is None for s in sched.slots)
+        toks, reason = await one(99)
+        assert len(toks) == 3 + (99 % 5)
     finally:
         await sched.stop()
 

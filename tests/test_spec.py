@@ -1,0 +1,133 @@
+"""N-gram speculative decoding (engine/spec.py): greedy exactness, multi-
+token acceptance on repetitive text, sampled slots unaffected, and the
+scheduler's packed-emission path end to end."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from crowdllama_tpu.engine.runner import ModelRunner
+from crowdllama_tpu.engine.spec import SpecModelRunner
+from crowdllama_tpu.models import transformer as T
+from crowdllama_tpu.models.config import get_config
+
+
+def _runners(draft_len=4):
+    cfg = get_config("tiny-test", max_context_length=128)
+    params = T.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    base = ModelRunner(cfg, params=params, max_slots=2, max_seq=128,
+                       dtype=jnp.float32)
+    spec = SpecModelRunner(cfg, params=params, max_slots=2, max_seq=128,
+                           dtype=jnp.float32, draft_len=draft_len)
+    return base, spec
+
+
+def _spec_rollout(spec, prompt, steps, temperature=0.0):
+    state = spec.init_state()
+    first, ks, vs, plen = spec.prefill(prompt, temperature, 1.0,
+                                       jax.random.PRNGKey(7))
+    state = spec.insert(state, 0, ks, vs, plen, first, temperature, 1.0,
+                        prompt_tokens=prompt)
+    toks = [first]
+    packed, state = spec.decode_steps(state, steps)
+    for step in range(packed.shape[0]):
+        n = int(packed[step, 0, 0])
+        toks.extend(int(t) for t in packed[step, 1:1 + n, 0])
+    return toks, packed
+
+
+def test_spec_greedy_exactness():
+    """Greedy spec decode must emit the exact tokens plain greedy decode
+    does — drafts change how many tokens per dispatch, never which."""
+    base, spec = _runners()
+    prompt = [5, 9, 5, 9, 5, 9, 5]  # repetitive: drafts will accept
+
+    state = base.init_state()
+    first, ks, vs, plen = base.prefill(prompt, 0.0, 1.0, jax.random.PRNGKey(7))
+    state = base.insert(state, 0, ks, vs, plen, first, 0.0, 1.0)
+    out, state = base.decode_steps(state, 24)
+    ref = [first] + [int(t) for t in out[:, 0]]
+
+    toks, packed = _spec_rollout(spec, prompt, 24)
+    n = min(len(ref), len(toks))
+    assert toks[:n] == ref[:n], (toks[:n], ref[:n])
+
+
+def test_spec_accepts_on_repetitive_model():
+    """When the model's own greedy output repeats, drafts accept and one
+    verify dispatch emits multiple tokens (the whole point).  A zeroed
+    model decodes a constant token — fully predictable by its bigram."""
+    cfg = get_config("tiny-test", max_context_length=128)
+    params = jax.tree_util.tree_map(
+        lambda a: a * 0, T.init_params(cfg, jax.random.PRNGKey(0),
+                                       dtype=jnp.float32))
+    spec = SpecModelRunner(cfg, params=params, max_slots=2, max_seq=128,
+                           dtype=jnp.float32, draft_len=4)
+    toks, packed = _spec_rollout(spec, [3, 1, 4, 1, 5], steps=6)
+    counts = packed[:, 0, 0]
+    assert counts.max() == 5, counts.tolist()  # 1 pending + 4 drafts
+    assert sum(counts) == len(toks) - 1
+
+
+def test_spec_sampled_slots_one_token_per_step():
+    _, spec = _runners()
+    toks, packed = _spec_rollout(spec, [3, 1, 4, 1, 5], steps=6,
+                                 temperature=0.8)
+    assert (packed[:, 0, 0] == 1).all()
+    assert len(toks) == 7  # first + 6 steps x 1
+
+
+def test_spec_history_proposals():
+    """The bigram proposer drafts the continuation of the latest match."""
+    _, spec = _runners(draft_len=3)
+    hist = jnp.asarray([[7, 8, 21, 22, 23, 7, 8, 0, 0, 0]
+                        + [0] * 118], jnp.int32)
+    # cur=6: pending bigram (7, 8) matches positions 0-1 → draft 21, 22, 23.
+    drafts = spec._propose(hist, jnp.asarray([6]))
+    assert drafts.tolist() == [[21, 22, 23]]
+
+
+async def test_spec_scheduler_end_to_end():
+    from crowdllama_tpu.engine.scheduler import DONE, GenRequest, Scheduler
+
+    _, spec = _runners()
+    sched = Scheduler(spec, decode_chunk=4)
+    sched.start()
+    try:
+        req = GenRequest(prompt_ids=[5, 9, 5, 9, 5], max_tokens=10, eos_id=-1)
+        await sched.submit(req)
+        toks = []
+        while True:
+            tok, reason = await asyncio.wait_for(req.out.get(), 60)
+            if tok is DONE:
+                break
+            toks.append(tok)
+        # Budget respected exactly despite multi-token spec steps.
+        assert reason == "length"
+        assert len(toks) == 10, toks
+        assert req.out.empty()
+    finally:
+        await sched.stop()
+
+
+async def test_spec_engine_config_path():
+    from crowdllama_tpu.config import Configuration, Intervals
+    from crowdllama_tpu.engine.engine import JaxEngine
+
+    cfg = Configuration(model="tiny-test", max_context_length=128,
+                        spec_decode="ngram", spec_draft=3,
+                        max_batch_slots=2, warmup=False,
+                        intervals=Intervals.default())
+    eng = JaxEngine(cfg)
+    await eng.start()
+    try:
+        n = 0
+        async for c in eng.generate("abcabcabc", max_tokens=8):
+            n += 1
+            if c.done:
+                assert c.completion_tokens == 8
+                break
+    finally:
+        await eng.stop()
