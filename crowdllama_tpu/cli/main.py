@@ -221,11 +221,16 @@ def _make_engine(cfg: Configuration, worker_mode: bool):
         # api.go:163-189).
         return FakeEngine(models=[])
     if cfg.engine_backend == "fake":
-        return FakeEngine(models=[cfg.model])
+        return FakeEngine(models=[m.strip() for m in cfg.model.split(",")
+                                  if m.strip()])
     if cfg.shard_count > 1:
         from crowdllama_tpu.engine.sharded import ShardedEngine
 
         return ShardedEngine(cfg)
+    if "," in cfg.model:
+        from crowdllama_tpu.engine.multi import MultiEngine
+
+        return MultiEngine(cfg)
     return JaxEngine(cfg)
 
 

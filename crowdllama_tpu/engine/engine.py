@@ -125,11 +125,18 @@ class Engine:
                 completion_tokens=chunk.completion_tokens if chunk.done else 0,
             )
 
+    def _format_chat(self, messages: list[dict], model: str = "") -> str:
+        """Chat → prompt string.  Engines with a templated tokenizer
+        override this; the default is the generic role-tagged flattening
+        (the reference concatenates contents, gateway.go:189-207)."""
+        return flatten_chat(messages)
+
     def _gen_from_request(self, req: pb.GenerateRequest) -> AsyncIterator[Chunk]:
         prompt = req.prompt
         if not prompt and req.messages:
-            prompt = flatten_chat(
-                [{"role": m.role, "content": m.content} for m in req.messages]
+            prompt = self._format_chat(
+                [{"role": m.role, "content": m.content} for m in req.messages],
+                model=req.model,
             )
         return self.generate(
             prompt,
@@ -282,6 +289,24 @@ class JaxEngine(Engine):
 
         return await loop.run_in_executor(None, _trace)
 
+    def _format_chat(self, messages: list[dict], model: str = "") -> str:
+        """Prefer the checkpoint's own chat template (Llama-3 headers,
+        Qwen im_start, ...) when the HF tokenizer ships one."""
+        fmt = getattr(self.tokenizer, "format_chat", None)
+        if fmt is not None:
+            try:
+                return fmt(messages)
+            except ValueError:
+                pass  # no template in this checkpoint: generic flattening
+            except Exception:
+                # A template that EXISTS but rejects this conversation
+                # (e.g. Gemma's raises on system-role messages) — fall back,
+                # but loudly: silently divergent prompt formats are a
+                # miserable thing to debug.
+                log.warning("chat template failed; using generic "
+                            "flattening", exc_info=True)
+        return flatten_chat(messages)
+
     async def generate(  # type: ignore[override]
         self,
         prompt: str,
@@ -360,7 +385,7 @@ class JaxEngine(Engine):
             vecs = await loop.run_in_executor(
                 self.scheduler._exec, self._runner.embed_prompts,
                 prompts[i:i + chunk_size])
-            out.extend([float(v) for v in vec] for vec in vecs)
+            out.extend(vecs.tolist())
         return out, n_tokens
 
 

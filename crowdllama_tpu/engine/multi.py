@@ -1,0 +1,88 @@
+"""MultiEngine: one worker serving several models (Ollama-style).
+
+The reference's workers advertise a *list* of supported models because
+Ollama hosts many; a single-model JAX engine would under-serve that
+surface.  ``MultiEngine`` runs one child ``JaxEngine`` per model name
+(``--model a,b,c``) behind the same ``Engine`` seam and routes each
+request by its ``model`` field.  Children share the device: their
+schedulers' dispatch threads interleave at the device queue, so serving
+stays single-flight per child while models multiplex the chip.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import replace as _dc_replace
+from typing import AsyncIterator
+
+from crowdllama_tpu.engine.engine import Chunk, Engine, JaxEngine
+
+log = logging.getLogger("crowdllama.engine.multi")
+
+
+class MultiEngine(Engine):
+    def __init__(self, config):
+        self.config = config
+        names = [m.strip() for m in config.model.split(",") if m.strip()]
+        if len(names) < 2:
+            raise ValueError("MultiEngine needs >= 2 comma-separated models")
+        self._engines: dict[str, JaxEngine] = {}
+        for name in names:
+            child_cfg = _dc_replace(config, model=name)
+            self._engines[name] = JaxEngine(child_cfg)
+        self.models = names
+
+    def _child(self, model: str) -> JaxEngine:
+        if not model:
+            # Single-model clients may omit the name; unambiguous only
+            # when one child exists (guarded in __init__) — require it.
+            raise ValueError(
+                f"model is required (serving {sorted(self._engines)})")
+        eng = self._engines.get(model)
+        if eng is None:
+            raise ValueError(
+                f"model {model!r} not served (have {sorted(self._engines)})")
+        return eng
+
+    async def start(self) -> None:
+        # Sequential start: children compile on the same device; parallel
+        # starts would interleave big compilations for no wall-clock win.
+        for name, eng in self._engines.items():
+            log.info("starting child engine for %s", name)
+            await eng.start()
+
+    async def stop(self) -> None:
+        await asyncio.gather(*(e.stop() for e in self._engines.values()),
+                             return_exceptions=True)
+
+    def attach_peer(self, peer) -> None:
+        for eng in self._engines.values():
+            eng.attach_peer(peer)
+
+    def describe(self) -> dict:
+        per = {name: e.describe() for name, e in self._engines.items()}
+        return {
+            "models": self.models,
+            "throughput": round(sum(d["throughput"] for d in per.values()), 2),
+            "load": round(max(d["load"] for d in per.values()), 3),
+            "engines": per,
+        }
+
+    def _format_chat(self, messages: list[dict], model: str = "") -> str:
+        return self._child(model)._format_chat(messages, model=model)
+
+    def generate(self, prompt: str, model: str = "", max_tokens: int = 128,
+                 temperature: float = 0.0, top_p: float = 1.0,
+                 ) -> AsyncIterator[Chunk]:
+        return self._child(model).generate(
+            prompt, model=model, max_tokens=max_tokens,
+            temperature=temperature, top_p=top_p)
+
+    async def embed(self, texts: list[str], model: str = "",
+                    truncate: bool = True) -> tuple[list[list[float]], int]:
+        return await self._child(model).embed(texts, model=model,
+                                              truncate=truncate)
+
+    async def capture_profile(self, seconds: float = 3.0) -> str:
+        return await next(iter(self._engines.values())).capture_profile(seconds)

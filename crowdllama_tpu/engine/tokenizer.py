@@ -89,6 +89,16 @@ class HFTokenizer:
     def stream_decoder(self) -> StreamDecoder:
         return _HFStreamDecoder(self)
 
+    def format_chat(self, messages: list[dict]) -> str:
+        """Render chat messages with the checkpoint's own chat template
+        (Llama-3 headers, Qwen im_start, ...).  Raises when the tokenizer
+        ships no template — callers fall back to the generic flattening."""
+        if not getattr(self._tok, "chat_template", None):
+            raise ValueError("tokenizer has no chat template")
+        return self._tok.apply_chat_template(
+            [dict(m) for m in messages], tokenize=False,
+            add_generation_prompt=True)
+
 
 class _HFStreamDecoder(StreamDecoder):
     """Incremental detokenizer over a pending-id window (O(1) per token).

@@ -130,6 +130,21 @@ class IPCServer:
                 })
             except Exception as e:
                 await self._send_json(writer, {"type": "error", "error": str(e)})
+        elif mtype == "embed":
+            inputs = obj.get("input")
+            if inputs is None:
+                inputs = obj.get("text", "")
+            if isinstance(inputs, str):
+                inputs = [inputs]
+            try:
+                vecs, n_tokens = await self.engine.embed(
+                    inputs, model=obj.get("model", ""))
+                await self._send_json(writer, {
+                    "type": "embeddings", "embeddings": vecs,
+                    "prompt_tokens": n_tokens,
+                })
+            except Exception as e:
+                await self._send_json(writer, {"type": "error", "error": str(e)})
         elif mtype == "profile":
             # Capture a jax.profiler trace of live engine activity (worker
             # nodes with --profile-dir; SURVEY §5 profiler hook).

@@ -259,3 +259,26 @@ def test_sampling_shapes():
     # top_p=0.01 with temp>0 collapses to argmax too
     toks = sample_tokens(logits, jnp.ones(4), jnp.full(4, 0.01), jax.random.PRNGKey(1))
     np.testing.assert_array_equal(np.asarray(toks), np.asarray(logits.argmax(-1)))
+
+
+def test_chat_template_preferred_over_flattening():
+    """JaxEngine renders chats with the tokenizer's template when it has
+    one, and falls back to the generic flattening when it doesn't."""
+    from crowdllama_tpu.engine.engine import JaxEngine
+
+    eng = JaxEngine.__new__(JaxEngine)  # formatting needs no started engine
+
+    class Templated:
+        def format_chat(self, messages):
+            return "<tmpl>" + messages[-1]["content"]
+
+    eng.tokenizer = Templated()
+    msgs = [{"role": "user", "content": "hi"}]
+    assert eng._format_chat(msgs) == "<tmpl>hi"
+
+    class Untemplated:
+        def format_chat(self, messages):
+            raise ValueError("tokenizer has no chat template")
+
+    eng.tokenizer = Untemplated()
+    assert "user: hi" in eng._format_chat(msgs)

@@ -84,3 +84,26 @@ async def test_garbage_line(tmp_path):
         writer.close()
     finally:
         await srv.stop()
+
+
+async def test_json_embed(tmp_path):
+    sock = str(tmp_path / "ipc.sock")
+    srv = IPCServer(sock, FakeEngine(models=["m"]))
+    await srv.start()
+    try:
+        reader, writer = await _client(sock)
+
+        async def ask(obj):
+            writer.write(json.dumps(obj).encode() + b"\n")
+            await writer.drain()
+            return json.loads(await asyncio.wait_for(reader.readline(), 5))
+
+        reply = await ask({"type": "embed", "model": "m",
+                           "input": ["alpha", "beta"]})
+        assert reply["type"] == "embeddings"
+        assert len(reply["embeddings"]) == 2
+        assert reply["embeddings"][0] != reply["embeddings"][1]
+        assert reply["prompt_tokens"] > 0
+        writer.close()
+    finally:
+        await srv.stop()

@@ -1,0 +1,61 @@
+"""MultiEngine: one worker serving several models behind the Engine seam."""
+
+import numpy as np
+
+from crowdllama_tpu.config import Configuration, Intervals
+from crowdllama_tpu.core import messages
+from crowdllama_tpu.engine.multi import MultiEngine
+
+
+def _cfg(**kw):
+    cfg = Configuration(model="tiny-test,tiny-test-qwen3",
+                        max_context_length=128, max_batch_slots=2,
+                        warmup=False, intervals=Intervals.default())
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+async def test_multi_engine_routes_by_model():
+    eng = MultiEngine(_cfg())
+    await eng.start()
+    try:
+        assert eng.models == ["tiny-test", "tiny-test-qwen3"]
+        outs = {}
+        for model in eng.models:
+            req = messages.create_generate_request(model, "hi", stream=False)
+            reply = await eng.handle(req, worker_id="w")
+            resp = messages.extract_generate_response(reply)
+            assert resp.done and resp.done_reason in ("stop", "length")
+            outs[model] = resp.response
+        # Two different models produced (almost surely) different text.
+        assert outs["tiny-test"] != outs["tiny-test-qwen3"]
+
+        d = eng.describe()
+        assert set(d["engines"]) == set(eng.models)
+
+        # Embeddings route too, with each model's own hidden size.
+        vecs, n = await eng.embed(["hello"], model="tiny-test-qwen3")
+        assert len(vecs[0]) == 64 and n > 0
+
+        # Unknown / missing model: MUST raise at the raw seam (the peer
+        # stream handler converts this into a wire error response).
+        for bad_model in ("nope", ""):
+            bad = messages.create_generate_request(bad_model, "hi",
+                                                   stream=False)
+            try:
+                await eng.handle(bad, worker_id="w")
+                raise AssertionError(
+                    f"model={bad_model!r} should have raised")
+            except ValueError:
+                pass
+    finally:
+        await eng.stop()
+
+
+def test_multi_engine_rejects_single_model():
+    try:
+        MultiEngine(_cfg(model="tiny-test"))
+        raise AssertionError("expected ValueError")
+    except ValueError:
+        pass
