@@ -220,17 +220,21 @@ def _make_engine(cfg: Configuration, worker_mode: bool):
         # Consumers never run inference locally (reference uses an echo stub,
         # api.go:163-189).
         return FakeEngine(models=[])
+    names = [m.strip() for m in cfg.model.split(",") if m.strip()]
     if cfg.engine_backend == "fake":
-        return FakeEngine(models=[m.strip() for m in cfg.model.split(",")
-                                  if m.strip()])
+        return FakeEngine(models=names)
+    if len(names) > 1 and cfg.shard_count > 1:
+        raise ValueError("multi-model workers cannot combine with "
+                         "--shard-count (shard one model per worker group)")
     if cfg.shard_count > 1:
         from crowdllama_tpu.engine.sharded import ShardedEngine
 
         return ShardedEngine(cfg)
-    if "," in cfg.model:
+    if len(names) > 1:
         from crowdllama_tpu.engine.multi import MultiEngine
 
         return MultiEngine(cfg)
+    cfg.model = names[0] if names else cfg.model  # tolerate a trailing comma
     return JaxEngine(cfg)
 
 

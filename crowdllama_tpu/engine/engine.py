@@ -333,22 +333,32 @@ class JaxEngine(Engine):
         await self.scheduler.submit(req)
         decoder = self.tokenizer.stream_decoder()
         completion = 0
-        while True:
-            token, reason = await req.out.get()
-            if token is DONE:
-                if reason.startswith("error"):
-                    raise RuntimeError(reason)
-                yield Chunk(
-                    text="", done=True, done_reason=reason,
-                    prompt_tokens=len(prompt_ids), completion_tokens=completion,
-                )
-                return
-            completion += 1
-            if token == req.eos_id:
-                continue  # silent; DONE follows
-            text = decoder.feed(token)
-            if text:
-                yield Chunk(text=text)
+        finished = False
+        try:
+            while True:
+                token, reason = await req.out.get()
+                if token is DONE:
+                    finished = True
+                    if reason.startswith("error"):
+                        raise RuntimeError(reason)
+                    yield Chunk(
+                        text="", done=True, done_reason=reason,
+                        prompt_tokens=len(prompt_ids),
+                        completion_tokens=completion,
+                    )
+                    return
+                completion += 1
+                if token == req.eos_id:
+                    continue  # silent; DONE follows
+                text = decoder.feed(token)
+                if text:
+                    yield Chunk(text=text)
+        finally:
+            if not finished:
+                # Consumer stopped early (client disconnect closes the
+                # generator): free the decode slot instead of generating
+                # into the void until max_tokens.
+                self.scheduler.cancel(req)
 
     async def embed(self, texts: list[str], model: str = "",
                     truncate: bool = True) -> tuple[list[list[float]], int]:

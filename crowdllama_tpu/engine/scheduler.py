@@ -55,6 +55,7 @@ class GenRequest:
     out: asyncio.Queue = field(default_factory=asyncio.Queue)
     submitted_at: float = field(default_factory=time.monotonic)
     first_token_at: float = 0.0
+    cancelled: bool = False  # client went away: drop at admission / free slot
 
 
 @dataclass
@@ -123,6 +124,22 @@ class Scheduler:
                 f"{self.runner.max_seq}"
             )
         await self.pending.put(req)
+        self._wake.set()
+
+    def cancel(self, req: GenRequest) -> None:
+        """Stop generating for a request whose client went away.
+
+        Only marks: the decode loop frees the slot at its next safe point
+        (a disconnected stream would otherwise burn batch throughput until
+        max_tokens); a request still in the pending queue is dropped at
+        admission.  The slot stays OCCUPIED until the loop drains it —
+        freeing it here would let a new admission reuse the slot while the
+        deferred device-side release is still queued, corrupting the new
+        request's KV; and calling runner.release from outside the loop can
+        donate the very state buffers a just-scheduled dispatch is about to
+        read (observed as "Array has been deleted").
+        """
+        req.cancelled = True
         self._wake.set()
 
     @property
@@ -206,6 +223,15 @@ class Scheduler:
             self._wake.clear()
             await self._wake.wait()
 
+        # Free cancelled slots — only the loop touches device state, so a
+        # release can never donate buffers out from under a dispatch, and
+        # the slot stays occupied (unreusable) until exactly here.
+        for i, info in enumerate(self.slots):
+            if info is not None and info.req.cancelled:
+                self.slots[i] = None
+                self.state = self.runner.release(self.state, i)
+                self.requests_served += 1
+
         # Admit pending requests into free slots — but at most one prefill
         # per iteration once any slot is decoding, so a burst of long prompts
         # interleaves with decode chunks instead of freezing token streaming
@@ -215,6 +241,8 @@ class Scheduler:
             if slot is None:
                 break
             req = self.pending.get_nowait()
+            if req.cancelled:
+                continue
             try:
                 await self._admit_one(req, slot)
             except ValueError as e:  # bad request (too long, etc.)

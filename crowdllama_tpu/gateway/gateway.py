@@ -66,6 +66,9 @@ class Gateway:
         self.app.router.add_get("/api/ps", self.handle_ps)
         self.app.router.add_post("/api/embed", self.handle_embed)
         self.app.router.add_post("/api/embeddings", self.handle_embeddings)
+        self.app.router.add_post("/api/pull", self.handle_pull)
+        for route in ("/api/delete", "/api/create", "/api/copy", "/api/push"):
+            self.app.router.add_route("*", route, self.handle_unsupported)
 
     # ----------------------------------------------------------- lifecycle
 
@@ -319,6 +322,39 @@ class Gateway:
             return await wire.read_length_prefixed_pb(s.reader, timeout=timeout)
         finally:
             s.close()
+
+    async def handle_pull(self, request: web.Request) -> web.Response:
+        """POST /api/pull — Ollama clients call this when a model is absent.
+
+        In a swarm, models are owned by workers, not downloaded by the
+        gateway: "pulling" succeeds iff some healthy worker already serves
+        the model (NDJSON status lines like Ollama's), otherwise a clear
+        error explains how models appear here."""
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"error": "invalid JSON"}, status=400)
+        name = body.get("model") or body.get("name") or ""
+        if not name:
+            return web.json_response({"error": "model is required"}, status=400)
+        # Same predicate routing uses: pull must not report success for a
+        # model /api/chat would then 503 on.
+        if self._find_worker(name) is not None:
+            lines = [{"status": "pulling manifest"}, {"status": "success"}]
+            return web.Response(
+                text="".join(json.dumps(line) + "\n" for line in lines),
+                content_type="application/x-ndjson")
+        return web.json_response({
+            "error": f"model {name!r} is not served by any worker; models "
+                     "are provided by swarm workers (start one with "
+                     f"--worker-mode --model {name})"}, status=404)
+
+    async def handle_unsupported(self, request: web.Request) -> web.Response:
+        """Model management (delete/create/copy/push) has no meaning at the
+        gateway: each worker owns its weights."""
+        return web.json_response({
+            "error": f"{request.path} is not supported: models are owned by "
+                     "swarm workers, not the gateway"}, status=501)
 
     # -------------------------------------------------------------- routing
 

@@ -114,3 +114,36 @@ async def test_gateway_embed_endpoints():
                 assert "error" in await resp.json()
     finally:
         await teardown()
+
+
+async def test_gateway_model_management_surface():
+    """/api/pull succeeds for swarm-served models (NDJSON like Ollama),
+    404s with guidance otherwise; delete/create/copy/push are clean 501s."""
+    from tests.test_integration import _topology, _wait_for
+
+    worker, consumer, gateway, gw_port, teardown = await _topology()
+    try:
+        await _wait_for(
+            lambda: any(
+                p.peer_id == worker.peer_id
+                for p in consumer.peer_manager.get_healthy_peers()
+            ),
+            what="consumer discovering worker",
+        )
+        base = f"http://127.0.0.1:{gw_port}"
+        async with aiohttp.ClientSession() as http:
+            async with http.post(f"{base}/api/pull",
+                                 json={"model": "tiny-test"}) as resp:
+                assert resp.status == 200
+                lines = [l for l in (await resp.text()).splitlines() if l]
+                import json as _json
+                assert _json.loads(lines[-1])["status"] == "success"
+            async with http.post(f"{base}/api/pull",
+                                 json={"model": "absent"}) as resp:
+                assert resp.status == 404
+                assert "worker" in (await resp.json())["error"]
+            async with http.post(f"{base}/api/delete",
+                                 json={"model": "tiny-test"}) as resp:
+                assert resp.status == 501
+    finally:
+        await teardown()

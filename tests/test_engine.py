@@ -282,3 +282,50 @@ def test_chat_template_preferred_over_flattening():
 
     eng.tokenizer = Untemplated()
     assert "user: hi" in eng._format_chat(msgs)
+
+
+async def test_client_disconnect_frees_slot():
+    """Closing the generate stream mid-flight (client disconnect) must free
+    the decode slot — not keep generating until max_tokens."""
+    from crowdllama_tpu.engine.runner import ModelRunner
+    from crowdllama_tpu.engine.scheduler import Scheduler, GenRequest, DONE
+    from crowdllama_tpu.models.config import get_config
+
+    cfg = get_config("tiny-test", max_context_length=128)
+    runner = ModelRunner(cfg, max_slots=2, max_seq=128)
+    sched = Scheduler(runner, decode_chunk=2)
+    sched.start()
+    try:
+        req = GenRequest(prompt_ids=[1, 2, 3], max_tokens=10_000, eos_id=-1)
+        await sched.submit(req)
+        await asyncio.wait_for(req.out.get(), 30)  # first token arrived
+        sched.cancel(req)
+        # The loop frees the slot at its next safe point.
+        for _ in range(600):
+            if all(s is None for s in sched.slots):
+                break
+            await asyncio.sleep(0.05)
+        assert all(s is None for s in sched.slots)
+        # Scheduler keeps serving new requests after the cancellation.
+        req2 = GenRequest(prompt_ids=[4, 5], max_tokens=3, eos_id=-1)
+        await sched.submit(req2)
+        toks = []
+        while True:
+            tok, reason = await asyncio.wait_for(req2.out.get(), 30)
+            if tok is DONE:
+                break
+            toks.append(tok)
+        assert len(toks) == 3 and reason == "length"
+        # A cancelled request still in the pending queue is dropped too.
+        req3 = GenRequest(prompt_ids=[6], max_tokens=5, eos_id=-1)
+        req3.cancelled = True
+        await sched.submit(req3)
+        req4 = GenRequest(prompt_ids=[7, 8], max_tokens=2, eos_id=-1)
+        await sched.submit(req4)
+        while True:
+            tok, reason = await asyncio.wait_for(req4.out.get(), 30)
+            if tok is DONE:
+                break
+        assert req3.out.empty()
+    finally:
+        await sched.stop()
