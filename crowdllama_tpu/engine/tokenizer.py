@@ -89,6 +89,13 @@ class HFTokenizer:
     def stream_decoder(self) -> StreamDecoder:
         return _HFStreamDecoder(self)
 
+    def token_text(self, token_id: int) -> str:
+        """The raw vocab string for one id (sentencepiece '▁'/BPE 'Ġ'
+        markers intact) — public surface for the stream decoder's
+        word-boundary restoration, so it survives a transformers bump."""
+        toks = self._tok.convert_ids_to_tokens([token_id])
+        return toks[0] if toks and toks[0] else ""
+
     def format_chat(self, messages: list[dict]) -> str:
         """Render chat messages with the checkpoint's own chat template
         (Llama-3 headers, Qwen im_start, ...).  Raises when the tokenizer
@@ -119,7 +126,7 @@ class _HFStreamDecoder(StreamDecoder):
         text = self._tok.decode(self._pending)
         if text.endswith("�"):  # mid-multibyte; wait for more ids
             return ""
-        lead = self._tok._tok.convert_ids_to_tokens([self._pending[0]])[0]
+        lead = self._tok.token_text(self._pending[0])
         if not self._first and lead and lead[0] in ("▁", "Ġ") and not text.startswith(" "):
             text = " " + text
         self._pending.clear()

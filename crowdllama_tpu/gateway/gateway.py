@@ -340,6 +340,10 @@ class Gateway:
         # Same predicate routing uses: pull must not report success for a
         # model /api/chat would then 503 on.
         if self._find_worker(name) is not None:
+            if not body.get("stream", True):
+                # Non-streaming clients (ollama-python default) parse ONE
+                # JSON body.
+                return web.json_response({"status": "success"})
             lines = [{"status": "pulling manifest"}, {"status": "success"}]
             return web.Response(
                 text="".join(json.dumps(line) + "\n" for line in lines),

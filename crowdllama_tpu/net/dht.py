@@ -14,6 +14,7 @@ from __future__ import annotations
 import asyncio
 import hashlib
 import logging
+import random
 import time
 from dataclasses import dataclass, field
 
@@ -555,7 +556,14 @@ class DHTNode:
                         and contact.peer_id not in state.shortlist
                     ):
                         state.shortlist[contact.peer_id] = contact
-        return list(found.values())[:limit]
+        out = list(found.values())
+        if len(out) > limit:
+            # More providers than the per-round cap: return a random subset
+            # so repeated discovery rounds cover the whole swarm instead of
+            # re-learning the same ``limit`` peers forever (a 16-worker
+            # swarm would otherwise plateau at 10 discovered).
+            random.shuffle(out)
+        return out[:limit]
 
     async def find_peer(self, peer_id: str) -> Contact | None:
         """Resolve a peer ID to a dialable contact (cf. gateway.go:248)."""

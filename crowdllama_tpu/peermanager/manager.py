@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import random
 import time
 from dataclasses import dataclass, field
 from typing import Awaitable, Callable
@@ -162,7 +163,7 @@ class PeerManager:
         routable (multi-worker models need the full group); ``exclude`` lets
         callers fail over past workers that just errored."""
         groups = self._complete_groups(model)
-        best, best_score = None, -1.0
+        best, best_score = [], -1.0
         for p in self.get_healthy_peers():
             if not p.is_worker or p.peer_id in exclude:
                 continue
@@ -176,8 +177,13 @@ class PeerManager:
                     continue  # group leader routes for the whole group
             score = r.tokens_throughput / (1.0 + max(r.load, 0.0))
             if score > best_score:
-                best, best_score = p, score
-        return best
+                best, best_score = [p], score
+            elif score == best_score:
+                best.append(p)
+        # Random tie-break: workers that advertise identical capability
+        # (fresh swarms, uniform hardware) would otherwise ALL receive every
+        # request at the same single worker until its load EMA moves.
+        return random.choice(best) if best else None
 
     def group_members(self, group_id: str) -> list[PeerInfo]:
         return sorted(

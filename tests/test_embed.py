@@ -147,3 +147,25 @@ async def test_gateway_model_management_surface():
                 assert resp.status == 501
     finally:
         await teardown()
+
+
+async def test_gateway_pull_non_streaming():
+    """stream:false pull must return ONE JSON body (ollama-python default)."""
+    from tests.test_integration import _topology, _wait_for
+
+    worker, consumer, gateway, gw_port, teardown = await _topology()
+    try:
+        await _wait_for(
+            lambda: any(p.peer_id == worker.peer_id
+                        for p in consumer.peer_manager.get_healthy_peers()),
+            what="discovery",
+        )
+        async with aiohttp.ClientSession() as http:
+            async with http.post(
+                f"http://127.0.0.1:{gw_port}/api/pull",
+                json={"model": "tiny-test", "stream": False},
+            ) as resp:
+                assert resp.status == 200
+                assert (await resp.json())["status"] == "success"
+    finally:
+        await teardown()
