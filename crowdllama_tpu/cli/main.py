@@ -285,6 +285,13 @@ async def run_node(cfg: Configuration, worker_mode: bool) -> None:
     finally:
         log.info("shutting down")
         stats.cancel()
+        # Graceful drain: stop advertising first (the swarm fails over to
+        # other workers), finish in-flight requests, then tear down.
+        await peer.stop_advertising()
+        drained = await engine.drain(cfg.drain_timeout)
+        if not drained:
+            log.warning("drain timed out after %.0fs; dropping in-flight "
+                        "requests", cfg.drain_timeout)
         if ipc is not None:
             await ipc.stop()
         if gateway is not None:

@@ -108,6 +108,7 @@ class Configuration:
     kv_prefix_cache: bool = True  # paged layout: share prompt-prefix pages
     spec_decode: str = ""  # "" | "ngram" speculative decode (engine/spec.py)
     spec_draft: int = 4  # draft tokens per verify step
+    drain_timeout: float = 30.0  # graceful-shutdown grace for in-flight reqs
     # Directory for jax.profiler traces; empty disables the profile surface
     # (SURVEY §5: "TPU build: JAX profiler traces + per-request timing").
     profile_dir: str = ""
@@ -166,6 +167,8 @@ class Configuration:
                                   cfg.spec_decode)
         cfg.spec_draft = int(env.get("CROWDLLAMA_TPU_SPEC_DRAFT",
                                      cfg.spec_draft))
+        cfg.drain_timeout = float(env.get("CROWDLLAMA_TPU_DRAIN_TIMEOUT",
+                                          cfg.drain_timeout))
         cfg.profile_dir = env.get("CROWDLLAMA_TPU_PROFILE_DIR", cfg.profile_dir)
         if env.get("CROWDLLAMA_TPU_WARMUP"):
             cfg.warmup = env["CROWDLLAMA_TPU_WARMUP"] in ("1", "true")

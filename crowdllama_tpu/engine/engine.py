@@ -46,6 +46,10 @@ class Engine:
     async def start(self) -> None: ...
     async def stop(self) -> None: ...
 
+    async def drain(self, timeout: float = 30.0) -> bool:
+        """Finish in-flight work before shutdown; True when drained."""
+        return True
+
     def attach_peer(self, peer) -> None:
         """Called by Peer.start() so engines that talk to the swarm (e.g.
         ShardedEngine's group leader) can reach the host/DHT/peer manager."""
@@ -246,6 +250,12 @@ class JaxEngine(Engine):
             pass
         state = r.release(state, 0)
         log.info("warmup compile done")
+
+    async def drain(self, timeout: float = 30.0) -> bool:
+        """Finish in-flight requests before shutdown; False on timeout."""
+        if self.scheduler is None:
+            return True
+        return await self.scheduler.drain(timeout)
 
     async def stop(self) -> None:
         if self.scheduler is not None:

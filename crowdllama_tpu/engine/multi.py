@@ -56,6 +56,11 @@ class MultiEngine(Engine):
         await asyncio.gather(*(e.stop() for e in self._engines.values()),
                              return_exceptions=True)
 
+    async def drain(self, timeout: float = 30.0) -> bool:
+        results = await asyncio.gather(
+            *(e.drain(timeout) for e in self._engines.values()))
+        return all(results)
+
     def attach_peer(self, peer) -> None:
         for eng in self._engines.values():
             eng.attach_peer(peer)

@@ -43,7 +43,7 @@ log = logging.getLogger("crowdllama.engine.scheduler")
 _DONE = object()
 
 
-@dataclass
+@dataclass(eq=False)  # identity semantics (slot/queue tracking, WeakSet)
 class GenRequest:
     prompt_ids: list[int]
     max_tokens: int = 128
@@ -91,6 +91,14 @@ class Scheduler:
         self._rng = jax.random.PRNGKey(int(time.time()) & 0x7FFFFFFF)
         self._inflight: _InFlightChunk | None = None
         self._last_retire_at = 0.0
+        self._admitting = 0  # popped from pending, not yet in a slot
+        self._draining = False
+        # Requests whose output queues drain must also see consumed (the
+        # consumer may still be flushing final frames to the client after
+        # the slot retires); weak so retired requests don't accumulate.
+        import weakref
+
+        self._tracked: "weakref.WeakSet[GenRequest]" = weakref.WeakSet()
         # Telemetry for Resource advertisement + /api/health.
         self.tokens_generated = 0
         self.throughput_ema = 0.0  # tokens/sec across the batch
@@ -99,6 +107,7 @@ class Scheduler:
     # ---------------------------------------------------------------- public
 
     def start(self) -> None:
+        self._draining = False
         if self._exec is None:  # restarted after stop(): fresh dispatcher
             self._exec = ThreadPoolExecutor(max_workers=1,
                                             thread_name_prefix="jax-dispatch")
@@ -118,13 +127,22 @@ class Scheduler:
             self._exec = None
 
     async def submit(self, req: GenRequest) -> None:
+        if self._draining:
+            # Shutting down: reject so the caller's error surfaces quickly
+            # and the gateway fails over to another worker, instead of
+            # accepting work we would hard-drop at the drain deadline.
+            raise RuntimeError("worker is draining for shutdown")
         if len(req.prompt_ids) >= self.runner.max_seq:
             raise ValueError(
                 f"prompt of {len(req.prompt_ids)} tokens exceeds max context "
                 f"{self.runner.max_seq}"
             )
         await self.pending.put(req)
+        self._track(req)
         self._wake.set()
+
+    def _track(self, req: GenRequest) -> None:
+        self._tracked.add(req)
 
     def cancel(self, req: GenRequest) -> None:
         """Stop generating for a request whose client went away.
@@ -141,6 +159,30 @@ class Scheduler:
         """
         req.cancelled = True
         self._wake.set()
+
+    async def drain(self, timeout: float = 30.0) -> bool:
+        """Wait for every admitted and pending request to finish (graceful
+        shutdown); True when fully drained, False on timeout.
+
+        Entering drain rejects new submissions (callers fail over).
+        ``_admitting`` covers the popped-but-not-yet-inserted window (a
+        request mid-prefill is in neither pending nor slots); tracked
+        output queues cover the retire-to-client-flush window — the
+        consumer coroutine may still be writing final frames after the
+        slot clears.  Cancelled requests' queues are exempt (no consumer).
+        """
+        self._draining = True
+        deadline = time.monotonic() + timeout
+        while True:
+            done = (all(s is None for s in self.slots)
+                    and self.pending.empty() and self._admitting == 0
+                    and all(r.out.empty() or r.cancelled
+                            for r in list(self._tracked)))
+            if done:
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            await asyncio.sleep(0.1)
 
     @property
     def load(self) -> float:
@@ -236,36 +278,13 @@ class Scheduler:
         # per iteration once any slot is decoding, so a burst of long prompts
         # interleaves with decode chunks instead of freezing token streaming
         # for every active request until the whole queue is prefilled.
-        while not self.pending.empty():
-            slot = self._free_slot()
-            if slot is None:
-                break
-            req = self.pending.get_nowait()
-            if req.cancelled:
-                continue
-            try:
-                await self._admit_one(req, slot)
-            except ValueError as e:  # bad request (too long, etc.)
-                log.warning("admit failed: %s", e)
-                req.out.put_nowait((_DONE, f"error: {e}"))
-                continue
-            except BaseException:
-                # Engine failure mid-admission: the popped request is in
-                # neither slots nor pending, so _loop's recovery would miss
-                # it — fail it here, then let the recovery reset state.
-                req.out.put_nowait((_DONE, "error: engine failure"))
-                raise
-            if sum(1 for s in self.slots if s is not None) > 1:
-                break
-
-        if all(s is None for s in self.slots) and self._inflight is None:
-            return
-
         loop = asyncio.get_running_loop()
 
         # Dispatch the NEXT chunk before reading back the previous one: the
         # dispatch is async (device-side queue), so the previous chunk's
-        # readback + emit below overlap this chunk's compute.
+        # readback + emit below overlap this chunk's compute.  Dispatching
+        # BEFORE admission also lets this chunk execute while a long
+        # prefill runs — the dominant decode stall under prompt bursts.
         dispatched: _InFlightChunk | None = None
         if any(s is not None for s in self.slots):
             k = self._chunk_size()
@@ -302,7 +321,33 @@ class Scheduler:
                     tokens_dev=tokens_dev, snapshot=list(self.slots),
                     dispatched_at=time.monotonic())
 
-        # Retire the PREVIOUS chunk (readback overlaps the new dispatch).
+        while not self.pending.empty():
+            slot = self._free_slot()
+            if slot is None:
+                break
+            req = self.pending.get_nowait()
+            if req.cancelled:
+                continue
+            self._admitting += 1
+            try:
+                await self._admit_one(req, slot)
+            except ValueError as e:  # bad request (too long, etc.)
+                log.warning("admit failed: %s", e)
+                req.out.put_nowait((_DONE, f"error: {e}"))
+                continue
+            except BaseException:
+                # Engine failure mid-admission: the popped request is in
+                # neither slots nor pending, so _loop's recovery would miss
+                # it — fail it here, then let the recovery reset state.
+                req.out.put_nowait((_DONE, "error: engine failure"))
+                raise  # the dispatched chunk is dropped; recovery resets state
+            finally:
+                self._admitting -= 1
+            if sum(1 for s in self.slots if s is not None) > 1:
+                break
+
+        # Retire the PREVIOUS chunk (readback overlaps the new dispatch and
+        # any prefill above).
         await self._retire_inflight(loop)
         self._inflight = dispatched
         # Yield so submitters/streamers run between chunks.

@@ -111,6 +111,7 @@ class ShardedEngine(Engine):
         self._pipeline_lock = asyncio.Lock()
         self._sem: asyncio.Semaphore | None = None
         self._active = 0
+        self._draining = False
         self._tput_ema = 0.0
         self._rng = np.random.default_rng(0)
 
@@ -195,6 +196,21 @@ class ShardedEngine(Engine):
         self.runner = (EPLeaderRunner(self.cfg, params,
                                       max_seq=self.cfg.max_context_length)
                        if self.is_leader else None)
+
+    async def drain(self, timeout: float = 30.0) -> bool:
+        """Wait for in-flight sharded generations before shutdown (the
+        pipeline streams close at stop(), severing anything still active);
+        new generations are rejected so clients fail over."""
+        import time as _time
+
+        self._draining = True
+        deadline = _time.monotonic() + timeout
+        while True:
+            if self._active == 0:
+                return True
+            if _time.monotonic() >= deadline:
+                return False
+            await asyncio.sleep(0.1)
 
     async def stop(self) -> None:
         async with self._pipeline_lock:
@@ -317,6 +333,8 @@ class ShardedEngine(Engine):
             raise RuntimeError(
                 f"shard member {self.shard_index} of {self.group_id} does not "
                 "serve requests; the group leader routes")
+        if self._draining:
+            raise RuntimeError("worker is draining for shutdown")
         if model and model not in self.models:
             raise ValueError(f"model {model!r} not served (have {self.models})")
 

@@ -170,7 +170,12 @@ class Peer:
                  "worker" if self.worker_mode else "consumer",
                  self.host.contact.addr)
 
-    async def stop(self) -> None:
+    async def stop_advertising(self) -> None:
+        """Stop the publish/advertise/refresh loops without closing streams.
+
+        The graceful-shutdown first step: the swarm stops learning about
+        this peer (provider records TTL out, metadata goes stale, health
+        probes fail over) while in-flight requests keep being served."""
         for t in self._tasks:
             t.cancel()
         for t in self._tasks:
@@ -179,6 +184,9 @@ class Peer:
             except asyncio.CancelledError:
                 pass
         self._tasks = []
+
+    async def stop(self) -> None:
+        await self.stop_advertising()
         if self.peer_manager is not None:
             await self.peer_manager.stop()
         if self.dht is not None:

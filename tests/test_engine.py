@@ -329,3 +329,72 @@ async def test_client_disconnect_frees_slot():
         assert req3.out.empty()
     finally:
         await sched.stop()
+
+
+async def test_scheduler_drain():
+    from crowdllama_tpu.engine.runner import ModelRunner
+    from crowdllama_tpu.engine.scheduler import Scheduler, GenRequest, DONE
+    from crowdllama_tpu.models.config import get_config
+
+    cfg = get_config("tiny-test", max_context_length=128)
+    runner = ModelRunner(cfg, max_slots=2, max_seq=128)
+    sched = Scheduler(runner, decode_chunk=2)
+    sched.start()
+    try:
+        req = GenRequest(prompt_ids=[1, 2], max_tokens=6, eos_id=-1)
+        await sched.submit(req)
+
+        async def consume():
+            got_done = False
+            while True:
+                tok, reason = await asyncio.wait_for(req.out.get(), 60)
+                if tok is DONE:
+                    return True
+        consumer = asyncio.create_task(consume())
+        assert await asyncio.wait_for(sched.drain(60), 90) is True
+        # Drained means the request completed AND its stream was consumed.
+        assert await consumer is True
+        # A draining scheduler rejects new work so clients fail over.
+        try:
+            await sched.submit(GenRequest(prompt_ids=[9], max_tokens=1))
+            raise AssertionError("submit during drain should raise")
+        except RuntimeError:
+            pass
+    finally:
+        await sched.stop()
+
+    # Timeout path: a runner too slow to finish within the grace reports
+    # False (tiny models finish 100k tokens in under the shortest useful
+    # timeout, so use a deliberately slow fake).
+    import time as _time
+
+    class _Slow:
+        max_slots = 1
+        max_seq = 10_000
+
+        def init_state(self):
+            return {}
+
+        def prefill(self, ids, temp, top_p, key, state=None):
+            return 5, None, None, len(ids)
+
+        def insert(self, state, slot, ks, vs, plen, tok, t, p,
+                   prompt_tokens=None):
+            return state
+
+        def release(self, state, slot):
+            return state
+
+        def decode_steps_device(self, state, k):
+            _time.sleep(0.2)
+            return np.zeros((k, 1), np.int32), state
+
+    slow = Scheduler(_Slow(), decode_chunk=1)
+    slow.start()
+    try:
+        req2 = GenRequest(prompt_ids=[3], max_tokens=100_000, eos_id=-1)
+        await slow.submit(req2)
+        await asyncio.wait_for(req2.out.get(), 30)
+        assert await slow.drain(0.5) is False
+    finally:
+        await slow.stop()
