@@ -74,6 +74,8 @@ jax.tree_util.register_dataclass(
 class PagedModelRunner(ModelRunner):
     """ModelRunner with the paged KV layout (same serving surface)."""
 
+    prefill_chunk = 0  # chunked admission disabled (see ModelRunner note)
+
     def __init__(self, cfg, *args, page_size: int = 128, pool_tokens: int = 0,
                  prefix_cache: bool = True, **kwargs):
         # Default mesh: tp-only.  The auto-chooser spills spare devices to

@@ -144,6 +144,11 @@ class ModelRunner:
         if self.kv_dtype == "int8":
             assert self.sp == 1 and self.pp == 1, (
                 "int8 KV cache does not compose with sp/pp meshes yet")
+        if self.pp > 1 or self.sp > 1:
+            # Chunked admission's _prefill_chunk runs the plain layer scan;
+            # pp needs pp_prefill and sp needs ring attention — keep those
+            # meshes on monolithic prefill.
+            self.prefill_chunk = 0
 
         if params is None:
             params = T.init_params(cfg, jax.random.PRNGKey(seed), dtype=dtype)
@@ -315,6 +320,92 @@ class ModelRunner:
             if n <= b:
                 return b
         raise ValueError(f"prompt length {n} exceeds max_seq {self.max_seq}")
+
+    # ------------------------------------------------------- chunked prefill
+
+    #: scheduler switches to incremental admission above this prompt length;
+    #: 0 disables (paged runners: a chunked job accumulates a full-length KV
+    #: buffer, defeating the page pool — they keep monolithic prefill +
+    #: prefix cache)
+    prefill_chunk = 512
+
+    class PrefillJob:
+        """Host handle for an in-progress chunked prefill.
+
+        Device state: accumulated KV buffers [L, 1, Hkv, S, Dh] (the
+        prompt's prefix so far) and the running last-logits row.  The
+        scheduler dispatches one chunk per decode-loop iteration, so token
+        streaming stalls at most one chunk — not the whole prompt.
+        """
+
+        def __init__(self, prompt_ids, ctx_k, ctx_v):
+            self.prompt_ids = prompt_ids
+            self.done_tokens = 0
+            self.ctx_k = ctx_k
+            self.ctx_v = ctx_v
+            self.last_logits = None
+
+        @property
+        def finished(self) -> bool:
+            return self.done_tokens >= len(self.prompt_ids)
+
+    def prefill_begin(self, prompt_ids: list[int]) -> "ModelRunner.PrefillJob":
+        if len(prompt_ids) >= self.max_seq:
+            raise ValueError(
+                f"prompt of {len(prompt_ids)} tokens exceeds max context "
+                f"{self.max_seq}")
+        l, hkv, dh = (self.cfg.num_layers, self.cfg.num_kv_heads,
+                      self.cfg.resolved_head_dim())
+        shape = (l, 1, hkv, self.max_seq, dh)
+        return self.PrefillJob(
+            list(prompt_ids),
+            jax.device_put(jnp.zeros(shape, self.dtype),
+                           self._prefill_kv_sharding),
+            jax.device_put(jnp.zeros(shape, self.dtype),
+                           self._prefill_kv_sharding),
+        )
+
+    def prefill_step(self, job: "ModelRunner.PrefillJob") -> bool:
+        """Run ONE chunk of the job's prompt; True when the prompt is done."""
+        chunk_ids = job.prompt_ids[
+            job.done_tokens:job.done_tokens + self.prefill_chunk]
+        bucket = min(self.bucket_for(len(chunk_ids)), self.prefill_chunk)
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :len(chunk_ids)] = chunk_ids
+        job.last_logits, job.ctx_k, job.ctx_v = self._prefill_chunk(
+            self.params, jnp.asarray(tokens), jnp.int32(len(chunk_ids)),
+            jnp.int32(job.done_tokens), job.ctx_k, job.ctx_v)
+        job.done_tokens += len(chunk_ids)
+        return job.finished
+
+    @partial(jax.jit, static_argnums=0, donate_argnums=(5, 6))
+    def _prefill_chunk(self, params, tokens, chunk_len, ctx_len, ctx_k, ctx_v):
+        t = tokens.shape[1]
+        positions = ctx_len + jnp.minimum(jnp.arange(t)[None, :],
+                                          chunk_len - 1)
+        kv_valid = (jnp.arange(t) < chunk_len)[None, :]
+        ctx_valid = (jnp.arange(self.max_seq) < ctx_len)[None, :]
+        logits, ks, vs = T.prefill(params, self.cfg, tokens, positions,
+                                   kv_valid=kv_valid,
+                                   ctx_k=ctx_k, ctx_v=ctx_v,
+                                   ctx_valid=ctx_valid)
+        # Append this chunk's KV to the accumulators.  Bucket padding rows
+        # beyond chunk_len land past the valid region and are either
+        # overwritten by the next chunk or masked by seq_lens forever.
+        ctx_k = jax.lax.dynamic_update_slice(
+            ctx_k, ks.astype(ctx_k.dtype), (0, 0, 0, ctx_len, 0))
+        ctx_v = jax.lax.dynamic_update_slice(
+            ctx_v, vs.astype(ctx_v.dtype), (0, 0, 0, ctx_len, 0))
+        return logits[0, chunk_len - 1], ctx_k, ctx_v  # [V]
+
+    def prefill_finish(self, job: "ModelRunner.PrefillJob", temperature: float,
+                       top_p: float, key: jax.Array):
+        """Sample the first token; returns (tok, ks, vs, plen) like prefill."""
+        assert job.finished and job.last_logits is not None
+        tok = sample_tokens(job.last_logits[None, :],
+                            jnp.float32(temperature)[None],
+                            jnp.float32(top_p)[None], key)[0]
+        return int(tok), job.ctx_k, job.ctx_v, len(job.prompt_ids)
 
     def prefill(self, prompt_ids: list[int], temperature: float, top_p: float,
                 key: jax.Array, state: DecodeState | None = None):

@@ -92,6 +92,10 @@ class Scheduler:
         self._inflight: _InFlightChunk | None = None
         self._last_retire_at = 0.0
         self._admitting = 0  # popped from pending, not yet in a slot
+        # In-progress chunked admission: (req, slot, PrefillJob).  One chunk
+        # runs per loop iteration so decode chunks interleave with a long
+        # prompt's prefill instead of stalling behind all of it.
+        self._chunking: tuple[GenRequest, int, object] | None = None
         self._draining = False
         # Requests whose output queues drain must also see consumed (the
         # consumer may still be flushing final frames to the client after
@@ -207,6 +211,12 @@ class Scheduler:
                 self.runner.prefill, req.prompt_ids, req.temperature,
                 req.top_p, sub, state=self.state),
         )
+        self._place(req, slot, ks, vs, plen, first)
+
+    def _place(self, req: GenRequest, slot: int, ks, vs, plen: int,
+               first: int) -> None:
+        """Insert a prefilled request into its slot and emit its first
+        token (shared by monolithic and chunked admission)."""
         self.state = self.runner.insert(
             self.state, slot, ks, vs, plen, first, req.temperature,
             req.top_p, prompt_tokens=req.prompt_ids,
@@ -249,6 +259,14 @@ class Scheduler:
                 # in-flight request, reset device state, keep the loop alive.
                 log.exception("decode loop error; failing in-flight requests")
                 self._inflight = None  # its slots are failed below anyway
+                if self._chunking is not None:
+                    # Mid-chunked-admission request is in neither pending
+                    # nor slots — fail it here (unless its own chunk step
+                    # already did, which clears _chunking before raising).
+                    creq, _, _ = self._chunking
+                    self._chunking = None
+                    self._admitting -= 1
+                    creq.out.put_nowait((_DONE, "error: engine failure"))
                 for i, info in enumerate(self.slots):
                     if info is not None:
                         info.req.out.put_nowait((_DONE, "error: engine failure"))
@@ -259,9 +277,10 @@ class Scheduler:
                 self.state = self.runner.init_state()
 
     async def _loop_once(self) -> None:
-        # Idle: wait for work (an undrained in-flight chunk is work).
+        # Idle: wait for work (an undrained in-flight chunk or an
+        # in-progress chunked admission is work).
         if (all(s is None for s in self.slots) and self.pending.empty()
-                and self._inflight is None):
+                and self._inflight is None and self._chunking is None):
             self._wake.clear()
             await self._wake.wait()
 
@@ -321,7 +340,28 @@ class Scheduler:
                     tokens_dev=tokens_dev, snapshot=list(self.slots),
                     dispatched_at=time.monotonic())
 
-        while not self.pending.empty():
+        # Advance an in-progress chunked admission by ONE prefill chunk.
+        if self._chunking is not None:
+            req, slot, job = self._chunking
+            try:
+                if req.cancelled:
+                    self._chunking = None
+                elif await asyncio.get_running_loop().run_in_executor(
+                        self._exec, self.runner.prefill_step, job):
+                    self._chunking = None
+                    self._rng, sub = jax.random.split(self._rng)
+                    first, ks, vs, plen = self.runner.prefill_finish(
+                        job, req.temperature, req.top_p, sub)
+                    self._place(req, slot, ks, vs, plen, first)
+            except BaseException:
+                self._chunking = None
+                req.out.put_nowait((_DONE, "error: engine failure"))
+                raise
+            finally:
+                if self._chunking is None:
+                    self._admitting -= 1
+
+        while self._chunking is None and not self.pending.empty():
             slot = self._free_slot()
             if slot is None:
                 break
@@ -329,6 +369,19 @@ class Scheduler:
             if req.cancelled:
                 continue
             self._admitting += 1
+            chunk = getattr(self.runner, "prefill_chunk", 0)
+            if chunk and len(req.prompt_ids) > chunk:
+                # Long prompt: admit incrementally, one chunk per loop
+                # iteration (decode keeps streaming in between).
+                try:
+                    job = self.runner.prefill_begin(req.prompt_ids)
+                except ValueError as e:
+                    log.warning("admit failed: %s", e)
+                    req.out.put_nowait((_DONE, f"error: {e}"))
+                    self._admitting -= 1
+                    continue
+                self._chunking = (req, slot, job)
+                break
             try:
                 await self._admit_one(req, slot)
             except ValueError as e:  # bad request (too long, etc.)

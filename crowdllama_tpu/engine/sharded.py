@@ -200,13 +200,24 @@ class ShardedEngine(Engine):
     async def drain(self, timeout: float = 30.0) -> bool:
         """Wait for in-flight sharded generations before shutdown (the
         pipeline streams close at stop(), severing anything still active);
-        new generations are rejected so clients fail over."""
+        new generations are rejected so clients fail over.
+
+        Leaders wait on their own request count; members also wait for the
+        leader's live KV sessions hosted here (shard_service) to release —
+        stopping a member mid-pipeline kills the leader's stream."""
         import time as _time
 
         self._draining = True
         deadline = _time.monotonic() + timeout
         while True:
-            if self._active == 0:
+            member_sessions = 0
+            svc = self.shard_service
+            if svc is not None:
+                counter = getattr(getattr(svc, "runner", None),
+                                  "session_count", None)
+                if counter is not None:
+                    member_sessions = counter() if callable(counter) else counter
+            if self._active == 0 and member_sessions == 0:
                 return True
             if _time.monotonic() >= deadline:
                 return False
