@@ -272,6 +272,13 @@ class JaxEngine(Engine):
                 "misses": self._runner.prefix_misses,
                 "tokens_reused": self._runner.prefix_tokens_reused,
             }
+        if self.scheduler is not None and self.scheduler.spec_steps:
+            d["spec_decode"] = {
+                "verify_steps": self.scheduler.spec_steps,
+                "tokens_emitted": self.scheduler.spec_emitted,
+                "tokens_per_step": round(
+                    self.scheduler.spec_emitted / self.scheduler.spec_steps, 2),
+            }
         return d
 
     async def capture_profile(self, seconds: float = 3.0) -> str:

@@ -356,7 +356,11 @@ class ModelRunner:
                 f"{self.max_seq}")
         l, hkv, dh = (self.cfg.num_layers, self.cfg.num_kv_heads,
                       self.cfg.resolved_head_dim())
-        shape = (l, 1, hkv, self.max_seq, dh)
+        # Accumulators sized to the PROMPT's bucket, not max_seq: a 600-token
+        # prompt on a 32k-context model must not allocate (or attend over)
+        # 32k-wide context buffers.
+        width = self.bucket_for(len(prompt_ids))
+        shape = (l, 1, hkv, width, dh)
         return self.PrefillJob(
             list(prompt_ids),
             jax.device_put(jnp.zeros(shape, self.dtype),
@@ -367,15 +371,24 @@ class ModelRunner:
 
     def prefill_step(self, job: "ModelRunner.PrefillJob") -> bool:
         """Run ONE chunk of the job's prompt; True when the prompt is done."""
-        chunk_ids = job.prompt_ids[
-            job.done_tokens:job.done_tokens + self.prefill_chunk]
-        bucket = min(self.bucket_for(len(chunk_ids)), self.prefill_chunk)
+        width = job.ctx_k.shape[3]
+        budget = width - job.done_tokens  # write room left in the buffers
+        take = min(self.prefill_chunk, len(job.prompt_ids) - job.done_tokens)
+        bucket = min(self.bucket_for(take), self.prefill_chunk)
+        if bucket > budget:
+            # Non-power-of-two max_seq tail: a bucket-sized write would
+            # CLAMP in dynamic_update_slice and corrupt earlier KV.  Shrink
+            # to the largest bucket that fits, or the exact remainder.
+            fitting = [b for b in self.buckets if b <= budget]
+            bucket = fitting[-1] if fitting else budget
+            take = min(take, bucket)
         tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, :len(chunk_ids)] = chunk_ids
+        tokens[0, :take] = job.prompt_ids[
+            job.done_tokens:job.done_tokens + take]
         job.last_logits, job.ctx_k, job.ctx_v = self._prefill_chunk(
-            self.params, jnp.asarray(tokens), jnp.int32(len(chunk_ids)),
+            self.params, jnp.asarray(tokens), jnp.int32(take),
             jnp.int32(job.done_tokens), job.ctx_k, job.ctx_v)
-        job.done_tokens += len(chunk_ids)
+        job.done_tokens += take
         return job.finished
 
     @partial(jax.jit, static_argnums=0, donate_argnums=(5, 6))
@@ -384,7 +397,7 @@ class ModelRunner:
         positions = ctx_len + jnp.minimum(jnp.arange(t)[None, :],
                                           chunk_len - 1)
         kv_valid = (jnp.arange(t) < chunk_len)[None, :]
-        ctx_valid = (jnp.arange(self.max_seq) < ctx_len)[None, :]
+        ctx_valid = (jnp.arange(ctx_k.shape[3]) < ctx_len)[None, :]
         logits, ks, vs = T.prefill(params, self.cfg, tokens, positions,
                                    kv_valid=kv_valid,
                                    ctx_k=ctx_k, ctx_v=ctx_v,
@@ -392,6 +405,7 @@ class ModelRunner:
         # Append this chunk's KV to the accumulators.  Bucket padding rows
         # beyond chunk_len land past the valid region and are either
         # overwritten by the next chunk or masked by seq_lens forever.
+        # prefill_step guarantees ctx_len + T <= width (no clamping).
         ctx_k = jax.lax.dynamic_update_slice(
             ctx_k, ks.astype(ctx_k.dtype), (0, 0, 0, ctx_len, 0))
         ctx_v = jax.lax.dynamic_update_slice(

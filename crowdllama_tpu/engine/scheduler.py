@@ -41,6 +41,9 @@ from crowdllama_tpu.engine.runner import ModelRunner
 log = logging.getLogger("crowdllama.engine.scheduler")
 
 _DONE = object()
+# Slot sentinel: reserved for an in-progress chunked admission — occupied
+# (skipped by _free_slot) but carrying no request yet.
+_RESERVED = object()
 
 
 @dataclass(eq=False)  # identity semantics (slot/queue tracking, WeakSet)
@@ -96,6 +99,11 @@ class Scheduler:
         # runs per loop iteration so decode chunks interleave with a long
         # prompt's prefill instead of stalling behind all of it.
         self._chunking: tuple[GenRequest, int, object] | None = None
+        import collections
+
+        # Long prompts popped while another chunked admission is running
+        # (kept FIFO ahead of pending).
+        self._deferred: collections.deque[GenRequest] = collections.deque()
         self._draining = False
         # Requests whose output queues drain must also see consumed (the
         # consumer may still be flushing final frames to the client after
@@ -107,6 +115,8 @@ class Scheduler:
         self.tokens_generated = 0
         self.throughput_ema = 0.0  # tokens/sec across the batch
         self.requests_served = 0
+        self.spec_steps = 0    # speculative verify dispatches retired
+        self.spec_emitted = 0  # tokens those dispatches emitted
 
     # ---------------------------------------------------------------- public
 
@@ -180,6 +190,7 @@ class Scheduler:
         while True:
             done = (all(s is None for s in self.slots)
                     and self.pending.empty() and self._admitting == 0
+                    and not self._deferred
                     and all(r.out.empty() or r.cancelled
                             for r in list(self._tracked)))
             if done:
@@ -268,9 +279,12 @@ class Scheduler:
                     self._admitting -= 1
                     creq.out.put_nowait((_DONE, "error: engine failure"))
                 for i, info in enumerate(self.slots):
-                    if info is not None:
+                    if isinstance(info, _SlotInfo):
                         info.req.out.put_nowait((_DONE, "error: engine failure"))
-                        self.slots[i] = None
+                    self.slots[i] = None
+                while self._deferred:
+                    self._deferred.popleft().out.put_nowait(
+                        (_DONE, "error: engine failure"))
                 while not self.pending.empty():
                     self.pending.get_nowait().out.put_nowait(
                         (_DONE, "error: engine failure"))
@@ -280,7 +294,8 @@ class Scheduler:
         # Idle: wait for work (an undrained in-flight chunk or an
         # in-progress chunked admission is work).
         if (all(s is None for s in self.slots) and self.pending.empty()
-                and self._inflight is None and self._chunking is None):
+                and self._inflight is None and self._chunking is None
+                and not self._deferred):
             self._wake.clear()
             await self._wake.wait()
 
@@ -288,7 +303,7 @@ class Scheduler:
         # release can never donate buffers out from under a dispatch, and
         # the slot stays occupied (unreusable) until exactly here.
         for i, info in enumerate(self.slots):
-            if info is not None and info.req.cancelled:
+            if isinstance(info, _SlotInfo) and info.req.cancelled:
                 self.slots[i] = None
                 self.state = self.runner.release(self.state, i)
                 self.requests_served += 1
@@ -305,7 +320,7 @@ class Scheduler:
         # BEFORE admission also lets this chunk execute while a long
         # prefill runs — the dominant decode stall under prompt bursts.
         dispatched: _InFlightChunk | None = None
-        if any(s is not None for s in self.slots):
+        if any(isinstance(s, _SlotInfo) for s in self.slots):
             k = self._chunk_size()
             # Paged-KV runners grow page tables before the chunk; slots an
             # overcommitted pool cannot grow finish with "length" (their
@@ -332,7 +347,7 @@ class Scheduler:
                         self.requests_served += 1
                     self.state = self.runner.release(self.state, slot)
                     starved = check(k)
-            if any(s is not None for s in self.slots):
+            if any(isinstance(s, _SlotInfo) for s in self.slots):
                 tokens_dev, self.state = await loop.run_in_executor(
                     self._exec, self.runner.decode_steps_device,
                     self.state, k)  # [K,B] on device
@@ -346,42 +361,59 @@ class Scheduler:
             try:
                 if req.cancelled:
                     self._chunking = None
-                elif await asyncio.get_running_loop().run_in_executor(
+                    self.slots[slot] = None  # release the reservation
+                elif await loop.run_in_executor(
                         self._exec, self.runner.prefill_step, job):
                     self._chunking = None
                     self._rng, sub = jax.random.split(self._rng)
-                    first, ks, vs, plen = self.runner.prefill_finish(
-                        job, req.temperature, req.top_p, sub)
+                    import functools
+
+                    first, ks, vs, plen = await loop.run_in_executor(
+                        self._exec, functools.partial(
+                            self.runner.prefill_finish, job,
+                            req.temperature, req.top_p, sub))
                     self._place(req, slot, ks, vs, plen, first)
             except BaseException:
                 self._chunking = None
+                self.slots[slot] = None
                 req.out.put_nowait((_DONE, "error: engine failure"))
                 raise
             finally:
                 if self._chunking is None:
                     self._admitting -= 1
 
-        while self._chunking is None and not self.pending.empty():
+        while True:
             slot = self._free_slot()
             if slot is None:
                 break
-            req = self.pending.get_nowait()
+            if self._deferred:
+                req = self._deferred.popleft()
+            elif not self.pending.empty():
+                req = self.pending.get_nowait()
+            else:
+                break
             if req.cancelled:
                 continue
-            self._admitting += 1
             chunk = getattr(self.runner, "prefill_chunk", 0)
             if chunk and len(req.prompt_ids) > chunk:
+                if self._chunking is not None:
+                    # One chunked admission at a time; keep FIFO order.
+                    self._deferred.append(req)
+                    break
                 # Long prompt: admit incrementally, one chunk per loop
-                # iteration (decode keeps streaming in between).
+                # iteration (decode keeps streaming in between).  The slot
+                # is RESERVED so short requests can still fill the others.
                 try:
                     job = self.runner.prefill_begin(req.prompt_ids)
                 except ValueError as e:
                     log.warning("admit failed: %s", e)
                     req.out.put_nowait((_DONE, f"error: {e}"))
-                    self._admitting -= 1
                     continue
+                self._admitting += 1
                 self._chunking = (req, slot, job)
-                break
+                self.slots[slot] = _RESERVED
+                continue
+            self._admitting += 1
             try:
                 await self._admit_one(req, slot)
             except ValueError as e:  # bad request (too long, etc.)
@@ -396,7 +428,7 @@ class Scheduler:
                 raise  # the dispatched chunk is dropped; recovery resets state
             finally:
                 self._admitting -= 1
-            if sum(1 for s in self.slots if s is not None) > 1:
+            if sum(1 for s in self.slots if isinstance(s, _SlotInfo)) > 1:
                 break
 
         # Retire the PREVIOUS chunk (readback overlaps the new dispatch and
@@ -423,7 +455,7 @@ class Scheduler:
                 # request they were dispatched for — a slot retired
                 # mid-chunk (EOS overshoot) or retired-and-readmitted
                 # since dispatch is skipped.
-                if info is None or self.slots[i] is not info:
+                if not isinstance(info, _SlotInfo) or self.slots[i] is not info:
                     continue
                 if tokens.ndim == 3:
                     # Speculative packed layout [K, 1+J, B] (engine/spec.py):
@@ -437,6 +469,12 @@ class Scheduler:
                 else:
                     self._emit(info.req, int(tokens[step, i]), info)
                     emitted += 1
+        if tokens.ndim == 3:
+            # Acceptance telemetry: emitted / (verify steps × live slots)
+            # ≈ tokens per dispatch the speculation is buying.
+            self.spec_steps += tokens.shape[0] * max(
+                1, sum(1 for s in fl.snapshot if isinstance(s, _SlotInfo)))
+            self.spec_emitted += emitted
         if emitted == 0:
             # Pure-overshoot chunk (dispatched before its slots' EOS was
             # discovered): not a throughput sample, don't drag the EMA down.

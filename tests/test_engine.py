@@ -465,3 +465,40 @@ async def test_chunked_admission_end_to_end():
     chun, r2 = await serve(True)
     assert r1 == r2 == "length"
     assert mono == chun, (mono, chun)
+
+
+async def test_short_requests_interleave_with_chunked_admission():
+    """A short prompt submitted AFTER a long one must finish first: chunked
+    admission reserves one slot and leaves the rest admitting."""
+    import time as _time
+
+    import jax.numpy as jnp
+    from crowdllama_tpu.engine.runner import ModelRunner
+    from crowdllama_tpu.engine.scheduler import DONE, GenRequest, Scheduler
+    from crowdllama_tpu.models.config import get_config
+
+    cfg = get_config("tiny-test", max_context_length=256)
+    r = ModelRunner(cfg, max_slots=2, max_seq=256, dtype=jnp.float32)
+    r.prefill_chunk = 32
+    sched = Scheduler(r, decode_chunk=2)
+    sched.start()
+    try:
+        rng = np.random.default_rng(7)
+        long_req = GenRequest(prompt_ids=rng.integers(1, 500, 200).tolist(),
+                              max_tokens=4, eos_id=-1)
+        short_req = GenRequest(prompt_ids=[1, 2, 3], max_tokens=4, eos_id=-1)
+        await sched.submit(long_req)
+        await sched.submit(short_req)
+
+        async def finish_time(req):
+            while True:
+                tok, _ = await asyncio.wait_for(req.out.get(), 120)
+                if tok is DONE:
+                    return _time.monotonic()
+
+        t_long, t_short = await asyncio.gather(finish_time(long_req),
+                                               finish_time(short_req))
+        assert t_short <= t_long, "short request waited behind chunked prefill"
+        assert sched.requests_served == 2
+    finally:
+        await sched.stop()

@@ -129,5 +129,9 @@ async def test_spec_engine_config_path():
             if c.done:
                 assert c.completion_tokens == 8
                 break
+        d = eng.describe()
+        # 8 completion tokens = 1 from prefill + >=7 from verify steps.
+        assert d["spec_decode"]["tokens_emitted"] >= 7
+        assert d["spec_decode"]["verify_steps"] > 0
     finally:
         await eng.stop()
