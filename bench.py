@@ -34,9 +34,38 @@ import numpy as np
 BASELINE_ADVERTISED_TOKS = 150.0  # reference worker's hardcoded claim
 
 
+def _wait_for_devices(budget_s: float = 300.0):
+    """The chip sits behind a network tunnel that occasionally drops and
+    needs minutes to recover; retry backend init instead of failing the
+    whole benchmark run on a transient."""
+    deadline = time.monotonic() + budget_s
+    delay = 5.0
+    while True:
+        try:
+            return jax.devices()
+        except RuntimeError as e:
+            if time.monotonic() >= deadline:
+                raise
+            print(f"# devices unavailable ({e}); retrying in {delay:.0f}s",
+                  file=sys.stderr)
+            try:
+                # Failed init is cached; reset it or the retry re-raises the
+                # stale error.  (jax.clear_backends was removed from the
+                # top-level API; jax.extend.backend carries it in jax 0.9.)
+                import jax.extend.backend as _jeb
+
+                _jeb.clear_backends()
+            except Exception as ce:
+                print(f"# clear_backends unavailable: {ce}", file=sys.stderr)
+            time.sleep(delay)
+            delay = min(delay * 2, 60.0)
+
+
 def main() -> None:
     from crowdllama_tpu.engine.runner import ModelRunner
     from crowdllama_tpu.models.config import get_config
+
+    _wait_for_devices()
 
     model = os.environ.get("CROWDLLAMA_BENCH_MODEL", "tinyllama-1.1b")
     slots = int(os.environ.get("CROWDLLAMA_BENCH_SLOTS", "8"))

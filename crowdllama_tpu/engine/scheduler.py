@@ -339,7 +339,7 @@ class Scheduler:
                 while starved:
                     slot = starved[0]
                     info = self.slots[slot]
-                    if info is not None:
+                    if isinstance(info, _SlotInfo):
                         log.warning(
                             "kv pool exhausted: finishing slot %d early", slot)
                         info.req.out.put_nowait((_DONE, "length"))
@@ -386,7 +386,11 @@ class Scheduler:
             slot = self._free_slot()
             if slot is None:
                 break
-            if self._deferred:
+            if self._deferred and self._chunking is None:
+                # Deferred long prompts only become admittable once the
+                # running chunked admission finishes; while it runs, fall
+                # through to pending so short requests keep admitting
+                # (no head-of-line blocking, no deque rotation).
                 req = self._deferred.popleft()
             elif not self.pending.empty():
                 req = self.pending.get_nowait()
@@ -397,9 +401,10 @@ class Scheduler:
             chunk = getattr(self.runner, "prefill_chunk", 0)
             if chunk and len(req.prompt_ids) > chunk:
                 if self._chunking is not None:
-                    # One chunked admission at a time; keep FIFO order.
+                    # One chunked admission at a time; park it and keep
+                    # admitting short requests from pending.
                     self._deferred.append(req)
-                    break
+                    continue
                 # Long prompt: admit incrementally, one chunk per loop
                 # iteration (decode keeps streaming in between).  The slot
                 # is RESERVED so short requests can still fill the others.

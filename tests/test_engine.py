@@ -502,3 +502,43 @@ async def test_short_requests_interleave_with_chunked_admission():
         assert sched.requests_served == 2
     finally:
         await sched.stop()
+
+
+async def test_deferred_long_prompts_keep_fifo_and_dont_block_shorts():
+    """Two long prompts + a short one: the short admits during the first
+    long's chunked prefill, and the longs complete in submission order."""
+    import time as _time
+
+    import jax.numpy as jnp
+    from crowdllama_tpu.engine.runner import ModelRunner
+    from crowdllama_tpu.engine.scheduler import DONE, GenRequest, Scheduler
+    from crowdllama_tpu.models.config import get_config
+
+    cfg = get_config("tiny-test", max_context_length=256)
+    r = ModelRunner(cfg, max_slots=4, max_seq=256, dtype=jnp.float32)
+    r.prefill_chunk = 32
+    sched = Scheduler(r, decode_chunk=2)
+    sched.start()
+    try:
+        rng = np.random.default_rng(8)
+        long1 = GenRequest(prompt_ids=rng.integers(1, 500, 180).tolist(),
+                           max_tokens=3, eos_id=-1)
+        long2 = GenRequest(prompt_ids=rng.integers(1, 500, 180).tolist(),
+                           max_tokens=3, eos_id=-1)
+        short = GenRequest(prompt_ids=[1, 2], max_tokens=3, eos_id=-1)
+        for req in (long1, long2, short):
+            await sched.submit(req)
+
+        async def finish_time(req):
+            while True:
+                tok, _ = await asyncio.wait_for(req.out.get(), 120)
+                if tok is DONE:
+                    return _time.monotonic()
+
+        t1, t2, ts = await asyncio.gather(finish_time(long1),
+                                          finish_time(long2),
+                                          finish_time(short))
+        assert ts <= t1 <= t2, (ts, t1, t2)
+        assert sched.requests_served == 3
+    finally:
+        await sched.stop()
