@@ -79,6 +79,9 @@ async def run_server(host: str, port: int, key_path: str) -> None:
                      len(dht.table), len(dht.providers.get(namespace_key())),
                      h.stats["streams_in"], h.stats["streams_out"],
                      h.stats["rejected"], dict(h.stats_by_protocol))
+            if h.stats_by_addr_class:
+                log.info("inbound peers by address class: %s",
+                         dict(h.stats_by_addr_class))
 
     stats = asyncio.create_task(stats_loop())
     try:

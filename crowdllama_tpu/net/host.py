@@ -132,6 +132,22 @@ class Stream:
             pass
 
 
+def _addr_class(host: str) -> str:
+    """loopback / private / public — the reachable-from-where classification
+    the reference derives from libp2p multiaddrs (dht.go:279-321)."""
+    import ipaddress
+
+    try:
+        ip = ipaddress.ip_address(host)
+    except ValueError:
+        return "hostname"
+    if ip.is_loopback:
+        return "loopback"
+    if ip.is_private or ip.is_link_local:
+        return "private"
+    return "public"
+
+
 def _hello_signing_bytes(
     proto: str, peer_id: str, ts: float, nonce: str, listen_port: int,
     eph_hex: str,
@@ -180,6 +196,16 @@ class Host:
             "streams_in": 0, "streams_out": 0, "rejected": 0,
         }
         self.stats_by_protocol: dict[str, int] = {}
+        # DISTINCT inbound peers by address class (the TCP analog of the
+        # reference's local/external connection classification,
+        # dht.go:279-321).  Deduped by peer id — streams are per-RPC, so a
+        # raw stream count would explode with every refresh loop.
+        self._peers_by_addr_class: dict[str, set[str]] = {}
+
+    @property
+    def stats_by_addr_class(self) -> dict[str, int]:
+        """Distinct authenticated inbound peers per address class."""
+        return {k: len(v) for k, v in self._peers_by_addr_class.items()}
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -333,6 +359,9 @@ class Host:
             # its advertised listening port.
             remote_contact: Contact | None = None
             peername = writer.get_extra_info("peername")
+            if peername:
+                self._peers_by_addr_class.setdefault(
+                    _addr_class(peername[0]), set()).add(remote_id)
             lport = int(hello.get("listen_port", 0))
             if peername and lport > 0:
                 remote_contact = Contact(remote_id, peername[0], lport)

@@ -203,3 +203,47 @@ async def test_advertiser_loop_and_reconnect():
     finally:
         await boot_host.close()
         await h1.close()
+
+
+def test_addr_classification():
+    from crowdllama_tpu.net.host import _addr_class
+
+    assert _addr_class("127.0.0.1") == "loopback"
+    assert _addr_class("::1") == "loopback"
+    assert _addr_class("10.1.2.3") == "private"
+    assert _addr_class("192.168.0.9") == "private"
+    assert _addr_class("169.254.0.1") == "private"
+    assert _addr_class("8.8.8.8") == "public"
+    assert _addr_class("example.com") == "hostname"
+
+
+async def test_inbound_addr_class_stats():
+    """The accepting host classifies inbound peers (ref dht.go:279-321)."""
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+    )
+
+    from crowdllama_tpu.net.host import Host
+
+    a = Host(Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    b = Host(Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    await a.start()
+    await b.start()
+    a.set_stream_handler("/t/1", lambda s: _echo(s))
+    try:
+        s = await b.new_stream(a.contact, "/t/1")
+        s.close()
+        # Deduped by peer: a second stream from the same peer doesn't
+        # inflate the count.
+        s2 = await b.new_stream(a.contact, "/t/1")
+        s2.close()
+        assert a.stats_by_addr_class == {"loopback": 1}
+    finally:
+        await a.close()
+        await b.close()
+
+
+async def _echo(stream):
+    stream.writer.write(b"ok")
+    await stream.writer.drain()
+    stream.writer.write_eof()
