@@ -16,7 +16,7 @@ bandwidth-bound either way.  Overridables via env:
   CROWDLLAMA_BENCH_SLOTS     batch slots        (default 8)
   CROWDLLAMA_BENCH_STEPS     timed decode steps (default 512)
   CROWDLLAMA_BENCH_CTX       max context        (default 1024)
-  CROWDLLAMA_BENCH_QUANTIZE  "int8" | "none"    (default int8)
+  CROWDLLAMA_BENCH_QUANTIZE  "int8" | "int4" | "none"  (default int8)
   CROWDLLAMA_BENCH_KV        "bf16" | "int8"    KV cache dtype (default bf16)
 """
 
@@ -87,13 +87,14 @@ def main() -> None:
 
     t0 = time.monotonic()
     params = None
-    if quantize == "int8":
+    if quantize in ("int8", "int4"):
         from crowdllama_tpu.ops.quant import random_quantized_params
 
-        # Leaf-by-leaf int8 init: never materializes the bf16 tree, so an
-        # 8B model (16 GB bf16) can be benched on the 16 GB chip it serves
-        # from.  Throughput-identical to quantize_params(init_params(...)).
-        params = random_quantized_params(cfg, jax.random.PRNGKey(0))
+        # Leaf-by-leaf quantized init: never materializes the bf16 tree, so
+        # an 8B model (16 GB bf16) can be benched on the 16 GB chip it
+        # serves from.  Throughput-identical to quantize_params(init(...)).
+        params = random_quantized_params(cfg, jax.random.PRNGKey(0),
+                                         mode=quantize)
     runner = ModelRunner(cfg, params=params, max_slots=slots,
                          max_seq=cfg.max_context_length, kv_dtype=kv_dtype)
     state = runner.init_state()

@@ -21,7 +21,7 @@ import json
 import os
 
 
-def model_bytes(cfg, quant: bool) -> tuple[int, int]:
+def model_bytes(cfg, quant: bool, bits: int = 8) -> tuple[int, int]:
     """(param_bytes, kv_bytes_per_slot_at_max_ctx)."""
     d, f, v = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
     h, hkv, nl = cfg.num_heads, cfg.num_kv_heads, cfg.num_layers
@@ -37,10 +37,16 @@ def model_bytes(cfg, quant: bool) -> tuple[int, int]:
     head = 0 if cfg.tie_word_embeddings else d * v
     matmul_params = nl * (attn + mlp)  # quantizable
     other_params = nl * norms + embed + head + d
-    wbytes = 1 if quant else 2
-    param_bytes = matmul_params * wbytes + other_params * 2
-    if quant:  # per-output-channel scales, bf16
-        param_bytes += nl * (h * dh + 2 * hkv * dh + d + (3 * f if not cfg.is_moe else cfg.num_experts * 3 * f)) * 2
+    wbytes = (bits / 8) if quant else 2
+    param_bytes = int(matmul_params * wbytes) + other_params * 2
+    if quant:
+        if bits == 8:  # per-output-channel bf16 scales
+            per_ch = nl * (h * dh + 2 * hkv * dh + d
+                           + (3 * f if not cfg.is_moe
+                              else cfg.num_experts * 3 * f))
+            param_bytes += per_ch * 2
+        else:  # int4: one bf16 scale per 64-weight group
+            param_bytes += (matmul_params // 64) * 2
     kv_bytes = nl * hkv * cfg.max_context_length * dh * 2 * 2  # k+v bf16
     return param_bytes, kv_bytes
 
@@ -63,6 +69,7 @@ def main() -> None:
         cfg = get_config(name)
         pb16, kv = model_bytes(cfg, quant=False)
         pb8, _ = model_bytes(cfg, quant=True)
+        pb4, _ = model_bytes(cfg, quant=True, bits=4)
         kv_per_tok = kv / cfg.max_context_length
         fits16 = pb16 + slots * kv < budget
         fits8 = pb8 + slots * kv < budget
@@ -78,6 +85,8 @@ def main() -> None:
         rows.append({"model": name, "params_b": params_b,
                      "bf16_gb": round(pb16 / 2**30, 1),
                      "int8_gb": round(pb8 / 2**30, 1),
+                     "int4_gb": round(pb4 / 2**30, 1),
+                     "fits_int4": pb4 + slots * kv < budget,
                      "kv_gb_at_max_ctx_x%d" % slots: round(slots * kv / 2**30, 1),
                      "fits_bf16": fits16, "fits_int8": fits8,
                      "max_ctx_fit_int8": ctx_fit})

@@ -25,9 +25,10 @@ def _norm_quantize(value: str) -> str:
     v = (value or "").strip().lower()
     if v in ("", "none", "off", "0", "false"):
         return ""
-    if v == "int8":
-        return "int8"
-    raise ValueError(f"unknown quantize mode {value!r} (want '' or 'int8')")
+    if v in ("int8", "int4"):
+        return v
+    raise ValueError(
+        f"unknown quantize mode {value!r} (want '', 'int8' or 'int4')")
 
 
 @dataclass
@@ -97,7 +98,7 @@ class Configuration:
     mesh_shape: str = ""  # e.g. "1x8" → (dp=1, tp=8); empty = all devices on tp
     decode_chunk: int = 8  # decode steps per device dispatch
     warmup: bool = True  # compile prefill/decode at engine start
-    quantize: str = ""  # "" (bf16) | "int8" weight-only (ops/quant.py)
+    quantize: str = ""  # "" (bf16) | "int8" | "int4" weight-only (ops/quant.py)
     # KV cache layout: "contiguous" [L,B,Hkv,S,Dh] per slot, or "paged"
     # (engine/paged.py): page pool + slot page tables; kv_pool_tokens 0 =
     # full capacity (no overcommit), else total pooled tokens.
@@ -232,7 +233,7 @@ class Configuration:
                             choices=("pp", "ep"),
                             help="pp: layer slices; ep: MoE expert banks")
         parser.add_argument("--quantize", dest="quantize",
-                            choices=("", "int8"),
+                            choices=("", "int8", "int4"),
                             help="weight-only quantization for the engine")
         parser.add_argument("--kv-layout", dest="kv_layout",
                             choices=("contiguous", "paged"),

@@ -183,10 +183,10 @@ class JaxEngine(Engine):
 
         def _build():
             params = load_or_init_params(cfg, self.config.model_path)
-            if self.config.quantize == "int8":
+            if self.config.quantize:
                 from crowdllama_tpu.ops.quant import quantize_params
 
-                params = quantize_params(params)
+                params = quantize_params(params, mode=self.config.quantize)
             kwargs = dict(
                 params=params,
                 mesh_spec=self.config.mesh_shape,

@@ -167,10 +167,10 @@ class ShardedEngine(Engine):
         from crowdllama_tpu.engine.weights import load_or_init_params
 
         params = load_or_init_params(self.cfg, self.config.model_path)
-        if self.config.quantize == "int8":
+        if self.config.quantize:
             from crowdllama_tpu.ops.quant import quantize_params
 
-            params = quantize_params(params)
+            params = quantize_params(params, mode=self.config.quantize)
         self.runner = ShardStageRunner(
             self.cfg, params, self.shard_index, self.shard_count,
             max_seq=self.cfg.max_context_length)

@@ -360,8 +360,13 @@ class Host:
             remote_contact: Contact | None = None
             peername = writer.get_extra_info("peername")
             if peername:
-                self._peers_by_addr_class.setdefault(
-                    _addr_class(peername[0]), set()).add(remote_id)
+                seen = self._peers_by_addr_class.setdefault(
+                    _addr_class(peername[0]), set())
+                if len(seen) < 50_000:
+                    # Bounded: a dialer minting a fresh key per connection
+                    # must not grow this without limit (the bootstrap
+                    # server runs for weeks).
+                    seen.add(remote_id)
             lport = int(hello.get("listen_port", 0))
             if peername and lport > 0:
                 remote_contact = Contact(remote_id, peername[0], lport)

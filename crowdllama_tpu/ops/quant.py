@@ -51,6 +51,28 @@ class QTensor:
         return self.q.shape
 
 
+@jax.tree_util.register_dataclass
+@dataclass
+class QTensor4:
+    """int4 weight + GROUP-wise scales (one per ``group`` input rows per
+    output channel).
+
+    ``q`` keeps the source shape [..., d_in, d_out]; ``s`` is
+    [..., d_in/group, d_out] — same rank as the weight, so the weight's
+    PartitionSpec applies to both.  int4 needs finer scale granularity than
+    int8's per-channel to hold accuracy; group-wise is the standard point
+    (AWQ/GPTQ-style), and the dequant reshape+broadcast still fuses into
+    the consumer matmul's operand read.
+    """
+
+    q: jnp.ndarray
+    s: jnp.ndarray
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+
 def quantize_weight(w: jnp.ndarray, scale_dtype=jnp.bfloat16) -> QTensor:
     """Symmetric per-output-channel int8 over the input dim (axis -2)."""
     a = jnp.asarray(w, jnp.float32)
@@ -59,32 +81,58 @@ def quantize_weight(w: jnp.ndarray, scale_dtype=jnp.bfloat16) -> QTensor:
     return QTensor(q=q, s=s.squeeze(-2).astype(scale_dtype))
 
 
+GROUP = 64  # int4 scale group (input rows per scale)
+
+
+def quantize_weight_int4(w: jnp.ndarray, group: int = GROUP,
+                         scale_dtype=jnp.bfloat16) -> QTensor4:
+    """Symmetric group-wise int4 over the input dim (axis -2)."""
+    a = jnp.asarray(w, jnp.float32)
+    *batch, d_in, d_out = a.shape
+    g = group if d_in % group == 0 else d_in  # fall back to one group
+    ar = a.reshape(*batch, d_in // g, g, d_out)
+    s = jnp.max(jnp.abs(ar), axis=-2, keepdims=True) / 7.0 + 1e-12
+    q = jnp.clip(jnp.round(ar / s), -7, 7).astype(jnp.int4)
+    return QTensor4(q=q.reshape(*batch, d_in, d_out),
+                    s=s.squeeze(-2).astype(scale_dtype))
+
+
 def dequant(t) -> jnp.ndarray:
-    """QTensor → bf16 weight (XLA fuses convert+scale into the consumer
-    matmul's operand read); plain arrays pass through."""
+    """QTensor/QTensor4 → bf16 weight (XLA fuses convert+scale into the
+    consumer matmul's operand read); plain arrays pass through."""
     if isinstance(t, QTensor):
         return t.q.astype(t.s.dtype) * t.s[..., None, :]
+    if isinstance(t, QTensor4):
+        *batch, d_in, d_out = t.q.shape
+        n_g = t.s.shape[-2]
+        w = t.q.astype(t.s.dtype).reshape(*batch, n_g, d_in // n_g, d_out)
+        return (w * t.s[..., :, None, :]).reshape(*batch, d_in, d_out)
     return t
 
 
-def quantize_params(params: Params, extra_keys: tuple[str, ...] = ("lm_head",)) -> Params:
+def quantize_params(params: Params, extra_keys: tuple[str, ...] = ("lm_head",),
+                    mode: str = "int8") -> Params:
     """Quantize the large matmul weights of a transformer param pytree
     (models.transformer.init_params layout) in place-of.
 
+    ``mode``: "int8" (per-output-channel) or "int4" (group-wise scales).
     Runs as ONE jitted program: eager per-op quantization costs a device
     round trip per op, which is minutes when the chip sits behind a network
     tunnel."""
+    if mode not in ("int8", "int4"):
+        raise ValueError(f"unknown quantization mode {mode!r}")
+    qfn = quantize_weight if mode == "int8" else quantize_weight_int4
 
     def _quantize(p: Params) -> Params:
         out = dict(p)
         layers = dict(p["layers"])
         for k in QUANT_KEYS:
             if k in layers:
-                layers[k] = quantize_weight(layers[k])
+                layers[k] = qfn(layers[k])
         out["layers"] = layers
         for k in extra_keys:
             if k in out:
-                out[k] = quantize_weight(out[k])
+                out[k] = qfn(out[k])
         return out
 
     return jax.jit(_quantize)(params)
@@ -103,7 +151,8 @@ def quantize_kv(x: jnp.ndarray, scale_dtype=jnp.bfloat16):
     return q, s.squeeze(-1).astype(scale_dtype)
 
 
-def random_quantized_params(cfg, key: jax.Array, dtype=jnp.bfloat16) -> Params:
+def random_quantized_params(cfg, key: jax.Array, dtype=jnp.bfloat16,
+                            mode: str = "int8") -> Params:
     """Random parameter pytree with the matmul weights *born* int8.
 
     Structurally (and throughput-) equivalent to
@@ -127,6 +176,13 @@ def random_quantized_params(cfg, key: jax.Array, dtype=jnp.bfloat16) -> Params:
         name = path[-1].key
         if name in QUANT_KEYS or name == "lm_head":
             d_in = sds.shape[-2]
+            if mode == "int4":
+                g = GROUP if d_in % GROUP == 0 else d_in
+                q = jax.random.randint(k, sds.shape, -7, 8,
+                                       dtype=jnp.int32).astype(jnp.int4)
+                s = jnp.full(sds.shape[:-2] + (d_in // g, sds.shape[-1]),
+                             1.0 / (7.0 * math.sqrt(d_in)), dtype)
+                return QTensor4(q=q, s=s)
             q = jax.random.randint(k, sds.shape, -127, 128, dtype=jnp.int8)
             s = jnp.full(sds.shape[:-2] + (sds.shape[-1],),
                          1.0 / (127.0 * math.sqrt(d_in)), dtype)

@@ -91,7 +91,11 @@ def shard_params(params: Params, cfg: ModelConfig, mesh: Mesh) -> Params:
 
     Quantized leaves (ops.quant.QTensor) shard ``q`` with the original
     weight's spec and ``s`` with that spec minus the input dim."""
-    from crowdllama_tpu.ops.quant import QTensor, drop_input_axis_spec
+    from crowdllama_tpu.ops.quant import (
+        QTensor,
+        QTensor4,
+        drop_input_axis_spec,
+    )
 
     specs = param_pspecs(cfg)
 
@@ -104,11 +108,23 @@ def shard_params(params: Params, cfg: ModelConfig, mesh: Mesh) -> Params:
                     a.s, NamedSharding(mesh, filter_spec(
                         drop_input_axis_spec(s, a.q.ndim), mesh))),
             )
+        if isinstance(a, QTensor4):
+            # Group scales keep the weight's rank (input dim → group dim),
+            # so the weight's spec applies to both — except axes the (much
+            # smaller) scale tensor cannot divide, which replicate.
+            wspec = filter_spec(s, mesh)
+            axes = tuple(wspec) + (None,) * (a.s.ndim - len(tuple(wspec)))
+            sspec = P(*(ax if ax is not None and dim % mesh.shape[ax] == 0
+                        else None
+                        for dim, ax in zip(a.s.shape, axes)))
+            return QTensor4(
+                q=jax.device_put(a.q, NamedSharding(mesh, wspec)),
+                s=jax.device_put(a.s, NamedSharding(mesh, sspec)))
         return jax.device_put(a, NamedSharding(mesh, filter_spec(s, mesh)))
 
     return jax.tree_util.tree_map(
         place, params, specs,
-        is_leaf=lambda x: isinstance(x, QTensor),
+        is_leaf=lambda x: isinstance(x, (QTensor, QTensor4)),
     )
 
 

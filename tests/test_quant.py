@@ -176,3 +176,79 @@ def test_quantized_runner_decodes():
     toks, state = runner.decode_steps(state, 4)
     assert toks.shape == (4, runner.max_slots)
     assert (toks[:, 0] >= 0).all()
+
+
+def test_int4_groupwise_logits_close_all_families():
+    """int4 RTN with group-64 scales: 15 levels bound the fidelity — on
+    these 2-layer random models logits correlate ~0.9 (real deep models
+    average the noise better).  int4 is the opt-in capacity point; int8
+    stays the accuracy default."""
+    for name in ("tiny-test", "tiny-test-moe", "tiny-test-gemma",
+                 "tiny-test-qwen2", "tiny-test-qwen3"):
+        cfg = get_config(name, max_context_length=32)
+        params = T.init_params(cfg, jax.random.PRNGKey(1), dtype=jnp.float32)
+        qparams = quantize_params(params, mode="int4")
+        tokens = jnp.asarray([[257, 104, 105, 32, 119]])
+        pos = jnp.arange(5)[None, :]
+        ref, _, _ = T.prefill(params, cfg, tokens, pos)
+        got, _, _ = T.prefill(qparams, cfg, tokens, pos)
+        a = np.asarray(ref, np.float64).ravel()
+        b = np.asarray(got, np.float64).ravel()
+        corr = np.corrcoef(a, b)[0, 1]
+        # Measured on these tiny random models: ~0.92 (llama/qwen), ~0.79
+        # (gemma: softcap tanh amplifies relative error).  The bar asserts
+        # the mechanism works, not that naive RTN int4 is accuracy-free —
+        # it is the opt-in capacity point (AWQ-style calibration is the
+        # known upgrade path and needs calibration data).
+        assert corr > 0.7, f"{name}: int4 logits corr {corr}"
+
+
+
+def test_int4_roundtrip_and_groups():
+    from crowdllama_tpu.ops.quant import QTensor4, quantize_weight_int4
+
+    w = jax.random.normal(jax.random.PRNGKey(0), (128, 16), jnp.float32)
+    qt = quantize_weight_int4(w, group=64)
+    assert qt.q.dtype == jnp.int4 and qt.s.shape == (2, 16)
+    back = np.asarray(dequant(qt), np.float32)
+    scale = np.repeat(np.asarray(qt.s, np.float32), 64, axis=0)
+    err = np.abs(back - np.asarray(w))
+    assert (err <= scale * 0.51 + np.abs(np.asarray(w)) * 0.01 + 1e-6).all()
+    # Non-divisible input dim falls back to one group.
+    qt2 = quantize_weight_int4(jnp.ones((60, 8)), group=64)
+    assert qt2.s.shape == (1, 8)
+
+
+def test_int4_params_shard_onto_mesh():
+    from crowdllama_tpu.ops.quant import QTensor4
+    from crowdllama_tpu.parallel.mesh import build_mesh
+    from crowdllama_tpu.parallel.sharding import shard_params
+
+    cfg = get_config("tiny-test", max_context_length=32)
+    qparams = quantize_params(
+        T.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16),
+        mode="int4")
+    mesh = build_mesh("2x1x1x1x2")  # dp=2, tp=2
+    sharded = shard_params(qparams, cfg, mesh)
+    wq = sharded["layers"]["wq"]
+    assert isinstance(wq, QTensor4)
+    assert wq.q.sharding.spec == jax.sharding.PartitionSpec("pp", None, "tp")
+    # tiny d=64 → 1 scale group: undividable axes replicate.
+    logits, _, _ = T.prefill(sharded, cfg, jnp.asarray([[1, 2, 3]]),
+                             jnp.arange(3)[None, :])
+    assert logits.shape == (1, 3, cfg.vocab_size)
+
+
+def test_int4_runner_decodes():
+    from crowdllama_tpu.engine.runner import ModelRunner
+    from crowdllama_tpu.ops.quant import random_quantized_params
+
+    cfg = get_config("tiny-test", max_context_length=64)
+    params = random_quantized_params(cfg, jax.random.PRNGKey(0), mode="int4")
+    runner = ModelRunner(cfg, params=params, max_slots=2, max_seq=64)
+    state = runner.init_state()
+    tok, ks, vs, plen = runner.prefill([1, 2, 3], 0.0, 1.0,
+                                       jax.random.PRNGKey(0))
+    state = runner.insert(state, 0, ks, vs, plen, tok, 0.0, 1.0)
+    toks, state = runner.decode_steps(state, 4)
+    assert toks.shape == (4, runner.max_slots)
