@@ -1,0 +1,48 @@
+"""Embeddings through the swarm gateway (cf. examples/chat.py).
+
+Uses the stock ``ollama`` Python client when installed, else stdlib HTTP —
+either way exercising the Ollama-compatible /api/embed surface.
+
+    python examples/embed.py [gateway_url] [model]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import urllib.request
+
+GATEWAY = sys.argv[1] if len(sys.argv) > 1 else "http://localhost:9001"
+MODEL = sys.argv[2] if len(sys.argv) > 2 else "tinyllama-1.1b"
+TEXTS = ["a tpu-native inference swarm",
+         "peer to peer model serving",
+         "an unrelated sentence about cooking"]
+
+
+def main() -> int:
+    try:
+        import ollama
+
+        client = ollama.Client(host=GATEWAY)
+        vecs = client.embed(model=MODEL, input=TEXTS)["embeddings"]
+    except (ImportError, AttributeError):  # absent, or pre-0.3 client
+        # without Client.embed
+
+        req = urllib.request.Request(
+            f"{GATEWAY}/api/embed",
+            data=json.dumps({"model": MODEL, "input": TEXTS}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            vecs = json.load(resp)["embeddings"]
+
+    def dot(a, b):
+        return sum(x * y for x, y in zip(a, b))
+
+    print(f"{len(vecs)} embeddings of dim {len(vecs[0])}")
+    print(f"sim(swarm, p2p serving) = {dot(vecs[0], vecs[1]):.3f}")
+    print(f"sim(swarm, cooking)     = {dot(vecs[0], vecs[2]):.3f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

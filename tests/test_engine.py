@@ -542,3 +542,49 @@ async def test_deferred_long_prompts_keep_fifo_and_dont_block_shorts():
         assert sched.requests_served == 3
     finally:
         await sched.stop()
+
+
+async def test_chunked_admission_failure_recovers():
+    """A prefill_step crash mid-chunked-admission fails that request cleanly
+    and the scheduler keeps serving."""
+    import jax.numpy as jnp
+    from crowdllama_tpu.engine.runner import ModelRunner
+    from crowdllama_tpu.engine.scheduler import DONE, GenRequest, Scheduler
+    from crowdllama_tpu.models.config import get_config
+
+    cfg = get_config("tiny-test", max_context_length=256)
+    r = ModelRunner(cfg, max_slots=2, max_seq=256, dtype=jnp.float32)
+    r.prefill_chunk = 32
+    boom = {"armed": True}
+    real_step = r.prefill_step
+
+    def failing_step(job):
+        if boom["armed"] and job.done_tokens >= 32:
+            boom["armed"] = False
+            raise RuntimeError("injected chunk failure")
+        return real_step(job)
+
+    r.prefill_step = failing_step
+    sched = Scheduler(r, decode_chunk=2)
+    sched.start()
+    try:
+        rng = np.random.default_rng(9)
+        req = GenRequest(prompt_ids=rng.integers(1, 500, 120).tolist(),
+                         max_tokens=4, eos_id=-1)
+        await sched.submit(req)
+        tok, reason = await asyncio.wait_for(req.out.get(), 60)
+        assert tok is DONE and reason.startswith("error")
+        # Scheduler recovered: a fresh request serves normally.
+        req2 = GenRequest(prompt_ids=rng.integers(1, 500, 90).tolist(),
+                          max_tokens=3, eos_id=-1)
+        await sched.submit(req2)
+        toks = []
+        while True:
+            tok, reason = await asyncio.wait_for(req2.out.get(), 60)
+            if tok is DONE:
+                break
+            toks.append(tok)
+        assert len(toks) == 3 and reason == "length"
+        assert all(s is None for s in sched.slots)
+    finally:
+        await sched.stop()
