@@ -1,43 +1,91 @@
-"""Headline benchmark: decode throughput (tokens/sec/chip) of the JAX engine.
+"""Driver benchmark suite: the full BASELINE metric set as JSON lines.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Emits MULTIPLE JSON lines (one per phase), each
+{"metric", "value", "unit", "vs_baseline", ...}, flushed as soon as the
+phase finishes and mirrored to BENCH_partial.jsonl — a later phase dying
+(or the TPU tunnel dropping mid-run) cannot erase earlier results.
 
-The reference publishes no measured numbers (SURVEY §6); the only throughput
-figure in its tree is the hardcoded 150 tokens/sec a worker *advertises*
-(/root/reference/pkg/peer/peer.go:323-333).  ``vs_baseline`` is therefore
-measured tokens/sec/chip divided by that advertised 150 tok/s.
+Phases (CROWDLLAMA_BENCH_PHASES to select, comma-separated):
+  decode    TinyLlama-1.1B int8 decode throughput (headline parity config)
+  decode8b  Llama-3-8B int8 decode throughput (BASELINE config 2 headline)
+  kernel    Pallas flash prefill+decode numeric parity vs the jnp reference
+            ops, on the attached device (interpret-mode on CPU fallback)
+  ttft      gateway p50 TTFT through the full loopback stack
+            (benchmarks/ttft.py as a subprocess)
+  swarm     swarm scaling 1->16 FakeEngine workers
+            (benchmarks/swarm_scaling.py as a subprocess, CPU)
 
-Model defaults to TinyLlama-1.1B (BASELINE config 1, randomly initialized —
-throughput does not depend on weight values).  Weights are int8 by default
-(weight-only, ops/quant.py) — the parity-honest configuration: the
-reference's engine (Ollama) serves quantized GGUF by default, and decode is
-bandwidth-bound either way.  Overridables via env:
-  CROWDLLAMA_BENCH_MODEL     (default tinyllama-1.1b)
-  CROWDLLAMA_BENCH_SLOTS     batch slots        (default 8)
-  CROWDLLAMA_BENCH_STEPS     timed decode steps (default 512)
-  CROWDLLAMA_BENCH_CTX       max context        (default 1024)
-  CROWDLLAMA_BENCH_QUANTIZE  "int8" | "int4" | "none"  (default int8)
-  CROWDLLAMA_BENCH_KV        "bf16" | "int8"    KV cache dtype (default bf16)
+The reference publishes no measured numbers (SURVEY §6); the only
+throughput figure in its tree is the hardcoded 150 tokens/sec a worker
+*advertises* (/root/reference/pkg/peer/peer.go:323-333).  ``vs_baseline``
+is therefore measured tokens/sec/chip divided by that advertised 150 tok/s
+where comparable, null elsewhere.
+
+Resilience: the chip sits behind a network tunnel that can drop for many
+minutes (BENCH_r02 lost the whole round to a 300 s budget).  The device
+wait budget is now 25 min by default (CROWDLLAMA_BENCH_BUDGET_S) and on
+final failure the suite falls back to CPU with a tiny model so the run
+still produces a parseable artifact (clearly labeled platform=cpu).
+
+Env knobs:
+  CROWDLLAMA_BENCH_BUDGET_S   device-wait budget seconds (default 1500)
+  CROWDLLAMA_BENCH_PHASES     comma list (default all)
+  CROWDLLAMA_BENCH_SLOTS      batch slots        (default 8)
+  CROWDLLAMA_BENCH_STEPS      timed decode steps (default 512)
+  CROWDLLAMA_BENCH_CTX        max context        (default 1024)
+  CROWDLLAMA_BENCH_QUANTIZE   "int8" | "int4" | "none"  (default int8)
+  CROWDLLAMA_BENCH_KV         "bf16" | "int8"    KV cache dtype (default bf16)
+  CROWDLLAMA_BENCH_MODEL      override the `decode` phase model
+  CROWDLLAMA_BENCH_SUBPROC_TIMEOUT  ttft/swarm subprocess timeout (default 900)
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
+import traceback
 from dataclasses import replace
-
-import jax
-import numpy as np
+from pathlib import Path
 
 BASELINE_ADVERTISED_TOKS = 150.0  # reference worker's hardcoded claim
+PARTIAL_PATH = Path(__file__).resolve().parent / "BENCH_partial.jsonl"
+_ALL_PHASES = ("decode", "decode8b", "kernel", "ttft", "swarm")
+
+# Honor JAX_PLATFORMS even though the image's sitecustomize pre-imports jax
+# pinned to the axon (TPU tunnel) platform — env vars alone are read too
+# early to win; jax.config.update must run before any backend initializes
+# (same workaround as benchmarks/_common.py and tests/conftest.py).
+if os.environ.get("JAX_PLATFORMS"):
+    try:
+        import jax as _jax
+
+        _jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    except Exception:  # pragma: no cover
+        pass
 
 
-def _wait_for_devices(budget_s: float = 300.0):
+def _emit(result: dict) -> None:
+    """Print one metric line and persist it immediately."""
+    line = json.dumps(result)
+    print(line, flush=True)
+    try:
+        with PARTIAL_PATH.open("a") as f:
+            f.write(line + "\n")
+    except OSError as e:  # pragma: no cover - readonly fs
+        print(f"# partial persist failed: {e}", file=sys.stderr)
+
+
+def _wait_for_devices(budget_s: float):
     """The chip sits behind a network tunnel that occasionally drops and
     needs minutes to recover; retry backend init instead of failing the
-    whole benchmark run on a transient."""
+    whole benchmark run on a transient.  After the budget, fall back to the
+    CPU backend so the run still emits parseable (clearly-labeled) lines
+    rather than rc=1 with nothing (BENCH_r02 postmortem, VERDICT r2 #1)."""
+    import jax
+
     deadline = time.monotonic() + budget_s
     delay = 5.0
     while True:
@@ -45,44 +93,65 @@ def _wait_for_devices(budget_s: float = 300.0):
             return jax.devices()
         except RuntimeError as e:
             if time.monotonic() >= deadline:
-                raise
+                print(f"# device budget exhausted ({e}); "
+                      "falling back to CPU", file=sys.stderr)
+                break
             print(f"# devices unavailable ({e}); retrying in {delay:.0f}s",
                   file=sys.stderr)
-            try:
-                # Failed init is cached; reset it or the retry re-raises the
-                # stale error.  (jax.clear_backends was removed from the
-                # top-level API; jax.extend.backend carries it in jax 0.9.)
-                import jax.extend.backend as _jeb
-
-                _jeb.clear_backends()
-            except Exception as ce:
-                print(f"# clear_backends unavailable: {ce}", file=sys.stderr)
+            _clear_backends()
             time.sleep(delay)
             delay = min(delay * 2, 60.0)
+    jax.config.update("jax_platforms", "cpu")
+    _clear_backends()
+    return jax.devices()
 
 
-def main() -> None:
+def _clear_backends() -> None:
+    # Failed init is cached; reset it or the retry re-raises the stale
+    # error.  (jax.clear_backends was removed from the top-level API;
+    # jax.extend.backend carries it in jax 0.9.)
+    try:
+        import jax.extend.backend as _jeb
+
+        _jeb.clear_backends()
+    except Exception as ce:  # pragma: no cover
+        print(f"# clear_backends unavailable: {ce}", file=sys.stderr)
+
+
+# ----------------------------------------------------------------- decode
+
+
+def _decode_phase(model: str) -> dict:
+    """Saturated-batch decode throughput (tokens/sec/chip) for ``model``."""
+    import jax
+    import numpy as np
+
     from crowdllama_tpu.engine.runner import ModelRunner
     from crowdllama_tpu.models.config import get_config
 
-    _wait_for_devices()
-
-    model = os.environ.get("CROWDLLAMA_BENCH_MODEL", "tinyllama-1.1b")
-    slots = int(os.environ.get("CROWDLLAMA_BENCH_SLOTS", "8"))
-    steps = int(os.environ.get("CROWDLLAMA_BENCH_STEPS", "512"))
-    ctx = int(os.environ.get("CROWDLLAMA_BENCH_CTX", "1024"))
-    quantize = os.environ.get("CROWDLLAMA_BENCH_QUANTIZE", "int8")
-    kv_dtype = os.environ.get("CROWDLLAMA_BENCH_KV", "bf16")
+    platform = jax.devices()[0].platform
+    if platform != "tpu":
+        # CPU fallback: a real-size model would take hours; bench the tiny
+        # model so the artifact still proves the serving path end-to-end.
+        model, steps, slots = "tiny-test", 64, 4
+        quantize, kv_dtype, ctx = "", "bf16", 256
+    else:
+        slots = int(os.environ.get("CROWDLLAMA_BENCH_SLOTS", "8"))
+        steps = int(os.environ.get("CROWDLLAMA_BENCH_STEPS", "512"))
+        ctx = int(os.environ.get("CROWDLLAMA_BENCH_CTX", "1024"))
+        quantize = os.environ.get("CROWDLLAMA_BENCH_QUANTIZE", "int8")
+        kv_dtype = os.environ.get("CROWDLLAMA_BENCH_KV", "bf16")
+        if quantize in ("none", "", "0"):
+            quantize = ""
 
     cfg = get_config(model)
     if ctx < cfg.max_context_length:
         cfg = replace(cfg, max_context_length=ctx)
     n_chips = max(1, len(jax.devices()))
 
-    print(f"# bench: model={model} slots={slots} steps={steps} "
+    print(f"# bench[{model}]: slots={slots} steps={steps} "
           f"ctx={cfg.max_context_length} devices={n_chips} "
-          f"quantize={quantize} kv={kv_dtype} "
-          f"platform={jax.devices()[0].platform}",
+          f"quantize={quantize or 'bf16'} kv={kv_dtype} platform={platform}",
           file=sys.stderr)
 
     t0 = time.monotonic()
@@ -111,7 +180,7 @@ def main() -> None:
 
     # Warmup compile of the timed decode program.
     chunk = min(32, steps)
-    tokens, state = runner.decode_steps(state, chunk)  # warmup + compile (syncs)
+    tokens, state = runner.decode_steps(state, chunk)  # warmup + compile
 
     # Timed: chain chunks on device (each dispatch overlaps the previous
     # chunk's execution) and read back ONCE — the serial state dependency
@@ -126,15 +195,184 @@ def main() -> None:
     tokens = np.asarray(tokens)  # sync
     dt = time.monotonic() - t0
 
-    toks_per_sec = done * runner.max_slots / dt
-    per_chip = toks_per_sec / n_chips
-    result = {
+    per_chip = done * runner.max_slots / dt / n_chips
+    on_tpu = platform == "tpu"
+    return {
         "metric": f"{model} decode throughput",
         "value": round(per_chip, 2),
         "unit": "tokens/sec/chip",
-        "vs_baseline": round(per_chip / BASELINE_ADVERTISED_TOKS, 3),
+        "vs_baseline": (round(per_chip / BASELINE_ADVERTISED_TOKS, 3)
+                        if on_tpu else None),
+        "extra": {"platform": platform, "slots": runner.max_slots,
+                  "steps": done, "ctx": cfg.max_context_length,
+                  "quantize": quantize or "bf16", "kv_dtype": kv_dtype},
     }
-    print(json.dumps(result))
+
+
+# ----------------------------------------------------------------- kernel
+
+
+def _kernel_parity_phase() -> dict:
+    """Flash Pallas kernels vs the jnp reference ops, on this device.
+
+    tests/test_pallas.py only ever runs the kernels in CPU interpret mode
+    (VERDICT r2 weak #5); this phase compiles them with Mosaic on the real
+    chip and asserts numeric agreement, so every BENCH artifact proves the
+    kernels still run on TPU.  On the CPU fallback it runs interpret mode
+    (labeled) so the line exists either way.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from crowdllama_tpu.ops import attention as A
+    from crowdllama_tpu.ops.pallas import flash
+
+    platform = jax.devices()[0].platform
+    mode = "mosaic" if platform == "tpu" else "interpret"
+
+    key = jax.random.PRNGKey(7)
+    b, t, h, hkv, dh = 2, 512, 8, 4, 128
+    scale = dh ** -0.5
+    ks = jax.random.split(key, 8)
+    q = jax.random.normal(ks[0], (b, t, h, dh), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (b, hkv, t, dh), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (b, hkv, t, dh), jnp.bfloat16)
+    positions = jnp.broadcast_to(jnp.arange(t)[None, :], (b, t))
+
+    checks: dict[str, float] = {}
+
+    def err(a, b_):
+        return float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                     - b_.astype(jnp.float32))))
+
+    # Interpret-mode fallback must not leak into os.environ: the ttft
+    # subprocess inherits the environment, and interpret-mode Pallas in a
+    # latency benchmark would be absurd.
+    prev = os.environ.get("CROWDLLAMA_PALLAS_INTERPRET")
+    if mode == "interpret":
+        os.environ["CROWDLLAMA_PALLAS_INTERPRET"] = "1"
+    try:
+        got = flash.flash_prefill_attention(q, k, v, positions, scale)
+        want = A.prefill_attention_ref(q, k, v, positions, scale)
+        checks["prefill"] = err(got, want)
+
+        # Sliding window + softcap (the Gemma-2 shape).
+        got = flash.flash_prefill_attention(q, k, v, positions, scale,
+                                            softcap=50.0, sliding_window=128)
+        want = A.prefill_attention_ref(q, k, v, positions, scale,
+                                       softcap=50.0, sliding_window=128)
+        checks["prefill_window_softcap"] = err(got, want)
+
+        qd = jax.random.normal(ks[3], (b, h, dh), jnp.bfloat16)
+        kc = jax.random.normal(ks[4], (b, hkv, t, dh), jnp.bfloat16)
+        vc = jax.random.normal(ks[5], (b, hkv, t, dh), jnp.bfloat16)
+        seq_lens = jnp.asarray(np.array([t, t // 2]), jnp.int32)
+        got = flash.flash_decode_attention(qd, kc, vc, seq_lens, scale)
+        want = A.decode_attention_ref(qd, kc, vc, seq_lens, scale)
+        checks["decode"] = err(got, want)
+    finally:
+        if mode == "interpret":
+            if prev is None:
+                os.environ.pop("CROWDLLAMA_PALLAS_INTERPRET", None)
+            else:
+                os.environ["CROWDLLAMA_PALLAS_INTERPRET"] = prev
+
+    tol = 2e-2  # bf16 inputs, fp32 accumulation in both paths
+    ok = all(e <= tol for e in checks.values())
+    return {
+        "metric": "pallas kernel parity (flash prefill+decode vs jnp)",
+        "value": 1.0 if ok else 0.0,
+        "unit": "pass",
+        "vs_baseline": None,
+        "extra": {"mode": mode, "platform": platform, "tolerance": tol,
+                  "max_abs_err": {k_: round(v_, 5)
+                                  for k_, v_ in checks.items()}},
+    }
+
+
+# ------------------------------------------------------------ subprocesses
+
+
+def _subprocess_phase(script: str, extra_env: dict[str, str]) -> dict:
+    """Run a benchmarks/ script and parse its final JSON stdout line."""
+    timeout = float(os.environ.get("CROWDLLAMA_BENCH_SUBPROC_TIMEOUT", "900"))
+    env = dict(os.environ)
+    env.setdefault("CROWDLLAMA_TPU_TEST_MODE", "1")
+    env.update(extra_env)
+    path = Path(__file__).resolve().parent / "benchmarks" / script
+    proc = subprocess.run(
+        [sys.executable, str(path)], env=env, timeout=timeout,
+        capture_output=True, text=True)
+    if proc.stderr:
+        sys.stderr.write(proc.stderr[-2000:])
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise RuntimeError(
+        f"{script} rc={proc.returncode}, no JSON line in stdout "
+        f"(tail: {proc.stdout[-300:]!r})")
+
+
+def _ttft_phase() -> dict:
+    import jax
+
+    env = {}
+    if jax.devices()[0].platform != "tpu":
+        env["JAX_PLATFORMS"] = "cpu"  # don't re-wait on the dead tunnel
+    return _subprocess_phase("ttft.py", env)
+
+
+def _swarm_phase() -> dict:
+    # Control-plane metric: FakeEngine workers, CPU platform by design.
+    return _subprocess_phase("swarm_scaling.py", {"JAX_PLATFORMS": "cpu"})
+
+
+# ------------------------------------------------------------------- main
+
+
+def main() -> None:
+    budget = float(os.environ.get("CROWDLLAMA_BENCH_BUDGET_S", "1500"))
+    phases = [p.strip() for p in os.environ.get(
+        "CROWDLLAMA_BENCH_PHASES", ",".join(_ALL_PHASES)).split(",")
+        if p.strip()]
+    try:
+        PARTIAL_PATH.unlink(missing_ok=True)  # fresh artifact per run
+    except OSError:
+        pass
+
+    devices = _wait_for_devices(budget)
+    if devices[0].platform != "tpu" and "decode8b" in phases:
+        # CPU fallback benches tiny-test either way — one copy is enough.
+        phases.remove("decode8b")
+
+    runners = {
+        "decode": lambda: _decode_phase(
+            os.environ.get("CROWDLLAMA_BENCH_MODEL", "tinyllama-1.1b")),
+        "decode8b": lambda: _decode_phase("llama-3-8b"),
+        "kernel": _kernel_parity_phase,
+        "ttft": _ttft_phase,
+        "swarm": _swarm_phase,
+    }
+    ok = 0
+    for phase in phases:
+        fn = runners.get(phase)
+        if fn is None:
+            print(f"# unknown phase {phase!r} (skipped)", file=sys.stderr)
+            continue
+        t0 = time.monotonic()
+        print(f"# phase {phase} starting", file=sys.stderr)
+        try:
+            _emit(fn())
+            ok += 1
+            print(f"# phase {phase} done in {time.monotonic() - t0:.0f}s",
+                  file=sys.stderr)
+        except Exception:
+            print(f"# phase {phase} FAILED after "
+                  f"{time.monotonic() - t0:.0f}s:", file=sys.stderr)
+            traceback.print_exc()
+    sys.exit(0 if ok else 1)
 
 
 if __name__ == "__main__":

@@ -65,6 +65,7 @@ class Engine:
         max_tokens: int = 128,
         temperature: float = 0.0,
         top_p: float = 1.0,
+        seed: int = 0,
     ) -> AsyncIterator[Chunk]:
         raise NotImplementedError
 
@@ -148,6 +149,7 @@ class Engine:
             max_tokens=req.max_tokens or 128,
             temperature=req.temperature,
             top_p=req.top_p or 1.0,
+            seed=int(req.seed or 0),
         )
 
 
@@ -331,6 +333,7 @@ class JaxEngine(Engine):
         max_tokens: int = 128,
         temperature: float = 0.0,
         top_p: float = 1.0,
+        seed: int = 0,
     ) -> AsyncIterator[Chunk]:
         from crowdllama_tpu.engine.scheduler import DONE, GenRequest
 
@@ -346,6 +349,7 @@ class JaxEngine(Engine):
             temperature=temperature,
             top_p=top_p,
             eos_id=self.tokenizer.eos_id,
+            seed=seed,
         )
         await self.scheduler.submit(req)
         decoder = self.tokenizer.stream_decoder()
@@ -435,7 +439,7 @@ class FakeEngine(Engine):
 
     async def generate(  # type: ignore[override]
         self, prompt: str, model: str = "", max_tokens: int = 128,
-        temperature: float = 0.0, top_p: float = 1.0,
+        temperature: float = 0.0, top_p: float = 1.0, seed: int = 0,
     ) -> AsyncIterator[Chunk]:
         self.calls += 1
         if self.delay:

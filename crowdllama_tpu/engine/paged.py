@@ -39,7 +39,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from crowdllama_tpu.engine.runner import ModelRunner
-from crowdllama_tpu.engine.sampling import sample_tokens
+from crowdllama_tpu.engine.sampling import (
+    default_slot_key,
+    sample_tokens,
+    sample_tokens_slots,
+    split_slot_keys,
+)
 from crowdllama_tpu.models import transformer as T
 from crowdllama_tpu.ops.attention import decode_attention
 from crowdllama_tpu.ops.rope import rope_table
@@ -60,13 +65,13 @@ class PagedDecodeState:
     active: jnp.ndarray    # [B]
     temperature: jnp.ndarray
     top_p: jnp.ndarray
-    key: jax.Array
+    keys: jnp.ndarray  # [B, 2] per-slot PRNG carries (see runner.DecodeState)
 
 
 jax.tree_util.register_dataclass(
     PagedDecodeState,
     data_fields=["pool_k", "pool_v", "seq_lens", "tokens", "active",
-                 "temperature", "top_p", "key"],
+                 "temperature", "top_p", "keys"],
     meta_fields=[],
 )
 
@@ -191,7 +196,8 @@ class PagedModelRunner(ModelRunner):
     # ------------------------------------------------------------- programs
 
     def _insert_paged_impl(self, state: PagedDecodeState, page_idx, ks, vs,
-                           slot, plen, first_token, temperature, top_p):
+                           slot, plen, first_token, temperature, top_p,
+                           slot_key):
         """Scatter a prefilled prompt's KV pages into the pool.
 
         ks/vs: [L, 1, Hkv, bucket, Dh]; page_idx: [bucket/page] pool pages.
@@ -214,7 +220,7 @@ class PagedModelRunner(ModelRunner):
             active=state.active.at[slot].set(True),
             temperature=state.temperature.at[slot].set(temperature),
             top_p=state.top_p.at[slot].set(top_p),
-            key=state.key,
+            keys=state.keys.at[slot].set(slot_key),
         )
 
     def _release_paged_impl(self, state: PagedDecodeState, slot):
@@ -223,7 +229,8 @@ class PagedModelRunner(ModelRunner):
             seq_lens=state.seq_lens.at[slot].set(0),
             tokens=state.tokens.at[slot].set(0),
             active=state.active.at[slot].set(False),
-            temperature=state.temperature, top_p=state.top_p, key=state.key,
+            temperature=state.temperature, top_p=state.top_p,
+            keys=state.keys,
         )
 
     def _prefill_ctx_impl(self, params, tokens, slen, ctx_len, pool_k, pool_v,
@@ -390,14 +397,15 @@ class PagedModelRunner(ModelRunner):
             x, (pool_k, pool_v) = jax.lax.scan(
                 body, x, (params["layers"], st.pool_k, st.pool_v, windows))
             logits = T._unembed(params, cfg, x)
-            key, sub = jax.random.split(st.key)
-            next_tokens = sample_tokens(logits, st.temperature, st.top_p, sub)
+            carry, sub = split_slot_keys(st.keys)
+            next_tokens = sample_tokens_slots(logits, st.temperature,
+                                              st.top_p, sub)
             next_tokens = jnp.where(st.active, next_tokens, 0)
             new_state = PagedDecodeState(
                 pool_k=pool_k, pool_v=pool_v,
                 seq_lens=jnp.where(st.active, st.seq_lens + 1, st.seq_lens),
                 tokens=next_tokens, active=st.active,
-                temperature=st.temperature, top_p=st.top_p, key=key,
+                temperature=st.temperature, top_p=st.top_p, keys=carry,
             )
             return new_state, next_tokens
 
@@ -441,12 +449,13 @@ class PagedModelRunner(ModelRunner):
             active=jnp.zeros((b,), bool),
             temperature=jnp.zeros((b,), jnp.float32),
             top_p=jnp.ones((b,), jnp.float32),
-            key=jax.random.PRNGKey(seed),
+            keys=jnp.zeros((b, 2), jnp.uint32),
         )
 
     def insert(self, state: PagedDecodeState, slot: int, ks, vs, plen: int,
                first_token: int, temperature: float, top_p: float,
-               prompt_tokens: list[int] | None = None):
+               prompt_tokens: list[int] | None = None,
+               slot_key=None):
         """Place a prefilled sequence: shared prefix pages (from the paired
         prefill's match, refcounted) + freshly scattered suffix pages."""
         bucket = ks.shape[3]
@@ -489,10 +498,12 @@ class PagedModelRunner(ModelRunner):
                     if ki > 0:  # chain edge for cascade eviction
                         self._key_children.setdefault(
                             keys[ki - 1], set()).add(keys[ki])
+        if slot_key is None:
+            slot_key = default_slot_key(slot)
         return self._insert_paged(
             state, jnp.asarray(fresh, jnp.int32), ks, vs, jnp.int32(slot),
             jnp.int32(plen), jnp.int32(first_token),
-            jnp.float32(temperature), jnp.float32(top_p),
+            jnp.float32(temperature), jnp.float32(top_p), slot_key,
         )
 
     def release(self, state: PagedDecodeState, slot: int):

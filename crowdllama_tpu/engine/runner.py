@@ -27,7 +27,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from crowdllama_tpu.engine.sampling import sample_tokens
+from crowdllama_tpu.engine.sampling import (
+    default_slot_key,
+    sample_tokens,
+    sample_tokens_slots,
+    split_slot_keys,
+)
 from crowdllama_tpu.models import transformer as T
 from crowdllama_tpu.models.config import ModelConfig
 from crowdllama_tpu.parallel.mesh import (
@@ -61,7 +66,10 @@ class DecodeState:
     active: jnp.ndarray    # [B] bool
     temperature: jnp.ndarray  # [B] fp32
     top_p: jnp.ndarray     # [B] fp32
-    key: jax.Array         # PRNG carry
+    # Per-slot PRNG carries [B, 2]: each slot samples with its own key
+    # stream (set at insert), so a seeded request reproduces its tokens
+    # regardless of slot assignment or what else shares the batch.
+    keys: jnp.ndarray
     # int8 KV cache only (kv_dtype="int8"): per-(position, kv-head) scales;
     # None for the bf16 cache (None is an empty pytree — same treedef works
     # for both layouts).
@@ -75,7 +83,7 @@ class DecodeState:
 jax.tree_util.register_dataclass(
     DecodeState,
     data_fields=["k_cache", "v_cache", "seq_lens", "tokens", "active",
-                 "temperature", "top_p", "key", "k_scale", "v_scale",
+                 "temperature", "top_p", "keys", "k_scale", "v_scale",
                  "hist"],
     meta_fields=[],
 )
@@ -197,7 +205,7 @@ class ModelRunner:
         return tok, ks, vs
 
     def _insert_impl(self, state: DecodeState, slot, ks, vs, plen, first_token,
-                     temperature, top_p) -> DecodeState:
+                     temperature, top_p, slot_key) -> DecodeState:
         """Write a prefilled sequence (ks/vs [L,1,Hkv,T,Dh]) into ``slot``."""
         k_scale, v_scale = state.k_scale, state.v_scale
         if self.kv_dtype == "int8":
@@ -221,7 +229,7 @@ class ModelRunner:
             active=state.active.at[slot].set(True),
             temperature=state.temperature.at[slot].set(temperature),
             top_p=state.top_p.at[slot].set(top_p),
-            key=state.key,
+            keys=state.keys.at[slot].set(slot_key),
             k_scale=k_scale, v_scale=v_scale,
             hist=state.hist,
         )
@@ -232,7 +240,7 @@ class ModelRunner:
             seq_lens=state.seq_lens.at[slot].set(0),
             tokens=state.tokens.at[slot].set(0),
             active=state.active.at[slot].set(False),
-            temperature=state.temperature, top_p=state.top_p, key=state.key,
+            temperature=state.temperature, top_p=state.top_p, keys=state.keys,
             k_scale=state.k_scale, v_scale=state.v_scale, hist=state.hist,
         )
 
@@ -269,15 +277,16 @@ class ModelRunner:
                     sp_mesh=self._sp_mesh, dp_axis=AXIS_DP,
                     n_shards=self.mesh.size,
                 )
-            key, sub = jax.random.split(st.key)
-            next_tokens = sample_tokens(logits, st.temperature, st.top_p, sub)
+            carry, sub = split_slot_keys(st.keys)
+            next_tokens = sample_tokens_slots(logits, st.temperature,
+                                              st.top_p, sub)
             next_tokens = jnp.where(st.active, next_tokens, 0)
             new_state = DecodeState(
                 k_cache=k_cache, v_cache=v_cache,
                 seq_lens=jnp.where(st.active, st.seq_lens + 1, st.seq_lens),
                 tokens=next_tokens,
                 active=st.active,
-                temperature=st.temperature, top_p=st.top_p, key=key,
+                temperature=st.temperature, top_p=st.top_p, keys=carry,
                 k_scale=k_scale, v_scale=v_scale, hist=st.hist,
             )
             return new_state, next_tokens
@@ -308,7 +317,9 @@ class ModelRunner:
             active=jnp.zeros((b,), bool),
             temperature=jnp.zeros((b,), jnp.float32),
             top_p=jnp.ones((b,), jnp.float32),
-            key=jax.random.PRNGKey(seed),
+            # Zero keys: valid carries, always overwritten at insert (the
+            # slot's stream comes from the request seed / scheduler RNG).
+            keys=jnp.zeros((b, 2), jnp.uint32),
             k_scale=(jax.device_put(jnp.zeros(shape[:-1], jnp.bfloat16),
                                     scale_sharding) if quantized else None),
             v_scale=(jax.device_put(jnp.zeros(shape[:-1], jnp.bfloat16),
@@ -490,14 +501,20 @@ class ModelRunner:
 
     def insert(self, state: DecodeState, slot: int, ks, vs, plen: int,
                first_token: int, temperature: float, top_p: float,
-               prompt_tokens: list[int] | None = None) -> DecodeState:
+               prompt_tokens: list[int] | None = None,
+               slot_key: jax.Array | None = None) -> DecodeState:
         # KV buckets shorter than max_seq: pad via dynamic slice into cache.
         # ``prompt_tokens`` is accepted (and ignored) so the scheduler can
         # pass the prompt uniformly; the spec runner needs it for its
-        # n-gram history (engine/spec.py).
+        # n-gram history (engine/spec.py).  ``slot_key`` seeds the slot's
+        # private sampling stream (scheduler derives it from the request
+        # seed); default keeps direct callers (bench, tests) deterministic.
+        if slot_key is None:
+            slot_key = default_slot_key(slot)
         return self._insert(
             state, jnp.int32(slot), ks, vs, jnp.int32(plen),
             jnp.int32(first_token), jnp.float32(temperature), jnp.float32(top_p),
+            slot_key,
         )
 
     def release(self, state: DecodeState, slot: int) -> DecodeState:

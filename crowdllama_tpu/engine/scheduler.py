@@ -53,6 +53,10 @@ class GenRequest:
     temperature: float = 0.0
     top_p: float = 1.0
     eos_id: int = -1
+    # 0 = unseeded (scheduler RNG); non-zero makes sampling reproducible:
+    # identical seeded requests yield identical tokens (Ollama honors seed;
+    # proto/llama_v1.proto carries it).
+    seed: int = 0
     id: int = field(default_factory=itertools.count().__next__)
     # queue of (token_id | _DONE sentinel, finish_reason)
     out: asyncio.Queue = field(default_factory=asyncio.Queue)
@@ -212,10 +216,21 @@ class Scheduler:
                 return i
         return None
 
+    def _req_key(self, req: GenRequest, lane: int) -> jax.Array:
+        """PRNG key for one sampling lane of a request (0 = prefill's first
+        token, 1 = the slot's decode stream).  Seeded requests derive both
+        from the seed alone, so identical seeded requests reproduce exactly;
+        unseeded ones draw from the scheduler RNG."""
+        if req.seed:
+            return jax.random.fold_in(
+                jax.random.PRNGKey(req.seed & 0x7FFFFFFF), lane)
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
     async def _admit_one(self, req: GenRequest, slot: int) -> None:
         import functools
 
-        self._rng, sub = jax.random.split(self._rng)
+        sub = self._req_key(req, 0)
         loop = asyncio.get_running_loop()
         first, ks, vs, plen = await loop.run_in_executor(
             self._exec, functools.partial(
@@ -231,6 +246,7 @@ class Scheduler:
         self.state = self.runner.insert(
             self.state, slot, ks, vs, plen, first, req.temperature,
             req.top_p, prompt_tokens=req.prompt_ids,
+            slot_key=self._req_key(req, 1),
         )
         info = _SlotInfo(req=req, prompt_len=plen)
         self.slots[slot] = info
@@ -365,7 +381,7 @@ class Scheduler:
                 elif await loop.run_in_executor(
                         self._exec, self.runner.prefill_step, job):
                     self._chunking = None
-                    self._rng, sub = jax.random.split(self._rng)
+                    sub = self._req_key(req, 0)
                     import functools
 
                     first, ks, vs, plen = await loop.run_in_executor(

