@@ -6,8 +6,10 @@ phase finishes and mirrored to BENCH_partial.jsonl — a later phase dying
 (or the TPU tunnel dropping mid-run) cannot erase earlier results.
 
 Phases (CROWDLLAMA_BENCH_PHASES to select, comma-separated):
-  decode    TinyLlama-1.1B int8 decode throughput (headline parity config)
-  decode8b  Llama-3-8B int8 decode throughput (BASELINE config 2 headline)
+  decode       TinyLlama-1.1B int8 decode throughput (headline parity config)
+  decode_paged same config on the paged KV pool + fused pallas paged-decode
+               kernel (the serving default) — must land within ~5% of decode
+  decode8b     Llama-3-8B int8 decode throughput (BASELINE config 2 headline)
   kernel    Pallas flash prefill+decode numeric parity vs the jnp reference
             ops, on the attached device (interpret-mode on CPU fallback)
   ttft      gateway p50 TTFT through the full loopback stack
@@ -52,7 +54,8 @@ from pathlib import Path
 
 BASELINE_ADVERTISED_TOKS = 150.0  # reference worker's hardcoded claim
 PARTIAL_PATH = Path(__file__).resolve().parent / "BENCH_partial.jsonl"
-_ALL_PHASES = ("decode", "decode8b", "kernel", "ttft", "swarm")
+_ALL_PHASES = ("decode", "decode_paged", "decode8b", "kernel", "ttft",
+               "swarm")
 
 # Honor JAX_PLATFORMS even though the image's sitecustomize pre-imports jax
 # pinned to the axon (TPU tunnel) platform — env vars alone are read too
@@ -121,7 +124,7 @@ def _clear_backends() -> None:
 # ----------------------------------------------------------------- decode
 
 
-def _decode_phase(model: str) -> dict:
+def _decode_phase(model: str, layout: str = "contiguous") -> dict:
     """Saturated-batch decode throughput (tokens/sec/chip) for ``model``."""
     import jax
     import numpy as np
@@ -164,8 +167,16 @@ def _decode_phase(model: str) -> dict:
         # serves from.  Throughput-identical to quantize_params(init(...)).
         params = random_quantized_params(cfg, jax.random.PRNGKey(0),
                                          mode=quantize)
-    runner = ModelRunner(cfg, params=params, max_slots=slots,
-                         max_seq=cfg.max_context_length, kv_dtype=kv_dtype)
+    if layout == "paged":
+        from crowdllama_tpu.engine.paged import PagedModelRunner
+
+        runner = PagedModelRunner(cfg, params=params, max_slots=slots,
+                                  max_seq=cfg.max_context_length,
+                                  kv_dtype=kv_dtype)
+    else:
+        runner = ModelRunner(cfg, params=params, max_slots=slots,
+                             max_seq=cfg.max_context_length,
+                             kv_dtype=kv_dtype)
     state = runner.init_state()
 
     # Fill every slot with a short prompt so the decode batch is saturated.
@@ -174,7 +185,8 @@ def _decode_phase(model: str) -> dict:
     for slot in range(runner.max_slots):
         prompt = rng.integers(1, cfg.vocab_size, size=24).tolist()
         key, sub = jax.random.split(key)
-        first, ks, vs, plen = runner.prefill(prompt, 0.7, 0.95, sub)
+        first, ks, vs, plen = runner.prefill(prompt, 0.7, 0.95, sub,
+                                             state=state)
         state = runner.insert(state, slot, ks, vs, plen, first, 0.7, 0.95)
     print(f"# setup+prefill: {time.monotonic() - t0:.1f}s", file=sys.stderr)
 
@@ -197,15 +209,17 @@ def _decode_phase(model: str) -> dict:
 
     per_chip = done * runner.max_slots / dt / n_chips
     on_tpu = platform == "tpu"
+    name = model if layout == "contiguous" else f"{model} (paged KV)"
     return {
-        "metric": f"{model} decode throughput",
+        "metric": f"{name} decode throughput",
         "value": round(per_chip, 2),
         "unit": "tokens/sec/chip",
         "vs_baseline": (round(per_chip / BASELINE_ADVERTISED_TOKS, 3)
                         if on_tpu else None),
         "extra": {"platform": platform, "slots": runner.max_slots,
                   "steps": done, "ctx": cfg.max_context_length,
-                  "quantize": quantize or "bf16", "kv_dtype": kv_dtype},
+                  "quantize": quantize or "bf16", "kv_dtype": kv_dtype,
+                  "kv_layout": layout},
     }
 
 
@@ -271,6 +285,40 @@ def _kernel_parity_phase() -> dict:
         got = flash.flash_decode_attention(qd, kc, vc, seq_lens, scale)
         want = A.decode_attention_ref(qd, kc, vc, seq_lens, scale)
         checks["decode"] = err(got, want)
+
+        # Fused paged-decode kernel vs the gather reference (bf16 + int8).
+        from crowdllama_tpu.ops.pallas.paged import (
+            flash_paged_decode_attention,
+        )
+        from crowdllama_tpu.ops.quant import quantize_kv
+
+        page, np_, pool_pages = 128, t // 128, 2 * (t // 128) + 1
+        rng = np.random.default_rng(3)
+        pool_k = jax.random.normal(ks[6], (pool_pages, hkv, page, dh),
+                                   jnp.bfloat16)
+        pool_v = jax.random.normal(ks[7], (pool_pages, hkv, page, dh),
+                                   jnp.bfloat16)
+        table = jnp.asarray(
+            rng.permutation(pool_pages)[: b * np_].reshape(b, np_), jnp.int32)
+        kg = pool_k[table].transpose(0, 2, 1, 3, 4).reshape(b, hkv, t, dh)
+        vg = pool_v[table].transpose(0, 2, 1, 3, 4).reshape(b, hkv, t, dh)
+        got = flash_paged_decode_attention(qd, pool_k, pool_v, table,
+                                           seq_lens, scale)
+        want = A.decode_attention_ref(qd, kg, vg, seq_lens, scale)
+        checks["paged_decode"] = err(got, want)
+
+        k_i8, k_sc = quantize_kv(pool_k)
+        v_i8, v_sc = quantize_kv(pool_v)
+        got = flash_paged_decode_attention(qd, k_i8, v_i8, table, seq_lens,
+                                           scale, k_scale=k_sc, v_scale=v_sc)
+        ksg = k_sc[table].transpose(0, 2, 1, 3).reshape(b, hkv, t)
+        vsg = v_sc[table].transpose(0, 2, 1, 3).reshape(b, hkv, t)
+        want = A.decode_attention_q(qd, k_i8[table].transpose(0, 2, 1, 3, 4)
+                                    .reshape(b, hkv, t, dh), ksg,
+                                    v_i8[table].transpose(0, 2, 1, 3, 4)
+                                    .reshape(b, hkv, t, dh), vsg,
+                                    seq_lens, scale)
+        checks["paged_decode_int8"] = err(got, want)
     finally:
         if mode == "interpret":
             if prev is None:
@@ -281,7 +329,7 @@ def _kernel_parity_phase() -> dict:
     tol = 2e-2  # bf16 inputs, fp32 accumulation in both paths
     ok = all(e <= tol for e in checks.values())
     return {
-        "metric": "pallas kernel parity (flash prefill+decode vs jnp)",
+        "metric": "pallas kernel parity (flash + paged decode vs jnp)",
         "value": 1.0 if ok else 0.0,
         "unit": "pass",
         "vs_baseline": None,
@@ -350,6 +398,9 @@ def main() -> None:
     runners = {
         "decode": lambda: _decode_phase(
             os.environ.get("CROWDLLAMA_BENCH_MODEL", "tinyllama-1.1b")),
+        "decode_paged": lambda: _decode_phase(
+            os.environ.get("CROWDLLAMA_BENCH_MODEL", "tinyllama-1.1b"),
+            layout="paged"),
         "decode8b": lambda: _decode_phase("llama-3-8b"),
         "kernel": _kernel_parity_phase,
         "ttft": _ttft_phase,

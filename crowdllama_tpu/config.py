@@ -99,10 +99,12 @@ class Configuration:
     decode_chunk: int = 8  # decode steps per device dispatch
     warmup: bool = True  # compile prefill/decode at engine start
     quantize: str = ""  # "" (bf16) | "int8" | "int4" weight-only (ops/quant.py)
-    # KV cache layout: "contiguous" [L,B,Hkv,S,Dh] per slot, or "paged"
-    # (engine/paged.py): page pool + slot page tables; kv_pool_tokens 0 =
-    # full capacity (no overcommit), else total pooled tokens.
-    kv_layout: str = "contiguous"
+    # KV cache layout: "paged" (engine/paged.py, the default: page pool +
+    # slot page tables + prefix cache + fused pallas decode) or
+    # "contiguous" [L,B,Hkv,S,Dh] per slot (required by spec_decode and
+    # dp/sp/pp meshes); kv_pool_tokens 0 = full capacity (no overcommit),
+    # else total pooled tokens.
+    kv_layout: str = "paged"
     kv_page_size: int = 128
     kv_pool_tokens: int = 0
     kv_dtype: str = "bf16"  # "bf16" | "int8" quantized KV cache (contiguous)
@@ -173,6 +175,11 @@ class Configuration:
         cfg.profile_dir = env.get("CROWDLLAMA_TPU_PROFILE_DIR", cfg.profile_dir)
         if env.get("CROWDLLAMA_TPU_WARMUP"):
             cfg.warmup = env["CROWDLLAMA_TPU_WARMUP"] in ("1", "true")
+        # Whether kv_layout was chosen by the user (env or override) vs the
+        # dataclass default — spec_decode auto-falls-back only on the
+        # default (see below).
+        explicit_layout = bool(env.get("CROWDLLAMA_TPU_KV_LAYOUT")) or (
+            overrides.get("kv_layout") is not None)
         for k, v in overrides.items():
             if v is not None:
                 setattr(cfg, k, v)
@@ -193,16 +200,23 @@ class Configuration:
         if cfg.kv_dtype not in ("bf16", "int8"):
             raise ValueError(f"unknown kv dtype {cfg.kv_dtype!r} "
                              "(want 'bf16' or 'int8')")
-        if cfg.kv_dtype == "int8" and cfg.kv_layout == "paged":
-            raise ValueError("int8 KV cache is contiguous-layout only")
+        # int8 KV composes with both layouts (paged pools carry per-page
+        # scales; ops/pallas/paged.py dequantizes in-kernel).
         cfg.spec_decode = (cfg.spec_decode or "").strip().lower()
         if cfg.spec_decode not in ("", "ngram"):
             raise ValueError(f"unknown spec_decode {cfg.spec_decode!r} "
                              "(want '' or 'ngram')")
         if cfg.spec_decode:
+            if cfg.kv_layout == "paged" and not explicit_layout:
+                # kv_layout is merely the paged default; the explicit spec
+                # request wins (spec's verify forward reads the cache as
+                # bf16 attention context).
+                cfg.kv_layout = "contiguous"
             if cfg.kv_layout != "contiguous" or cfg.kv_dtype != "bf16":
-                raise ValueError("spec_decode requires the contiguous bf16 "
-                                 "KV cache")
+                raise ValueError(
+                    "spec_decode requires the contiguous bf16 KV cache — "
+                    "set --kv-layout contiguous --kv-dtype bf16 (kv_layout "
+                    "defaults to paged)")
             if cfg.spec_draft < 1:
                 raise ValueError("spec_draft must be >= 1")
         return cfg
@@ -246,7 +260,7 @@ class Configuration:
         parser.add_argument("--kv-dtype", dest="kv_dtype",
                             choices=("bf16", "int8"),
                             help="KV cache dtype (int8: quantized cache, "
-                                 "contiguous layout only)")
+                                 "contiguous or paged layout)")
         parser.add_argument("--spec-decode", dest="spec_decode",
                             choices=("", "ngram"),
                             help="speculative decoding (ngram prompt lookup)")

@@ -195,13 +195,38 @@ class JaxEngine(Engine):
                 max_slots=self.config.max_batch_slots,
                 max_seq=cfg.max_context_length,
             )
-            if self.config.kv_layout == "paged":
+            kv_layout = self.config.kv_layout
+            if self.config.spec_decode == "ngram":
+                # Spec decode needs the contiguous bf16 cache (the verify
+                # forward reads it as attention context); an explicit
+                # paged+spec combination is rejected by config validation,
+                # so reaching here means kv_layout is the paged default —
+                # the explicit spec request wins.
+                kv_layout = "contiguous"
+            if kv_layout == "paged" and self.config.mesh_shape:
+                import jax
+
+                from crowdllama_tpu.parallel.mesh import parse_mesh_spec
+
+                dp, pp, sp, _ep, _tp = parse_mesh_spec(
+                    self.config.mesh_shape, len(jax.devices()))
+                if dp > 1 or pp > 1 or sp > 1:
+                    # The shared page pool cannot shard over dp, and sp/pp
+                    # need the contiguous layout — honor the mesh request
+                    # rather than crash on the paged default.
+                    log.warning(
+                        "kv_layout=paged does not compose with mesh %s "
+                        "(dp/sp/pp > 1); using the contiguous layout",
+                        self.config.mesh_shape)
+                    kv_layout = "contiguous"
+            if kv_layout == "paged":
                 from crowdllama_tpu.engine.paged import PagedModelRunner
 
                 return PagedModelRunner(
                     cfg, page_size=self.config.kv_page_size,
                     pool_tokens=self.config.kv_pool_tokens,
-                    prefix_cache=self.config.kv_prefix_cache, **kwargs)
+                    prefix_cache=self.config.kv_prefix_cache,
+                    kv_dtype=self.config.kv_dtype, **kwargs)
             if self.config.spec_decode == "ngram":
                 from crowdllama_tpu.engine.spec import SpecModelRunner
 
@@ -244,7 +269,8 @@ class JaxEngine(Engine):
             pages = np.full((r.max_pages_per_slot,), r.total_pages, np.int32)
             r._prefill_ctx(r.params, jnp.zeros((1, r.buckets[0]), jnp.int32),
                            jnp.int32(1), jnp.int32(0), state.pool_k,
-                           state.pool_v, jnp.asarray(pages), jnp.float32(0.0),
+                           state.pool_v, state.k_scale, state.v_scale,
+                           jnp.asarray(pages), jnp.float32(0.0),
                            jnp.float32(1.0), jax.random.PRNGKey(0))
         try:
             r.embed_prompts([[1, 2, 3]])

@@ -12,11 +12,15 @@ paged attention as the north star).  Here KV lives in a pool of fixed
 - page table:  host-side ``[B, max_pages]`` int32, passed into each decode
   dispatch (tiny transfer); pages are allocated at insert (prompt pages)
   and before each decode chunk (growth), freed at release.
-- decode attention: gather the slot's pages into a virtual-contiguous view
-  and run the existing masked attention — exact, static-shaped.  The
-  gather materializes the view per layer, which a fused ragged-paged
-  Pallas kernel would avoid; capacity (not bandwidth) is what paging buys
-  at this stage.
+- decode attention: the fused Pallas kernel (ops/pallas/paged.py) reads
+  pages straight from the pool via the scalar-prefetched page table —
+  no virtual-contiguous gather, so paging buys capacity AND streams the
+  minimum bytes.  CPU and sharded (tp>1) meshes fall back to the jnp
+  gather view (exact, static-shaped, just more HBM traffic).
+- int8 pools (``kv_dtype="int8"``): pages are int8 with per-(position,
+  kv-head) scales; the kernel dequantizes in-flight (K on the score
+  plane, V folded into probabilities), and suffix prefill dequantizes
+  only the one slot's context pages.  Composes with the prefix cache.
 
 Page exhaustion under an overcommitted pool surfaces at admission as a
 ValueError (the scheduler fails that request cleanly); when growth runs
@@ -46,7 +50,12 @@ from crowdllama_tpu.engine.sampling import (
     split_slot_keys,
 )
 from crowdllama_tpu.models import transformer as T
-from crowdllama_tpu.ops.attention import decode_attention
+from crowdllama_tpu.ops.attention import decode_attention, decode_attention_q
+from crowdllama_tpu.ops.pallas.paged import (
+    flash_paged_decode_attention,
+    paged_pallas_supported,
+)
+from crowdllama_tpu.ops.quant import quantize_kv
 from crowdllama_tpu.ops.rope import rope_table
 
 log = logging.getLogger("crowdllama.engine.paged")
@@ -66,12 +75,16 @@ class PagedDecodeState:
     temperature: jnp.ndarray
     top_p: jnp.ndarray
     keys: jnp.ndarray  # [B, 2] per-slot PRNG carries (see runner.DecodeState)
+    # int8 pools only (kv_dtype="int8"): per-(page-position, kv-head)
+    # scales [L, P, Hkv, page]; None for bf16 pools.
+    k_scale: jnp.ndarray | None = None
+    v_scale: jnp.ndarray | None = None
 
 
 jax.tree_util.register_dataclass(
     PagedDecodeState,
     data_fields=["pool_k", "pool_v", "seq_lens", "tokens", "active",
-                 "temperature", "top_p", "keys"],
+                 "temperature", "top_p", "keys", "k_scale", "v_scale"],
     meta_fields=[],
 )
 
@@ -91,10 +104,6 @@ class PagedModelRunner(ModelRunner):
 
             kwargs["mesh_spec"] = (
                 f"1x{largest_tp(len(jax.devices()), cfg.num_kv_heads)}")
-        if kwargs.get("kv_dtype", "bf16") != "bf16":
-            raise NotImplementedError(
-                "int8 KV cache is contiguous-layout only for now "
-                "(paged pages stay bf16)")
         super().__init__(cfg, *args, **kwargs)
         from crowdllama_tpu.parallel.mesh import AXIS_DP
 
@@ -204,6 +213,19 @@ class PagedModelRunner(ModelRunner):
         """
         l, _, hkv, bucket, dh = ks.shape
         npages = bucket // self.page_size
+        k_scale, v_scale = state.k_scale, state.v_scale
+        if self.kv_dtype == "int8":
+            # Quantize the prompt's KV before the page scatter; scales are
+            # per (position, kv-head) like the contiguous int8 cache.
+            ks, k_sc = quantize_kv(ks, scale_dtype=k_scale.dtype)
+            vs, v_sc = quantize_kv(vs, scale_dtype=v_scale.dtype)
+            # [L, 1, Hkv, bucket] -> [L, np, Hkv, page]
+            ksp = k_sc[:, 0].reshape(l, hkv, npages, self.page_size
+                                     ).transpose(0, 2, 1, 3)
+            vsp = v_sc[:, 0].reshape(l, hkv, npages, self.page_size
+                                     ).transpose(0, 2, 1, 3)
+            k_scale = k_scale.at[:, page_idx].set(ksp)
+            v_scale = v_scale.at[:, page_idx].set(vsp)
         # [L, Hkv, bucket, Dh] -> [L, np, Hkv, page, Dh] (page-major rows)
         kp = ks[:, 0].reshape(l, hkv, npages, self.page_size, dh).transpose(
             0, 2, 1, 3, 4)
@@ -215,6 +237,7 @@ class PagedModelRunner(ModelRunner):
             vp.astype(state.pool_v.dtype))
         return PagedDecodeState(
             pool_k=pool_k, pool_v=pool_v,
+            k_scale=k_scale, v_scale=v_scale,
             seq_lens=state.seq_lens.at[slot].set(plen),
             tokens=state.tokens.at[slot].set(first_token),
             active=state.active.at[slot].set(True),
@@ -226,6 +249,7 @@ class PagedModelRunner(ModelRunner):
     def _release_paged_impl(self, state: PagedDecodeState, slot):
         return PagedDecodeState(
             pool_k=state.pool_k, pool_v=state.pool_v,
+            k_scale=state.k_scale, v_scale=state.v_scale,
             seq_lens=state.seq_lens.at[slot].set(0),
             tokens=state.tokens.at[slot].set(0),
             active=state.active.at[slot].set(False),
@@ -234,7 +258,7 @@ class PagedModelRunner(ModelRunner):
         )
 
     def _prefill_ctx_impl(self, params, tokens, slen, ctx_len, pool_k, pool_v,
-                          pages, temperature, top_p, key):
+                          k_scale, v_scale, pages, temperature, top_p, key):
         """Suffix prefill attending over cached prefix pages.
 
         tokens [1, bucket] suffix; pages [max_pages_per_slot] pool pages
@@ -247,10 +271,18 @@ class PagedModelRunner(ModelRunner):
         t = tokens.shape[1]
         c = pages.shape[0] * pg
         # [L, n, Hkv, pg, Dh] -> [L, 1, Hkv, n*pg, Dh] virtual-contiguous ctx
-        ck = pool_k[:, pages].transpose(0, 2, 1, 3, 4).reshape(
-            l, 1, hkv, c, dh)
-        cv = pool_v[:, pages].transpose(0, 2, 1, 3, 4).reshape(
-            l, 1, hkv, c, dh)
+        ck, cv = pool_k[:, pages], pool_v[:, pages]
+        if self.kv_dtype == "int8":
+            # Dequantize the one slot's context pages (compute-bound prefill
+            # can afford the bf16 view; decode never materializes one).
+            ck = (ck.astype(jnp.float32)
+                  * k_scale[:, pages][..., None].astype(jnp.float32)
+                  ).astype(self.dtype)
+            cv = (cv.astype(jnp.float32)
+                  * v_scale[:, pages][..., None].astype(jnp.float32)
+                  ).astype(self.dtype)
+        ck = ck.transpose(0, 2, 1, 3, 4).reshape(l, 1, hkv, c, dh)
+        cv = cv.transpose(0, 2, 1, 3, 4).reshape(l, 1, hkv, c, dh)
         ctx_valid = (jnp.arange(c) < ctx_len)[None, :]
         positions = ctx_len + jnp.minimum(jnp.arange(t)[None, :], slen - 1)
         kv_valid = (jnp.arange(t) < slen)[None, :]
@@ -343,6 +375,7 @@ class PagedModelRunner(ModelRunner):
         tok, ks, vs = self._prefill_ctx(
             self.params, jnp.asarray(tokens), jnp.int32(len(suffix)),
             jnp.int32(ctx_len), state.pool_k, state.pool_v,
+            state.k_scale, state.v_scale,
             jnp.asarray(pages), jnp.float32(temperature),
             jnp.float32(top_p), key,
         )
@@ -361,6 +394,10 @@ class PagedModelRunner(ModelRunner):
         windows = T.layer_sliding_windows(cfg)
         view_len = self.max_pages_per_slot * pg
         slot_idx = jnp.arange(b)
+        quant = self.kv_dtype == "int8"
+        # Fused kernel reads pages via the scalar-prefetched table; the jnp
+        # gather view is the portable (CPU / sharded-mesh) fallback.
+        use_kernel = paged_pallas_supported(pg, dh, self.mesh.size)
 
         def step(st: PagedDecodeState, _):
             positions = jnp.minimum(st.seq_lens, self.max_seq - 1)
@@ -374,28 +411,55 @@ class PagedModelRunner(ModelRunner):
             offset = positions % pg
 
             def body(x, scanned):
-                lp, pk, pv, window = scanned  # pk/pv: [P, Hkv, page, Dh]
+                lp, pk, pv, ksc, vsc, window = scanned
                 pool = {}
 
                 def attn_fn(q, k, v):
-                    pk2 = pk.at[cur_page, :, offset].set(k.astype(pk.dtype))
-                    pv2 = pv.at[cur_page, :, offset].set(v.astype(pv.dtype))
-                    pool["pk"], pool["pv"] = pk2, pv2
+                    if quant:
+                        kq, k_sc = quantize_kv(k, scale_dtype=ksc.dtype)
+                        vq, v_sc = quantize_kv(v, scale_dtype=vsc.dtype)
+                        pk2 = pk.at[cur_page, :, offset].set(kq)
+                        pv2 = pv.at[cur_page, :, offset].set(vq)
+                        ks2 = ksc.at[cur_page, :, offset].set(k_sc)
+                        vs2 = vsc.at[cur_page, :, offset].set(v_sc)
+                    else:
+                        pk2 = pk.at[cur_page, :, offset].set(
+                            k.astype(pk.dtype))
+                        pv2 = pv.at[cur_page, :, offset].set(
+                            v.astype(pv.dtype))
+                        ks2 = vs2 = None
+                    pool.update(pk=pk2, pv=pv2, ks=ks2, vs=vs2)
+                    if use_kernel:
+                        return flash_paged_decode_attention(
+                            q, pk2, pv2, page_table, lens, scale,
+                            softcap=cfg.attn_logit_softcap,
+                            sliding_window=window,
+                            k_scale=ks2, v_scale=vs2)
                     # Virtual-contiguous view of each slot's pages.
                     kc = pk2[page_table].transpose(0, 2, 1, 3, 4).reshape(
                         b, hkv, view_len, dh)
                     vc = pv2[page_table].transpose(0, 2, 1, 3, 4).reshape(
                         b, hkv, view_len, dh)
+                    if quant:
+                        ksg = ks2[page_table].transpose(0, 2, 1, 3).reshape(
+                            b, hkv, view_len)
+                        vsg = vs2[page_table].transpose(0, 2, 1, 3).reshape(
+                            b, hkv, view_len)
+                        return decode_attention_q(
+                            q, kc, ksg, vc, vsg, lens, scale,
+                            softcap=cfg.attn_logit_softcap,
+                            sliding_window=window)
                     return decode_attention(q, kc, vc, lens, scale,
                                             softcap=cfg.attn_logit_softcap,
                                             sliding_window=window)
 
                 x = T.decode_layer_body(lp, cfg, x, positions, cos, sin,
                                         attn_fn)
-                return x, (pool["pk"], pool["pv"])
+                return x, (pool["pk"], pool["pv"], pool["ks"], pool["vs"])
 
-            x, (pool_k, pool_v) = jax.lax.scan(
-                body, x, (params["layers"], st.pool_k, st.pool_v, windows))
+            x, (pool_k, pool_v, k_scale, v_scale) = jax.lax.scan(
+                body, x, (params["layers"], st.pool_k, st.pool_v,
+                          st.k_scale, st.v_scale, windows))
             logits = T._unembed(params, cfg, x)
             carry, sub = split_slot_keys(st.keys)
             next_tokens = sample_tokens_slots(logits, st.temperature,
@@ -403,6 +467,7 @@ class PagedModelRunner(ModelRunner):
             next_tokens = jnp.where(st.active, next_tokens, 0)
             new_state = PagedDecodeState(
                 pool_k=pool_k, pool_v=pool_v,
+                k_scale=k_scale, v_scale=v_scale,
                 seq_lens=jnp.where(st.active, st.seq_lens + 1, st.seq_lens),
                 tokens=next_tokens, active=st.active,
                 temperature=st.temperature, top_p=st.top_p, keys=carry,
@@ -430,6 +495,10 @@ class PagedModelRunner(ModelRunner):
         pool_sharding = NamedSharding(
             self.mesh, filter_spec(P(None, None, AXIS_TP, None, None),
                                    self.mesh))
+        quantized = self.kv_dtype == "int8"
+        pool_dtype = jnp.int8 if quantized else self.dtype
+        scale_sharding = NamedSharding(
+            self.mesh, filter_spec(P(None, None, AXIS_TP, None), self.mesh))
         self._free_pages = list(range(self.total_pages))
         self._slot_pages = {}
         self._host_seq[:] = 0
@@ -442,8 +511,12 @@ class PagedModelRunner(ModelRunner):
         self._pending_match = None
         b = self.max_slots
         return PagedDecodeState(
-            pool_k=jax.device_put(jnp.zeros(shape, self.dtype), pool_sharding),
-            pool_v=jax.device_put(jnp.zeros(shape, self.dtype), pool_sharding),
+            pool_k=jax.device_put(jnp.zeros(shape, pool_dtype), pool_sharding),
+            pool_v=jax.device_put(jnp.zeros(shape, pool_dtype), pool_sharding),
+            k_scale=(jax.device_put(jnp.zeros(shape[:-1], jnp.bfloat16),
+                                    scale_sharding) if quantized else None),
+            v_scale=(jax.device_put(jnp.zeros(shape[:-1], jnp.bfloat16),
+                                    scale_sharding) if quantized else None),
             seq_lens=jnp.zeros((b,), jnp.int32),
             tokens=jnp.zeros((b,), jnp.int32),
             active=jnp.zeros((b,), bool),

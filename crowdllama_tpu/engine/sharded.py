@@ -137,10 +137,13 @@ class ShardedEngine(Engine):
             raise ValueError("quantize is not supported with shard strategy "
                              "'ep' yet (use 'pp' or unsharded)")
         if self.config.kv_layout == "paged":
-            # Shard stages hold per-session B=1 caches, not slot pools; a
-            # requested-but-ignored layout must fail loudly.
-            raise ValueError("kv_layout='paged' is not supported by sharded "
-                             "engines yet (use the unsharded engine)")
+            # Shard stages hold per-session B=1 caches, not slot pools — a
+            # shared page pool has nothing to pool over here, so the paged
+            # DEFAULT simply doesn't apply (contiguous per-session caches
+            # are used); log rather than fail so the layout default can be
+            # paged for the unsharded engine.
+            log.info("sharded engines use per-session contiguous caches; "
+                     "kv_layout='paged' does not apply")
         self.cfg = cfg
         loop = asyncio.get_running_loop()
         # Every member loads the checkpoint and keeps only its shard; the

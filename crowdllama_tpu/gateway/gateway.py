@@ -376,9 +376,11 @@ class Gateway:
             max_tokens=int(options.get("num_predict", 0)),
             temperature=float(options.get("temperature", 0.0)),
             top_p=float(options.get("top_p", 1.0)),
-            # Mask into uint64 range: Ollama clients send arbitrary ints
-            # (commonly -1); the proto field is uint64 and would raise.
-            seed=int(options.get("seed", 0)) & 0xFFFFFFFFFFFFFFFF,
+            # Negative seeds are the conventional "random" sentinel
+            # (clients commonly send -1) — map to 0 (unseeded) rather than
+            # masking into a fixed reproducible value; the proto field is
+            # uint64 and would reject negatives anyway.
+            seed=max(0, int(options.get("seed", 0))),
         )
         tried: set[str] = set()
         last_err = "no workers available for model"
