@@ -63,6 +63,11 @@ class Resource:
     hbm_gb_per_chip: float = 0.0
     ici_topology: str = ""  # e.g. "2x4"
     max_context_length: int = 0
+    # Whether this worker's engine can serve /api/embed (sharded group
+    # leaders and pp/sp-mesh engines cannot) — the gateway routes embed
+    # requests only to capable workers instead of burning its failover
+    # retry on a worker that would deterministically fail.
+    embeddings: bool = True
     shard_group: ShardGroup | None = None
 
     def touch(self) -> None:

@@ -291,6 +291,10 @@ class JaxEngine(Engine):
 
     def describe(self) -> dict:
         d = {"models": self.models, "throughput": 0.0, "load": 0.0}
+        if self._runner is not None:
+            # pp/sp meshes have no embeddings forward (runner.embed_prompts
+            # raises) — advertise the gap so embed routing avoids us.
+            d["embeddings"] = self._runner.pp == 1 and self._runner.sp == 1
         if self.scheduler is not None:
             d["throughput"] = round(self.scheduler.throughput_ema, 2)
             d["load"] = round(self.scheduler.load, 3)
@@ -416,6 +420,10 @@ class JaxEngine(Engine):
         them (and never block the event loop)."""
         if self.scheduler is None:
             raise RuntimeError("engine not started")
+        if self.scheduler._draining:
+            # Mirror submit(): reject so the gateway fails over instead of
+            # racing the executor shutdown mid-drain (ADVICE r2).
+            raise RuntimeError("worker is draining for shutdown")
         if model and model not in self.models:
             raise ValueError(f"model {model!r} not served (have {self.models})")
         max_len = self._runner.max_seq - 1
@@ -438,11 +446,15 @@ class JaxEngine(Engine):
         # behind a bulk embed of hundreds of texts.
         out: list[list[float]] = []
         chunk_size = self._runner._EMBED_BATCH[-1]
-        for i in range(0, len(prompts), chunk_size):
-            vecs = await loop.run_in_executor(
-                self.scheduler._exec, self._runner.embed_prompts,
-                prompts[i:i + chunk_size])
-            out.extend(vecs.tolist())
+        self.scheduler._embeds += 1  # drain() waits for in-flight embeds
+        try:
+            for i in range(0, len(prompts), chunk_size):
+                vecs = await loop.run_in_executor(
+                    self.scheduler._exec, self._runner.embed_prompts,
+                    prompts[i:i + chunk_size])
+                out.extend(vecs.tolist())
+        finally:
+            self.scheduler._embeds -= 1
         return out, n_tokens
 
 

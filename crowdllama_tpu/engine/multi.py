@@ -69,6 +69,8 @@ class MultiEngine(Engine):
         per = {name: e.describe() for name, e in self._engines.items()}
         return {
             "models": self.models,
+            "embeddings": any(d.get("embeddings", True)
+                              for d in per.values()),
             "throughput": round(sum(d["throughput"] for d in per.values()), 2),
             "load": round(max(d["load"] for d in per.values()), 3),
             "engines": per,

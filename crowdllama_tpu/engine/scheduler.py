@@ -109,6 +109,7 @@ class Scheduler:
         # (kept FIFO ahead of pending).
         self._deferred: collections.deque[GenRequest] = collections.deque()
         self._draining = False
+        self._embeds = 0  # embedding forwards in flight on the executor
         # Requests whose output queues drain must also see consumed (the
         # consumer may still be flushing final frames to the client after
         # the slot retires); weak so retired requests don't accumulate.
@@ -192,9 +193,15 @@ class Scheduler:
         self._draining = True
         deadline = time.monotonic() + timeout
         while True:
+            # _inflight: the final overshoot chunk may still be queued on
+            # device after every slot retired — stop() must not cancel the
+            # loop with a program in flight (ADVICE r2).  _embeds covers
+            # embedding forwards on the dispatch executor.
             done = (all(s is None for s in self.slots)
                     and self.pending.empty() and self._admitting == 0
                     and not self._deferred
+                    and self._inflight is None
+                    and self._embeds == 0
                     and all(r.out.empty() or r.cancelled
                             for r in list(self._tracked)))
             if done:

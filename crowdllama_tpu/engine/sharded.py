@@ -239,6 +239,10 @@ class ShardedEngine(Engine):
         return {
             "models": self.models,
             "throughput": round(self._tput_ema, 2),
+            # Sharded engines have no embeddings path (Engine.embed raises
+            # NotImplementedError) — advertise it so the gateway never
+            # routes /api/embed here (Resource.embeddings).
+            "embeddings": False,
             "load": round(self._active / max(self.config.max_batch_slots, 1), 3),
             "shard_group": ShardGroup(
                 group_id=self.group_id,

@@ -284,7 +284,8 @@ class Gateway:
         tried: set[str] = set()
         last_err = "no workers available for model"
         for _attempt in range(2):  # retry once on next-best worker
-            worker = self._find_worker(model, exclude=tried)
+            worker = self._find_worker(model, exclude=tried,
+                                       require_embeddings=True)
             if worker is None:
                 break
             tried.add(worker.peer_id)
@@ -362,9 +363,13 @@ class Gateway:
 
     # -------------------------------------------------------------- routing
 
-    def _find_worker(self, model: str, exclude: set[str] = frozenset()):
+    def _find_worker(self, model: str, exclude: set[str] = frozenset(),
+                     require_embeddings: bool = False):
         pm = self.peer.peer_manager
-        return pm.find_best_worker(model, exclude=exclude) if pm else None
+        if pm is None:
+            return None
+        return pm.find_best_worker(model, exclude=exclude,
+                                   require_embeddings=require_embeddings)
 
     async def _route(self, request, model, stream, options,
                      messages=None, prompt="", chat=True) -> web.StreamResponse:
@@ -378,9 +383,10 @@ class Gateway:
             top_p=float(options.get("top_p", 1.0)),
             # Negative seeds are the conventional "random" sentinel
             # (clients commonly send -1) — map to 0 (unseeded) rather than
-            # masking into a fixed reproducible value; the proto field is
-            # uint64 and would reject negatives anyway.
-            seed=max(0, int(options.get("seed", 0))),
+            # masking into a fixed reproducible value; oversize values clamp
+            # into the proto's uint64 range instead of raising.
+            seed=min(max(0, int(options.get("seed", 0))),
+                     0xFFFFFFFFFFFFFFFF),
         )
         tried: set[str] = set()
         last_err = "no workers available for model"

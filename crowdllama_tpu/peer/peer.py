@@ -211,6 +211,7 @@ class Peer:
         r.version = VERSION
         r.worker_mode = self.worker_mode
         r.max_context_length = self.config.max_context_length
+        r.embeddings = bool(d.get("embeddings", True))
         for k, v in _tpu_capabilities().items():
             setattr(r, k, v)
         sg = d.get("shard_group")
@@ -289,11 +290,17 @@ class Peer:
             if msg.WhichOneof("message") == "embed_request":
                 # "invalid:" marks deterministic client errors (bad input)
                 # so the gateway returns 400 without burning a retry on
-                # another worker that would fail identically.
+                # another worker that would fail identically.  Capability
+                # gaps (NotImplementedError) stay retryable — another
+                # worker may well embed — and routing avoids them anyway
+                # via Resource.embeddings.
                 prefix = "invalid: " if isinstance(e, ValueError) else ""
+                detail = str(e) or (
+                    "this worker's engine does not support embeddings"
+                    if isinstance(e, NotImplementedError) else repr(e))
                 err = create_embed_response(
                     model=msg.embed_request.model, embeddings=[],
-                    worker_id=self.peer_id, error=prefix + str(e),
+                    worker_id=self.peer_id, error=prefix + detail,
                 )
             else:
                 err = create_generate_response(

@@ -156,7 +156,8 @@ class PeerManager:
     # ------------------------------------------------------------ scheduler
 
     def find_best_worker(
-        self, model: str, exclude: set[str] = frozenset()
+        self, model: str, exclude: set[str] = frozenset(),
+        require_embeddings: bool = False,
     ) -> PeerInfo | None:
         """Model-filtered best worker by throughput/(1+load)
         (manager.go:338-387).  Workers in an incomplete shard group are not
@@ -169,6 +170,8 @@ class PeerManager:
                 continue
             r = p.resource
             if model and model not in r.supported_models:
+                continue
+            if require_embeddings and not r.embeddings:
                 continue
             if r.shard_group is not None:
                 if r.shard_group.group_id not in groups:
