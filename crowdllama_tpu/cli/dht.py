@@ -57,6 +57,11 @@ async def run_server(host: str, port: int, key_path: str) -> None:
     km = KeyManager(key_path or None)
     key = km.get_or_create_private_key("dht")
     h, dht = await new_host_and_dht(key, listen_host=host, listen_port=port)
+    # Bootstrap nodes double as NAT relays: NATed workers register reverse
+    # streams here (net/relay.py; libp2p-relay parity, dht.go:386-395).
+    from crowdllama_tpu.net.relay import RelayService
+
+    relay = RelayService(h)
     iv = Intervals.default()
     # Liveness probes evict crashed providers promptly — the counterpart of
     # the reference bootstrap server's disconnect-driven removal
@@ -75,10 +80,12 @@ async def run_server(host: str, port: int, key_path: str) -> None:
         while True:
             await asyncio.sleep(15)
             log.info("routing table: %d peers | namespace providers: %d | "
-                     "streams in=%d out=%d rejected=%d | by proto: %s",
+                     "streams in=%d out=%d rejected=%d | relayed workers: %d "
+                     "| by proto: %s",
                      len(dht.table), len(dht.providers.get(namespace_key())),
                      h.stats["streams_in"], h.stats["streams_out"],
-                     h.stats["rejected"], dict(h.stats_by_protocol))
+                     h.stats["rejected"], relay.registered_count,
+                     dict(h.stats_by_protocol))
             if h.stats_by_addr_class:
                 log.info("inbound peers by address class: %s",
                          dict(h.stats_by_addr_class))

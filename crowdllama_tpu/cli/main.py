@@ -59,6 +59,15 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--temperature", type=float, default=0.7)
     run.add_argument("--top-p", type=float, default=0.95)
     run.add_argument("--max-tokens", type=int, default=0)
+    pull = sub.add_parser(
+        "pull", help="fetch a model's checkpoint from a swarm peer "
+                     "(hash-verified safetensors transfer)")
+    pull.add_argument("model", help="model name advertised by some worker")
+    pull.add_argument("--bootstrap-peers", required=True,
+                      help="comma-separated host:port bootstrap addresses")
+    pull.add_argument("--models-dir", default="",
+                      help="destination root (default ~/.crowdllama-tpu/models)")
+    pull.add_argument("--key-path", default="")
     return p
 
 
@@ -75,6 +84,11 @@ def main(argv: list[str] | None = None) -> int:
         except KeyboardInterrupt:
             print(file=sys.stderr)
             return 0
+    if args.command == "pull":
+        try:
+            return asyncio.run(_pull(args))
+        except KeyboardInterrupt:
+            return 1
     if args.command == "start":
         cfg = Configuration.from_flags(args)
         new_app_logger("crowdllama", cfg.verbose)
@@ -88,6 +102,51 @@ def main(argv: list[str] | None = None) -> int:
             return 0
     build_parser().print_help()
     return 1
+
+
+async def _pull(args) -> int:
+    """Standalone swarm pull: discover a peer advertising the model, fetch
+    its checkpoint with hash verification, print the local path.  The
+    swarm-native `ollama pull` (the reference embeds Ollama's,
+    /root/reference/cmd/crowdllama/main.go:49-78)."""
+    from crowdllama_tpu.core.protocol import namespace_key
+    from crowdllama_tpu.net.discovery import discover_peers, new_host_and_dht
+    from crowdllama_tpu.net.model_share import fetch_model
+    from crowdllama_tpu.utils.keys import KeyManager
+
+    logging.basicConfig(stream=sys.stderr, level=logging.INFO)
+    cfg = Configuration.from_environment()
+    models_dir = args.models_dir or cfg.models_dir
+    key = KeyManager(args.key_path or None).get_or_create_private_key("pull")
+    host, dht = await new_host_and_dht(key, listen_host="127.0.0.1")
+    try:
+        boots = [a.strip() for a in args.bootstrap_peers.split(",") if a.strip()]
+        await dht.bootstrap(boots)
+        resources = await discover_peers(host, dht)
+        sources = [r for r in resources
+                   if r.worker_mode and args.model in r.supported_models]
+        if not sources:
+            print(f"no swarm peer advertises model {args.model!r} "
+                  f"(discovered {len(resources)} peers)", file=sys.stderr)
+            return 1
+        last_err = None
+        for r in sources:
+            contact = await dht.find_peer(r.peer_id)
+            if contact is None:
+                last_err = RuntimeError(
+                    f"cannot resolve peer {r.peer_id[:8]}")
+                continue
+            try:
+                dest = await fetch_model(host, contact, args.model, models_dir)
+                print(dest)
+                return 0
+            except Exception as e:
+                last_err = e
+                log.warning("pull from %s failed: %s", r.peer_id[:8], e)
+        print(f"pull failed from every source: {last_err}", file=sys.stderr)
+        return 1
+    finally:
+        await host.close()
 
 
 async def _network_status(gateway: str) -> int:
@@ -230,12 +289,13 @@ def _make_engine(cfg: Configuration, worker_mode: bool):
         from crowdllama_tpu.engine.sharded import ShardedEngine
 
         return ShardedEngine(cfg)
-    if len(names) > 1:
-        from crowdllama_tpu.engine.multi import MultiEngine
+    # Always the multi-model container (even for one model): swarm pull
+    # hot-registers via MultiEngine.add_model, and a single-model JaxEngine
+    # cannot grow.
+    from crowdllama_tpu.engine.multi import MultiEngine
 
-        return MultiEngine(cfg)
-    cfg.model = names[0] if names else cfg.model  # tolerate a trailing comma
-    return JaxEngine(cfg)
+    cfg.model = ",".join(names) if names else cfg.model
+    return MultiEngine(cfg)
 
 
 async def run_node(cfg: Configuration, worker_mode: bool) -> None:

@@ -92,6 +92,8 @@ class Configuration:
     # Engine configuration (replaces the reference's OllamaBaseURL).
     model: str = "tinyllama-1.1b"
     model_path: str = ""  # local HF checkpoint dir; empty = random-init weights
+    # Destination for swarm-pulled checkpoints (net/model_share.py).
+    models_dir: str = "~/.crowdllama-tpu/models"
     engine_backend: str = "jax"  # "jax" | "fake" (testing)
     max_batch_slots: int = 8
     max_context_length: int = 2048
@@ -109,6 +111,10 @@ class Configuration:
     kv_pool_tokens: int = 0
     kv_dtype: str = "bf16"  # "bf16" | "int8" quantized KV cache (contiguous)
     kv_prefix_cache: bool = True  # paged layout: share prompt-prefix pages
+    # NAT traversal (net/relay.py): "auto" probes reachability via the
+    # bootstrap node's dialback and relays only when unreachable; "always"
+    # forces relaying (tests / known-NATed deployments); "off" disables.
+    relay_mode: str = "auto"
     spec_decode: str = ""  # "" | "ngram" speculative decode (engine/spec.py)
     spec_draft: int = 4  # draft tokens per verify step
     drain_timeout: float = 30.0  # graceful-shutdown grace for in-flight reqs
@@ -149,6 +155,7 @@ class Configuration:
         cfg.ipc_socket = env.get("CROWDLLAMA_TPU_SOCKET", cfg.ipc_socket)
         cfg.model = env.get("CROWDLLAMA_TPU_MODEL", cfg.model)
         cfg.model_path = env.get("CROWDLLAMA_TPU_MODEL_PATH", cfg.model_path)
+        cfg.models_dir = env.get("CROWDLLAMA_TPU_MODELS_DIR", cfg.models_dir)
         cfg.engine_backend = env.get("CROWDLLAMA_TPU_ENGINE", cfg.engine_backend)
         cfg.mesh_shape = env.get("CROWDLLAMA_TPU_MESH", cfg.mesh_shape)
         cfg.decode_chunk = int(env.get("CROWDLLAMA_TPU_DECODE_CHUNK", cfg.decode_chunk))
@@ -166,6 +173,7 @@ class Configuration:
         if env.get("CROWDLLAMA_TPU_KV_PREFIX_CACHE"):
             cfg.kv_prefix_cache = env["CROWDLLAMA_TPU_KV_PREFIX_CACHE"] in (
                 "1", "true")
+        cfg.relay_mode = env.get("CROWDLLAMA_TPU_RELAY_MODE", cfg.relay_mode)
         cfg.spec_decode = env.get("CROWDLLAMA_TPU_SPEC_DECODE",
                                   cfg.spec_decode)
         cfg.spec_draft = int(env.get("CROWDLLAMA_TPU_SPEC_DRAFT",
@@ -202,6 +210,10 @@ class Configuration:
                              "(want 'bf16' or 'int8')")
         # int8 KV composes with both layouts (paged pools carry per-page
         # scales; ops/pallas/paged.py dequantizes in-kernel).
+        cfg.relay_mode = (cfg.relay_mode or "auto").strip().lower()
+        if cfg.relay_mode not in ("auto", "always", "off"):
+            raise ValueError(f"unknown relay_mode {cfg.relay_mode!r} "
+                             "(want 'auto', 'always' or 'off')")
         cfg.spec_decode = (cfg.spec_decode or "").strip().lower()
         if cfg.spec_decode not in ("", "ngram"):
             raise ValueError(f"unknown spec_decode {cfg.spec_decode!r} "
@@ -261,6 +273,10 @@ class Configuration:
                             choices=("bf16", "int8"),
                             help="KV cache dtype (int8: quantized cache, "
                                  "contiguous or paged layout)")
+        parser.add_argument("--relay-mode", dest="relay_mode",
+                            choices=("auto", "always", "off"),
+                            help="NAT relay through the bootstrap node "
+                                 "(auto: only when unreachable)")
         parser.add_argument("--spec-decode", dest="spec_decode",
                             choices=("", "ngram"),
                             help="speculative decoding (ngram prompt lookup)")
@@ -278,7 +294,8 @@ class Configuration:
                 "model", "model_path", "engine_backend", "mesh_shape",
                 "shard_group", "shard_index", "shard_count", "shard_strategy",
                 "quantize", "kv_layout", "kv_page_size", "kv_pool_tokens",
-                "kv_dtype", "spec_decode", "spec_draft", "profile_dir",
+                "kv_dtype", "relay_mode", "spec_decode", "spec_draft",
+                "profile_dir",
             )
         }
         bp = getattr(args, "bootstrap_peers", None)

@@ -18,6 +18,15 @@ INFERENCE_PROTOCOL = "/crowdllama/inference/1.0.0"
 # workers of a shard group (no reference counterpart — the reference routes
 # whole requests to single workers only, SURVEY §2).
 SHARD_PROTOCOL = "/crowdllama/shard/1.0.0"
+# NAT traversal: reverse streams through a public relay node (net/relay.py).
+# The reference gets relay/hole-punch handling from libp2p
+# (/root/reference/pkg/dht/dht.go:386-395, internal/discovery/discovery.go:62).
+RELAY_PROTOCOL = "/crowdllama/relay/1.0.0"
+# Swarm model distribution: hash-verified safetensors transfer between
+# workers (net/model_share.py).  The reference inherits `ollama pull`
+# (/root/reference/cmd/crowdllama/main.go:49-78 embeds the Ollama CLI);
+# here acquisition is peer-to-peer — zero-egress swarms share checkpoints.
+MODEL_PROTOCOL = "/crowdllama/model/1.0.0"
 
 # DHT key namespace prefix (cf. types.go:23).
 DHT_PREFIX = "/crowdllama/peer/"

@@ -68,6 +68,10 @@ class Resource:
     # requests only to capable workers instead of burning its failover
     # retry on a worker that would deterministically fail.
     embeddings: bool = True
+    # "direct" | "relay" — how this worker is reachable (relay = reverse
+    # streams through its bootstrap node, net/relay.py; the reference logs
+    # the equivalent libp2p circuit classification, dht.go:386-395).
+    reachability: str = "direct"
     shard_group: ShardGroup | None = None
 
     def touch(self) -> None:

@@ -58,6 +58,11 @@ class Engine:
         """Capability/telemetry snapshot for Resource advertisement."""
         return {"models": self.models, "throughput": 0.0, "load": 0.0}
 
+    def model_dir(self, model: str) -> str | None:
+        """Local checkpoint directory for ``model`` if this engine can
+        SHARE it over the swarm (net/model_share.py); None otherwise."""
+        return None
+
     def generate(
         self,
         prompt: str,
@@ -288,6 +293,15 @@ class JaxEngine(Engine):
     async def stop(self) -> None:
         if self.scheduler is not None:
             await self.scheduler.stop()
+
+    def model_dir(self, model: str) -> str | None:
+        from pathlib import Path
+
+        mp = self.config.model_path
+        if (model in self.models and mp
+                and list(Path(mp).expanduser().glob("*.safetensors"))):
+            return mp
+        return None
 
     def describe(self) -> dict:
         d = {"models": self.models, "throughput": 0.0, "load": 0.0}

@@ -25,13 +25,19 @@ class MultiEngine(Engine):
     def __init__(self, config):
         self.config = config
         names = [m.strip() for m in config.model.split(",") if m.strip()]
-        if len(names) < 2:
-            raise ValueError("MultiEngine needs >= 2 comma-separated models")
+        if not names:
+            raise ValueError("MultiEngine needs >= 1 model name")
         self._engines: dict[str, JaxEngine] = {}
-        for name in names:
-            child_cfg = _dc_replace(config, model=name)
+        for i, name in enumerate(names):
+            # model_path names ONE checkpoint: it belongs to the first
+            # listed model only — later children random-init rather than
+            # silently loading (and re-sharing) the wrong model's bytes.
+            child_cfg = _dc_replace(config, model=name,
+                                    model_path=config.model_path if i == 0
+                                    else "")
             self._engines[name] = JaxEngine(child_cfg)
         self.models = names
+        self._peer = None
 
     def _child(self, model: str) -> JaxEngine:
         if not model:
@@ -62,8 +68,28 @@ class MultiEngine(Engine):
         return all(results)
 
     def attach_peer(self, peer) -> None:
+        self._peer = peer
         for eng in self._engines.values():
             eng.attach_peer(peer)
+
+    def model_dir(self, model: str) -> str | None:
+        eng = self._engines.get(model)
+        return eng.model_dir(model) if eng is not None else None
+
+    async def add_model(self, name: str, path: str = "") -> None:
+        """Hot-register a model (swarm pull landing, net/model_share.py):
+        build + start a child engine, then advertise the new list."""
+        if name in self._engines:
+            return
+        child_cfg = _dc_replace(self.config, model=name,
+                                model_path=path or self.config.model_path)
+        eng = JaxEngine(child_cfg)
+        await eng.start()
+        self._engines[name] = eng
+        self.models = list(self._engines)
+        if self._peer is not None:
+            self._peer.update_metadata()  # advertise without waiting a tick
+        log.info("hot-registered model %s from %s", name, path or "<default>")
 
     def describe(self) -> dict:
         per = {name: e.describe() for name, e in self._engines.items()}

@@ -327,10 +327,12 @@ class Gateway:
     async def handle_pull(self, request: web.Request) -> web.Response:
         """POST /api/pull — Ollama clients call this when a model is absent.
 
-        In a swarm, models are owned by workers, not downloaded by the
-        gateway: "pulling" succeeds iff some healthy worker already serves
-        the model (NDJSON status lines like Ollama's), otherwise a clear
-        error explains how models appear here."""
+        Resolution order: a healthy worker already serves the model →
+        success immediately; otherwise the gateway PROXIES the pull to a
+        worker (net/model_share.py "pull" op): that worker acquires the
+        checkpoint peer-to-peer from whoever shares it and hot-registers
+        it.  Only when no worker can acquire it does a clear error explain
+        how models appear here."""
         try:
             body = await request.json()
         except json.JSONDecodeError:
@@ -338,20 +340,48 @@ class Gateway:
         name = body.get("model") or body.get("name") or ""
         if not name:
             return web.json_response({"error": "model is required"}, status=400)
-        # Same predicate routing uses: pull must not report success for a
-        # model /api/chat would then 503 on.
-        if self._find_worker(name) is not None:
+
+        def _success(extra_lines=()):
             if not body.get("stream", True):
                 # Non-streaming clients (ollama-python default) parse ONE
                 # JSON body.
                 return web.json_response({"status": "success"})
-            lines = [{"status": "pulling manifest"}, {"status": "success"}]
+            lines = [{"status": "pulling manifest"}, *extra_lines,
+                     {"status": "success"}]
             return web.Response(
                 text="".join(json.dumps(line) + "\n" for line in lines),
                 content_type="application/x-ndjson")
+
+        # Same predicate routing uses: pull must not report success for a
+        # model /api/chat would then 503 on.
+        if self._find_worker(name) is not None:
+            return _success()
+
+        # Proxy to a worker that could acquire and serve it (best-scored
+        # worker regardless of model; it pulls from whichever peer shares
+        # the checkpoint — the swarm-native `ollama pull`).
+        pull_err = "no workers available"
+        pm = self.peer.peer_manager
+        target = pm.find_best_worker("") if pm else None
+        if target is not None:
+            from crowdllama_tpu.net.model_share import request_pull
+
+            try:
+                contact = await self.peer.dht.find_peer(target.peer_id)
+                if contact is None:
+                    raise RuntimeError(
+                        f"cannot resolve worker {target.peer_id[:8]}")
+                path = await request_pull(self.peer.host, contact, name)
+                return _success([{"status": f"pulled to {path} on worker "
+                                            f"{target.peer_id[:8]}"}])
+            except Exception as e:
+                pull_err = str(e)
+                log.warning("proxied pull of %s via %s failed: %s",
+                            name, target.peer_id[:8], e)
         return web.json_response({
-            "error": f"model {name!r} is not served by any worker; models "
-                     "are provided by swarm workers (start one with "
+            "error": f"model {name!r} is not served by any worker and the "
+                     f"swarm pull failed ({pull_err}); models are provided "
+                     "by swarm workers (start one with "
                      f"--worker-mode --model {name})"}, status=404)
 
     async def handle_unsupported(self, request: web.Request) -> web.Response:

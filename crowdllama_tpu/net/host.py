@@ -38,6 +38,7 @@ from cryptography.hazmat.primitives.serialization import (
     PublicFormat,
 )
 
+from crowdllama_tpu.core.protocol import RELAY_PROTOCOL
 from crowdllama_tpu.net.secure import (
     SecureReader,
     SecureWriter,
@@ -88,22 +89,32 @@ async def read_json_frame(reader: asyncio.StreamReader, timeout: float | None = 
 
 @dataclass(frozen=True)
 class Contact:
-    """A dialable peer: identity + address (libp2p AddrInfo analog)."""
+    """A dialable peer: identity + address (libp2p AddrInfo analog).
+
+    ``relay=True`` marks a RELAYED address: host/port are a public relay
+    node (net/relay.py), and dialing opens a reverse stream through it to
+    ``peer_id`` — the TCP analog of a libp2p circuit address
+    (/root/reference/pkg/dht/dht.go:386-395 classifies these)."""
 
     peer_id: str
     host: str
     port: int
+    relay: bool = False
 
     @property
     def addr(self) -> str:
         return f"{self.host}:{self.port}"
 
     def to_dict(self) -> dict:
-        return {"peer_id": self.peer_id, "host": self.host, "port": self.port}
+        d = {"peer_id": self.peer_id, "host": self.host, "port": self.port}
+        if self.relay:
+            d["relay"] = True
+        return d
 
     @classmethod
     def from_dict(cls, d: dict) -> "Contact":
-        return cls(peer_id=str(d["peer_id"]), host=str(d["host"]), port=int(d["port"]))
+        return cls(peer_id=str(d["peer_id"]), host=str(d["host"]),
+                   port=int(d["port"]), relay=bool(d.get("relay", False)))
 
 
 @dataclass
@@ -184,6 +195,11 @@ class Host:
         self.listen_host = listen_host
         self.listen_port = listen_port
         self.advertise_host = advertise_host
+        # NAT relay state (net/relay.py): when set, .contact advertises the
+        # relay address, and hellos advertise listen_port 0 so remote
+        # peerstores never learn this node's (unreachable) direct address.
+        self.relay_contact: Contact | None = None
+        self.hello_dialable = True
         self._handlers: dict[str, StreamHandler] = {}
         self._server: asyncio.Server | None = None
         # peerstore: peer_id -> Contact learned from hellos / DHT results
@@ -239,10 +255,17 @@ class Host:
 
     @property
     def contact(self) -> Contact:
+        if self.relay_contact is not None:
+            return self.relay_contact
         host = self.advertise_host or (
             "127.0.0.1" if self.listen_host in ("0.0.0.0", "::") else self.listen_host
         )
         return Contact(peer_id=self.peer_id, host=host, port=self.listen_port)
+
+    @property
+    def _hello_port(self) -> int:
+        """Port advertised in hellos (0 = not directly dialable)."""
+        return self.listen_port if self.hello_dialable else 0
 
     # -- handlers ----------------------------------------------------------
 
@@ -263,6 +286,8 @@ class Host:
         a bare "host:port" address (identity learned from the remote hello, as
         when dialing a bootstrap address, cf. discovery.go:92-141).
         """
+        if isinstance(target, Contact) and target.relay:
+            return await self._new_stream_via_relay(target, protocol, timeout)
         if isinstance(target, Contact):
             host, port, expect_id = target.host, target.port, target.peer_id
         else:
@@ -273,60 +298,98 @@ class Host:
             asyncio.open_connection(host, port), timeout
         )
         try:
-            # Nonce exchange: we challenge the server, it challenges us.
-            my_nonce = os.urandom(16).hex()
-            await write_json_frame(writer, {"proto": protocol, "nonce": my_nonce})
-            challenge = await read_json_frame(reader, timeout)
-            if challenge.get("error"):
-                raise HandshakeError(f"remote rejected stream: {challenge['error']}")
-            server_nonce = str(challenge.get("nonce", ""))
-            if not server_nonce:
-                raise HandshakeError("missing server nonce")
-
-            eph = X25519PrivateKey.generate()
-            eph_hex = eph.public_key().public_bytes(
-                Encoding.Raw, PublicFormat.Raw).hex()
-            ts = time.time()
-            sig = self.key.sign(
-                _hello_signing_bytes(protocol, self.peer_id, ts, server_nonce,
-                                     self.listen_port, eph_hex)
-            )
-            await write_json_frame(
-                writer,
-                {
-                    "proto": protocol,
-                    "peer_id": self.peer_id,
-                    "pubkey": self._pubkey_hex(),
-                    "ts": ts,
-                    "sig": sig.hex(),
-                    "listen_port": self.listen_port,
-                    "eph": eph_hex,
-                },
-            )
-            ack = await read_json_frame(reader, timeout)
-            if not ack.get("ok"):
-                raise HandshakeError(f"remote rejected stream: {ack.get('error', 'unknown')}")
-            remote_id, remote_eph = _verify_hello(ack, protocol, my_nonce)
-            if expect_id is not None and remote_id != expect_id:
-                raise HandshakeError(
-                    f"peer identity mismatch: expected {expect_id[:8]} got {remote_id[:8]}"
-                )
-            # Encrypt everything after the handshake (we are the client).
-            c2s, s2c = derive_keys(
-                ecdh(eph, remote_eph), protocol, self.peer_id, remote_id,
-                my_nonce, server_nonce)
-            remote_contact = Contact(remote_id, host, port)
-            self.peerstore[remote_id] = remote_contact
-            self.stats["streams_out"] += 1
-            return Stream(
-                protocol=protocol,
-                remote_peer_id=remote_id,
-                remote_contact=remote_contact,
-                reader=SecureReader(reader, s2c),
-                writer=SecureWriter(writer, c2s),
-            )
+            return await self._client_handshake(
+                reader, writer, protocol, expect_id, timeout,
+                contact=lambda rid: Contact(rid, host, port))
         except Exception:
             writer.close()
+            raise
+
+    async def _client_handshake(self, reader, writer, protocol: str,
+                                expect_id: str | None, timeout: float,
+                                contact) -> Stream:
+        """Client side of the signed-hello + AEAD handshake over an open
+        byte pipe (a raw TCP connection, or a relay-spliced stream —
+        ``contact`` maps the authenticated remote id to the Contact stored
+        in the peerstore)."""
+        # Nonce exchange: we challenge the server, it challenges us.
+        my_nonce = os.urandom(16).hex()
+        await write_json_frame(writer, {"proto": protocol, "nonce": my_nonce})
+        challenge = await read_json_frame(reader, timeout)
+        if challenge.get("error"):
+            raise HandshakeError(f"remote rejected stream: {challenge['error']}")
+        server_nonce = str(challenge.get("nonce", ""))
+        if not server_nonce:
+            raise HandshakeError("missing server nonce")
+
+        eph = X25519PrivateKey.generate()
+        eph_hex = eph.public_key().public_bytes(
+            Encoding.Raw, PublicFormat.Raw).hex()
+        ts = time.time()
+        lport = self._hello_port
+        sig = self.key.sign(
+            _hello_signing_bytes(protocol, self.peer_id, ts, server_nonce,
+                                 lport, eph_hex)
+        )
+        await write_json_frame(
+            writer,
+            {
+                "proto": protocol,
+                "peer_id": self.peer_id,
+                "pubkey": self._pubkey_hex(),
+                "ts": ts,
+                "sig": sig.hex(),
+                "listen_port": lport,
+                "eph": eph_hex,
+            },
+        )
+        ack = await read_json_frame(reader, timeout)
+        if not ack.get("ok"):
+            raise HandshakeError(f"remote rejected stream: {ack.get('error', 'unknown')}")
+        remote_id, remote_eph = _verify_hello(ack, protocol, my_nonce)
+        if expect_id is not None and remote_id != expect_id:
+            raise HandshakeError(
+                f"peer identity mismatch: expected {expect_id[:8]} got {remote_id[:8]}"
+            )
+        # Encrypt everything after the handshake (we are the client).
+        c2s, s2c = derive_keys(
+            ecdh(eph, remote_eph), protocol, self.peer_id, remote_id,
+            my_nonce, server_nonce)
+        remote_contact = contact(remote_id)
+        if remote_contact is not None:
+            self.peerstore[remote_id] = remote_contact
+        self.stats["streams_out"] += 1
+        return Stream(
+            protocol=protocol,
+            remote_peer_id=remote_id,
+            remote_contact=remote_contact,
+            reader=SecureReader(reader, s2c),
+            writer=SecureWriter(writer, c2s),
+        )
+
+    async def _new_stream_via_relay(self, target: Contact, protocol: str,
+                                    timeout: float) -> Stream:
+        """Open ``protocol`` to a NATed peer through its relay: dial the
+        relay, ask it to splice us to ``target.peer_id``, then run the
+        normal end-to-end handshake through the splice — the relay carries
+        only the inner ciphertext."""
+        outer = await self.new_stream(f"{target.host}:{target.port}",
+                                      RELAY_PROTOCOL, timeout)
+        try:
+            await write_json_frame(outer.writer,
+                                   {"op": "connect", "target": target.peer_id})
+            reply = await read_json_frame(outer.reader, timeout)
+            if not reply.get("ok"):
+                raise HandshakeError(
+                    f"relay refused: {reply.get('error', 'unknown')}")
+            stream = await self._client_handshake(
+                outer.reader, outer.writer, protocol, target.peer_id,
+                timeout, contact=lambda rid: target)
+            self.stats["streams_relayed_out"] = (
+                self.stats.get("streams_relayed_out", 0) + 1)
+            return stream
+        except Exception:
+            outer.close()
             raise
 
     # -- inbound -----------------------------------------------------------
@@ -336,6 +399,25 @@ class Host:
         if task is not None:
             self._conn_tasks.add(task)
             task.add_done_callback(self._conn_tasks.discard)
+        peername = writer.get_extra_info("peername")
+        await self._serve_pipe(reader, writer, peername)
+
+    async def serve_relayed(self, outer: Stream) -> None:
+        """Serve one inbound stream arriving through a relay splice: run
+        the server-side handshake and handler over the already-open pipe
+        (the worker side of net/relay.py reverse connections)."""
+        task = asyncio.current_task()
+        if task is not None:
+            self._conn_tasks.add(task)
+            task.add_done_callback(self._conn_tasks.discard)
+        self.stats["streams_relayed_in"] = (
+            self.stats.get("streams_relayed_in", 0) + 1)
+        await self._serve_pipe(outer.reader, outer.writer, None)
+
+    async def _serve_pipe(self, reader, writer, peername) -> None:
+        """Server side of the handshake + handler dispatch over any byte
+        pipe (direct TCP or relay splice — ``peername`` None for relayed
+        pipes: the observed address would be the relay's, not the peer's)."""
         handshaked = False
         try:
             # Nonce exchange first (see new_stream).
@@ -358,7 +440,6 @@ class Host:
             # Learn a dialable contact for the remote: observed source host +
             # its advertised listening port.
             remote_contact: Contact | None = None
-            peername = writer.get_extra_info("peername")
             if peername:
                 seen = self._peers_by_addr_class.setdefault(
                     _addr_class(peername[0]), set())
@@ -376,9 +457,10 @@ class Host:
             eph_hex = eph.public_key().public_bytes(
                 Encoding.Raw, PublicFormat.Raw).hex()
             ts = time.time()
+            my_lport = self._hello_port
             sig = self.key.sign(
                 _hello_signing_bytes(proto, self.peer_id, ts, client_nonce,
-                                     self.listen_port, eph_hex)
+                                     my_lport, eph_hex)
             )
             await write_json_frame(
                 writer,
@@ -389,7 +471,7 @@ class Host:
                     "pubkey": self._pubkey_hex(),
                     "ts": ts,
                     "sig": sig.hex(),
-                    "listen_port": self.listen_port,
+                    "listen_port": my_lport,
                     "eph": eph_hex,
                 },
             )

@@ -1,0 +1,243 @@
+"""Swarm model distribution: peer-to-peer safetensors transfer.
+
+The reference's workers acquire models with ``ollama pull`` (the binary
+embeds the Ollama CLI, /root/reference/cmd/crowdllama/main.go:49-78); this
+swarm is zero-egress, so acquisition is peer-to-peer: a worker that serves
+a model from a local checkpoint shares it over ``MODEL_PROTOCOL``, and a
+worker that wants it streams the files from a DHT-discovered peer with
+per-file SHA-256 verification, then hot-registers the model
+(MultiEngine.add_model).
+
+Wire ops (one request per authenticated stream, like the DHT RPCs):
+
+- ``manifest`` {model} → {files: [{name, size, sha256}]}
+- ``fetch``    {model, name} → {size, sha256} + raw bytes
+- ``pull``     {model} → asks THIS worker to acquire the model from the
+  swarm and serve it (the gateway's /api/pull proxies here)
+
+Only checkpoint-shaped files are served (config/tokenizer json,
+safetensors + index) and names are sanitized — a manifest cannot point
+outside the checkpoint directory.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+from pathlib import Path
+
+from crowdllama_tpu.core.protocol import MODEL_PROTOCOL
+from crowdllama_tpu.net.host import (
+    Contact,
+    Host,
+    Stream,
+    read_json_frame,
+    write_json_frame,
+)
+
+log = logging.getLogger("crowdllama.net.model_share")
+
+CHUNK = 256 * 1024
+OP_TIMEOUT = 30.0
+# Manifest hashing digests whole checkpoints (minutes for tens of GB on a
+# cold cache) — the client must out-wait it.
+MANIFEST_TIMEOUT = 900.0
+FETCH_IDLE_TIMEOUT = 60.0
+MAX_FILE_BYTES = 64 * 1024 ** 3  # sanity cap (a 70B int8 shard is ~35 GB)
+
+#: checkpoint files eligible for transfer (allow-list, not a deny-list)
+_SHAREABLE = (
+    "config.json", "generation_config.json", "model.safetensors.index.json",
+    "tokenizer.json", "tokenizer_config.json", "tokenizer.model",
+    "special_tokens_map.json",
+)
+
+
+def _shareable(name: str) -> bool:
+    if "/" in name or "\\" in name or name.startswith(".") or ".." in name:
+        return False
+    return name in _SHAREABLE or name.endswith(".safetensors")
+
+
+def _sha256_file(path: Path) -> str:
+    h = hashlib.sha256()
+    with path.open("rb") as f:
+        while chunk := f.read(1 << 20):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+class ModelShareService:
+    """Serves this worker's checkpoints and handles pull triggers.
+
+    ``model_dir(model)`` and ``pull(model)`` come from the owning Peer —
+    the service itself is transport only."""
+
+    def __init__(self, model_dir, pull=None):
+        self._model_dir = model_dir          # (model) -> Path | None
+        self._pull = pull                    # async (model) -> str | None
+        # (path, size, mtime_ns) -> sha256: checkpoints are immutable in
+        # practice; re-hashing tens of GB per manifest request would burn
+        # minutes of CPU per pull attempt.
+        self._hash_cache: dict[tuple, str] = {}
+
+    async def handle(self, stream: Stream) -> None:
+        try:
+            req = await read_json_frame(stream.reader, OP_TIMEOUT)
+            op = str(req.get("op", ""))
+            model = str(req.get("model", ""))
+            if op == "manifest":
+                await self._manifest(stream, model)
+            elif op == "fetch":
+                await self._fetch(stream, model, str(req.get("name", "")))
+            elif op == "pull" and self._pull is not None:
+                try:
+                    path = await self._pull(model)
+                    await write_json_frame(stream.writer,
+                                           {"ok": True, "path": str(path)})
+                except Exception as e:
+                    await write_json_frame(stream.writer,
+                                           {"ok": False, "error": str(e)})
+            else:
+                await write_json_frame(
+                    stream.writer, {"ok": False, "error": f"unknown op {op!r}"})
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            log.debug("model share stream failed: %s", e)
+        finally:
+            stream.close()
+
+    def _dir_for(self, model: str) -> Path | None:
+        d = self._model_dir(model)
+        if d is None:
+            return None
+        d = Path(d).expanduser()
+        return d if d.is_dir() and list(d.glob("*.safetensors")) else None
+
+    async def _manifest(self, stream: Stream, model: str) -> None:
+        d = self._dir_for(model)
+        if d is None:
+            await write_json_frame(stream.writer, {
+                "ok": False,
+                "error": f"no shareable checkpoint for {model!r} here"})
+            return
+        loop = asyncio.get_running_loop()
+        files = []
+        for p in sorted(d.iterdir()):
+            if p.is_file() and _shareable(p.name):
+                st = p.stat()
+                cache_key = (str(p), st.st_size, st.st_mtime_ns)
+                digest = self._hash_cache.get(cache_key)
+                if digest is None:
+                    # Hash off-loop: a 16 GB shard takes a while to digest.
+                    digest = await loop.run_in_executor(None, _sha256_file, p)
+                    self._hash_cache[cache_key] = digest
+                files.append({"name": p.name, "size": st.st_size,
+                              "sha256": digest})
+        await write_json_frame(stream.writer, {"ok": True, "files": files})
+
+    async def _fetch(self, stream: Stream, model: str, name: str) -> None:
+        d = self._dir_for(model)
+        if d is None or not _shareable(name) or not (d / name).is_file():
+            await write_json_frame(stream.writer, {
+                "ok": False, "error": f"no file {name!r} for model {model!r}"})
+            return
+        path = d / name
+        size = path.stat().st_size
+        await write_json_frame(stream.writer, {"ok": True, "size": size})
+        loop = asyncio.get_running_loop()
+        with path.open("rb") as f:
+            while True:
+                chunk = await loop.run_in_executor(None, f.read, CHUNK)
+                if not chunk:
+                    break
+                stream.writer.write(chunk)
+                await stream.writer.drain()
+
+
+async def fetch_model(host: Host, source: Contact, model: str,
+                      dest_root: str | Path) -> Path:
+    """Download ``model``'s checkpoint from ``source`` into
+    ``dest_root/<model>/``; every file is SHA-256-verified against the
+    manifest before the function returns.  Partial downloads live in a
+    ``.partial`` staging dir so a crash never leaves a plausible-looking
+    but corrupt checkpoint."""
+    dest = Path(dest_root).expanduser() / model.replace("/", "_")
+    staging = dest.with_name(dest.name + ".partial")
+    if staging.exists():
+        # A dirty staging dir from an aborted pull must not leak stale
+        # (unverified) shards into the promoted checkpoint.
+        import shutil
+
+        shutil.rmtree(staging)
+    staging.mkdir(parents=True)
+
+    stream = await host.new_stream(source, MODEL_PROTOCOL)
+    try:
+        await write_json_frame(stream.writer,
+                               {"op": "manifest", "model": model})
+        reply = await read_json_frame(stream.reader, MANIFEST_TIMEOUT)
+    finally:
+        stream.close()
+    if not reply.get("ok"):
+        raise RuntimeError(f"manifest failed: {reply.get('error')}")
+    files = reply.get("files") or []
+    if not any(f["name"].endswith(".safetensors") for f in files):
+        raise RuntimeError(f"source has no safetensors for {model!r}")
+
+    for f in files:
+        name, size, want = str(f["name"]), int(f["size"]), str(f["sha256"])
+        if not _shareable(name) or not (0 <= size <= MAX_FILE_BYTES):
+            raise RuntimeError(f"refusing manifest entry {name!r}")
+        stream = await host.new_stream(source, MODEL_PROTOCOL)
+        try:
+            await write_json_frame(stream.writer,
+                                   {"op": "fetch", "model": model,
+                                    "name": name})
+            head = await read_json_frame(stream.reader, OP_TIMEOUT)
+            if not head.get("ok"):
+                raise RuntimeError(f"fetch {name}: {head.get('error')}")
+            if int(head.get("size", -1)) != size:
+                raise RuntimeError(f"fetch {name}: size changed mid-transfer")
+            h = hashlib.sha256()
+            with (staging / name).open("wb") as out:
+                remaining = size
+                while remaining > 0:
+                    chunk = await asyncio.wait_for(
+                        stream.reader.read(min(CHUNK, remaining)),
+                        FETCH_IDLE_TIMEOUT)
+                    if not chunk:
+                        raise RuntimeError(f"fetch {name}: stream truncated")
+                    out.write(chunk)
+                    h.update(chunk)
+                    remaining -= len(chunk)
+            if h.hexdigest() != want:
+                raise RuntimeError(f"fetch {name}: sha256 mismatch")
+        finally:
+            stream.close()
+        log.info("pulled %s/%s (%d bytes, verified)", model, name, size)
+
+    # Atomic-ish promote: all files verified, swap staging into place.
+    if dest.exists():
+        import shutil
+
+        shutil.rmtree(dest)
+    staging.rename(dest)
+    return dest
+
+
+async def request_pull(host: Host, worker: Contact, model: str,
+                       timeout: float = 600.0) -> str:
+    """Ask a REMOTE worker to pull ``model`` from the swarm and serve it
+    (the gateway's /api/pull proxy path)."""
+    stream = await host.new_stream(worker, MODEL_PROTOCOL)
+    try:
+        await write_json_frame(stream.writer, {"op": "pull", "model": model})
+        reply = await read_json_frame(stream.reader, timeout)
+        if not reply.get("ok"):
+            raise RuntimeError(str(reply.get("error", "pull failed")))
+        return str(reply.get("path", ""))
+    finally:
+        stream.close()

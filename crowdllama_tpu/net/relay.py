@@ -1,0 +1,335 @@
+"""NAT traversal: reverse streams through a public relay node.
+
+The reference inherits its whole NAT story from libp2p — hole punching
+(/root/reference/internal/discovery/discovery.go:62), NATPortMap
+(pkg/dht/dht.go:97), relay/circuit address classification
+(pkg/dht/dht.go:386-395).  Over plain TCP the workable equivalent is a
+TURN-style relay (hole punching needs coordinated simultaneous opens that
+asyncio TCP cannot express portably), served here by the DHT bootstrap
+node:
+
+- A NATed worker keeps ONE persistent outbound control stream to the
+  relay (``register``), heartbeated.  Its advertised Contact carries the
+  relay's address with ``relay=True`` (host.contact), and its hellos
+  advertise listen_port 0 so no peerstore ever learns a bogus direct
+  address.
+- A dialer that resolves a ``relay=True`` contact connects to the relay
+  (``connect``), the relay notifies the worker over the control stream,
+  the worker opens a fresh outbound ``accept`` connection, and the relay
+  splices the two byte streams.
+- The normal signed-hello + AEAD handshake then runs END-TO-END through
+  the splice (host._client_handshake / host.serve_relayed): the relay
+  forwards only the inner ciphertext — it authenticates WHO relays
+  (register/connect/accept arrive on authenticated streams) but cannot
+  read or forge what crosses the splice.
+
+Reachability is probed with ``dialback``: the relay attempts a plain TCP
+connect to the worker's observed source IP + advertised port; workers in
+``relay_mode=auto`` relay only when the dialback fails.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+
+from crowdllama_tpu.core.protocol import RELAY_PROTOCOL
+from crowdllama_tpu.net.host import (
+    Contact,
+    Host,
+    Stream,
+    read_json_frame,
+    write_json_frame,
+)
+
+log = logging.getLogger("crowdllama.net.relay")
+
+ACCEPT_TIMEOUT = 15.0      # connect waits this long for the worker's accept
+DIALBACK_TIMEOUT = 3.0     # TCP connect budget for reachability probes
+PING_INTERVAL = 15.0       # worker control-stream heartbeat
+CONTROL_IDLE = 3 * PING_INTERVAL
+SPLICE_CHUNK = 64 * 1024
+MAX_REGISTRATIONS = 10_000
+MAX_SPLICES_PER_PEER = 64
+
+
+class _Registration:
+    def __init__(self, stream: Stream):
+        self.stream = stream
+        self.lock = asyncio.Lock()  # serializes relay->worker frames
+        self.splices = 0
+
+
+class RelayService:
+    """Relay server: registered on the bootstrap/DHT node's host."""
+
+    def __init__(self, host: Host):
+        self.host = host
+        self._workers: dict[str, _Registration] = {}
+        # conn_id -> future resolved with (worker Stream, done Event)
+        self._pending: dict[str, asyncio.Future] = {}
+        host.set_stream_handler(RELAY_PROTOCOL, self.handle)
+
+    @property
+    def registered_count(self) -> int:
+        return len(self._workers)
+
+    async def handle(self, stream: Stream) -> None:
+        try:
+            req = await read_json_frame(stream.reader, ACCEPT_TIMEOUT)
+        except Exception:
+            stream.close()
+            return
+        op = str(req.get("op", ""))
+        try:
+            if op == "register":
+                await self._handle_register(stream)
+            elif op == "connect":
+                await self._handle_connect(stream, str(req.get("target", "")))
+            elif op == "accept":
+                await self._handle_accept(stream, str(req.get("conn_id", "")))
+            elif op == "dialback":
+                await self._handle_dialback(stream, int(req.get("port", 0)))
+            else:
+                await write_json_frame(stream.writer,
+                                       {"ok": False,
+                                        "error": f"unknown op {op!r}"})
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            log.debug("relay %s failed: %s", op, e)
+        finally:
+            stream.close()
+
+    # ------------------------------------------------------------- register
+
+    async def _handle_register(self, stream: Stream) -> None:
+        peer = stream.remote_peer_id
+        if len(self._workers) >= MAX_REGISTRATIONS:
+            await write_json_frame(stream.writer,
+                                   {"ok": False, "error": "relay full"})
+            return
+        reg = _Registration(stream)
+        old = self._workers.get(peer)
+        self._workers[peer] = reg
+        if old is not None:
+            old.stream.close()  # newest registration wins (worker restarted)
+        await write_json_frame(stream.writer, {"ok": True})
+        log.info("relay: registered %s (%d total)", peer[:8],
+                 len(self._workers))
+        try:
+            while True:
+                frame = await read_json_frame(stream.reader, CONTROL_IDLE)
+                if frame.get("op") == "ping":
+                    async with reg.lock:
+                        await write_json_frame(stream.writer, {"op": "pong"})
+        except Exception:
+            pass
+        finally:
+            if self._workers.get(peer) is reg:
+                del self._workers[peer]
+                log.info("relay: deregistered %s", peer[:8])
+
+    # -------------------------------------------------------------- connect
+
+    async def _handle_connect(self, stream: Stream, target: str) -> None:
+        reg = self._workers.get(target)
+        if reg is None:
+            await write_json_frame(
+                stream.writer,
+                {"ok": False, "error": f"peer {target[:8]} not relayed here"})
+            return
+        if reg.splices >= MAX_SPLICES_PER_PEER:
+            await write_json_frame(
+                stream.writer, {"ok": False, "error": "relay splice cap"})
+            return
+        conn_id = os.urandom(8).hex()
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[conn_id] = fut
+        try:
+            async with reg.lock:
+                await write_json_frame(reg.stream.writer,
+                                       {"op": "incoming", "conn_id": conn_id})
+            worker_stream, done = await asyncio.wait_for(fut, ACCEPT_TIMEOUT)
+        except (asyncio.TimeoutError, Exception) as e:
+            self._pending.pop(conn_id, None)
+            try:
+                await write_json_frame(
+                    stream.writer,
+                    {"ok": False, "error": f"worker accept failed: {e}"})
+            except Exception:
+                pass
+            return
+        await write_json_frame(stream.writer, {"ok": True})
+        reg.splices += 1
+        try:
+            await _splice(stream, worker_stream)
+        finally:
+            reg.splices -= 1
+            done.set()
+
+    async def _handle_accept(self, stream: Stream, conn_id: str) -> None:
+        fut = self._pending.pop(conn_id, None)
+        if fut is None or fut.done():
+            await write_json_frame(
+                stream.writer,
+                {"ok": False, "error": f"unknown conn {conn_id!r}"})
+            return
+        done = asyncio.Event()
+        fut.set_result((stream, done))
+        # Park until the connect side finishes splicing — returning would
+        # close this stream (handle()'s finally) mid-splice.
+        await done.wait()
+
+    # ------------------------------------------------------------- dialback
+
+    async def _handle_dialback(self, stream: Stream, port: int) -> None:
+        """Reachability probe: can WE dial the caller back directly?"""
+        ip = ""
+        contact = stream.remote_contact
+        if contact is not None:
+            ip = contact.host
+        reachable = False
+        if ip and 0 < port < 65536:
+            try:
+                _r, w = await asyncio.wait_for(
+                    asyncio.open_connection(ip, port), DIALBACK_TIMEOUT)
+                w.close()
+                reachable = True
+            except Exception:
+                reachable = False
+        await write_json_frame(stream.writer, {
+            "ok": True, "reachable": reachable, "observed_ip": ip})
+
+
+async def _splice(a: Stream, b: Stream) -> None:
+    """Bidirectional byte copy until either side closes."""
+
+    async def one(src: Stream, dst: Stream) -> None:
+        try:
+            while True:
+                chunk = await src.reader.read(SPLICE_CHUNK)
+                if not chunk:
+                    break
+                dst.writer.write(chunk)
+                await dst.writer.drain()
+        except Exception:
+            pass
+        finally:
+            dst.close()
+            src.close()
+
+    await asyncio.gather(one(a, b), one(b, a))
+
+
+class RelayClient:
+    """Worker-side relay registration: keeps the control stream alive and
+    answers ``incoming`` notifications with reverse connections."""
+
+    def __init__(self, host: Host, relay_addr: str,
+                 ping_interval: float = PING_INTERVAL):
+        self.host = host
+        self.relay_addr = relay_addr
+        self.ping_interval = ping_interval
+        self._task: asyncio.Task | None = None
+        self._accepts: set[asyncio.Task] = set()
+        self.registered = asyncio.Event()
+
+    async def start(self) -> None:
+        self._task = asyncio.create_task(self._run(), name="relay-client")
+        # Surface immediate registration failures (bad relay address) at
+        # start; later drops are handled by the reconnect loop.
+        await asyncio.wait_for(self.registered.wait(), ACCEPT_TIMEOUT)
+
+    async def stop(self) -> None:
+        for t in [self._task, *self._accepts]:
+            if t is not None:
+                t.cancel()
+        for t in [self._task, *self._accepts]:
+            if t is not None:
+                try:
+                    await t
+                except asyncio.CancelledError:
+                    pass
+        self._task = None
+        self._accepts.clear()
+
+    async def _run(self) -> None:
+        backoff = 1.0
+        while True:
+            control: Stream | None = None
+            try:
+                control = await self.host.new_stream(self.relay_addr,
+                                                     RELAY_PROTOCOL)
+                await write_json_frame(control.writer, {"op": "register"})
+                reply = await read_json_frame(control.reader, ACCEPT_TIMEOUT)
+                if not reply.get("ok"):
+                    raise RuntimeError(
+                        f"relay refused registration: {reply.get('error')}")
+                self.registered.set()
+                backoff = 1.0
+                ping = asyncio.create_task(self._ping_loop(control))
+                try:
+                    while True:
+                        frame = await read_json_frame(control.reader,
+                                                      CONTROL_IDLE)
+                        if frame.get("op") == "incoming":
+                            t = asyncio.create_task(
+                                self._accept(str(frame["conn_id"])))
+                            self._accepts.add(t)
+                            t.add_done_callback(self._accepts.discard)
+                finally:
+                    ping.cancel()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                self.registered.clear()
+                log.warning("relay control stream lost (%s); retrying in "
+                            "%.0fs", e, backoff)
+            finally:
+                if control is not None:
+                    control.close()
+            await asyncio.sleep(backoff)
+            backoff = min(backoff * 2, 30.0)
+
+    async def _ping_loop(self, control: Stream) -> None:
+        while True:
+            await asyncio.sleep(self.ping_interval)
+            await write_json_frame(control.writer, {"op": "ping"})
+
+    async def _accept(self, conn_id: str) -> None:
+        try:
+            outer = await self.host.new_stream(self.relay_addr,
+                                               RELAY_PROTOCOL)
+        except Exception as e:
+            log.warning("relay accept dial failed: %s", e)
+            return
+        try:
+            await write_json_frame(outer.writer,
+                                   {"op": "accept", "conn_id": conn_id})
+            # The spliced client's opening frame follows; serve it like any
+            # inbound connection (end-to-end handshake + handler dispatch).
+            await self.host.serve_relayed(outer)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            log.debug("relayed stream failed: %s", e)
+        finally:
+            outer.close()
+
+
+async def dialback_probe(host: Host, relay_addr: str) -> bool:
+    """Ask the relay whether this host's listen port is reachable from it.
+
+    The probe stream advertises our real listen_port (hellos must stay
+    dialable during the probe even if we later decide to relay)."""
+    stream = await host.new_stream(relay_addr, RELAY_PROTOCOL)
+    try:
+        await write_json_frame(stream.writer,
+                               {"op": "dialback", "port": host.listen_port})
+        reply = await read_json_frame(stream.reader,
+                                      DIALBACK_TIMEOUT + ACCEPT_TIMEOUT)
+        return bool(reply.get("ok")) and bool(reply.get("reachable"))
+    finally:
+        stream.close()

@@ -116,6 +116,7 @@ class Peer:
         self.resource = Resource(worker_mode=worker_mode, version=VERSION)
         self.peer_manager: PeerManager | None = None
         self._tasks: list[asyncio.Task] = []
+        self.relay_client = None  # net/relay.py RelayClient when relaying
 
     # ----------------------------------------------------------- lifecycle
 
@@ -130,6 +131,17 @@ class Peer:
 
         self.host.set_stream_handler(METADATA_PROTOCOL, self._handle_metadata_stream)
         self.host.set_stream_handler(INFERENCE_PROTOCOL, self._handle_inference_stream)
+        if self.worker_mode:
+            # Swarm model distribution (net/model_share.py): share local
+            # checkpoints and accept pull triggers (the `ollama pull`
+            # surface the reference inherits, cmd/crowdllama/main.go:49-78).
+            from crowdllama_tpu.core.protocol import MODEL_PROTOCOL
+            from crowdllama_tpu.net.model_share import ModelShareService
+
+            self._model_share = ModelShareService(
+                model_dir=self.engine.model_dir, pull=self.pull_model)
+            self.host.set_stream_handler(MODEL_PROTOCOL,
+                                         self._model_share.handle)
         shard_service = getattr(self.engine, "shard_service", None)
         if shard_service is not None:
             # Sharded-model member: serve our pipeline stage to group leaders.
@@ -149,6 +161,8 @@ class Peer:
         if self.config.bootstrap_peers:
             n = await self.dht.bootstrap(self.config.bootstrap_peers)
             log.info("bootstrapped to %d/%d peers", n, len(self.config.bootstrap_peers))
+
+        await self._setup_relay()
 
         self.peer_manager.start()
         iv = self.config.intervals
@@ -185,8 +199,100 @@ class Peer:
                 pass
         self._tasks = []
 
+    async def _setup_relay(self) -> None:
+        """NAT traversal (net/relay.py; libp2p relay/hole-punch parity,
+        /root/reference/pkg/dht/dht.go:386-395, discovery.go:62): a worker
+        the bootstrap node cannot dial back registers for reverse streams
+        through it and advertises the relay address instead of its own."""
+        if (not self.worker_mode or self.config.relay_mode == "off"
+                or not self.config.bootstrap_peers):
+            return
+        from crowdllama_tpu.net.host import Contact
+        from crowdllama_tpu.net.relay import RelayClient, dialback_probe
+
+        relay_addr = self.config.bootstrap_peers[0]
+        if self.config.relay_mode == "auto":
+            try:
+                if await dialback_probe(self.host, relay_addr):
+                    return  # directly reachable: no relay needed
+            except Exception as e:
+                # No relay service at the bootstrap node (or probe error):
+                # relaying through it is impossible either way — stay
+                # direct rather than stall startup on doomed registration.
+                log.debug("dialback probe unavailable (%s); staying "
+                          "direct", e)
+                return
+        log.info("worker not directly reachable: relaying via %s", relay_addr)
+        # Stop advertising the direct address BEFORE registering, so the
+        # relay (and every later peer) never learns a bogus direct contact.
+        self.host.hello_dialable = False
+        client = RelayClient(self.host, relay_addr)
+        try:
+            await client.start()
+        except Exception:
+            await client.stop()  # kill the reconnect loop too
+            self.host.hello_dialable = True  # direct-only better than dead
+            log.exception("relay registration failed; staying direct")
+            return
+        self.relay_client = client
+        rhost, _, rport = relay_addr.rpartition(":")
+        self.host.relay_contact = Contact(
+            peer_id=self.host.peer_id, host=rhost or "127.0.0.1",
+            port=int(rport), relay=True)
+        self.resource.reachability = "relay"
+
+    async def pull_model(self, model: str) -> str:
+        """Acquire ``model`` from a swarm peer and serve it.
+
+        Resolves a healthy worker advertising the model, streams its
+        checkpoint with per-file hash verification (net/model_share.py),
+        then hot-registers it on engines that support it
+        (MultiEngine.add_model).  Returns the local checkpoint path."""
+        from crowdllama_tpu.net.model_share import fetch_model
+
+        if model in (self.engine.models or []):
+            d = self.engine.model_dir(model)
+            return d or ""
+        if self.peer_manager is None:
+            raise RuntimeError("peer not started")
+        candidates = [
+            p for p in self.peer_manager.get_healthy_peers()
+            if p.is_worker and model in p.resource.supported_models
+            and p.peer_id != self.peer_id]
+        if not candidates:
+            raise RuntimeError(
+                f"no swarm peer advertises model {model!r}")
+        last_err: Exception | None = None
+        for cand in candidates:
+            try:
+                contact = await self.dht.find_peer(cand.peer_id)
+                if contact is None:
+                    raise RuntimeError(f"cannot resolve {cand.peer_id[:8]}")
+                dest = await fetch_model(self.host, contact, model,
+                                         self.config.models_dir)
+                break
+            except Exception as e:  # source without a checkpoint, wire error
+                log.warning("pull of %s from %s failed: %s", model,
+                            cand.peer_id[:8], e)
+                last_err = e
+        else:
+            raise RuntimeError(f"pull failed from every source: {last_err}")
+        add = getattr(self.engine, "add_model", None)
+        if add is None:
+            # Succeeding here would let the gateway's /api/pull report
+            # success for a model /api/chat still 503s on.
+            raise RuntimeError(
+                f"checkpoint downloaded to {dest} but this worker's engine "
+                f"cannot hot-register models; restart with --model {model} "
+                f"--model-path {dest}")
+        await add(model, str(dest))
+        return str(dest)
+
     async def stop(self) -> None:
         await self.stop_advertising()
+        if self.relay_client is not None:
+            await self.relay_client.stop()
+            self.relay_client = None
         if self.peer_manager is not None:
             await self.peer_manager.stop()
         if self.dht is not None:

@@ -53,9 +53,13 @@ async def test_multi_engine_routes_by_model():
         await eng.stop()
 
 
-def test_multi_engine_rejects_single_model():
+def test_multi_engine_single_model_allowed():
+    # Single-model MultiEngine is valid since swarm pull (hot add_model
+    # needs the multi container even before a second model exists).
+    eng = MultiEngine(_cfg(model="tiny-test"))
+    assert eng.models == ["tiny-test"]
     try:
-        MultiEngine(_cfg(model="tiny-test"))
+        MultiEngine(_cfg(model=""))
         raise AssertionError("expected ValueError")
     except ValueError:
         pass

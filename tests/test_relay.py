@@ -1,0 +1,162 @@
+"""NAT relay (net/relay.py): reverse streams through the bootstrap node.
+
+Parity target: the reference's libp2p relay/hole-punch handling
+(/root/reference/pkg/dht/dht.go:386-395, internal/discovery/discovery.go:62)
+— a worker that cannot accept inbound TCP must still serve the swarm.
+"""
+
+import asyncio
+
+import aiohttp
+from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+
+from crowdllama_tpu.config import Configuration, Intervals
+from crowdllama_tpu.core.protocol import METADATA_PROTOCOL
+from crowdllama_tpu.engine.engine import FakeEngine
+from crowdllama_tpu.gateway.gateway import Gateway
+from crowdllama_tpu.net.discovery import new_host_and_dht
+from crowdllama_tpu.net.host import Contact, Host
+from crowdllama_tpu.net.relay import (
+    RelayClient,
+    RelayService,
+    dialback_probe,
+)
+from crowdllama_tpu.peer.peer import Peer
+
+
+def _cfg(bootstrap, **kw):
+    cfg = Configuration(listen_host="127.0.0.1", bootstrap_peers=[bootstrap],
+                        intervals=Intervals.default())
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+async def _wait_for(cond, timeout=20.0, what="condition"):
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        if cond():
+            return
+        await asyncio.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+async def test_relay_reverse_stream_and_dialback():
+    """Protocol-level: register + connect splices an end-to-end
+    authenticated stream; dialback reports loopback reachability."""
+    relay_host = Host(Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    await relay_host.start()
+    RelayService(relay_host)
+    relay_addr = f"127.0.0.1:{relay_host.listen_port}"
+
+    worker_host = Host(Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    await worker_host.start()
+    served = asyncio.Event()
+
+    async def echo_handler(stream):
+        data = await stream.reader.readexactly(5)
+        stream.writer.write(data[::-1])
+        await stream.writer.drain()
+        served.set()
+
+    worker_host.set_stream_handler("/test/echo", echo_handler)
+
+    client_host = Host(Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    await client_host.start()
+
+    relay_client = RelayClient(worker_host, relay_addr)
+    try:
+        # Reachability probe: loopback listeners ARE dialable.
+        assert await dialback_probe(worker_host, relay_addr) is True
+
+        await relay_client.start()
+        target = Contact(peer_id=worker_host.peer_id, host="127.0.0.1",
+                         port=relay_host.listen_port, relay=True)
+        stream = await client_host.new_stream(target, "/test/echo")
+        # Identity is the WORKER's (end-to-end handshake through the splice).
+        assert stream.remote_peer_id == worker_host.peer_id
+        stream.writer.write(b"hello")
+        await stream.writer.drain()
+        assert await stream.reader.readexactly(5) == b"olleh"
+        await asyncio.wait_for(served.wait(), 5)
+        stream.close()
+        assert client_host.stats.get("streams_relayed_out", 0) == 1
+        assert worker_host.stats.get("streams_relayed_in", 0) == 1
+    finally:
+        await relay_client.stop()
+        await client_host.close()
+        await worker_host.close()
+        await relay_host.close()
+
+
+async def test_relayed_worker_serves_through_gateway():
+    """End-to-end VERDICT r3 done-criterion: a worker with an UNREACHABLE
+    listen address still serves a gateway /api/chat request through the
+    relay.  The worker binds to 127.0.0.1 but never advertises it
+    (relay_mode=always -> hellos carry listen_port 0), so every inbound
+    stream — metadata, health probes, inference — must arrive via the
+    relay splice."""
+    boot_host, _boot_dht = await new_host_and_dht(
+        Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    RelayService(boot_host)
+    bootstrap = f"127.0.0.1:{boot_host.listen_port}"
+
+    worker = Peer(Ed25519PrivateKey.generate(),
+                  _cfg(bootstrap, relay_mode="always"),
+                  engine=FakeEngine(models=["tiny-test"]), worker_mode=True)
+    await worker.start()
+    assert worker.relay_client is not None
+    assert worker.resource.reachability == "relay"
+    assert worker.host.contact.relay is True
+
+    consumer = Peer(Ed25519PrivateKey.generate(), _cfg(bootstrap),
+                    engine=FakeEngine(models=[]), worker_mode=False)
+    await consumer.start()
+    gateway = Gateway(consumer, port=0, host="127.0.0.1")
+    await gateway.start()
+    gw_port = gateway._runner.addresses[0][1]
+
+    try:
+        await _wait_for(
+            lambda: consumer.peer_manager.find_best_worker("tiny-test")
+            is not None,
+            what="consumer discovering relayed worker")
+        # Discovery itself crossed the relay (metadata stream).
+        assert worker.host.stats.get("streams_relayed_in", 0) >= 1
+
+        async with aiohttp.ClientSession() as s:
+            body = {"model": "tiny-test", "stream": False,
+                    "messages": [{"role": "user", "content": "via relay"}]}
+            async with s.post(f"http://127.0.0.1:{gw_port}/api/chat",
+                              json=body) as resp:
+                assert resp.status == 200, await resp.text()
+                d = await resp.json()
+                assert "via relay" in d["message"]["content"]
+                assert d["worker_id"] == worker.peer_id
+    finally:
+        await gateway.stop()
+        await consumer.stop()
+        await worker.stop()
+        await boot_host.close()
+
+
+async def test_direct_worker_stays_direct_in_auto_mode():
+    """relay_mode=auto on a loopback-reachable worker: the dialback probe
+    succeeds and no relay registration happens."""
+    boot_host, _ = await new_host_and_dht(
+        Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    RelayService(boot_host)
+    bootstrap = f"127.0.0.1:{boot_host.listen_port}"
+
+    worker = Peer(Ed25519PrivateKey.generate(),
+                  _cfg(bootstrap, relay_mode="auto"),
+                  engine=FakeEngine(models=["tiny-test"]), worker_mode=True)
+    await worker.start()
+    try:
+        assert worker.relay_client is None
+        assert worker.resource.reachability == "direct"
+        assert worker.host.contact.relay is False
+    finally:
+        await worker.stop()
+        await boot_host.close()
