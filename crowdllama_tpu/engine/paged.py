@@ -37,6 +37,7 @@ from __future__ import annotations
 import logging
 import math
 from dataclasses import dataclass
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -92,7 +93,12 @@ jax.tree_util.register_dataclass(
 class PagedModelRunner(ModelRunner):
     """ModelRunner with the paged KV layout (same serving surface)."""
 
-    prefill_chunk = 0  # chunked admission disabled (see ModelRunner note)
+    #: Chunked admission works on the paged layout too: the job accumulates
+    #: one prompt's bucket-sized KV buffer (exactly what monolithic prefill
+    #: materializes anyway) and insert() scatters it into pages.  The
+    #: scheduler consults :meth:`prefill_prefers_monolithic` first so
+    #: prompts the prefix cache mostly covers keep the suffix-only path.
+    prefill_chunk = 512
 
     def __init__(self, cfg, *args, page_size: int = 128, pool_tokens: int = 0,
                  prefix_cache: bool = True, **kwargs):
@@ -314,6 +320,84 @@ class PagedModelRunner(ModelRunner):
                                 np.int32).tobytes())
             keys.append(h.digest())
         return keys
+
+    def prefill_begin(self, prompt_ids: list[int], state=None):
+        """Chunked-admission job, seeded from cached prefix pages.
+
+        A stale pending match from a failed monolithic prefill must never
+        leak into this job's insert (it would index foreign pages under the
+        wrong chain keys), so pending state clears first.  With ``state``
+        (the scheduler's live decode state) the cached prefix's KV is
+        COPIED into the job's context accumulators and ``done_tokens``
+        starts past it — the chunked path then prefills only the suffix,
+        so a mostly-cached long prompt costs its uncovered tail, not the
+        whole prompt."""
+        self._clear_pending()
+        job = super().prefill_begin(prompt_ids)
+        if state is None or not self.prefix_cache:
+            return job
+        pg = self.page_size
+        plen = len(prompt_ids)
+        matched: list[int] = []
+        # Cap one page early: >= 1 suffix token must remain for logits.
+        for k in self._chain_keys(prompt_ids, max(0, (plen - 1) // pg)):
+            page = self._prefix_index.get(k)
+            if page is None:
+                break
+            matched.append(page)
+            self._lru_tick += 1
+            self._index_lru[k] = self._lru_tick
+        if not matched:
+            self.prefix_misses += 1
+            return job
+        ctx_len = len(matched) * pg
+        width = job.ctx_k.shape[3]
+        pages = np.full((width // pg,), self.total_pages, np.int32)
+        pages[:len(matched)] = matched  # dump-page padded: one compile/bucket
+        # The copy consumes the CURRENT pool arrays — XLA orders it before
+        # any later donation of those buffers, and garbage beyond ctx_len
+        # is masked by the job's ctx_valid.
+        job.ctx_k, job.ctx_v = self._seed_ctx(
+            state.pool_k, state.pool_v, state.k_scale, state.v_scale,
+            jnp.asarray(pages), job.ctx_k, job.ctx_v)
+        job.done_tokens = ctx_len
+        self.prefix_hits += 1
+        self.prefix_tokens_reused += ctx_len
+        return job
+
+    @partial(jax.jit, static_argnums=0, donate_argnums=(6, 7))
+    def _seed_ctx(self, pool_k, pool_v, k_scale, v_scale, pages, ctx_k,
+                  ctx_v):
+        """Copy pool pages into a prefill job's context accumulators
+        ([L, n, Hkv, pg, Dh] gather → [L, 1, Hkv, n*pg, Dh] prefix)."""
+        l, hkv, dh = (self.cfg.num_layers, self.cfg.num_kv_heads,
+                      self.cfg.resolved_head_dim())
+        c = pages.shape[0] * self.page_size
+        ck, cv = pool_k[:, pages], pool_v[:, pages]
+        if self.kv_dtype == "int8":
+            ck = (ck.astype(jnp.float32)
+                  * k_scale[:, pages][..., None].astype(jnp.float32))
+            cv = (cv.astype(jnp.float32)
+                  * v_scale[:, pages][..., None].astype(jnp.float32))
+        ck = ck.transpose(0, 2, 1, 3, 4).reshape(l, 1, hkv, c, dh)
+        cv = cv.transpose(0, 2, 1, 3, 4).reshape(l, 1, hkv, c, dh)
+        return (ck.astype(ctx_k.dtype)[..., :ctx_k.shape[3], :],
+                cv.astype(ctx_v.dtype)[..., :ctx_v.shape[3], :])
+
+    def prefill_prefers_monolithic(self, prompt_ids: list[int]) -> bool:
+        """True when the prefix cache covers enough of the prompt that the
+        suffix-only (ctx) prefill beats chunked admission: the uncovered
+        suffix fits within one admission chunk."""
+        if not self.prefix_cache:
+            return False
+        pg = self.page_size
+        plen = len(prompt_ids)
+        matched = 0
+        for k in self._chain_keys(prompt_ids, max(0, (plen - 1) // pg)):
+            if k not in self._prefix_index:
+                break
+            matched += pg
+        return plen - matched <= self.prefill_chunk
 
     def prefill(self, prompt_ids: list[int], temperature: float, top_p: float,
                 key, state: PagedDecodeState | None = None):
@@ -539,6 +623,12 @@ class PagedModelRunner(ModelRunner):
                 f"{pg} (align buckets to pages)")
         keys, shared = self._pending_match or ([], [])
         self._pending_match = None
+        if not keys and self.prefix_cache and prompt_tokens:
+            # Chunk-admitted prompts (scheduler's prefill_begin/step path)
+            # never ran prefill()'s matching — index their pages here so
+            # later prompts sharing the prefix still hit.
+            keys = self._chain_keys(list(prompt_tokens),
+                                    len(prompt_tokens) // self.page_size)
         self._free(slot)  # defensive: slot must not leak prior pages
         try:
             fresh = self._alloc(bucket // pg)

@@ -335,9 +335,9 @@ class ModelRunner:
     # ------------------------------------------------------- chunked prefill
 
     #: scheduler switches to incremental admission above this prompt length;
-    #: 0 disables (paged runners: a chunked job accumulates a full-length KV
-    #: buffer, defeating the page pool — they keep monolithic prefill +
-    #: prefix cache)
+    #: 0 disables (only pp/sp meshes, whose prefill cannot run the plain
+    #: ctx-accumulating chunk program — see __init__).  Paged runners chunk
+    #: too, seeding the job from cached prefix pages (engine/paged.py).
     prefill_chunk = 512
 
     class PrefillJob:
@@ -360,7 +360,11 @@ class ModelRunner:
         def finished(self) -> bool:
             return self.done_tokens >= len(self.prompt_ids)
 
-    def prefill_begin(self, prompt_ids: list[int]) -> "ModelRunner.PrefillJob":
+    def prefill_begin(self, prompt_ids: list[int],
+                      state=None) -> "ModelRunner.PrefillJob":
+        # ``state`` is accepted (and ignored) so the scheduler can pass its
+        # live decode state uniformly; the paged runner seeds the job's
+        # context from cached prefix pages with it.
         if len(prompt_ids) >= self.max_seq:
             raise ValueError(
                 f"prompt of {len(prompt_ids)} tokens exceeds max context "

@@ -396,6 +396,14 @@ class Scheduler:
                             self.runner.prefill_finish, job,
                             req.temperature, req.top_p, sub))
                     self._place(req, slot, ks, vs, plen, first)
+            except ValueError as e:
+                # Bad request / pool exhaustion at insert (PagesExhausted
+                # is a ValueError): fail THIS request, engine stays up —
+                # mirrors the monolithic admission path below.
+                self._chunking = None
+                self.slots[slot] = None
+                log.warning("chunked admit failed: %s", e)
+                req.out.put_nowait((_DONE, f"error: {e}"))
             except BaseException:
                 self._chunking = None
                 self.slots[slot] = None
@@ -422,7 +430,12 @@ class Scheduler:
             if req.cancelled:
                 continue
             chunk = getattr(self.runner, "prefill_chunk", 0)
-            if chunk and len(req.prompt_ids) > chunk:
+            # Paged runners keep the suffix-only (prefix-cache) path for
+            # prompts the cache mostly covers — chunked admission would
+            # re-prefill what cached pages already hold.
+            hint = getattr(self.runner, "prefill_prefers_monolithic", None)
+            if (chunk and len(req.prompt_ids) > chunk
+                    and not (hint is not None and hint(req.prompt_ids))):
                 if self._chunking is not None:
                     # One chunked admission at a time; park it and keep
                     # admitting short requests from pending.
@@ -432,7 +445,8 @@ class Scheduler:
                 # iteration (decode keeps streaming in between).  The slot
                 # is RESERVED so short requests can still fill the others.
                 try:
-                    job = self.runner.prefill_begin(req.prompt_ids)
+                    job = self.runner.prefill_begin(req.prompt_ids,
+                                                    state=self.state)
                 except ValueError as e:
                     log.warning("admit failed: %s", e)
                     req.out.put_nowait((_DONE, f"error: {e}"))

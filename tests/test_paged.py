@@ -242,3 +242,83 @@ def test_config_paged_int8_composes():
     with pytest.raises(ValueError):  # spec still needs contiguous bf16
         Configuration.from_environment(spec_decode="ngram",
                                        kv_layout="paged")
+
+
+def test_paged_chunked_admission_matches_monolithic():
+    """Chunked admission (prefill_begin/step/finish) on the paged runner:
+    greedy tokens match monolithic prefill, and the chunk-admitted pages
+    are prefix-indexed so later prompts sharing the prefix hit."""
+    cfg = get_config("tiny-test", max_context_length=256)
+    pr = PagedModelRunner(cfg, max_slots=2, max_seq=256, page_size=32,
+                          mesh_spec="1", kv_dtype="int8")
+    pr.prefill_chunk = 64  # force chunking for the 100-token prompt
+    prompt = list(range(1, 101))
+
+    state = pr.init_state()
+    job = pr.prefill_begin(prompt)
+    while not pr.prefill_step(job):
+        pass
+    tok, ks, vs, plen = pr.prefill_finish(job, 0.0, 1.0, jax.random.PRNGKey(0))
+    state = pr.insert(state, 0, ks, vs, plen, tok, 0.0, 1.0,
+                      prompt_tokens=prompt)
+    t_chunked, state = pr.decode_steps(state, 6)
+
+    pr2 = PagedModelRunner(cfg, params=pr.params, max_slots=2, max_seq=256,
+                           page_size=32, mesh_spec="1", kv_dtype="int8")
+    s2 = pr2.init_state()
+    tok2, ks2, vs2, plen2 = pr2.prefill(prompt, 0.0, 1.0,
+                                        jax.random.PRNGKey(0), state=s2)
+    s2 = pr2.insert(s2, 0, ks2, vs2, plen2, tok2, 0.0, 1.0,
+                    prompt_tokens=prompt)
+    t_mono, s2 = pr2.decode_steps(s2, 6)
+    assert tok == tok2
+    assert t_chunked[:, 0].tolist() == t_mono[:, 0].tolist()
+
+    # Chunk-admitted pages feed the prefix cache (and the monolithic hint).
+    assert pr.prefill_prefers_monolithic(prompt)
+    pr.prefill(prompt[:96] + [7, 8, 9], 0.0, 1.0, jax.random.PRNGKey(1),
+               state=state)
+    assert pr.prefix_hits == 1
+
+
+def test_paged_chunked_admission_seeds_from_prefix_cache():
+    """Chunked admission with a cached prefix: the job's context is seeded
+    from the cached pages (prefill_begin state path), so a mostly-cached
+    long prompt prefills only its uncovered suffix — and the result matches
+    an uncached monolithic prefill exactly."""
+    for kvd in ("bf16", "int8"):
+        cfg = get_config("tiny-test", max_context_length=256)
+        pr = PagedModelRunner(cfg, max_slots=2, max_seq=256, page_size=32,
+                              mesh_spec="1", kv_dtype=kvd)
+        pr.prefill_chunk = 64
+        base = list(range(1, 129))  # 4 full pages
+        state = pr.init_state()
+        tok, ks, vs, plen = pr.prefill(base + [50, 51], 0.0, 1.0,
+                                       jax.random.PRNGKey(0), state=state)
+        state = pr.insert(state, 0, ks, vs, plen, tok, 0.0, 1.0,
+                          prompt_tokens=base + [50, 51])
+        hits0, reused0 = pr.prefix_hits, pr.prefix_tokens_reused
+
+        promptB = base + list(range(200, 300))  # suffix 100 > chunk 64
+        job = pr.prefill_begin(promptB, state=state)
+        assert job.done_tokens == 128  # seeded past the cached prefix
+        while not pr.prefill_step(job):
+            pass
+        tokB, ksB, vsB, plenB = pr.prefill_finish(job, 0.0, 1.0,
+                                                  jax.random.PRNGKey(2))
+        state = pr.insert(state, 1, ksB, vsB, plenB, tokB, 0.0, 1.0,
+                          prompt_tokens=promptB)
+        assert pr.prefix_hits == hits0 + 1
+        assert pr.prefix_tokens_reused == reused0 + 128
+
+        pr2 = PagedModelRunner(cfg, params=pr.params, max_slots=2,
+                               max_seq=256, page_size=32, mesh_spec="1",
+                               kv_dtype=kvd)
+        s2 = pr2.init_state()
+        tok2, ks2, vs2, plen2 = pr2.prefill(promptB, 0.0, 1.0,
+                                            jax.random.PRNGKey(2))
+        s2 = pr2.insert(s2, 1, ks2, vs2, plen2, tok2, 0.0, 1.0)
+        assert tokB == tok2
+        tB, state = pr.decode_steps(state, 5)
+        t2, s2 = pr2.decode_steps(s2, 5)
+        assert tB[:, 1].tolist() == t2[:, 1].tolist()
