@@ -86,24 +86,49 @@ def _wait_for_devices(budget_s: float):
     needs minutes to recover; retry backend init instead of failing the
     whole benchmark run on a transient.  After the budget, fall back to the
     CPU backend so the run still emits parseable (clearly-labeled) lines
-    rather than rc=1 with nothing (BENCH_r02 postmortem, VERDICT r2 #1)."""
+    rather than rc=1 with nothing (BENCH_r02 postmortem, VERDICT r2 #1).
+
+    Probes run in SUBPROCESSES with a hard per-attempt timeout: a downed
+    tunnel can make backend init HANG indefinitely inside the C extension
+    (observed 20+ min, uninterruptible in-process) rather than raise — an
+    in-process retry loop would never regain control."""
     import jax
 
+    if jax.config.jax_platforms == "cpu":
+        return jax.devices()  # explicitly pinned (tests / CPU runs)
     deadline = time.monotonic() + budget_s
     delay = 5.0
     while True:
-        try:
-            return jax.devices()
-        except RuntimeError as e:
-            if time.monotonic() >= deadline:
-                print(f"# device budget exhausted ({e}); "
-                      "falling back to CPU", file=sys.stderr)
-                break
-            print(f"# devices unavailable ({e}); retrying in {delay:.0f}s",
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            print("# device budget exhausted; falling back to CPU",
                   file=sys.stderr)
-            _clear_backends()
-            time.sleep(delay)
-            delay = min(delay * 2, 60.0)
+            break
+        env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(len(jax.devices()))"],
+                timeout=min(120.0, max(remaining, 10.0)),
+                capture_output=True, text=True, env=env)
+            if probe.returncode == 0 and probe.stdout.strip().isdigit():
+                try:
+                    # Tunnel is up per the probe: init in-process.  A drop
+                    # in the gap between probe and init must re-enter the
+                    # retry loop, not crash the run.
+                    return jax.devices()
+                except RuntimeError as e:
+                    _clear_backends()
+                    detail = f"post-probe init failed: {e}"
+            else:
+                detail = (probe.stderr or "").strip().splitlines()
+                detail = detail[-1] if detail else f"rc={probe.returncode}"
+        except subprocess.TimeoutExpired:
+            detail = "backend init hung (tunnel down)"
+        print(f"# devices unavailable ({detail}); retrying in {delay:.0f}s",
+              file=sys.stderr)
+        time.sleep(delay)
+        delay = min(delay * 2, 60.0)
     jax.config.update("jax_platforms", "cpu")
     _clear_backends()
     return jax.devices()

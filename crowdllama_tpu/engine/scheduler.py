@@ -445,8 +445,17 @@ class Scheduler:
                 # iteration (decode keeps streaming in between).  The slot
                 # is RESERVED so short requests can still fill the others.
                 try:
-                    job = self.runner.prefill_begin(req.prompt_ids,
-                                                    state=self.state)
+                    # Executor, not the loop: prefix-cache seeding gathers
+                    # cached pages on device (compile on first use) — the
+                    # loop must keep streaming while that happens.  The
+                    # loop parks on this await, so allocator/index state
+                    # stays single-flight.
+                    import functools
+
+                    job = await loop.run_in_executor(
+                        self._exec, functools.partial(
+                            self.runner.prefill_begin, req.prompt_ids,
+                            state=self.state))
                 except ValueError as e:
                     log.warning("admit failed: %s", e)
                     req.out.put_nowait((_DONE, f"error: {e}"))
