@@ -460,6 +460,13 @@ class Scheduler:
                     log.warning("admit failed: %s", e)
                     req.out.put_nowait((_DONE, f"error: {e}"))
                     continue
+                except BaseException:
+                    # Engine failure in prefill_begin (e.g. the prefix-seed
+                    # gather): the popped request is in neither slots nor
+                    # pending — fail it before the loop's recovery resets
+                    # state, or its client waits forever.
+                    req.out.put_nowait((_DONE, "error: engine failure"))
+                    raise
                 self._admitting += 1
                 self._chunking = (req, slot, job)
                 self.slots[slot] = _RESERVED

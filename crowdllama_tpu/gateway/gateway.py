@@ -67,8 +67,21 @@ class Gateway:
         self.app.router.add_post("/api/embed", self.handle_embed)
         self.app.router.add_post("/api/embeddings", self.handle_embeddings)
         self.app.router.add_post("/api/pull", self.handle_pull)
+        self.app.router.add_get("/metrics", self.handle_metrics)
         for route in ("/api/delete", "/api/create", "/api/copy", "/api/push"):
             self.app.router.add_route("*", route, self.handle_unsupported)
+        # Prometheus-style counters fed by the logging middleware
+        # ((path, status) -> count / summed seconds).  The reference has no
+        # metrics surface at all (SURVEY §5: "No Prometheus/metrics
+        # endpoint") — this is part of the TPU-native superset.
+        self._req_count: dict[tuple[str, int], int] = {}
+        self._req_seconds: dict[tuple[str, int], float] = {}
+        # Label hygiene: only registered routes become label values —
+        # scanner probes of arbitrary paths must not grow the counter maps
+        # without bound or inject quotes into the exposition format.
+        self._known_paths = {r.resource.canonical
+                             for r in self.app.router.routes()
+                             if r.resource is not None}
 
     # ----------------------------------------------------------- lifecycle
 
@@ -89,12 +102,25 @@ class Gateway:
     @web.middleware
     async def _log_middleware(self, request: web.Request, handler):
         t0 = time.monotonic()
+        status = 0
         try:
             resp = await handler(request)
+            status = resp.status
             return resp
+        except web.HTTPException as e:
+            # aiohttp delivers router 404/405s (and handler short-circuits)
+            # by raising — record their real status, not 0.
+            status = e.status
+            raise
         finally:
+            dt = time.monotonic() - t0
             log.info("%s %s -> %.0fms", request.method, request.path,
-                     (time.monotonic() - t0) * 1000)
+                     dt * 1000)
+            path = (request.path if request.path in self._known_paths
+                    else "other")
+            key = (path, status)
+            self._req_count[key] = self._req_count.get(key, 0) + 1
+            self._req_seconds[key] = self._req_seconds.get(key, 0.0) + dt
 
     # ------------------------------------------------------------ handlers
 
@@ -383,6 +409,50 @@ class Gateway:
                      f"swarm pull failed ({pull_err}); models are provided "
                      "by swarm workers (start one with "
                      f"--worker-mode --model {name})"}, status=404)
+
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        """GET /metrics — Prometheus text exposition of gateway + swarm
+        state.  The machine-readable twin of /api/health (which mirrors the
+        reference's JSON health map, gateway.go:426-461); the reference has
+        no metrics endpoint."""
+        lines = [
+            "# TYPE crowdllama_gateway_requests_total counter",
+        ]
+        for (path, status), n in sorted(self._req_count.items()):
+            lines.append(
+                f'crowdllama_gateway_requests_total{{path="{path}",'
+                f'status="{status}"}} {n}')
+        lines.append("# TYPE crowdllama_gateway_request_seconds_total counter")
+        for (path, status), s in sorted(self._req_seconds.items()):
+            lines.append(
+                f'crowdllama_gateway_request_seconds_total{{path="{path}",'
+                f'status="{status}"}} {s:.6f}')
+        pm = self.peer.peer_manager
+        if pm is not None:
+            workers = pm.get_workers()
+            healthy = [p for p in workers if p.is_healthy]
+            lines += [
+                "# TYPE crowdllama_workers_total gauge",
+                f"crowdllama_workers_total {len(workers)}",
+                "# TYPE crowdllama_workers_healthy gauge",
+                f"crowdllama_workers_healthy {len(healthy)}",
+                "# TYPE crowdllama_worker_throughput_tokens_per_sec gauge",
+                "# TYPE crowdllama_worker_load gauge",
+                "# TYPE crowdllama_worker_healthy gauge",
+            ]
+            for p in workers:
+                pid = p.peer_id[:16]
+                r = p.resource
+                lines.append(
+                    f'crowdllama_worker_throughput_tokens_per_sec{{'
+                    f'peer="{pid}"}} {r.tokens_throughput}')
+                lines.append(
+                    f'crowdllama_worker_load{{peer="{pid}"}} {r.load}')
+                lines.append(
+                    f'crowdllama_worker_healthy{{peer="{pid}"}} '
+                    f'{1 if p.is_healthy else 0}')
+        return web.Response(text="\n".join(lines) + "\n",
+                            content_type="text/plain")
 
     async def handle_unsupported(self, request: web.Request) -> web.Response:
         """Model management (delete/create/copy/push) has no meaning at the

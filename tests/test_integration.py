@@ -271,3 +271,32 @@ async def test_seeded_generation_reproducible_through_gateway():
         await worker.stop()
         await engine.stop()
         await boot_host.close()
+
+
+async def test_metrics_endpoint():
+    """GET /metrics: Prometheus text exposition with request counters and
+    swarm worker gauges (no reference counterpart — SURVEY §5 notes the
+    reference has no metrics endpoint)."""
+    worker, consumer, gateway, gw_port, teardown = await _topology()
+    try:
+        await _wait_for(
+            lambda: any(p.peer_id == worker.peer_id
+                        for p in consumer.peer_manager.get_healthy_peers()),
+            what="discovery",
+        )
+        async with aiohttp.ClientSession() as s:
+            body = {"model": "tiny-test", "stream": False,
+                    "messages": [{"role": "user", "content": "hi"}]}
+            async with s.post(f"http://127.0.0.1:{gw_port}/api/chat",
+                              json=body) as resp:
+                assert resp.status == 200
+            async with s.get(f"http://127.0.0.1:{gw_port}/metrics") as resp:
+                assert resp.status == 200
+                text = await resp.text()
+        assert ('crowdllama_gateway_requests_total{path="/api/chat",'
+                'status="200"} 1') in text
+        assert "crowdllama_workers_healthy 1" in text
+        assert "crowdllama_worker_load{" in text
+        assert "crowdllama_gateway_request_seconds_total{" in text
+    finally:
+        await teardown()

@@ -160,3 +160,62 @@ async def test_direct_worker_stays_direct_in_auto_mode():
     finally:
         await worker.stop()
         await boot_host.close()
+
+
+async def test_relay_client_reregisters_after_relay_restart():
+    """The worker's control-stream reconnect loop: when the relay node
+    restarts (new process, same address), the worker re-registers and
+    keeps serving reverse streams."""
+    relay_host = Host(Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    await relay_host.start()
+    RelayService(relay_host)
+    relay_port = relay_host.listen_port
+    relay_addr = f"127.0.0.1:{relay_port}"
+
+    worker_host = Host(Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    await worker_host.start()
+
+    async def echo(stream):
+        data = await stream.reader.readexactly(2)
+        stream.writer.write(data)
+        await stream.writer.drain()
+
+    worker_host.set_stream_handler("/test/echo", echo)
+    client_host = Host(Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    await client_host.start()
+
+    rc = RelayClient(worker_host, relay_addr, ping_interval=0.2)
+    try:
+        await rc.start()
+        # Kill the relay; the control stream dies and the client loops.
+        await relay_host.close()
+        await asyncio.sleep(0.3)
+        assert not rc.registered.is_set()
+
+        # Same-port restart (retry: the OS may briefly hold the port).
+        relay_host2 = Host(Ed25519PrivateKey.generate(),
+                           listen_host="127.0.0.1", listen_port=relay_port)
+        for _ in range(40):
+            try:
+                await relay_host2.start()
+                break
+            except OSError:
+                await asyncio.sleep(0.25)
+        else:
+            raise AssertionError("could not rebind relay port")
+        RelayService(relay_host2)
+
+        await asyncio.wait_for(rc.registered.wait(), 15)
+        target = Contact(peer_id=worker_host.peer_id, host="127.0.0.1",
+                         port=relay_port, relay=True)
+        stream = await client_host.new_stream(target, "/test/echo")
+        stream.writer.write(b"ok")
+        await stream.writer.drain()
+        assert await stream.reader.readexactly(2) == b"ok"
+        stream.close()
+        await relay_host2.close()
+    finally:
+        await rc.stop()
+        await client_host.close()
+        await worker_host.close()
+        await relay_host.close()
