@@ -277,6 +277,12 @@ class JaxEngine(Engine):
                            state.pool_v, state.k_scale, state.v_scale,
                            jnp.asarray(pages), jnp.float32(0.0),
                            jnp.float32(1.0), jax.random.PRNGKey(0))
+        if getattr(r, "prefill_chunk", 0) and r.max_seq > r.prefill_chunk:
+            # Chunked-admission programs (the long-prompt path): compile
+            # one chunk step at the chunk bucket so the first long prompt
+            # doesn't pay the forward's XLA compile in its TTFT.
+            job = r.prefill_begin(list(range(1, r.prefill_chunk + 2)))
+            r.prefill_step(job)
         try:
             r.embed_prompts([[1, 2, 3]])
         except NotImplementedError:  # pp/sp meshes have no embeddings path

@@ -1,0 +1,21 @@
+"""Swarm model pull example: acquire a checkpoint from a peer.
+
+The reference gets this surface from the embedded Ollama CLI
+(`crowdllama pull ...`); here acquisition is peer-to-peer and
+hash-verified (net/model_share.py) because the swarm is zero-egress.
+
+    # worker A serves tiny-test from a local HF checkpoint dir
+    crowdllama-tpu start --worker-mode --model tiny-test \
+        --model-path /ckpts/tiny-test --bootstrap-peers host:9000 &
+
+    # fetch it to this machine (prints the local checkpoint path)
+    python examples/pull.py tiny-test --bootstrap-peers host:9000
+"""
+
+import subprocess
+import sys
+
+if __name__ == "__main__":
+    sys.exit(subprocess.call(
+        [sys.executable, "-m", "crowdllama_tpu.cli.main", "pull",
+         *sys.argv[1:]]))
