@@ -57,7 +57,10 @@ from pathlib import Path
 
 BASELINE_ADVERTISED_TOKS = 150.0  # reference worker's hardcoded claim
 PARTIAL_PATH = Path(__file__).resolve().parent / "BENCH_partial.jsonl"
-_ALL_PHASES = ("decode", "decode_paged", "decode8b", "kernel", "ttft",
+# kernel runs FIRST: it proves the Mosaic-compiled kernels on this chip;
+# if it fails, later phases run with CROWDLLAMA_NO_PALLAS=1 so a kernel
+# regression degrades to the XLA paths instead of zeroing the artifact.
+_ALL_PHASES = ("kernel", "decode", "decode_paged", "decode8b", "ttft",
                "swarm")
 
 # Honor JAX_PLATFORMS even though the image's sitecustomize pre-imports jax
@@ -247,7 +250,10 @@ def _decode_phase(model: str, layout: str = "contiguous",
         "extra": {"platform": platform, "slots": runner.max_slots,
                   "steps": done, "ctx": cfg.max_context_length,
                   "quantize": quantize or "bf16", "kv_dtype": kv_dtype,
-                  "kv_layout": layout},
+                  "kv_layout": layout,
+                  # Artifact must be self-describing: a paged number from
+                  # the jnp gather fallback is not a fused-kernel number.
+                  "no_pallas": bool(os.environ.get("CROWDLLAMA_NO_PALLAS"))},
     }
 
 
@@ -448,15 +454,26 @@ def main() -> None:
             continue
         t0 = time.monotonic()
         print(f"# phase {phase} starting", file=sys.stderr)
+        kernel_ok = True
         try:
-            _emit(fn())
+            result = fn()
+            _emit(result)
             ok += 1
             print(f"# phase {phase} done in {time.monotonic() - t0:.0f}s",
                   file=sys.stderr)
+            kernel_ok = phase != "kernel" or result.get("value") == 1.0
         except Exception:
             print(f"# phase {phase} FAILED after "
                   f"{time.monotonic() - t0:.0f}s:", file=sys.stderr)
             traceback.print_exc()
+            kernel_ok = phase != "kernel"
+        if not kernel_ok:
+            # Mosaic parity/compile failure on this chip: keep the rest of
+            # the suite on the XLA paths (each later phase records the
+            # degradation in its own extra.no_pallas field).
+            os.environ["CROWDLLAMA_NO_PALLAS"] = "1"
+            print("# kernel phase failed: later phases run with "
+                  "CROWDLLAMA_NO_PALLAS=1", file=sys.stderr)
     sys.exit(0 if ok else 1)
 
 
