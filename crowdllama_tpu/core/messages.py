@@ -23,6 +23,7 @@ def create_generate_request(
     temperature: float = 0.0,
     top_p: float = 0.0,
     seed: int = 0,
+    stop: Iterable[str] = (),
 ) -> pb.BaseMessage:
     req = pb.GenerateRequest(
         model=model,
@@ -33,6 +34,8 @@ def create_generate_request(
         top_p=top_p,
         seed=seed,
     )
+    for s_ in stop:
+        req.stop.append(str(s_))
     for m in messages:
         req.messages.append(pb.ChatMessage(role=m.get("role", "user"), content=m.get("content", "")))
     return pb.BaseMessage(generate_request=req)

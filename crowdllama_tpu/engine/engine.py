@@ -38,6 +38,42 @@ class Chunk:
     completion_tokens: int = 0
 
 
+class StopMatcher:
+    """Streaming stop-sequence scanner (Ollama options.stop semantics).
+
+    ``feed(text)`` returns (emit_now, stopped): text that is safe to send —
+    up to ``max(len(stop)) - 1`` chars are held back so a stop spanning two
+    decoded chunks is still caught — and whether a stop fired (everything
+    from the match onward is dropped).  ``flush()`` returns the held tail
+    at end-of-stream.  ONE implementation, shared by every engine that
+    streams text (a fix here cannot ship in one engine and miss another).
+    """
+
+    def __init__(self, stop: list[str] | None):
+        self.stops = [s for s in (stop or []) if s]
+        self._hold = max((len(s) for s in self.stops), default=1) - 1
+        self._pending = ""
+
+    def feed(self, text: str) -> tuple[str, bool]:
+        if not self.stops:
+            return text, False
+        self._pending += text
+        cut = min((i for i in (self._pending.find(s) for s in self.stops)
+                   if i >= 0), default=-1)
+        if cut >= 0:
+            emit, self._pending = self._pending[:cut], ""
+            return emit, True
+        if len(self._pending) > self._hold:
+            split = len(self._pending) - self._hold
+            emit, self._pending = self._pending[:split], self._pending[split:]
+            return emit, False
+        return "", False
+
+    def flush(self) -> str:
+        out, self._pending = self._pending, ""
+        return out
+
+
 class Engine:
     """Abstract engine seam."""
 
@@ -71,6 +107,7 @@ class Engine:
         temperature: float = 0.0,
         top_p: float = 1.0,
         seed: int = 0,
+        stop: list[str] | None = None,
     ) -> AsyncIterator[Chunk]:
         raise NotImplementedError
 
@@ -155,6 +192,7 @@ class Engine:
             temperature=req.temperature,
             top_p=req.top_p or 1.0,
             seed=int(req.seed or 0),
+            stop=list(req.stop),
         )
 
 
@@ -384,6 +422,7 @@ class JaxEngine(Engine):
         temperature: float = 0.0,
         top_p: float = 1.0,
         seed: int = 0,
+        stop: list[str] | None = None,
     ) -> AsyncIterator[Chunk]:
         from crowdllama_tpu.engine.scheduler import DONE, GenRequest
 
@@ -403,6 +442,7 @@ class JaxEngine(Engine):
         )
         await self.scheduler.submit(req)
         decoder = self.tokenizer.stream_decoder()
+        matcher = StopMatcher(stop)
         completion = 0
         finished = False
         try:
@@ -413,7 +453,7 @@ class JaxEngine(Engine):
                     if reason.startswith("error"):
                         raise RuntimeError(reason)
                     yield Chunk(
-                        text="", done=True, done_reason=reason,
+                        text=matcher.flush(), done=True, done_reason=reason,
                         prompt_tokens=len(prompt_ids),
                         completion_tokens=completion,
                     )
@@ -422,8 +462,20 @@ class JaxEngine(Engine):
                 if token == req.eos_id:
                     continue  # silent; DONE follows
                 text = decoder.feed(token)
-                if text:
-                    yield Chunk(text=text)
+                if not text:
+                    continue
+                emit, stopped = matcher.feed(text)
+                if stopped:
+                    finished = True
+                    self.scheduler.cancel(req)
+                    yield Chunk(
+                        text=emit, done=True, done_reason="stop",
+                        prompt_tokens=len(prompt_ids),
+                        completion_tokens=completion,
+                    )
+                    return
+                if emit:
+                    yield Chunk(text=emit)
         finally:
             if not finished:
                 # Consumer stopped early (client disconnect closes the
@@ -498,15 +550,27 @@ class FakeEngine(Engine):
     async def generate(  # type: ignore[override]
         self, prompt: str, model: str = "", max_tokens: int = 128,
         temperature: float = 0.0, top_p: float = 1.0, seed: int = 0,
+        stop: list[str] | None = None,
     ) -> AsyncIterator[Chunk]:
         self.calls += 1
         if self.delay:
             await asyncio.sleep(self.delay)
+        matcher = StopMatcher(stop)
         words = f"echo: {prompt}".split(" ")
-        for w in words[:-1]:
-            yield Chunk(text=w + " ")
-        yield Chunk(text=words[-1], done=True, done_reason="stop",
-                    prompt_tokens=len(prompt.split()), completion_tokens=len(words))
+        emitted = 0
+        stopped = False
+        for i, w in enumerate(words):
+            emit, stopped = matcher.feed(w + ("" if i == len(words) - 1
+                                              else " "))
+            if emit:
+                yield Chunk(text=emit)
+                emitted += 1
+            if stopped:
+                break
+        yield Chunk(text="" if stopped else matcher.flush(), done=True,
+                    done_reason="stop",
+                    prompt_tokens=len(prompt.split()),
+                    completion_tokens=max(emitted, 1))
 
     async def embed(self, texts: list[str], model: str = "",
                     truncate: bool = True) -> tuple[list[list[float]], int]:

@@ -42,7 +42,7 @@ import numpy as np
 
 from crowdllama_tpu.config import Configuration
 from crowdllama_tpu.core.resource import ShardGroup
-from crowdllama_tpu.engine.engine import Chunk, Engine
+from crowdllama_tpu.engine.engine import Chunk, Engine, StopMatcher
 
 log = logging.getLogger("crowdllama.engine.sharded")
 
@@ -347,6 +347,7 @@ class ShardedEngine(Engine):
         temperature: float = 0.0,
         top_p: float = 1.0,
         seed: int = 0,
+        stop: list[str] | None = None,
     ) -> AsyncIterator[Chunk]:
         if not self.is_leader:
             raise RuntimeError(
@@ -371,6 +372,8 @@ class ShardedEngine(Engine):
         pipeline = await self._resolve_pipeline()
         session = uuid.uuid4().hex
         decoder = self.tokenizer.stream_decoder()
+        matcher = StopMatcher(stop)
+        tail = ""  # pre-match text carried into the final chunk on stop
         completion = 0
         t0 = time.monotonic()
         # Seeded requests sample from a private generator so identical
@@ -391,7 +394,13 @@ class ShardedEngine(Engine):
                         break
                     text = decoder.feed(token)
                     if text:
-                        yield Chunk(text=text)
+                        emit, stopped = matcher.feed(text)
+                        if stopped:
+                            tail = emit  # excludes the matched stop
+                            reason = "stop"
+                            break
+                        if emit:
+                            yield Chunk(text=emit)
                     if completion >= budget:
                         break
                     logits = await pipeline.decode(session, token, n, n + 1)
@@ -401,7 +410,8 @@ class ShardedEngine(Engine):
                 inst = completion / dt
                 self._tput_ema = (inst if self._tput_ema == 0.0
                                   else 0.8 * self._tput_ema + 0.2 * inst)
-                yield Chunk(text="", done=True, done_reason=reason,
+                yield Chunk(text=tail + matcher.flush(), done=True,
+                            done_reason=reason,
                             prompt_tokens=len(prompt_ids),
                             completion_tokens=completion)
             except (ConnectionError, asyncio.IncompleteReadError, OSError,

@@ -487,6 +487,10 @@ class Gateway:
             # into the proto's uint64 range instead of raising.
             seed=min(max(0, int(options.get("seed", 0))),
                      0xFFFFFFFFFFFFFFFF),
+            # Ollama accepts a string or a list for options.stop.
+            stop=([stops] if isinstance(
+                stops := options.get("stop") or [], str) else
+                [str(x) for x in stops]),
         )
         tried: set[str] = set()
         last_err = "no workers available for model"

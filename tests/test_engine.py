@@ -588,3 +588,33 @@ async def test_chunked_admission_failure_recovers():
         assert all(s is None for s in sched.slots)
     finally:
         await sched.stop()
+
+
+async def test_stop_sequences():
+    """Ollama options.stop parity: generation halts at the first stop
+    sequence; the matched text (and anything after) is never emitted —
+    including stops that span two decoded token chunks."""
+    eng = _mkengine()
+    await eng.start()
+    try:
+        # Greedy tiny-test output is deterministic; capture a baseline.
+        base = []
+        async for c in eng.generate("stop test", max_tokens=16):
+            base.append(c.text)
+        full = "".join(base)
+        assert len(full) >= 4
+        # Use a mid-output substring as the stop sequence (spans whatever
+        # chunk boundary the decoder happened to produce).
+        stop_seq = full[2:5]
+        out, final = [], None
+        async for c in eng.generate("stop test", max_tokens=16,
+                                    stop=[stop_seq]):
+            out.append(c.text)
+            if c.done:
+                final = c
+        text = "".join(out)
+        assert final is not None and final.done_reason == "stop"
+        assert stop_seq not in text
+        assert text == full[:full.find(stop_seq)]
+    finally:
+        await eng.stop()

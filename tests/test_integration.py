@@ -300,3 +300,34 @@ async def test_metrics_endpoint():
         assert "crowdllama_gateway_request_seconds_total{" in text
     finally:
         await teardown()
+
+
+async def test_gateway_options_stop_parsed():
+    """options.stop reaches the worker through the REAL gateway parse
+    path, in both Ollama spellings (string and list): FakeEngine echoes
+    the prompt, so a stop sequence drawn from the prompt truncates the
+    echo."""
+    worker, consumer, gateway, gw_port, teardown = await _topology()
+    try:
+        await _wait_for(
+            lambda: any(p.peer_id == worker.peer_id
+                        for p in consumer.peer_manager.get_healthy_peers()),
+            what="discovery",
+        )
+        async with aiohttp.ClientSession() as s:
+            for stop_val in ("wor", ["wor"]):
+                body = {"model": "tiny-test", "stream": False,
+                        "options": {"stop": stop_val},
+                        "messages": [{"role": "user",
+                                      "content": "hello world"}]}
+                async with s.post(f"http://127.0.0.1:{gw_port}/api/chat",
+                                  json=body) as resp:
+                    assert resp.status == 200, await resp.text()
+                    d = await resp.json()
+                # The chat flattens to "user: hello world\nassistant:";
+                # the echo must truncate just before "wor".
+                full = "echo: user: hello world\nassistant:"
+                assert d["message"]["content"] == full[:full.find("wor")]
+                assert d["done_reason"] == "stop"
+    finally:
+        await teardown()
