@@ -24,6 +24,7 @@ def create_generate_request(
     top_p: float = 0.0,
     seed: int = 0,
     stop: Iterable[str] = (),
+    top_k: int = 0,
 ) -> pb.BaseMessage:
     req = pb.GenerateRequest(
         model=model,
@@ -33,6 +34,7 @@ def create_generate_request(
         temperature=temperature,
         top_p=top_p,
         seed=seed,
+        top_k=top_k,
     )
     for s_ in stop:
         req.stop.append(str(s_))

@@ -108,6 +108,7 @@ class Engine:
         top_p: float = 1.0,
         seed: int = 0,
         stop: list[str] | None = None,
+        top_k: int = 0,
     ) -> AsyncIterator[Chunk]:
         raise NotImplementedError
 
@@ -193,6 +194,7 @@ class Engine:
             top_p=req.top_p or 1.0,
             seed=int(req.seed or 0),
             stop=list(req.stop),
+            top_k=int(req.top_k or 0),
         )
 
 
@@ -314,7 +316,8 @@ class JaxEngine(Engine):
                            jnp.int32(1), jnp.int32(0), state.pool_k,
                            state.pool_v, state.k_scale, state.v_scale,
                            jnp.asarray(pages), jnp.float32(0.0),
-                           jnp.float32(1.0), jax.random.PRNGKey(0))
+                           jnp.float32(1.0), jnp.int32(0),
+                           jax.random.PRNGKey(0))
         if getattr(r, "prefill_chunk", 0) and r.max_seq > r.prefill_chunk:
             # Chunked-admission programs (the long-prompt path): compile
             # one chunk step at the chunk bucket so the first long prompt
@@ -423,6 +426,7 @@ class JaxEngine(Engine):
         top_p: float = 1.0,
         seed: int = 0,
         stop: list[str] | None = None,
+        top_k: int = 0,
     ) -> AsyncIterator[Chunk]:
         from crowdllama_tpu.engine.scheduler import DONE, GenRequest
 
@@ -437,6 +441,7 @@ class JaxEngine(Engine):
             max_tokens=max_tokens,
             temperature=temperature,
             top_p=top_p,
+            top_k=max(0, int(top_k)),
             eos_id=self.tokenizer.eos_id,
             seed=seed,
         )
@@ -550,7 +555,7 @@ class FakeEngine(Engine):
     async def generate(  # type: ignore[override]
         self, prompt: str, model: str = "", max_tokens: int = 128,
         temperature: float = 0.0, top_p: float = 1.0, seed: int = 0,
-        stop: list[str] | None = None,
+        stop: list[str] | None = None, top_k: int = 0,
     ) -> AsyncIterator[Chunk]:
         self.calls += 1
         if self.delay:

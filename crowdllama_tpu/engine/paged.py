@@ -75,6 +75,7 @@ class PagedDecodeState:
     active: jnp.ndarray    # [B]
     temperature: jnp.ndarray
     top_p: jnp.ndarray
+    top_k: jnp.ndarray  # [B] int32 — Ollama options.top_k (0 = off)
     keys: jnp.ndarray  # [B, 2] per-slot PRNG carries (see runner.DecodeState)
     # int8 pools only (kv_dtype="int8"): per-(page-position, kv-head)
     # scales [L, P, Hkv, page]; None for bf16 pools.
@@ -85,7 +86,8 @@ class PagedDecodeState:
 jax.tree_util.register_dataclass(
     PagedDecodeState,
     data_fields=["pool_k", "pool_v", "seq_lens", "tokens", "active",
-                 "temperature", "top_p", "keys", "k_scale", "v_scale"],
+                 "temperature", "top_p", "top_k", "keys", "k_scale",
+                 "v_scale"],
     meta_fields=[],
 )
 
@@ -212,7 +214,7 @@ class PagedModelRunner(ModelRunner):
 
     def _insert_paged_impl(self, state: PagedDecodeState, page_idx, ks, vs,
                            slot, plen, first_token, temperature, top_p,
-                           slot_key):
+                           top_k, slot_key):
         """Scatter a prefilled prompt's KV pages into the pool.
 
         ks/vs: [L, 1, Hkv, bucket, Dh]; page_idx: [bucket/page] pool pages.
@@ -249,6 +251,7 @@ class PagedModelRunner(ModelRunner):
             active=state.active.at[slot].set(True),
             temperature=state.temperature.at[slot].set(temperature),
             top_p=state.top_p.at[slot].set(top_p),
+            top_k=state.top_k.at[slot].set(top_k),
             keys=state.keys.at[slot].set(slot_key),
         )
 
@@ -260,11 +263,12 @@ class PagedModelRunner(ModelRunner):
             tokens=state.tokens.at[slot].set(0),
             active=state.active.at[slot].set(False),
             temperature=state.temperature, top_p=state.top_p,
-            keys=state.keys,
+            top_k=state.top_k, keys=state.keys,
         )
 
     def _prefill_ctx_impl(self, params, tokens, slen, ctx_len, pool_k, pool_v,
-                          k_scale, v_scale, pages, temperature, top_p, key):
+                          k_scale, v_scale, pages, temperature, top_p, top_k,
+                          key):
         """Suffix prefill attending over cached prefix pages.
 
         tokens [1, bucket] suffix; pages [max_pages_per_slot] pool pages
@@ -297,7 +301,7 @@ class PagedModelRunner(ModelRunner):
                                    ctx_k=ck, ctx_v=cv, ctx_valid=ctx_valid)
         last = logits[0, slen - 1]
         tok = sample_tokens(last[None, :], temperature[None], top_p[None],
-                            key)[0]
+                            key, top_k=top_k[None])[0]
         return tok, ks, vs
 
     def _clear_pending(self) -> None:
@@ -400,7 +404,7 @@ class PagedModelRunner(ModelRunner):
         return plen - matched <= self.prefill_chunk
 
     def prefill(self, prompt_ids: list[int], temperature: float, top_p: float,
-                key, state: PagedDecodeState | None = None):
+                key, state: PagedDecodeState | None = None, top_k: int = 0):
         """Bucketed prefill with automatic prefix caching.
 
         With ``state`` (the scheduler passes its live decode state) the
@@ -413,13 +417,15 @@ class PagedModelRunner(ModelRunner):
         pg = self.page_size
         plen = len(prompt_ids)
         if not self.prefix_cache:
-            return super().prefill(prompt_ids, temperature, top_p, key)
+            return super().prefill(prompt_ids, temperature, top_p, key,
+                                   top_k=top_k)
         # Index keys for every full prompt page; matching is capped one page
         # earlier so at least one suffix token remains to produce logits.
         keys = self._chain_keys(prompt_ids, plen // pg)
         if state is None:
             self._pending_match = (keys, [])
-            return super().prefill(prompt_ids, temperature, top_p, key)
+            return super().prefill(prompt_ids, temperature, top_p, key,
+                                   top_k=top_k)
         matched: list[int] = []
         for k in keys[:max(0, (plen - 1) // pg)]:
             page = self._prefix_index.get(k)
@@ -438,7 +444,8 @@ class PagedModelRunner(ModelRunner):
         if not matched:
             self.prefix_misses += 1
             self._pending_match = (keys, [])
-            return super().prefill(prompt_ids, temperature, top_p, key)
+            return super().prefill(prompt_ids, temperature, top_p, key,
+                                   top_k=top_k)
         self.prefix_hits += 1
         # Pin the matched pages NOW: their refcount may be 0 (only the index
         # holds them), and the paired insert's _alloc could otherwise evict
@@ -461,7 +468,7 @@ class PagedModelRunner(ModelRunner):
             jnp.int32(ctx_len), state.pool_k, state.pool_v,
             state.k_scale, state.v_scale,
             jnp.asarray(pages), jnp.float32(temperature),
-            jnp.float32(top_p), key,
+            jnp.float32(top_p), jnp.int32(top_k), key,
         )
         self._pending_match = (keys, matched)
         return int(tok), ks, vs, plen
@@ -547,14 +554,15 @@ class PagedModelRunner(ModelRunner):
             logits = T._unembed(params, cfg, x)
             carry, sub = split_slot_keys(st.keys)
             next_tokens = sample_tokens_slots(logits, st.temperature,
-                                              st.top_p, sub)
+                                              st.top_p, sub, top_k=st.top_k)
             next_tokens = jnp.where(st.active, next_tokens, 0)
             new_state = PagedDecodeState(
                 pool_k=pool_k, pool_v=pool_v,
                 k_scale=k_scale, v_scale=v_scale,
                 seq_lens=jnp.where(st.active, st.seq_lens + 1, st.seq_lens),
                 tokens=next_tokens, active=st.active,
-                temperature=st.temperature, top_p=st.top_p, keys=carry,
+                temperature=st.temperature, top_p=st.top_p,
+                top_k=st.top_k, keys=carry,
             )
             return new_state, next_tokens
 
@@ -606,13 +614,14 @@ class PagedModelRunner(ModelRunner):
             active=jnp.zeros((b,), bool),
             temperature=jnp.zeros((b,), jnp.float32),
             top_p=jnp.ones((b,), jnp.float32),
+            top_k=jnp.zeros((b,), jnp.int32),
             keys=jnp.zeros((b, 2), jnp.uint32),
         )
 
     def insert(self, state: PagedDecodeState, slot: int, ks, vs, plen: int,
                first_token: int, temperature: float, top_p: float,
                prompt_tokens: list[int] | None = None,
-               slot_key=None):
+               slot_key=None, top_k: int = 0):
         """Place a prefilled sequence: shared prefix pages (from the paired
         prefill's match, refcounted) + freshly scattered suffix pages."""
         bucket = ks.shape[3]
@@ -666,7 +675,8 @@ class PagedModelRunner(ModelRunner):
         return self._insert_paged(
             state, jnp.asarray(fresh, jnp.int32), ks, vs, jnp.int32(slot),
             jnp.int32(plen), jnp.int32(first_token),
-            jnp.float32(temperature), jnp.float32(top_p), slot_key,
+            jnp.float32(temperature), jnp.float32(top_p), jnp.int32(top_k),
+            slot_key,
         )
 
     def release(self, state: PagedDecodeState, slot: int):

@@ -66,6 +66,7 @@ class DecodeState:
     active: jnp.ndarray    # [B] bool
     temperature: jnp.ndarray  # [B] fp32
     top_p: jnp.ndarray     # [B] fp32
+    top_k: jnp.ndarray     # [B] int32 — Ollama options.top_k (0 = off)
     # Per-slot PRNG carries [B, 2]: each slot samples with its own key
     # stream (set at insert), so a seeded request reproduces its tokens
     # regardless of slot assignment or what else shares the batch.
@@ -83,8 +84,8 @@ class DecodeState:
 jax.tree_util.register_dataclass(
     DecodeState,
     data_fields=["k_cache", "v_cache", "seq_lens", "tokens", "active",
-                 "temperature", "top_p", "keys", "k_scale", "v_scale",
-                 "hist"],
+                 "temperature", "top_p", "top_k", "keys", "k_scale",
+                 "v_scale", "hist"],
     meta_fields=[],
 )
 
@@ -184,7 +185,8 @@ class ModelRunner:
 
     # ------------------------------------------------------------- programs
 
-    def _prefill_impl(self, params, tokens, plen, temperature, top_p, key):
+    def _prefill_impl(self, params, tokens, plen, temperature, top_p, top_k,
+                      key):
         """tokens [1, T] padded; plen scalar; returns (first_token, ks, vs)."""
         t = tokens.shape[1]
         # Padding positions clamp to plen-1; kv_valid excludes them from
@@ -201,11 +203,12 @@ class ModelRunner:
                                        sp_batch_axis=None,
                                        n_shards=self.mesh.size)
         last = logits[0, plen - 1]  # [V]
-        tok = sample_tokens(last[None, :], temperature[None], top_p[None], key)[0]
+        tok = sample_tokens(last[None, :], temperature[None], top_p[None],
+                            key, top_k=top_k[None])[0]
         return tok, ks, vs
 
     def _insert_impl(self, state: DecodeState, slot, ks, vs, plen, first_token,
-                     temperature, top_p, slot_key) -> DecodeState:
+                     temperature, top_p, top_k, slot_key) -> DecodeState:
         """Write a prefilled sequence (ks/vs [L,1,Hkv,T,Dh]) into ``slot``."""
         k_scale, v_scale = state.k_scale, state.v_scale
         if self.kv_dtype == "int8":
@@ -229,6 +232,7 @@ class ModelRunner:
             active=state.active.at[slot].set(True),
             temperature=state.temperature.at[slot].set(temperature),
             top_p=state.top_p.at[slot].set(top_p),
+            top_k=state.top_k.at[slot].set(top_k),
             keys=state.keys.at[slot].set(slot_key),
             k_scale=k_scale, v_scale=v_scale,
             hist=state.hist,
@@ -240,7 +244,8 @@ class ModelRunner:
             seq_lens=state.seq_lens.at[slot].set(0),
             tokens=state.tokens.at[slot].set(0),
             active=state.active.at[slot].set(False),
-            temperature=state.temperature, top_p=state.top_p, keys=state.keys,
+            temperature=state.temperature, top_p=state.top_p,
+            top_k=state.top_k, keys=state.keys,
             k_scale=state.k_scale, v_scale=state.v_scale, hist=state.hist,
         )
 
@@ -279,14 +284,15 @@ class ModelRunner:
                 )
             carry, sub = split_slot_keys(st.keys)
             next_tokens = sample_tokens_slots(logits, st.temperature,
-                                              st.top_p, sub)
+                                              st.top_p, sub, top_k=st.top_k)
             next_tokens = jnp.where(st.active, next_tokens, 0)
             new_state = DecodeState(
                 k_cache=k_cache, v_cache=v_cache,
                 seq_lens=jnp.where(st.active, st.seq_lens + 1, st.seq_lens),
                 tokens=next_tokens,
                 active=st.active,
-                temperature=st.temperature, top_p=st.top_p, keys=carry,
+                temperature=st.temperature, top_p=st.top_p,
+                top_k=st.top_k, keys=carry,
                 k_scale=k_scale, v_scale=v_scale, hist=st.hist,
             )
             return new_state, next_tokens
@@ -317,6 +323,7 @@ class ModelRunner:
             active=jnp.zeros((b,), bool),
             temperature=jnp.zeros((b,), jnp.float32),
             top_p=jnp.ones((b,), jnp.float32),
+            top_k=jnp.zeros((b,), jnp.int32),
             # Zero keys: valid carries, always overwritten at insert (the
             # slot's stream comes from the request seed / scheduler RNG).
             keys=jnp.zeros((b, 2), jnp.uint32),
@@ -428,16 +435,18 @@ class ModelRunner:
         return logits[0, chunk_len - 1], ctx_k, ctx_v  # [V]
 
     def prefill_finish(self, job: "ModelRunner.PrefillJob", temperature: float,
-                       top_p: float, key: jax.Array):
+                       top_p: float, key: jax.Array, top_k: int = 0):
         """Sample the first token; returns (tok, ks, vs, plen) like prefill."""
         assert job.finished and job.last_logits is not None
         tok = sample_tokens(job.last_logits[None, :],
                             jnp.float32(temperature)[None],
-                            jnp.float32(top_p)[None], key)[0]
+                            jnp.float32(top_p)[None], key,
+                            top_k=jnp.int32(top_k)[None])[0]
         return int(tok), job.ctx_k, job.ctx_v, len(job.prompt_ids)
 
     def prefill(self, prompt_ids: list[int], temperature: float, top_p: float,
-                key: jax.Array, state: DecodeState | None = None):
+                key: jax.Array, state: DecodeState | None = None,
+                top_k: int = 0):
         """Run bucketed prefill; returns (first_token, ks, vs, plen).
 
         ``state`` is accepted (and ignored) so the scheduler can pass its
@@ -449,7 +458,8 @@ class ModelRunner:
         tokens[0, :plen] = prompt_ids
         tok, ks, vs = self._prefill(
             self.params, jnp.asarray(tokens), jnp.int32(plen),
-            jnp.float32(temperature), jnp.float32(top_p), key,
+            jnp.float32(temperature), jnp.float32(top_p), jnp.int32(top_k),
+            key,
         )
         return int(tok), ks, vs, plen
 
@@ -506,7 +516,8 @@ class ModelRunner:
     def insert(self, state: DecodeState, slot: int, ks, vs, plen: int,
                first_token: int, temperature: float, top_p: float,
                prompt_tokens: list[int] | None = None,
-               slot_key: jax.Array | None = None) -> DecodeState:
+               slot_key: jax.Array | None = None,
+               top_k: int = 0) -> DecodeState:
         # KV buckets shorter than max_seq: pad via dynamic slice into cache.
         # ``prompt_tokens`` is accepted (and ignored) so the scheduler can
         # pass the prompt uniformly; the spec runner needs it for its
@@ -517,8 +528,8 @@ class ModelRunner:
             slot_key = default_slot_key(slot)
         return self._insert(
             state, jnp.int32(slot), ks, vs, jnp.int32(plen),
-            jnp.int32(first_token), jnp.float32(temperature), jnp.float32(top_p),
-            slot_key,
+            jnp.int32(first_token), jnp.float32(temperature),
+            jnp.float32(top_p), jnp.int32(top_k), slot_key,
         )
 
     def release(self, state: DecodeState, slot: int) -> DecodeState:

@@ -37,11 +37,13 @@ def default_slot_key(slot: int) -> jax.Array:
     return jax.random.fold_in(jax.random.PRNGKey(0), slot)
 
 
-def _nucleus_filter(logits, temperature, top_p, window):
+def _nucleus_filter(logits, temperature, top_p, window, top_k=None):
     """Shared top-k + nucleus filtering: returns (filtered [B, W] scaled
     logits, top_idx [B, W], greedy [B]).  Both sampling entry points use
     this one implementation so a boundary fix cannot ship in one and miss
-    the other."""
+    the other.  ``top_k`` [B] int32 (Ollama options.top_k) further
+    restricts each row to its k best tokens; 0/None disables (the window
+    truncation still applies)."""
     greedy = jnp.argmax(logits, axis=-1)
 
     temp = jnp.maximum(temperature, 1e-6)[:, None]
@@ -49,7 +51,14 @@ def _nucleus_filter(logits, temperature, top_p, window):
     top_logits, top_idx = jax.lax.top_k(logits, window)  # [B, W]
     scaled = top_logits / temp
 
-    # Nucleus filter on the (already sorted) top-k distribution.
+    # top_k FIRST, then nucleus over the renormalized survivors — the
+    # Ollama/llama.cpp composition (and sharded.py's sample_host, which
+    # softmaxes over only the k candidates): top_p must measure mass
+    # within the top-k distribution, not the full-window one.
+    if top_k is not None:
+        limit = jnp.where(top_k > 0, jnp.minimum(top_k, window), window)
+        scaled = jnp.where(jnp.arange(window)[None, :] < limit[:, None],
+                           scaled, -jnp.inf)
     probs = jax.nn.softmax(scaled, axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
     # keep tokens while cumulative prob (exclusive) < top_p; the top token
@@ -64,10 +73,11 @@ def sample_tokens_slots(
     top_p: jnp.ndarray,         # [B]
     keys: jnp.ndarray,          # [B, 2] per-slot PRNG keys
     window: int = TOPK_WINDOW,
+    top_k: jnp.ndarray | None = None,  # [B] int32, 0 = disabled
 ) -> jnp.ndarray:
     """Like :func:`sample_tokens` but with an independent key per slot."""
     filtered, top_idx, greedy = _nucleus_filter(logits, temperature, top_p,
-                                                window)
+                                                window, top_k=top_k)
     choice = jax.vmap(jax.random.categorical)(keys, filtered)  # [B] in [0, W)
     sampled = jnp.take_along_axis(top_idx, choice[:, None], axis=-1)[:, 0]
     return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
@@ -80,9 +90,10 @@ def sample_tokens(
                                 #      top-`window` truncation (see module doc)
     key: jax.Array,
     window: int = TOPK_WINDOW,
+    top_k: jnp.ndarray | None = None,  # [B] int32, 0 = disabled
 ) -> jnp.ndarray:
     filtered, top_idx, greedy = _nucleus_filter(logits, temperature, top_p,
-                                                window)
+                                                window, top_k=top_k)
     choice = jax.random.categorical(key, filtered, axis=-1)  # [B] in [0, W)
     sampled = jnp.take_along_axis(top_idx, choice[:, None], axis=-1)[:, 0]
     return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)

@@ -52,6 +52,7 @@ class GenRequest:
     max_tokens: int = 128
     temperature: float = 0.0
     top_p: float = 1.0
+    top_k: int = 0  # Ollama options.top_k (0 = disabled)
     eos_id: int = -1
     # 0 = unseeded (scheduler RNG); non-zero makes sampling reproducible:
     # identical seeded requests yield identical tokens (Ollama honors seed;
@@ -242,7 +243,7 @@ class Scheduler:
         first, ks, vs, plen = await loop.run_in_executor(
             self._exec, functools.partial(
                 self.runner.prefill, req.prompt_ids, req.temperature,
-                req.top_p, sub, state=self.state),
+                req.top_p, sub, state=self.state, top_k=req.top_k),
         )
         self._place(req, slot, ks, vs, plen, first)
 
@@ -253,7 +254,7 @@ class Scheduler:
         self.state = self.runner.insert(
             self.state, slot, ks, vs, plen, first, req.temperature,
             req.top_p, prompt_tokens=req.prompt_ids,
-            slot_key=self._req_key(req, 1),
+            slot_key=self._req_key(req, 1), top_k=req.top_k,
         )
         info = _SlotInfo(req=req, prompt_len=plen)
         self.slots[slot] = info
@@ -394,7 +395,8 @@ class Scheduler:
                     first, ks, vs, plen = await loop.run_in_executor(
                         self._exec, functools.partial(
                             self.runner.prefill_finish, job,
-                            req.temperature, req.top_p, sub))
+                            req.temperature, req.top_p, sub,
+                            top_k=req.top_k))
                     self._place(req, slot, ks, vs, plen, first)
             except ValueError as e:
                 # Bad request / pool exhaustion at insert (PagesExhausted

@@ -48,7 +48,7 @@ log = logging.getLogger("crowdllama.engine.sharded")
 
 
 def sample_host(logits: np.ndarray, temperature: float, top_p: float,
-                rng: np.random.Generator) -> int:
+                rng: np.random.Generator, top_k: int = 0) -> int:
     """Greedy / temperature / nucleus sampling on the leader host.
 
     The pipeline returns one [V] logits vector per step; sampling here is
@@ -64,6 +64,8 @@ def sample_host(logits: np.ndarray, temperature: float, top_p: float,
     if temperature <= 0:
         return int(logits.argmax())
     w = min(TOPK_WINDOW, logits.shape[-1])
+    if top_k > 0:
+        w = min(w, top_k)
     top = np.argpartition(logits, -w)[-w:]
     top = top[np.argsort(logits[top])[::-1]]  # descending
     x = logits[top].astype(np.float64) / max(temperature, 1e-6)
@@ -348,6 +350,7 @@ class ShardedEngine(Engine):
         top_p: float = 1.0,
         seed: int = 0,
         stop: list[str] | None = None,
+        top_k: int = 0,
     ) -> AsyncIterator[Chunk]:
         if not self.is_leader:
             raise RuntimeError(
@@ -384,7 +387,8 @@ class ShardedEngine(Engine):
             self._active += 1
             try:
                 logits = await pipeline.prefill(session, prompt_ids, bucket)
-                token = sample_host(logits, temperature, top_p, rng)
+                token = sample_host(logits, temperature, top_p, rng,
+                                    top_k=top_k)
                 n = len(prompt_ids)
                 reason = "length"
                 while True:
@@ -404,7 +408,8 @@ class ShardedEngine(Engine):
                     if completion >= budget:
                         break
                     logits = await pipeline.decode(session, token, n, n + 1)
-                    token = sample_host(logits, temperature, top_p, rng)
+                    token = sample_host(logits, temperature, top_p, rng,
+                                        top_k=top_k)
                     n += 1
                 dt = max(time.monotonic() - t0, 1e-6)
                 inst = completion / dt

@@ -74,9 +74,11 @@ class SpecModelRunner(ModelRunner):
         return state
 
     def insert(self, state, slot, ks, vs, plen, first_token, temperature,
-               top_p, prompt_tokens: list[int] | None = None, slot_key=None):
+               top_p, prompt_tokens: list[int] | None = None, slot_key=None,
+               top_k: int = 0):
         state = super().insert(state, slot, ks, vs, plen, first_token,
-                               temperature, top_p, slot_key=slot_key)
+                               temperature, top_p, slot_key=slot_key,
+                               top_k=top_k)
         row = np.zeros((self.max_seq,), np.int32)
         if prompt_tokens:
             row[:plen] = prompt_tokens[:plen]
@@ -150,7 +152,7 @@ class SpecModelRunner(ModelRunner):
 
             carry, sub = split_slot_keys(st.keys)
             sampled0 = sample_tokens_slots(logits[:, 0], st.temperature,
-                                           st.top_p, sub)
+                                           st.top_p, sub, top_k=st.top_k)
             emit = model_next.at[:, 0].set(
                 jnp.where(greedy, model_next[:, 0], sampled0))  # [B, J]
             emit = jnp.where(st.active[:, None], emit, 0)
@@ -170,7 +172,8 @@ class SpecModelRunner(ModelRunner):
                 seq_lens=st.seq_lens + counts,
                 tokens=jnp.where(st.active, pending, st.tokens),
                 active=st.active,
-                temperature=st.temperature, top_p=st.top_p, keys=carry,
+                temperature=st.temperature, top_p=st.top_p,
+                top_k=st.top_k, keys=carry,
                 hist=hist,
             )
             packed = jnp.concatenate(

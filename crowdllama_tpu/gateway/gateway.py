@@ -491,6 +491,9 @@ class Gateway:
             stop=([stops] if isinstance(
                 stops := options.get("stop") or [], str) else
                 [str(x) for x in stops]),
+            # Clamp like seed: out-of-range/null client values must not
+            # escape as proto setter errors.
+            top_k=min(max(0, int(options.get("top_k", 0) or 0)), 2**31 - 1),
         )
         tried: set[str] = set()
         last_err = "no workers available for model"

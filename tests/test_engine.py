@@ -167,12 +167,12 @@ async def test_event_loop_free_during_dispatch():
         def init_state(self):
             return {}
 
-        def prefill(self, ids, temp, top_p, key, state=None):
+        def prefill(self, ids, temp, top_p, key, state=None, top_k=0):
             time.sleep(0.4)  # blocking device wait
             return 5, None, None, len(ids)
 
         def insert(self, state, slot, ks, vs, plen, tok, t, p,
-                   prompt_tokens=None, slot_key=None):
+                   prompt_tokens=None, slot_key=None, top_k=0):
             return state
 
         def release(self, state, slot):
@@ -375,11 +375,11 @@ async def test_scheduler_drain():
         def init_state(self):
             return {}
 
-        def prefill(self, ids, temp, top_p, key, state=None):
+        def prefill(self, ids, temp, top_p, key, state=None, top_k=0):
             return 5, None, None, len(ids)
 
         def insert(self, state, slot, ks, vs, plen, tok, t, p,
-                   prompt_tokens=None, slot_key=None):
+                   prompt_tokens=None, slot_key=None, top_k=0):
             return state
 
         def release(self, state, slot):
@@ -616,5 +616,29 @@ async def test_stop_sequences():
         assert final is not None and final.done_reason == "stop"
         assert stop_seq not in text
         assert text == full[:full.find(stop_seq)]
+    finally:
+        await eng.stop()
+
+
+async def test_top_k_sampling():
+    """Ollama options.top_k parity: top_k=1 at high temperature must
+    reproduce greedy decoding exactly (the distribution collapses to the
+    argmax), where unrestricted sampling at that temperature diverges."""
+    eng = _mkengine(mesh="1x1x1")
+    await eng.start()
+    try:
+        async def run(**kw):
+            out = []
+            async for c in eng.generate("topk test", max_tokens=10, **kw):
+                out.append(c.text)
+            return "".join(out)
+
+        greedy = await run(temperature=0.0)
+        k1 = await run(temperature=5.0, top_k=1, seed=7)
+        assert k1 == greedy, (k1, greedy)
+        # Sanity: without the top_k restriction, t=5 sampling diverges
+        # from greedy (astronomically unlikely to match for 10 tokens).
+        free = await run(temperature=5.0, seed=7)
+        assert free != greedy
     finally:
         await eng.stop()
