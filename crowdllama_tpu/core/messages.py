@@ -25,6 +25,7 @@ def create_generate_request(
     seed: int = 0,
     stop: Iterable[str] = (),
     top_k: int = 0,
+    repeat_penalty: float = 0.0,
 ) -> pb.BaseMessage:
     req = pb.GenerateRequest(
         model=model,
@@ -35,6 +36,7 @@ def create_generate_request(
         top_p=top_p,
         seed=seed,
         top_k=top_k,
+        repeat_penalty=repeat_penalty,
     )
     for s_ in stop:
         req.stop.append(str(s_))

@@ -109,6 +109,7 @@ class Engine:
         seed: int = 0,
         stop: list[str] | None = None,
         top_k: int = 0,
+        repeat_penalty: float = 1.0,
     ) -> AsyncIterator[Chunk]:
         raise NotImplementedError
 
@@ -195,6 +196,7 @@ class Engine:
             seed=int(req.seed or 0),
             stop=list(req.stop),
             top_k=int(req.top_k or 0),
+            repeat_penalty=float(req.repeat_penalty or 1.0),
         )
 
 
@@ -308,16 +310,7 @@ class JaxEngine(Engine):
         for k in {1, self.config.decode_chunk}:
             _, state = r.decode_steps(state, k)
         if getattr(r, "prefix_cache", False):
-            # ctx_len=0 compiles the same program a real hit uses (the
-            # context tensor shape is fixed; ctx_len only masks) for the
-            # smallest suffix bucket.
-            pages = np.full((r.max_pages_per_slot,), r.total_pages, np.int32)
-            r._prefill_ctx(r.params, jnp.zeros((1, r.buckets[0]), jnp.int32),
-                           jnp.int32(1), jnp.int32(0), state.pool_k,
-                           state.pool_v, state.k_scale, state.v_scale,
-                           jnp.asarray(pages), jnp.float32(0.0),
-                           jnp.float32(1.0), jnp.int32(0),
-                           jax.random.PRNGKey(0))
+            r.warmup_ctx_prefill(state)
         if getattr(r, "prefill_chunk", 0) and r.max_seq > r.prefill_chunk:
             # Chunked-admission programs (the long-prompt path): compile
             # one chunk step at the chunk bucket so the first long prompt
@@ -427,6 +420,7 @@ class JaxEngine(Engine):
         seed: int = 0,
         stop: list[str] | None = None,
         top_k: int = 0,
+        repeat_penalty: float = 1.0,
     ) -> AsyncIterator[Chunk]:
         from crowdllama_tpu.engine.scheduler import DONE, GenRequest
 
@@ -442,6 +436,7 @@ class JaxEngine(Engine):
             temperature=temperature,
             top_p=top_p,
             top_k=max(0, int(top_k)),
+            repeat_penalty=float(repeat_penalty or 1.0),
             eos_id=self.tokenizer.eos_id,
             seed=seed,
         )
@@ -556,6 +551,7 @@ class FakeEngine(Engine):
         self, prompt: str, model: str = "", max_tokens: int = 128,
         temperature: float = 0.0, top_p: float = 1.0, seed: int = 0,
         stop: list[str] | None = None, top_k: int = 0,
+        repeat_penalty: float = 1.0,
     ) -> AsyncIterator[Chunk]:
         self.calls += 1
         if self.delay:

@@ -107,12 +107,12 @@ class MultiEngine(Engine):
 
     def generate(self, prompt: str, model: str = "", max_tokens: int = 128,
                  temperature: float = 0.0, top_p: float = 1.0, seed: int = 0,
-                 stop: list[str] | None = None,
-                 top_k: int = 0) -> AsyncIterator[Chunk]:
+                 stop: list[str] | None = None, top_k: int = 0,
+                 repeat_penalty: float = 1.0) -> AsyncIterator[Chunk]:
         return self._child(model).generate(
             prompt, model=model, max_tokens=max_tokens,
             temperature=temperature, top_p=top_p, seed=seed, stop=stop,
-            top_k=top_k)
+            top_k=top_k, repeat_penalty=repeat_penalty)
 
     async def embed(self, texts: list[str], model: str = "",
                     truncate: bool = True) -> tuple[list[list[float]], int]:

@@ -45,6 +45,8 @@ import numpy as np
 
 from crowdllama_tpu.engine.runner import ModelRunner
 from crowdllama_tpu.engine.sampling import (
+    REPEAT_LAST_N,
+    apply_repeat_penalty,
     default_slot_key,
     sample_tokens,
     sample_tokens_slots,
@@ -76,6 +78,8 @@ class PagedDecodeState:
     temperature: jnp.ndarray
     top_p: jnp.ndarray
     top_k: jnp.ndarray  # [B] int32 — Ollama options.top_k (0 = off)
+    repeat_penalty: jnp.ndarray  # [B] f32 (runner.DecodeState semantics)
+    recent: jnp.ndarray          # [B, REPEAT_LAST_N] int32
     keys: jnp.ndarray  # [B, 2] per-slot PRNG carries (see runner.DecodeState)
     # int8 pools only (kv_dtype="int8"): per-(page-position, kv-head)
     # scales [L, P, Hkv, page]; None for bf16 pools.
@@ -86,8 +90,8 @@ class PagedDecodeState:
 jax.tree_util.register_dataclass(
     PagedDecodeState,
     data_fields=["pool_k", "pool_v", "seq_lens", "tokens", "active",
-                 "temperature", "top_p", "top_k", "keys", "k_scale",
-                 "v_scale"],
+                 "temperature", "top_p", "top_k", "repeat_penalty",
+                 "recent", "keys", "k_scale", "v_scale"],
     meta_fields=[],
 )
 
@@ -214,7 +218,7 @@ class PagedModelRunner(ModelRunner):
 
     def _insert_paged_impl(self, state: PagedDecodeState, page_idx, ks, vs,
                            slot, plen, first_token, temperature, top_p,
-                           top_k, slot_key):
+                           top_k, repeat_penalty, recent_row, slot_key):
         """Scatter a prefilled prompt's KV pages into the pool.
 
         ks/vs: [L, 1, Hkv, bucket, Dh]; page_idx: [bucket/page] pool pages.
@@ -252,6 +256,8 @@ class PagedModelRunner(ModelRunner):
             temperature=state.temperature.at[slot].set(temperature),
             top_p=state.top_p.at[slot].set(top_p),
             top_k=state.top_k.at[slot].set(top_k),
+            repeat_penalty=state.repeat_penalty.at[slot].set(repeat_penalty),
+            recent=state.recent.at[slot].set(recent_row),
             keys=state.keys.at[slot].set(slot_key),
         )
 
@@ -263,12 +269,13 @@ class PagedModelRunner(ModelRunner):
             tokens=state.tokens.at[slot].set(0),
             active=state.active.at[slot].set(False),
             temperature=state.temperature, top_p=state.top_p,
-            top_k=state.top_k, keys=state.keys,
+            top_k=state.top_k, repeat_penalty=state.repeat_penalty,
+            recent=state.recent, keys=state.keys,
         )
 
     def _prefill_ctx_impl(self, params, tokens, slen, ctx_len, pool_k, pool_v,
                           k_scale, v_scale, pages, temperature, top_p, top_k,
-                          key):
+                          repeat_penalty, recent_row, key):
         """Suffix prefill attending over cached prefix pages.
 
         tokens [1, bucket] suffix; pages [max_pages_per_slot] pool pages
@@ -299,8 +306,10 @@ class PagedModelRunner(ModelRunner):
         logits, ks, vs = T.prefill(params, cfg, tokens, positions,
                                    kv_valid=kv_valid,
                                    ctx_k=ck, ctx_v=cv, ctx_valid=ctx_valid)
-        last = logits[0, slen - 1]
-        tok = sample_tokens(last[None, :], temperature[None], top_p[None],
+        last = apply_repeat_penalty(
+            logits[0, slen - 1][None, :], recent_row[None],
+            repeat_penalty[None])
+        tok = sample_tokens(last, temperature[None], top_p[None],
                             key, top_k=top_k[None])[0]
         return tok, ks, vs
 
@@ -388,6 +397,22 @@ class PagedModelRunner(ModelRunner):
         return (ck.astype(ctx_k.dtype)[..., :ctx_k.shape[3], :],
                 cv.astype(ctx_v.dtype)[..., :ctx_v.shape[3], :])
 
+    def warmup_ctx_prefill(self, state: "PagedDecodeState") -> None:
+        """Compile the suffix-over-cached-context program for the smallest
+        suffix bucket (ctx_len=0 masks the context; shapes are what a real
+        hit uses).  Owned HERE so engine warmup cannot drift from the jit
+        signature."""
+        pages = np.full((self.max_pages_per_slot,), self.total_pages,
+                        np.int32)
+        self._prefill_ctx(
+            self.params, jnp.zeros((1, self.buckets[0]), jnp.int32),
+            jnp.int32(1), jnp.int32(0), state.pool_k, state.pool_v,
+            state.k_scale, state.v_scale, jnp.asarray(pages),
+            jnp.float32(0.0), jnp.float32(1.0), jnp.int32(0),
+            jnp.float32(1.0),
+            jnp.asarray(self._recent_from_prompt([])),
+            jax.random.PRNGKey(0))
+
     def prefill_prefers_monolithic(self, prompt_ids: list[int]) -> bool:
         """True when the prefix cache covers enough of the prompt that the
         suffix-only (ctx) prefill beats chunked admission: the uncovered
@@ -404,7 +429,8 @@ class PagedModelRunner(ModelRunner):
         return plen - matched <= self.prefill_chunk
 
     def prefill(self, prompt_ids: list[int], temperature: float, top_p: float,
-                key, state: PagedDecodeState | None = None, top_k: int = 0):
+                key, state: PagedDecodeState | None = None, top_k: int = 0,
+                repeat_penalty: float = 1.0):
         """Bucketed prefill with automatic prefix caching.
 
         With ``state`` (the scheduler passes its live decode state) the
@@ -418,14 +444,16 @@ class PagedModelRunner(ModelRunner):
         plen = len(prompt_ids)
         if not self.prefix_cache:
             return super().prefill(prompt_ids, temperature, top_p, key,
-                                   top_k=top_k)
+                                   top_k=top_k,
+                                   repeat_penalty=repeat_penalty)
         # Index keys for every full prompt page; matching is capped one page
         # earlier so at least one suffix token remains to produce logits.
         keys = self._chain_keys(prompt_ids, plen // pg)
         if state is None:
             self._pending_match = (keys, [])
             return super().prefill(prompt_ids, temperature, top_p, key,
-                                   top_k=top_k)
+                                   top_k=top_k,
+                                   repeat_penalty=repeat_penalty)
         matched: list[int] = []
         for k in keys[:max(0, (plen - 1) // pg)]:
             page = self._prefix_index.get(k)
@@ -445,7 +473,8 @@ class PagedModelRunner(ModelRunner):
             self.prefix_misses += 1
             self._pending_match = (keys, [])
             return super().prefill(prompt_ids, temperature, top_p, key,
-                                   top_k=top_k)
+                                   top_k=top_k,
+                                   repeat_penalty=repeat_penalty)
         self.prefix_hits += 1
         # Pin the matched pages NOW: their refcount may be 0 (only the index
         # holds them), and the paired insert's _alloc could otherwise evict
@@ -468,7 +497,9 @@ class PagedModelRunner(ModelRunner):
             jnp.int32(ctx_len), state.pool_k, state.pool_v,
             state.k_scale, state.v_scale,
             jnp.asarray(pages), jnp.float32(temperature),
-            jnp.float32(top_p), jnp.int32(top_k), key,
+            jnp.float32(top_p), jnp.int32(top_k),
+            jnp.float32(repeat_penalty),
+            jnp.asarray(self._recent_from_prompt(prompt_ids)), key,
         )
         self._pending_match = (keys, matched)
         return int(tok), ks, vs, plen
@@ -553,16 +584,24 @@ class PagedModelRunner(ModelRunner):
                           st.k_scale, st.v_scale, windows))
             logits = T._unembed(params, cfg, x)
             carry, sub = split_slot_keys(st.keys)
+            logits = apply_repeat_penalty(logits, st.recent,
+                                          st.repeat_penalty)
             next_tokens = sample_tokens_slots(logits, st.temperature,
                                               st.top_p, sub, top_k=st.top_k)
             next_tokens = jnp.where(st.active, next_tokens, 0)
+            bidx2 = jnp.arange(st.recent.shape[0])
+            cursor = (st.seq_lens + 1) % REPEAT_LAST_N
+            recent = st.recent.at[bidx2, cursor].set(
+                jnp.where(st.active, next_tokens,
+                          st.recent[bidx2, cursor]))
             new_state = PagedDecodeState(
                 pool_k=pool_k, pool_v=pool_v,
                 k_scale=k_scale, v_scale=v_scale,
                 seq_lens=jnp.where(st.active, st.seq_lens + 1, st.seq_lens),
                 tokens=next_tokens, active=st.active,
                 temperature=st.temperature, top_p=st.top_p,
-                top_k=st.top_k, keys=carry,
+                top_k=st.top_k, repeat_penalty=st.repeat_penalty,
+                recent=recent, keys=carry,
             )
             return new_state, next_tokens
 
@@ -615,13 +654,16 @@ class PagedModelRunner(ModelRunner):
             temperature=jnp.zeros((b,), jnp.float32),
             top_p=jnp.ones((b,), jnp.float32),
             top_k=jnp.zeros((b,), jnp.int32),
+            repeat_penalty=jnp.ones((b,), jnp.float32),
+            recent=jnp.full((b, REPEAT_LAST_N), self.cfg.vocab_size,
+                            jnp.int32),
             keys=jnp.zeros((b, 2), jnp.uint32),
         )
 
     def insert(self, state: PagedDecodeState, slot: int, ks, vs, plen: int,
                first_token: int, temperature: float, top_p: float,
                prompt_tokens: list[int] | None = None,
-               slot_key=None, top_k: int = 0):
+               slot_key=None, top_k: int = 0, repeat_penalty: float = 1.0):
         """Place a prefilled sequence: shared prefix pages (from the paired
         prefill's match, refcounted) + freshly scattered suffix pages."""
         bucket = ks.shape[3]
@@ -672,11 +714,13 @@ class PagedModelRunner(ModelRunner):
                             keys[ki - 1], set()).add(keys[ki])
         if slot_key is None:
             slot_key = default_slot_key(slot)
+        recent_row = self._recent_from_prompt(
+            list(prompt_tokens or []), first_token, plen=plen)
         return self._insert_paged(
             state, jnp.asarray(fresh, jnp.int32), ks, vs, jnp.int32(slot),
             jnp.int32(plen), jnp.int32(first_token),
             jnp.float32(temperature), jnp.float32(top_p), jnp.int32(top_k),
-            slot_key,
+            jnp.float32(repeat_penalty), jnp.asarray(recent_row), slot_key,
         )
 
     def release(self, state: PagedDecodeState, slot: int):

@@ -28,6 +28,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from crowdllama_tpu.engine.sampling import (
+    REPEAT_LAST_N,
+    apply_repeat_penalty,
     default_slot_key,
     sample_tokens,
     sample_tokens_slots,
@@ -67,6 +69,11 @@ class DecodeState:
     temperature: jnp.ndarray  # [B] fp32
     top_p: jnp.ndarray     # [B] fp32
     top_k: jnp.ndarray     # [B] int32 — Ollama options.top_k (0 = off)
+    # Ollama options.repeat_penalty (1.0/0 = off) + last-N emitted-token
+    # ring per slot (entries >= vocab_size are padding; cursor is
+    # seq_lens % N).  Applied to logits before greedy/top-k (llama.cpp).
+    repeat_penalty: jnp.ndarray  # [B] f32
+    recent: jnp.ndarray          # [B, REPEAT_LAST_N] int32
     # Per-slot PRNG carries [B, 2]: each slot samples with its own key
     # stream (set at insert), so a seeded request reproduces its tokens
     # regardless of slot assignment or what else shares the batch.
@@ -84,8 +91,8 @@ class DecodeState:
 jax.tree_util.register_dataclass(
     DecodeState,
     data_fields=["k_cache", "v_cache", "seq_lens", "tokens", "active",
-                 "temperature", "top_p", "top_k", "keys", "k_scale",
-                 "v_scale", "hist"],
+                 "temperature", "top_p", "top_k", "repeat_penalty",
+                 "recent", "keys", "k_scale", "v_scale", "hist"],
     meta_fields=[],
 )
 
@@ -186,7 +193,7 @@ class ModelRunner:
     # ------------------------------------------------------------- programs
 
     def _prefill_impl(self, params, tokens, plen, temperature, top_p, top_k,
-                      key):
+                      repeat_penalty, recent_row, key):
         """tokens [1, T] padded; plen scalar; returns (first_token, ks, vs)."""
         t = tokens.shape[1]
         # Padding positions clamp to plen-1; kv_valid excludes them from
@@ -202,13 +209,16 @@ class ModelRunner:
                                        sp_mesh=self._sp_mesh,
                                        sp_batch_axis=None,
                                        n_shards=self.mesh.size)
-        last = logits[0, plen - 1]  # [V]
-        tok = sample_tokens(last[None, :], temperature[None], top_p[None],
+        last = apply_repeat_penalty(
+            logits[0, plen - 1][None, :], recent_row[None],
+            repeat_penalty[None])  # [1, V]
+        tok = sample_tokens(last, temperature[None], top_p[None],
                             key, top_k=top_k[None])[0]
         return tok, ks, vs
 
     def _insert_impl(self, state: DecodeState, slot, ks, vs, plen, first_token,
-                     temperature, top_p, top_k, slot_key) -> DecodeState:
+                     temperature, top_p, top_k, repeat_penalty, recent_row,
+                     slot_key) -> DecodeState:
         """Write a prefilled sequence (ks/vs [L,1,Hkv,T,Dh]) into ``slot``."""
         k_scale, v_scale = state.k_scale, state.v_scale
         if self.kv_dtype == "int8":
@@ -233,6 +243,8 @@ class ModelRunner:
             temperature=state.temperature.at[slot].set(temperature),
             top_p=state.top_p.at[slot].set(top_p),
             top_k=state.top_k.at[slot].set(top_k),
+            repeat_penalty=state.repeat_penalty.at[slot].set(repeat_penalty),
+            recent=state.recent.at[slot].set(recent_row),
             keys=state.keys.at[slot].set(slot_key),
             k_scale=k_scale, v_scale=v_scale,
             hist=state.hist,
@@ -245,7 +257,8 @@ class ModelRunner:
             tokens=state.tokens.at[slot].set(0),
             active=state.active.at[slot].set(False),
             temperature=state.temperature, top_p=state.top_p,
-            top_k=state.top_k, keys=state.keys,
+            top_k=state.top_k, repeat_penalty=state.repeat_penalty,
+            recent=state.recent, keys=state.keys,
             k_scale=state.k_scale, v_scale=state.v_scale, hist=state.hist,
         )
 
@@ -283,16 +296,25 @@ class ModelRunner:
                     n_shards=self.mesh.size,
                 )
             carry, sub = split_slot_keys(st.keys)
+            logits = apply_repeat_penalty(logits, st.recent,
+                                          st.repeat_penalty)
             next_tokens = sample_tokens_slots(logits, st.temperature,
                                               st.top_p, sub, top_k=st.top_k)
             next_tokens = jnp.where(st.active, next_tokens, 0)
+            # The sampled token's sequence position is seq_lens + 1 (the
+            # pending token occupies seq_lens).
+            bidx = jnp.arange(st.recent.shape[0])
+            cursor = (st.seq_lens + 1) % REPEAT_LAST_N
+            recent = st.recent.at[bidx, cursor].set(
+                jnp.where(st.active, next_tokens, st.recent[bidx, cursor]))
             new_state = DecodeState(
                 k_cache=k_cache, v_cache=v_cache,
                 seq_lens=jnp.where(st.active, st.seq_lens + 1, st.seq_lens),
                 tokens=next_tokens,
                 active=st.active,
                 temperature=st.temperature, top_p=st.top_p,
-                top_k=st.top_k, keys=carry,
+                top_k=st.top_k, repeat_penalty=st.repeat_penalty,
+                recent=recent, keys=carry,
                 k_scale=k_scale, v_scale=v_scale, hist=st.hist,
             )
             return new_state, next_tokens
@@ -324,6 +346,9 @@ class ModelRunner:
             temperature=jnp.zeros((b,), jnp.float32),
             top_p=jnp.ones((b,), jnp.float32),
             top_k=jnp.zeros((b,), jnp.int32),
+            repeat_penalty=jnp.ones((b,), jnp.float32),
+            recent=jnp.full((b, REPEAT_LAST_N), self.cfg.vocab_size,
+                            jnp.int32),
             # Zero keys: valid carries, always overwritten at insert (the
             # slot's stream comes from the request seed / scheduler RNG).
             keys=jnp.zeros((b, 2), jnp.uint32),
@@ -435,18 +460,43 @@ class ModelRunner:
         return logits[0, chunk_len - 1], ctx_k, ctx_v  # [V]
 
     def prefill_finish(self, job: "ModelRunner.PrefillJob", temperature: float,
-                       top_p: float, key: jax.Array, top_k: int = 0):
+                       top_p: float, key: jax.Array, top_k: int = 0,
+                       repeat_penalty: float = 1.0):
         """Sample the first token; returns (tok, ks, vs, plen) like prefill."""
         assert job.finished and job.last_logits is not None
-        tok = sample_tokens(job.last_logits[None, :],
+        logits = apply_repeat_penalty(
+            job.last_logits[None, :],
+            jnp.asarray(self._recent_from_prompt(job.prompt_ids))[None],
+            jnp.float32(repeat_penalty)[None])
+        tok = sample_tokens(logits,
                             jnp.float32(temperature)[None],
                             jnp.float32(top_p)[None], key,
                             top_k=jnp.int32(top_k)[None])[0]
         return int(tok), job.ctx_k, job.ctx_v, len(job.prompt_ids)
 
+    def _recent_from_prompt(self, prompt_ids: list[int],
+                            first_token: int | None = None,
+                            plen: int | None = None) -> np.ndarray:
+        """Last-N ring seeded from the prompt tail (+ the first sampled
+        token, which sits at sequence position plen), padded with
+        vocab_size (never penalized).  Token at sequence position ``pos``
+        lives in ring slot ``pos % N`` — decode's writes (at
+        (seq_lens+1) % N) then continue the ring seamlessly.  Callers
+        without the prompt pass ``plen`` so the first token still lands in
+        its correct ring slot."""
+        row = np.full((REPEAT_LAST_N,), self.cfg.vocab_size, np.int32)
+        plen = len(prompt_ids) if plen is None else plen
+        seq = {plen - len(prompt_ids) + i: t
+               for i, t in enumerate(prompt_ids)}
+        if first_token is not None:
+            seq[plen] = first_token
+        for pos in sorted(seq)[-REPEAT_LAST_N:]:
+            row[pos % REPEAT_LAST_N] = seq[pos]
+        return row
+
     def prefill(self, prompt_ids: list[int], temperature: float, top_p: float,
                 key: jax.Array, state: DecodeState | None = None,
-                top_k: int = 0):
+                top_k: int = 0, repeat_penalty: float = 1.0):
         """Run bucketed prefill; returns (first_token, ks, vs, plen).
 
         ``state`` is accepted (and ignored) so the scheduler can pass its
@@ -459,7 +509,8 @@ class ModelRunner:
         tok, ks, vs = self._prefill(
             self.params, jnp.asarray(tokens), jnp.int32(plen),
             jnp.float32(temperature), jnp.float32(top_p), jnp.int32(top_k),
-            key,
+            jnp.float32(repeat_penalty),
+            jnp.asarray(self._recent_from_prompt(prompt_ids)), key,
         )
         return int(tok), ks, vs, plen
 
@@ -517,7 +568,7 @@ class ModelRunner:
                first_token: int, temperature: float, top_p: float,
                prompt_tokens: list[int] | None = None,
                slot_key: jax.Array | None = None,
-               top_k: int = 0) -> DecodeState:
+               top_k: int = 0, repeat_penalty: float = 1.0) -> DecodeState:
         # KV buckets shorter than max_seq: pad via dynamic slice into cache.
         # ``prompt_tokens`` is accepted (and ignored) so the scheduler can
         # pass the prompt uniformly; the spec runner needs it for its
@@ -526,10 +577,13 @@ class ModelRunner:
         # seed); default keeps direct callers (bench, tests) deterministic.
         if slot_key is None:
             slot_key = default_slot_key(slot)
+        recent_row = self._recent_from_prompt(
+            list(prompt_tokens or []), first_token, plen=plen)
         return self._insert(
             state, jnp.int32(slot), ks, vs, jnp.int32(plen),
             jnp.int32(first_token), jnp.float32(temperature),
-            jnp.float32(top_p), jnp.int32(top_k), slot_key,
+            jnp.float32(top_p), jnp.int32(top_k),
+            jnp.float32(repeat_penalty), jnp.asarray(recent_row), slot_key,
         )
 
     def release(self, state: DecodeState, slot: int) -> DecodeState:

@@ -17,6 +17,27 @@ import jax
 import jax.numpy as jnp
 
 TOPK_WINDOW = 64
+#: repeat-penalty lookback (Ollama repeat_last_n default)
+REPEAT_LAST_N = 64
+
+
+def apply_repeat_penalty(logits, recent, penalty):
+    """llama.cpp-style presence penalty over the last-N tokens.
+
+    logits [B, V]; recent [B, N] int32 token ids (entries >= V are padding
+    — the ring is initialized with an out-of-range fill so token 0 is not
+    spuriously penalized); penalty [B] (values <= 0 or == 1 disable).
+    Positive logits divide by the penalty, negative multiply — applied
+    BEFORE greedy/top-k like llama.cpp, so even greedy decoding repeats
+    less when the option is set."""
+    b, v = logits.shape
+    rows = jnp.arange(b)[:, None]
+    # Out-of-range entries land in a scratch column that is sliced away.
+    presence = jnp.zeros((b, v + 1), bool).at[
+        rows, jnp.clip(recent, 0, v)].set(True)[:, :v]
+    pen = jnp.where(penalty > 0, penalty, 1.0)[:, None]
+    adj = jnp.where(logits > 0, logits / pen, logits * pen)
+    return jnp.where(presence & (pen != 1.0), adj, logits)
 
 
 def split_slot_keys(keys: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:

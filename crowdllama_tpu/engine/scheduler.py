@@ -53,6 +53,7 @@ class GenRequest:
     temperature: float = 0.0
     top_p: float = 1.0
     top_k: int = 0  # Ollama options.top_k (0 = disabled)
+    repeat_penalty: float = 1.0  # Ollama options.repeat_penalty (1 = off)
     eos_id: int = -1
     # 0 = unseeded (scheduler RNG); non-zero makes sampling reproducible:
     # identical seeded requests yield identical tokens (Ollama honors seed;
@@ -243,7 +244,8 @@ class Scheduler:
         first, ks, vs, plen = await loop.run_in_executor(
             self._exec, functools.partial(
                 self.runner.prefill, req.prompt_ids, req.temperature,
-                req.top_p, sub, state=self.state, top_k=req.top_k),
+                req.top_p, sub, state=self.state, top_k=req.top_k,
+                repeat_penalty=req.repeat_penalty),
         )
         self._place(req, slot, ks, vs, plen, first)
 
@@ -255,6 +257,7 @@ class Scheduler:
             self.state, slot, ks, vs, plen, first, req.temperature,
             req.top_p, prompt_tokens=req.prompt_ids,
             slot_key=self._req_key(req, 1), top_k=req.top_k,
+            repeat_penalty=req.repeat_penalty,
         )
         info = _SlotInfo(req=req, prompt_len=plen)
         self.slots[slot] = info
@@ -396,7 +399,8 @@ class Scheduler:
                         self._exec, functools.partial(
                             self.runner.prefill_finish, job,
                             req.temperature, req.top_p, sub,
-                            top_k=req.top_k))
+                            top_k=req.top_k,
+                            repeat_penalty=req.repeat_penalty))
                     self._place(req, slot, ks, vs, plen, first)
             except ValueError as e:
                 # Bad request / pool exhaustion at insert (PagesExhausted

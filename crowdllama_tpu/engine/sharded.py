@@ -48,7 +48,9 @@ log = logging.getLogger("crowdllama.engine.sharded")
 
 
 def sample_host(logits: np.ndarray, temperature: float, top_p: float,
-                rng: np.random.Generator, top_k: int = 0) -> int:
+                rng: np.random.Generator, top_k: int = 0,
+                recent: "list[int] | None" = None,
+                repeat_penalty: float = 1.0) -> int:
     """Greedy / temperature / nucleus sampling on the leader host.
 
     The pipeline returns one [V] logits vector per step; sampling here is
@@ -59,8 +61,13 @@ def sample_host(logits: np.ndarray, temperature: float, top_p: float,
     so a request samples identically whether it lands on a sharded leader
     or an unsharded worker.
     """
-    from crowdllama_tpu.engine.sampling import TOPK_WINDOW
+    from crowdllama_tpu.engine.sampling import REPEAT_LAST_N, TOPK_WINDOW
 
+    if repeat_penalty > 0 and repeat_penalty != 1.0 and recent:
+        logits = logits.copy()
+        for t in set(recent[-REPEAT_LAST_N:]):
+            logits[t] = (logits[t] / repeat_penalty if logits[t] > 0
+                         else logits[t] * repeat_penalty)
     if temperature <= 0:
         return int(logits.argmax())
     w = min(TOPK_WINDOW, logits.shape[-1])
@@ -351,6 +358,7 @@ class ShardedEngine(Engine):
         seed: int = 0,
         stop: list[str] | None = None,
         top_k: int = 0,
+        repeat_penalty: float = 1.0,
     ) -> AsyncIterator[Chunk]:
         if not self.is_leader:
             raise RuntimeError(
@@ -386,9 +394,12 @@ class ShardedEngine(Engine):
         async with self._sem:
             self._active += 1
             try:
+                history = list(prompt_ids)
                 logits = await pipeline.prefill(session, prompt_ids, bucket)
                 token = sample_host(logits, temperature, top_p, rng,
-                                    top_k=top_k)
+                                    top_k=top_k, recent=history,
+                                    repeat_penalty=repeat_penalty)
+                history.append(token)
                 n = len(prompt_ids)
                 reason = "length"
                 while True:
@@ -409,7 +420,9 @@ class ShardedEngine(Engine):
                         break
                     logits = await pipeline.decode(session, token, n, n + 1)
                     token = sample_host(logits, temperature, top_p, rng,
-                                        top_k=top_k)
+                                        top_k=top_k, recent=history,
+                                        repeat_penalty=repeat_penalty)
+                    history.append(token)
                     n += 1
                 dt = max(time.monotonic() - t0, 1e-6)
                 inst = completion / dt

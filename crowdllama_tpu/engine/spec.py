@@ -20,6 +20,10 @@ queries attend jointly over cache entries (< seq_len) and the causal
 speculative window; KV for all J positions is scattered into the cache, and
 rejected positions are simply masked by seq_lens until overwritten.
 
+``repeat_penalty`` is not applied on this path (the draft/verify loop is
+greedy-oriented; penalized greedy would diverge from the drafts) — use the
+normal decode path when that option matters.
+
 The reference has no speculation anywhere (its engine is Ollama).
 """
 
@@ -75,10 +79,11 @@ class SpecModelRunner(ModelRunner):
 
     def insert(self, state, slot, ks, vs, plen, first_token, temperature,
                top_p, prompt_tokens: list[int] | None = None, slot_key=None,
-               top_k: int = 0):
+               top_k: int = 0, repeat_penalty: float = 1.0):
         state = super().insert(state, slot, ks, vs, plen, first_token,
                                temperature, top_p, slot_key=slot_key,
-                               top_k=top_k)
+                               top_k=top_k, repeat_penalty=repeat_penalty,
+                               prompt_tokens=prompt_tokens)
         row = np.zeros((self.max_seq,), np.int32)
         if prompt_tokens:
             row[:plen] = prompt_tokens[:plen]
@@ -173,7 +178,8 @@ class SpecModelRunner(ModelRunner):
                 tokens=jnp.where(st.active, pending, st.tokens),
                 active=st.active,
                 temperature=st.temperature, top_p=st.top_p,
-                top_k=st.top_k, keys=carry,
+                top_k=st.top_k, repeat_penalty=st.repeat_penalty,
+                recent=st.recent, keys=carry,
                 hist=hist,
             )
             packed = jnp.concatenate(

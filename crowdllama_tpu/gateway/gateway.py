@@ -494,6 +494,8 @@ class Gateway:
             # Clamp like seed: out-of-range/null client values must not
             # escape as proto setter errors.
             top_k=min(max(0, int(options.get("top_k", 0) or 0)), 2**31 - 1),
+            repeat_penalty=max(0.0, float(
+                options.get("repeat_penalty", 1.0) or 1.0)),
         )
         tried: set[str] = set()
         last_err = "no workers available for model"
