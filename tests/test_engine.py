@@ -167,14 +167,11 @@ async def test_event_loop_free_during_dispatch():
         def init_state(self):
             return {}
 
-        def prefill(self, ids, temp, top_p, key, state=None, top_k=0,
-                    repeat_penalty=1.0):
+        def prefill(self, ids, temp, top_p, key, state=None, **kw):
             time.sleep(0.4)  # blocking device wait
             return 5, None, None, len(ids)
 
-        def insert(self, state, slot, ks, vs, plen, tok, t, p,
-                   prompt_tokens=None, slot_key=None, top_k=0,
-                   repeat_penalty=1.0):
+        def insert(self, state, slot, ks, vs, plen, tok, t, p, **kw):
             return state
 
         def release(self, state, slot):
@@ -377,13 +374,10 @@ async def test_scheduler_drain():
         def init_state(self):
             return {}
 
-        def prefill(self, ids, temp, top_p, key, state=None, top_k=0,
-                    repeat_penalty=1.0):
+        def prefill(self, ids, temp, top_p, key, state=None, **kw):
             return 5, None, None, len(ids)
 
-        def insert(self, state, slot, ks, vs, plen, tok, t, p,
-                   prompt_tokens=None, slot_key=None, top_k=0,
-                   repeat_penalty=1.0):
+        def insert(self, state, slot, ks, vs, plen, tok, t, p, **kw):
             return state
 
         def release(self, state, slot):
