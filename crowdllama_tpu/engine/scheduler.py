@@ -231,8 +231,19 @@ class Scheduler:
         from the seed alone, so identical seeded requests reproduce exactly;
         unseeded ones draw from the scheduler RNG."""
         if req.seed:
-            return jax.random.fold_in(
-                jax.random.PRNGKey(req.seed & 0x7FFFFFFF), lane)
+            # Full 64-bit seed: low 31 bits seed the key, the remaining 33
+            # fold in (two words), so seeds differing only above bit 31 —
+            # including bit 63 — don't collide (ADVICE r3).  Clients may
+            # send negative or oversized JSON ints — reduce to uint64 first
+            # (fold_in rejects values outside uint32).
+            seed = req.seed & 0xFFFFFFFFFFFFFFFF
+            key = jax.random.PRNGKey(seed & 0x7FFFFFFF)
+            hi = seed >> 31
+            if hi:
+                key = jax.random.fold_in(key, hi & 0xFFFFFFFF)
+                if hi >> 32:
+                    key = jax.random.fold_in(key, hi >> 32)
+            return jax.random.fold_in(key, lane)
         self._rng, sub = jax.random.split(self._rng)
         return sub
 

@@ -25,6 +25,8 @@ from __future__ import annotations
 import asyncio
 import hashlib
 import logging
+import re
+import shutil
 from pathlib import Path
 
 from crowdllama_tpu.core.protocol import MODEL_PROTOCOL
@@ -54,6 +56,40 @@ _SHAREABLE = (
 )
 
 
+#: one HF-style name segment: must start alphanumeric (no dotfiles, no
+#: "."/".."), then alnum/dot/dash/underscore only — no separators.
+_SEGMENT_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]*$")
+
+
+def safe_model_dirname(model: str) -> str:
+    """Validate a (possibly remote-supplied) model name and return the
+    directory name it maps to under the models dir.
+
+    Model names reach this code from untrusted peers (the gateway's
+    /api/pull proxies any client string to a worker's MODEL_PROTOCOL
+    ``pull`` op), and the fetch path rmtree's/renames ``dest`` — so a name
+    like ``.`` or ``..`` must never resolve to the models root or above it.
+    Accepts HF-style ``org/name`` (each segment validated separately);
+    rejects empty/overlong names, backslashes, and any segment that is
+    ``.``, ``..``, or starts with a dot."""
+    if not model or len(model) > 256 or "\\" in model:
+        raise ValueError(f"invalid model name {model!r}")
+    segs = model.split("/")
+    if not all(_SEGMENT_RE.match(s) for s in segs):
+        raise ValueError(f"invalid model name {model!r}")
+    return "_".join(segs)
+
+
+def _dest_under_root(dest_root: str | Path, model: str) -> Path:
+    """``dest_root/<flattened model>`` with a belt-and-braces containment
+    assert (the dirname is already regex-validated)."""
+    root = Path(dest_root).expanduser().resolve()
+    dest = (root / safe_model_dirname(model)).resolve()
+    if dest.parent != root or dest == root:
+        raise ValueError(f"model name {model!r} escapes models dir")
+    return dest
+
+
 def _shareable(name: str) -> bool:
     if "/" in name or "\\" in name or name.startswith(".") or ".." in name:
         return False
@@ -74,9 +110,13 @@ class ModelShareService:
     ``model_dir(model)`` and ``pull(model)`` come from the owning Peer —
     the service itself is transport only."""
 
-    def __init__(self, model_dir, pull=None):
+    def __init__(self, model_dir, pull=None, allow_pull: bool = True):
         self._model_dir = model_dir          # (model) -> Path | None
         self._pull = pull                    # async (model) -> str | None
+        self._allow_pull = allow_pull
+        # One swarm-triggered pull at a time: a hostile peer spamming the
+        # op must not fan out N concurrent multi-GB downloads.
+        self._pull_lock = asyncio.Lock()
         # (path, size, mtime_ns) -> sha256: checkpoints are immutable in
         # practice; re-hashing tens of GB per manifest request would burn
         # minutes of CPU per pull attempt.
@@ -87,13 +127,30 @@ class ModelShareService:
             req = await read_json_frame(stream.reader, OP_TIMEOUT)
             op = str(req.get("op", ""))
             model = str(req.get("model", ""))
+            try:
+                safe_model_dirname(model)
+            except ValueError as e:
+                await write_json_frame(stream.writer,
+                                       {"ok": False, "error": str(e)})
+                return
             if op == "manifest":
                 await self._manifest(stream, model)
             elif op == "fetch":
                 await self._fetch(stream, model, str(req.get("name", "")))
             elif op == "pull" and self._pull is not None:
+                if not self._allow_pull:
+                    await write_json_frame(stream.writer, {
+                        "ok": False,
+                        "error": "swarm-triggered pulls disabled on this "
+                                 "worker (CROWDLLAMA_TPU_ALLOW_SWARM_PULL)"})
+                    return
+                if self._pull_lock.locked():
+                    await write_json_frame(stream.writer, {
+                        "ok": False, "error": "a pull is already running"})
+                    return
                 try:
-                    path = await self._pull(model)
+                    async with self._pull_lock:
+                        path = await self._pull(model)
                     await write_json_frame(stream.writer,
                                            {"ok": True, "path": str(path)})
                 except Exception as e:
@@ -163,14 +220,14 @@ async def fetch_model(host: Host, source: Contact, model: str,
     ``dest_root/<model>/``; every file is SHA-256-verified against the
     manifest before the function returns.  Partial downloads live in a
     ``.partial`` staging dir so a crash never leaves a plausible-looking
-    but corrupt checkpoint."""
-    dest = Path(dest_root).expanduser() / model.replace("/", "_")
+    but corrupt checkpoint.  The model name is validated (it may come from
+    an untrusted peer via the ``pull`` op) so ``dest`` can never resolve to
+    the models root or escape it."""
+    dest = _dest_under_root(dest_root, model)
     staging = dest.with_name(dest.name + ".partial")
     if staging.exists():
         # A dirty staging dir from an aborted pull must not leak stale
         # (unverified) shards into the promoted checkpoint.
-        import shutil
-
         shutil.rmtree(staging)
     staging.mkdir(parents=True)
 
@@ -186,6 +243,13 @@ async def fetch_model(host: Host, source: Contact, model: str,
     files = reply.get("files") or []
     if not any(f["name"].endswith(".safetensors") for f in files):
         raise RuntimeError(f"source has no safetensors for {model!r}")
+    total = sum(int(f.get("size", 0)) for f in files)
+    free = shutil.disk_usage(staging).free
+    if total * 1.05 + (256 << 20) > free:
+        shutil.rmtree(staging, ignore_errors=True)
+        raise RuntimeError(
+            f"not enough disk for {model!r}: need {total} bytes, "
+            f"{free} free under {staging.parent}")
 
     for f in files:
         name, size, want = str(f["name"]), int(f["size"]), str(f["sha256"])
@@ -221,8 +285,6 @@ async def fetch_model(host: Host, source: Contact, model: str,
 
     # Atomic-ish promote: all files verified, swap staging into place.
     if dest.exists():
-        import shutil
-
         shutil.rmtree(dest)
     staging.rename(dest)
     return dest

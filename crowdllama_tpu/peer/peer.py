@@ -139,7 +139,8 @@ class Peer:
             from crowdllama_tpu.net.model_share import ModelShareService
 
             self._model_share = ModelShareService(
-                model_dir=self.engine.model_dir, pull=self.pull_model)
+                model_dir=self.engine.model_dir, pull=self.pull_model,
+                allow_pull=getattr(self.config, "allow_swarm_pull", True))
             self.host.set_stream_handler(MODEL_PROTOCOL,
                                          self._model_share.handle)
         shard_service = getattr(self.engine, "shard_service", None)
@@ -248,8 +249,12 @@ class Peer:
         checkpoint with per-file hash verification (net/model_share.py),
         then hot-registers it on engines that support it
         (MultiEngine.add_model).  Returns the local checkpoint path."""
-        from crowdllama_tpu.net.model_share import fetch_model
+        from crowdllama_tpu.net.model_share import (
+            fetch_model,
+            safe_model_dirname,
+        )
 
+        safe_model_dirname(model)  # reject path-traversal names up front
         if model in (self.engine.models or []):
             d = self.engine.model_dir(model)
             return d or ""

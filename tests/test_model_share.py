@@ -27,6 +27,73 @@ transformers = pytest.importorskip("transformers")
 torch = pytest.importorskip("torch")
 
 
+def test_model_name_validation(tmp_path):
+    """Remote-supplied model names must never resolve to (or above) the
+    models root — '.' or '..' would make fetch_model's promote-step rmtree
+    delete the whole models dir (ADVICE r3, high)."""
+    from crowdllama_tpu.net.model_share import (
+        _dest_under_root,
+        safe_model_dirname,
+    )
+
+    for bad in (".", "..", "", "a/../b", "/etc", "a//b", ".hidden",
+                "a\\b", "..evil", "x/" , "/x", "a/.ssh", "x" * 300):
+        with pytest.raises(ValueError):
+            safe_model_dirname(bad)
+    assert safe_model_dirname("tiny-test") == "tiny-test"
+    assert safe_model_dirname("meta-llama/Llama-3-8B") == (
+        "meta-llama_Llama-3-8B")
+    assert safe_model_dirname("Qwen2.5-7B") == "Qwen2.5-7B"
+
+    root = tmp_path / "models"
+    root.mkdir()
+    dest = _dest_under_root(root, "org/name")
+    assert dest.parent == root.resolve() and dest.name == "org_name"
+    with pytest.raises(ValueError):
+        _dest_under_root(root, "..")
+
+
+async def test_pull_op_gating(tiny_checkpoint, tmp_path):
+    """A worker with allow_swarm_pull=False refuses the remote 'pull' op
+    (ADVICE r3, medium) but still serves manifests; bad model names are
+    rejected at the wire."""
+    from crowdllama_tpu.core.protocol import MODEL_PROTOCOL
+    from crowdllama_tpu.net.host import (
+        read_json_frame,
+        write_json_frame,
+    )
+
+    boot_host, bootstrap, worker_a, eng_a = await _share_topology(
+        tiny_checkpoint, tmp_path, allow_swarm_pull=False)
+    client_host, _ = await new_host_and_dht(
+        Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+
+    async def op(req):
+        stream = await client_host.new_stream(
+            worker_a.host.contact, MODEL_PROTOCOL)
+        try:
+            await write_json_frame(stream.writer, req)
+            return await read_json_frame(stream.reader, 10.0)
+        finally:
+            stream.close()
+
+    try:
+        reply = await op({"op": "pull", "model": "tiny-test"})
+        assert not reply["ok"] and "disabled" in reply["error"]
+        reply = await op({"op": "manifest", "model": "tiny-test"})
+        assert reply["ok"] and reply["files"]
+        reply = await op({"op": "manifest", "model": ".."})
+        assert not reply["ok"] and "invalid model name" in reply["error"]
+        reply = await op({"op": "fetch", "model": "../../etc",
+                          "name": "passwd"})
+        assert not reply["ok"]
+    finally:
+        await client_host.close()
+        await worker_a.stop()
+        await eng_a.stop()
+        await boot_host.close()
+
+
 def _cfg(bootstrap, **kw):
     cfg = Configuration(listen_host="127.0.0.1", bootstrap_peers=[bootstrap],
                         intervals=Intervals.default())
@@ -68,14 +135,14 @@ def tiny_checkpoint(tmp_path_factory):
     return d
 
 
-async def _share_topology(tiny_checkpoint, tmp_path):
+async def _share_topology(tiny_checkpoint, tmp_path, **cfg_kw):
     boot_host, _ = await new_host_and_dht(
         Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
     bootstrap = f"127.0.0.1:{boot_host.listen_port}"
 
     # Worker A: serves tiny-test FROM the checkpoint (shareable).
     cfg_a = _cfg(bootstrap, model="tiny-test",
-                 model_path=str(tiny_checkpoint), warmup=False)
+                 model_path=str(tiny_checkpoint), warmup=False, **cfg_kw)
     eng_a = MultiEngine(cfg_a)
     await eng_a.start()
     worker_a = Peer(Ed25519PrivateKey.generate(), cfg_a, engine=eng_a,
