@@ -57,6 +57,9 @@ class Intervals:
     max_failed_attempts: int = 3
     dht_provider_check: float = 60.0
     dht_bucket_refresh: float = 600.0
+    # relay_mode=auto workers re-probe reachability on this cadence and
+    # drop their relay when a direct dialback starts succeeding.
+    relay_reprobe: float = 60.0
 
     @classmethod
     def default(cls) -> "Intervals":
@@ -73,6 +76,7 @@ class Intervals:
                 backoff_base=0.5,
                 dht_provider_check=2.0,
                 dht_bucket_refresh=5.0,
+                relay_reprobe=2.0,
             )
         return cls()
 

@@ -72,6 +72,11 @@ class Resource:
     # streams through its bootstrap node, net/relay.py; the reference logs
     # the equivalent libp2p circuit classification, dht.go:386-395).
     reachability: str = "direct"
+    # True when this peer hosts a RelayService NATed workers can register
+    # with (any directly-reachable worker does — libp2p's multi-relay
+    # circuit semantics, dht.go:386-395; relay failover candidates come
+    # from these advertisements).
+    relay_capable: bool = False
     shard_group: ShardGroup | None = None
 
     def touch(self) -> None:

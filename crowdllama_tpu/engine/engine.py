@@ -329,10 +329,7 @@ class JaxEngine(Engine):
             # (max_seq == prefill_chunk + 1 has no such prompt, ADVICE r3).
             job = r.prefill_begin(list(range(1, r.prefill_chunk + 2)))
             r.prefill_step(job)
-        try:
-            r.embed_prompts([[1, 2, 3]])
-        except NotImplementedError:  # pp/sp meshes have no embeddings path
-            pass
+        r.embed_prompts([[1, 2, 3]])
         state = r.release(state, 0)
         log.info("warmup compile done")
 
@@ -358,9 +355,9 @@ class JaxEngine(Engine):
     def describe(self) -> dict:
         d = {"models": self.models, "throughput": 0.0, "load": 0.0}
         if self._runner is not None:
-            # pp/sp meshes have no embeddings forward (runner.embed_prompts
-            # raises) — advertise the gap so embed routing avoids us.
-            d["embeddings"] = self._runner.pp == 1 and self._runner.sp == 1
+            # Every mesh kind has an embeddings forward now (pp runs the
+            # microbatch pipeline, sp the ring — runner.embed_prompts).
+            d["embeddings"] = True
         if self.scheduler is not None:
             d["throughput"] = round(self.scheduler.throughput_ema, 2)
             d["load"] = round(self.scheduler.load, 3)

@@ -45,7 +45,11 @@ from crowdllama_tpu.parallel.mesh import (
     build_mesh,
     choose_mesh_shape,
 )
-from crowdllama_tpu.parallel.pipeline import pp_decode_step, pp_prefill
+from crowdllama_tpu.parallel.pipeline import (
+    pp_decode_step,
+    pp_hidden_states,
+    pp_prefill,
+)
 from crowdllama_tpu.parallel.sharding import (
     cache_pspec,
     filter_spec,
@@ -525,11 +529,9 @@ class ModelRunner:
 
         Same-bucket prompts share one forward (padded to 1/2/4/8 rows) —
         bulk /api/embed costs ~N/8 dispatches instead of N.  Sequence
-        padding is excluded from attention and the pooling mask."""
-        if self.pp > 1 or self.sp > 1:
-            raise NotImplementedError(
-                "embeddings are not implemented on pp/sp meshes yet "
-                "(the plain layer scan assumes an unsharded layer stack)")
+        padding is excluded from attention and the pooling mask.  pp meshes
+        run the microbatch pipeline forward, sp meshes the ring-attention
+        forward (same code paths prefill uses)."""
         out = np.zeros((len(prompts), self.cfg.hidden_size), np.float32)
         groups: dict[int, list[int]] = {}
         for i, ids in enumerate(prompts):
@@ -555,9 +557,14 @@ class ModelRunner:
         t = tokens.shape[1]
         positions = jnp.minimum(jnp.arange(t)[None, :], plens[:, None] - 1)
         kv_valid = jnp.arange(t)[None, :] < plens[:, None]  # [B, T]
-        h = T.hidden_states(params, self.cfg, tokens, positions,
-                            kv_valid=kv_valid,
-                            n_shards=self.mesh.size)  # [B, T, D]
+        if self.pp > 1:
+            h = pp_hidden_states(params, self.cfg, tokens, positions,
+                                 self.mesh, kv_valid=kv_valid)  # [B, T, D]
+        else:
+            h = T.hidden_states(params, self.cfg, tokens, positions,
+                                kv_valid=kv_valid,
+                                sp_mesh=self._sp_mesh,
+                                n_shards=self.mesh.size)  # [B, T, D]
         mask = kv_valid[..., None].astype(jnp.float32)  # [B, T, 1]
         pooled = jnp.sum(h.astype(jnp.float32) * mask, axis=1) / jnp.maximum(
             jnp.sum(mask, axis=1), 1.0)

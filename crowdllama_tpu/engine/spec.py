@@ -78,6 +78,44 @@ def propose_ngram_drafts(hist, seq_lens, draft_len: int, max_seq: int):
     return jax.vmap(one)(hist, cur)
 
 
+def _verify_accept_emit(st, logits, drafts, j: int, s_max: int):
+    """The layout-independent half of one verify step, shared by both spec
+    runners (the contiguous and paged implementations differ ONLY in how
+    context is gathered and new KV is scattered — this logic must stay
+    token-for-token identical between them).
+
+    Returns ``(counts, emit, pending, hist, carry)``: per-slot emit counts,
+    the [B, J] emitted-token block, the next pending token, the updated
+    draft history, and the advanced per-slot PRNG carries."""
+    bidx = jnp.arange(st.tokens.shape[0])
+    model_next = jnp.argmax(logits, axis=-1).astype(jnp.int32)   # [B, J]
+    greedy = st.temperature <= 0.0
+    match = (drafts == model_next[:, :-1]) & greedy[:, None]
+    accepted = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
+                       axis=1)                                   # [B] 0..k
+    # Don't speculate past the context window: emitted tokens beyond
+    # max_seq-1 would clamp-overwrite the last cache position.
+    room = jnp.maximum(s_max - 1 - st.seq_lens, 0)
+    accepted = jnp.minimum(accepted, room)
+
+    carry, sub = split_slot_keys(st.keys)
+    sampled0 = sample_tokens_slots(logits[:, 0], st.temperature,
+                                   st.top_p, sub, top_k=st.top_k)
+    emit = model_next.at[:, 0].set(
+        jnp.where(greedy, model_next[:, 0], sampled0))           # [B, J]
+    emit = jnp.where(st.active[:, None], emit, 0)
+    counts = jnp.where(st.active, accepted + 1, 0)               # [B]
+    pending = jnp.take_along_axis(
+        emit, accepted[:, None], axis=1)[:, 0]                   # [B]
+
+    # History: token at sequence position seq_lens+1+i is emit[i].
+    hpos = jnp.minimum(st.seq_lens[:, None] + 1 + jnp.arange(j), s_max - 1)
+    hist = st.hist.at[bidx[:, None], hpos].set(
+        jnp.where(jnp.arange(j)[None, :] <= accepted[:, None],
+                  emit, st.hist[bidx[:, None], hpos]))
+    return counts, emit, pending, hist, carry
+
+
 class SpecModelRunner(ModelRunner):
     """ModelRunner with n-gram speculative decode (contiguous KV only).
 
@@ -156,32 +194,8 @@ class SpecModelRunner(ModelRunner):
             v_cache = st.v_cache.at[:, bidx[:, None], :, positions].set(
                 vs.transpose(1, 3, 0, 2, 4).astype(st.v_cache.dtype))
 
-            model_next = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B,J]
-            greedy = st.temperature <= 0.0
-            match = (drafts == model_next[:, :-1]) & greedy[:, None]
-            accepted = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
-                               axis=1)                          # [B] 0..k
-            # Don't speculate past the context window: emitted tokens beyond
-            # max_seq-1 would clamp-overwrite the last cache position.
-            room = jnp.maximum(s_max - 1 - st.seq_lens, 0)
-            accepted = jnp.minimum(accepted, room)
-
-            carry, sub = split_slot_keys(st.keys)
-            sampled0 = sample_tokens_slots(logits[:, 0], st.temperature,
-                                           st.top_p, sub, top_k=st.top_k)
-            emit = model_next.at[:, 0].set(
-                jnp.where(greedy, model_next[:, 0], sampled0))  # [B, J]
-            emit = jnp.where(st.active[:, None], emit, 0)
-            counts = jnp.where(st.active, accepted + 1, 0)      # [B]
-            pending = jnp.take_along_axis(
-                emit, accepted[:, None], axis=1)[:, 0]          # [B]
-
-            # History: token at sequence position seq_lens+1+i is emit[i].
-            hpos = jnp.minimum(st.seq_lens[:, None] + 1 + jnp.arange(j),
-                               s_max - 1)
-            hist = st.hist.at[bidx[:, None], hpos].set(
-                jnp.where(jnp.arange(j)[None, :] <= accepted[:, None],
-                          emit, st.hist[bidx[:, None], hpos]))
+            counts, emit, pending, hist, carry = _verify_accept_emit(
+                st, logits, drafts, j, s_max)
 
             new_state = DecodeState(
                 k_cache=k_cache, v_cache=v_cache,
@@ -329,29 +343,8 @@ class SpecPagedModelRunner(PagedModelRunner):
             pool_v = st.pool_v.at[:, pages_bj, :, off].set(
                 vs.transpose(1, 3, 0, 2, 4).astype(st.pool_v.dtype))
 
-            model_next = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            greedy = st.temperature <= 0.0
-            match = (drafts == model_next[:, :-1]) & greedy[:, None]
-            accepted = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
-                               axis=1)                          # [B] 0..k
-            room = jnp.maximum(s_max - 1 - st.seq_lens, 0)
-            accepted = jnp.minimum(accepted, room)
-
-            carry, sub = split_slot_keys(st.keys)
-            sampled0 = sample_tokens_slots(logits[:, 0], st.temperature,
-                                           st.top_p, sub, top_k=st.top_k)
-            emit = model_next.at[:, 0].set(
-                jnp.where(greedy, model_next[:, 0], sampled0))  # [B, J]
-            emit = jnp.where(st.active[:, None], emit, 0)
-            counts = jnp.where(st.active, accepted + 1, 0)      # [B]
-            pending = jnp.take_along_axis(
-                emit, accepted[:, None], axis=1)[:, 0]          # [B]
-
-            hpos = jnp.minimum(st.seq_lens[:, None] + 1 + jnp.arange(j),
-                               s_max - 1)
-            hist = st.hist.at[bidx[:, None], hpos].set(
-                jnp.where(jnp.arange(j)[None, :] <= accepted[:, None],
-                          emit, st.hist[bidx[:, None], hpos]))
+            counts, emit, pending, hist, carry = _verify_accept_emit(
+                st, logits, drafts, j, s_max)
 
             new_state = PagedDecodeState(
                 pool_k=pool_k, pool_v=pool_v,

@@ -109,6 +109,13 @@ TINY_TEST_GEMMA = _register(ModelConfig(
     max_context_length=256, rms_norm_eps=1e-6,
 ))
 
+TINY_TEST_QWEN3_MOE = _register(ModelConfig(
+    name="tiny-test-qwen3-moe", family="qwen3", vocab_size=512,
+    hidden_size=64, intermediate_size=128, num_layers=2, num_heads=4,
+    num_kv_heads=2, head_dim=32, qk_norm=True, num_experts=4,
+    num_experts_per_tok=2, max_context_length=256, rms_norm_eps=1e-6,
+))
+
 TINY_TEST_QWEN2 = _register(ModelConfig(
     name="tiny-test-qwen2", family="qwen2", vocab_size=512, hidden_size=64,
     intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=2,

@@ -347,6 +347,8 @@ def hidden_states(
     positions: jnp.ndarray,  # [B, T]
     kv_valid: jnp.ndarray | None = None,
     n_shards: int = 1,       # total mesh devices (gates pallas dispatch)
+    sp_mesh=None,            # Mesh → ring attention over its "sp" axis
+    sp_batch_axis: str | None = None,
 ) -> jnp.ndarray:
     """Final-norm hidden states [B, T, D] — the embeddings forward.
 
@@ -354,11 +356,14 @@ def hidden_states(
     vocab projection is the single most expensive op at embedding batch
     sizes and its output is unused for /api/embed).  ``n_shards`` must be
     the mesh size at the call site — like prefill, the Pallas kernel cannot
-    run over GSPMD-sharded operands."""
+    run over GSPMD-sharded operands.  With ``sp_mesh`` attention runs as
+    the same ppermute ring prefill uses (long-context embeddings on sp
+    meshes)."""
     x = _embed(params, cfg, tokens)
     x, _, _ = scan_prefill_layers(
         params["layers"], layer_sliding_windows(cfg), cfg, x, positions,
         kv_valid=kv_valid, n_shards=n_shards,
+        sp_mesh=sp_mesh, sp_batch_axis=sp_batch_axis,
     )
     return rms_norm(x, params["final_norm"], cfg.rms_norm_eps,
                     plus_one=cfg.family == "gemma2")

@@ -129,6 +129,11 @@ class Stream:
     remote_contact: Contact | None  # None when the remote is not listening
     reader: "asyncio.StreamReader"
     writer: "asyncio.StreamWriter"
+    # Socket-observed source IP of an INBOUND stream ("" for outbound):
+    # unlike remote_contact it survives non-dialable hellos (listen_port
+    # 0), which is what the relay's dialback probe needs — a relaying
+    # worker's hello is deliberately non-dialable.
+    observed_ip: str = ""
 
     def close(self) -> None:
         try:
@@ -485,6 +490,7 @@ class Host:
                 remote_contact=remote_contact,
                 reader=SecureReader(reader, c2s),
                 writer=SecureWriter(writer, s2c),
+                observed_ip=peername[0] if peername else "",
             )
             self.stats["streams_in"] += 1
             self.stats_by_protocol[proto] = (

@@ -69,7 +69,16 @@ class RelayService:
         self._workers: dict[str, _Registration] = {}
         # conn_id -> future resolved with (worker Stream, done Event)
         self._pending: dict[str, asyncio.Future] = {}
+        self._closed = False
         host.set_stream_handler(RELAY_PROTOCOL, self.handle)
+
+    def close(self) -> None:
+        """Stop relaying: refuse new ops and drop every registration (their
+        control streams close, so workers fail over to another relay)."""
+        self._closed = True
+        for reg in list(self._workers.values()):
+            reg.stream.close()
+        self._workers.clear()
 
     @property
     def registered_count(self) -> int:
@@ -83,7 +92,10 @@ class RelayService:
             return
         op = str(req.get("op", ""))
         try:
-            if op == "register":
+            if self._closed:
+                await write_json_frame(stream.writer,
+                                       {"ok": False, "error": "relay closed"})
+            elif op == "register":
                 await self._handle_register(stream)
             elif op == "connect":
                 await self._handle_connect(stream, str(req.get("target", "")))
@@ -185,11 +197,15 @@ class RelayService:
     # ------------------------------------------------------------- dialback
 
     async def _handle_dialback(self, stream: Stream, port: int) -> None:
-        """Reachability probe: can WE dial the caller back directly?"""
-        ip = ""
-        contact = stream.remote_contact
-        if contact is not None:
-            ip = contact.host
+        """Reachability probe: can WE dial the caller back directly?
+
+        Uses the socket-observed source IP (NOT the hello contact): a
+        relaying worker's hello is deliberately non-dialable, and the
+        whole point of the auto-mode re-probe is to notice that such a
+        worker's port has become reachable."""
+        ip = stream.observed_ip
+        if not ip and stream.remote_contact is not None:
+            ip = stream.remote_contact.host
         reachable = False
         if ip and 0 < port < 65536:
             try:
@@ -225,16 +241,41 @@ async def _splice(a: Stream, b: Stream) -> None:
 
 class RelayClient:
     """Worker-side relay registration: keeps the control stream alive and
-    answers ``incoming`` notifications with reverse connections."""
+    answers ``incoming`` notifications with reverse connections.
+
+    ``candidates`` (a nullary callable returning relay addresses, e.g. the
+    peer's view of relay_capable swarm members) enables failover: after two
+    consecutive failed registration cycles on the current relay the client
+    rotates to the next candidate — libp2p's multi-relay circuit semantics
+    (the reference gets this from AutoRelay, dht.go:386-395).
+    ``on_relay_change(addr)`` fires after every successful registration so
+    the owner can re-advertise the (possibly new) relay contact."""
 
     def __init__(self, host: Host, relay_addr: str,
-                 ping_interval: float = PING_INTERVAL):
+                 ping_interval: float = PING_INTERVAL,
+                 candidates=None, on_relay_change=None):
         self.host = host
         self.relay_addr = relay_addr
         self.ping_interval = ping_interval
+        self.candidates = candidates
+        self.on_relay_change = on_relay_change
         self._task: asyncio.Task | None = None
         self._accepts: set[asyncio.Task] = set()
         self.registered = asyncio.Event()
+
+    def _next_candidate(self) -> str:
+        """Next failover relay, rotating past the current one."""
+        if self.candidates is None:
+            return ""
+        try:
+            cands = [a for a in self.candidates() if a]
+        except Exception as e:
+            log.debug("relay candidate lookup failed: %s", e)
+            return ""
+        if self.relay_addr in cands:
+            i = cands.index(self.relay_addr)
+            cands = cands[i + 1:] + cands[:i]
+        return cands[0] if cands else ""
 
     async def start(self) -> None:
         self._task = asyncio.create_task(self._run(), name="relay-client")
@@ -257,6 +298,8 @@ class RelayClient:
 
     async def _run(self) -> None:
         backoff = 1.0
+        fails = 0
+        fast_rotations = 0  # immediate failovers since the last success
         while True:
             control: Stream | None = None
             try:
@@ -269,6 +312,13 @@ class RelayClient:
                         f"relay refused registration: {reply.get('error')}")
                 self.registered.set()
                 backoff = 1.0
+                fails = 0
+                fast_rotations = 0
+                if self.on_relay_change is not None:
+                    try:
+                        self.on_relay_change(self.relay_addr)
+                    except Exception:
+                        log.exception("on_relay_change failed")
                 ping = asyncio.create_task(self._ping_loop(control))
                 try:
                     while True:
@@ -285,6 +335,21 @@ class RelayClient:
                 raise
             except Exception as e:
                 self.registered.clear()
+                fails += 1
+                nxt = self._next_candidate() if fails >= 2 else ""
+                if nxt and nxt != self.relay_addr:
+                    log.warning("relay %s unreachable (%s); failing over "
+                                "to %s", self.relay_addr, e, nxt)
+                    self.relay_addr = nxt
+                    fails = 0
+                    # One immediate try per candidate; once the whole pool
+                    # has failed since the last success, keep rotating but
+                    # under the normal exponential backoff — a swarm-wide
+                    # outage must not turn into a 1 Hz retry storm.
+                    fast_rotations += 1
+                    if fast_rotations <= 4:
+                        backoff = 1.0
+                        continue
                 log.warning("relay control stream lost (%s); retrying in "
                             "%.0fs", e, backoff)
             finally:

@@ -80,6 +80,40 @@ def pp_prefill(
     n_microbatches: int = 0,  # 0 → min(pp, B)
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Pipelined full-prompt forward: (logits [B,T,V], k, v [L,B,Hkv,T,Dh])."""
+    out_x, ks, vs = _pp_forward(params, cfg, tokens, positions, mesh,
+                                kv_valid, n_microbatches)
+    logits = T._unembed(params, cfg, out_x)
+    return logits, ks, vs
+
+
+def pp_hidden_states(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,     # [B, T] int32
+    positions: jnp.ndarray,  # [B, T] int32
+    mesh: Mesh,
+    kv_valid: jnp.ndarray | None = None,
+    n_microbatches: int = 0,
+) -> jnp.ndarray:
+    """Final-norm hidden states [B, T, D] via the microbatch pipeline — the
+    embeddings forward on pp meshes (the per-stage KV is computed by the
+    shared pipeline body and discarded; embedding batches are small)."""
+    out_x, _, _ = _pp_forward(params, cfg, tokens, positions, mesh,
+                              kv_valid, n_microbatches)
+    return T.rms_norm(out_x, params["final_norm"], cfg.rms_norm_eps,
+                      plus_one=cfg.family == "gemma2")
+
+
+def _pp_forward(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,
+    positions: jnp.ndarray,
+    mesh: Mesh,
+    kv_valid: jnp.ndarray | None,
+    n_microbatches: int,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Shared pipeline body: (pre-final-norm activations [B,T,D], k, v)."""
     _require_partial_manual()
     npp = mesh.shape[AXIS_PP]
     b, t = tokens.shape
@@ -150,8 +184,7 @@ def pp_prefill(
         axis_names={AXIS_PP},
         check_vma=False,
     )(params["layers"], windows, x, positions, kv_valid)
-    logits = T._unembed(params, cfg, out_x.astype(x.dtype))
-    return logits, ks, vs
+    return out_x.astype(x.dtype), ks, vs
 
 
 def pp_decode_step(

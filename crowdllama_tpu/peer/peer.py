@@ -117,6 +117,7 @@ class Peer:
         self.peer_manager: PeerManager | None = None
         self._tasks: list[asyncio.Task] = []
         self.relay_client = None  # net/relay.py RelayClient when relaying
+        self.relay_service = None  # RelayService when hosting one (public)
 
     # ----------------------------------------------------------- lifecycle
 
@@ -180,6 +181,11 @@ class Peer:
                 run_every(iv.advertise, self._advertise, log, logging.DEBUG),
                 name="peer-advertise"),
         ]
+        if self.worker_mode and self.config.relay_mode == "auto":
+            self._tasks.append(asyncio.create_task(
+                run_every(iv.relay_reprobe, self._reprobe_relay, log,
+                          logging.DEBUG),
+                name="peer-relay-reprobe"))
         log.info("peer %s up (%s) on %s",
                  self.host.peer_id[:8],
                  "worker" if self.worker_mode else "consumer",
@@ -204,18 +210,25 @@ class Peer:
         """NAT traversal (net/relay.py; libp2p relay/hole-punch parity,
         /root/reference/pkg/dht/dht.go:386-395, discovery.go:62): a worker
         the bootstrap node cannot dial back registers for reverse streams
-        through it and advertises the relay address instead of its own."""
-        if (not self.worker_mode or self.config.relay_mode == "off"
-                or not self.config.bootstrap_peers):
+        through it — with failover to any relay_capable swarm peer — and
+        advertises the relay address instead of its own.  Directly
+        reachable workers instead HOST a RelayService themselves and
+        advertise relay_capable, so the swarm's relay capacity scales with
+        its public membership instead of hanging off bootstrap_peers[0]."""
+        if not self.worker_mode or self.config.relay_mode == "off":
             return
-        from crowdllama_tpu.net.host import Contact
         from crowdllama_tpu.net.relay import RelayClient, dialback_probe
 
+        if not self.config.bootstrap_peers:
+            self._start_relay_service()
+            return
         relay_addr = self.config.bootstrap_peers[0]
         if self.config.relay_mode == "auto":
             try:
                 if await dialback_probe(self.host, relay_addr):
-                    return  # directly reachable: no relay needed
+                    # Directly reachable: no relay needed — serve as one.
+                    self._start_relay_service()
+                    return
             except Exception as e:
                 # No relay service at the bootstrap node (or probe error):
                 # relaying through it is impossible either way — stay
@@ -223,24 +236,119 @@ class Peer:
                 log.debug("dialback probe unavailable (%s); staying "
                           "direct", e)
                 return
+        await self._register_relay(relay_addr)
+
+    async def _register_relay(self, relay_addr: str) -> bool:
+        """Register for reverse streams via ``relay_addr`` (with failover
+        candidates); returns False when registration can't start."""
+        from crowdllama_tpu.net.relay import RelayClient
+
         log.info("worker not directly reachable: relaying via %s", relay_addr)
         # Stop advertising the direct address BEFORE registering, so the
         # relay (and every later peer) never learns a bogus direct contact.
         self.host.hello_dialable = False
-        client = RelayClient(self.host, relay_addr)
+        client = RelayClient(self.host, relay_addr,
+                             candidates=self._relay_candidates,
+                             on_relay_change=self._on_relay_change)
         try:
             await client.start()
         except Exception:
             await client.stop()  # kill the reconnect loop too
             self.host.hello_dialable = True  # direct-only better than dead
             log.exception("relay registration failed; staying direct")
-            return
+            return False
         self.relay_client = client
+        if self.relay_service is not None:
+            # A NATed node can't relay for others — stop advertising it.
+            self.relay_service.close()
+            self.relay_service = None
+            self.resource.relay_capable = False
+        self._on_relay_change(client.relay_addr)
+        return True
+
+    def _start_relay_service(self) -> None:
+        """Host a RelayService for NATed swarm members (public workers)."""
+        from crowdllama_tpu.net.relay import RelayService
+
+        if self.relay_service is None:
+            self.relay_service = RelayService(self.host)
+            self.resource.relay_capable = True
+            log.info("hosting relay service for NATed peers")
+
+    def _relay_candidates(self) -> list[str]:
+        """Failover relay addresses: bootstrap peers first, then every
+        healthy swarm peer advertising relay_capable (resolved through the
+        local DHT routing table — no network round trip)."""
+        cands = list(self.config.bootstrap_peers)
+        try:
+            capable = {
+                p.peer_id for p in self.peer_manager.get_healthy_peers()
+                if getattr(p.resource, "relay_capable", False)}
+            for c in self.dht.table.contacts():
+                if c.peer_id in capable and not c.relay:
+                    cands.append(f"{c.host}:{c.port}")
+        except Exception as e:
+            log.debug("relay candidate scan failed: %s", e)
+        seen: set[str] = set()
+        return [a for a in cands if not (a in seen or seen.add(a))]
+
+    def _on_relay_change(self, relay_addr: str) -> None:
+        """(Re-)advertise the current relay contact — fires on every
+        successful registration, including failover to a new relay."""
+        from crowdllama_tpu.net.host import Contact
+
         rhost, _, rport = relay_addr.rpartition(":")
         self.host.relay_contact = Contact(
             peer_id=self.host.peer_id, host=rhost or "127.0.0.1",
             port=int(rport), relay=True)
         self.resource.reachability = "relay"
+        self.update_metadata()
+
+    async def _reprobe_relay(self) -> None:
+        """relay_mode=auto reachability tracking, BOTH directions: a
+        relaying worker whose listen port became directly reachable (NAT
+        opened, port-forward added) drops the relay; a direct worker whose
+        port stopped being reachable (mapping expired) goes back to
+        relaying — without this the upgrade would be one-way and a
+        transiently-open NAT would strand the worker advertising a dead
+        direct address."""
+        if self.config.relay_mode != "auto":
+            return
+        from crowdllama_tpu.net.relay import dialback_probe
+
+        if self.relay_client is not None:
+            try:
+                reachable = await dialback_probe(
+                    self.host, self.relay_client.relay_addr)
+            except Exception:
+                return  # relay gone mid-probe: client failover handles it
+            if not reachable:
+                return
+            log.info("direct dialback succeeded; dropping relay %s",
+                     self.relay_client.relay_addr)
+            await self.relay_client.stop()
+            self.relay_client = None
+            self.host.relay_contact = None
+            self.host.hello_dialable = True
+            self.resource.reachability = "direct"
+            self._start_relay_service()
+            self.update_metadata()
+            await self._publish_metadata()
+            return
+
+        # Direct worker: confirm we are still dialable via any known relay.
+        cands = self._relay_candidates()
+        if not cands:
+            return
+        try:
+            reachable = await dialback_probe(self.host, cands[0])
+        except Exception:
+            return  # no relay service reachable to probe through
+        if reachable:
+            return
+        log.info("direct dialback stopped succeeding; returning to relay")
+        if await self._register_relay(cands[0]):
+            await self._publish_metadata()
 
     async def pull_model(self, model: str) -> str:
         """Acquire ``model`` from a swarm peer and serve it.
@@ -298,6 +406,9 @@ class Peer:
         if self.relay_client is not None:
             await self.relay_client.stop()
             self.relay_client = None
+        if self.relay_service is not None:
+            self.relay_service.close()
+            self.relay_service = None
         if self.peer_manager is not None:
             await self.peer_manager.stop()
         if self.dht is not None:
