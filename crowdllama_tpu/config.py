@@ -60,6 +60,11 @@ class Intervals:
     # relay_mode=auto workers re-probe reachability on this cadence and
     # drop their relay when a direct dialback starts succeeding.
     relay_reprobe: float = 60.0
+    # Minimum age before the advertise/publish tickers actually re-provide
+    # their DHT records (membership or own-contact changes re-provide
+    # immediately; PROVIDER_TTL is 30 min, so 2 min keeps records fresh at
+    # ~1/100th of the naive per-tick chatter).
+    reprovide: float = 120.0
 
     @classmethod
     def default(cls) -> "Intervals":
@@ -77,6 +82,7 @@ class Intervals:
                 dht_provider_check=2.0,
                 dht_bucket_refresh=5.0,
                 relay_reprobe=2.0,
+                reprovide=3.0,
             )
         return cls()
 

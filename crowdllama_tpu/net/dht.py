@@ -285,6 +285,8 @@ class DHTNode:
         self.server_mode = server_mode
         self.bootstrap_addrs: list[str] = []
         self._maintenance: list[asyncio.Task] = []
+        # provide() rate-limit memo: key -> (t, fingerprint, accepted).
+        self._last_provide: dict[bytes, tuple] = {}
         host.set_stream_handler(KAD_PROTOCOL, self._handle_stream)
 
     # ------------------------------------------------------------- liveness
@@ -502,20 +504,37 @@ class DHTNode:
         )[:K]
         return out
 
-    async def provide(self, key: bytes) -> int:
+    async def provide(self, key: bytes, min_interval: float = 0.0) -> int:
         """Advertise self as provider for key on the K closest nodes.
 
         cf. peer.go:409-447 (PublishMetadata → DHT.Provide).  Also stores
         locally so single-node and two-node topologies resolve.  Returns the
         number of remote nodes that accepted the record.
-        """
+
+        ``min_interval`` rate-limits the NETWORK side: a re-provide of the
+        same key is skipped while the last one is younger than this AND
+        nothing that invalidates the published record changed (our own
+        contact — relay failover/upgrade changes it — or the routing-table
+        size, i.e. membership).  The reference's 1 s advertise ticker goes
+        through libp2p's Advertise, which also only re-publishes on TTL
+        expiry internally — a literal provide-per-tick is O(N x K) streams
+        per second swarm-wide against a 30-minute TTL (the round-3
+        16-worker scaling cliff's dominant chatter term)."""
         me = self.host.contact
         if self.server_mode:
             self.providers.add(key, me)
+        fingerprint = (me.host, me.port, me.relay, len(self.table))
+        if min_interval:
+            prev = self._last_provide.get(key)
+            if (prev is not None and prev[1] == fingerprint
+                    and time.monotonic() - prev[0] < min_interval):
+                return prev[2]
         targets = await self.lookup(key)
         payload = {"op": "add_provider", "key": key.hex(), "provider": me.to_dict()}
         results = await asyncio.gather(*(self._rpc(c, payload) for c in targets))
-        return sum(1 for r in results if r and r.get("ok"))
+        accepted = sum(1 for r in results if r and r.get("ok"))
+        self._last_provide[key] = (time.monotonic(), fingerprint, accepted)
+        return accepted
 
     async def find_providers(self, key: bytes, limit: int = 10) -> list[Contact]:
         """Iterative GET_PROVIDERS (cf. discovery.go:332-366, limit 10)."""

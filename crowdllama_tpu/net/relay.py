@@ -388,13 +388,21 @@ async def dialback_probe(host: Host, relay_addr: str) -> bool:
     """Ask the relay whether this host's listen port is reachable from it.
 
     The probe stream advertises our real listen_port (hellos must stay
-    dialable during the probe even if we later decide to relay)."""
+    dialable during the probe even if we later decide to relay).
+
+    Raises when the remote REFUSES the probe (closed relay, no relay
+    support) — callers must be able to tell "the relay says my port is
+    unreachable" from "this relay can't answer", or a reachable auto-mode
+    worker behind a dead relay would flap into needless relaying."""
     stream = await host.new_stream(relay_addr, RELAY_PROTOCOL)
     try:
         await write_json_frame(stream.writer,
                                {"op": "dialback", "port": host.listen_port})
         reply = await read_json_frame(stream.reader,
                                       DIALBACK_TIMEOUT + ACCEPT_TIMEOUT)
-        return bool(reply.get("ok")) and bool(reply.get("reachable"))
+        if not reply.get("ok"):
+            raise RuntimeError(
+                f"dialback refused: {reply.get('error', 'not ok')}")
+        return bool(reply.get("reachable"))
     finally:
         stream.close()

@@ -453,12 +453,17 @@ class Peer:
         key so the record refreshes in place instead of accumulating.
         """
         await self.dht.reconnect_if_needed()
-        await self.dht.provide(metadata_key(self.host.peer_id.encode()))
+        await self.dht.provide(metadata_key(self.host.peer_id.encode()),
+                               min_interval=self.config.intervals.reprovide)
 
     async def _advertise(self) -> None:
-        """Provide the namespace rendezvous key (peer.go:450-504)."""
+        """Provide the namespace rendezvous key (peer.go:450-504).  The
+        tick stays fast (reconnect watch + membership/contact-change
+        detection inside provide()); the network re-provide is
+        rate-limited to ``intervals.reprovide``."""
         await self.dht.reconnect_if_needed()
-        await self.dht.provide(namespace_key())
+        await self.dht.provide(namespace_key(),
+                               min_interval=self.config.intervals.reprovide)
 
     # ------------------------------------------------------------- streams
 

@@ -146,12 +146,16 @@ class PeerManager:
         return info is not None and not info.is_healthy
 
     def skip_set(self) -> set[str]:
-        """Peers discovery should skip (unhealthy or quarantined),
-        cf. discovery.go:292."""
-        return (
-            {pid for pid, p in self.peers.items() if not p.is_healthy}
-            | set(self.recently_removed)
-        )
+        """Peers discovery should skip: EVERY known peer plus the
+        quarantine set (cf. discovery.go:292, which skips unhealthy).
+
+        Known-healthy peers are skipped too because their metadata is
+        already refreshed by the health loop (health_check_peer's live
+        fetch) — re-fetching it each discovery round made steady-state
+        control-plane streams O(N x providers) per round and was the
+        dominant chatter term in the 16-worker scaling cliff.  Discovery's
+        job here is finding NEW providers only."""
+        return set(self.peers) | set(self.recently_removed)
 
     # ------------------------------------------------------------ scheduler
 
@@ -240,10 +244,21 @@ class PeerManager:
                       self.config.max_failed_attempts, e)
             return False
 
+    #: Concurrent health probes per tick: each probe is a full
+    #: handshake-priced stream; an uncapped gather over a 16-peer table
+    #: bursts them all at once and spikes event-loop lag on small hosts.
+    _HEALTH_CONCURRENCY = 4
+
     async def perform_health_checks(self) -> None:
         now = time.monotonic()
+        sem = asyncio.Semaphore(self._HEALTH_CONCURRENCY)
+
+        async def probe(p):
+            async with sem:
+                await self.health_check_peer(p)
+
         await asyncio.gather(*(
-            self.health_check_peer(p)
+            probe(p)
             for p in list(self.peers.values())
             if p.next_check_at <= now
         ))

@@ -5,17 +5,33 @@ from __future__ import annotations
 import asyncio
 import inspect
 import logging
+import random
 from typing import Callable
+
+#: Default tick jitter fraction for swarm background loops.  Every peer in
+#: an N-node swarm runs the same advertise/publish/health/discovery
+#: cadences; without phase jitter the ticks synchronize (all N processes
+#: were started together in tests/benches, and drifting clocks re-align on
+#: long sleeps), producing N-wide bursts of handshake-heavy streams that
+#: spike event-loop lag — the round-3 16-worker scaling cliff's signature.
+DEFAULT_JITTER = 0.25
 
 
 async def run_every(interval: float, fn: Callable, log: logging.Logger,
-                    level: int = logging.ERROR) -> None:
+                    level: int = logging.ERROR,
+                    jitter: float = DEFAULT_JITTER) -> None:
     """Run ``fn`` (sync or async) every ``interval`` seconds forever.
 
     The single loop contract for every background service (peer publish /
     advertise / refresh, manager discovery / health / cleanup): errors are
     logged at ``level`` and never kill the loop; cancellation propagates.
+
+    ``jitter`` desynchronizes fleets: the first tick waits a random
+    fraction of the interval and every sleep is scaled by a per-tick
+    uniform factor in [1-jitter, 1+jitter].  Pass 0 for strict cadence.
     """
+    if jitter:
+        await asyncio.sleep(random.random() * interval * jitter)
     while True:
         try:
             result = fn()
@@ -26,4 +42,7 @@ async def run_every(interval: float, fn: Callable, log: logging.Logger,
         except Exception:
             log.log(level, "background loop error (%s)",
                     getattr(fn, "__name__", fn), exc_info=level >= logging.ERROR)
-        await asyncio.sleep(interval)
+        sleep = interval
+        if jitter:
+            sleep *= 1 + jitter * (2 * random.random() - 1)
+        await asyncio.sleep(sleep)
