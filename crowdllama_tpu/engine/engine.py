@@ -231,6 +231,16 @@ class JaxEngine(Engine):
         loop = asyncio.get_running_loop()
 
         def _build():
+            import jax
+
+            from crowdllama_tpu.engine.plan import resolve_serving_plan
+
+            # The composition matrix's single decision point
+            # (engine/plan.py; exhaustively swept by tests/test_matrix.py).
+            plan = resolve_serving_plan(self.config, len(jax.devices()))
+            for note in plan.notes:
+                log.warning("%s", note)
+
             params = load_or_init_params(cfg, self.config.model_path)
             if self.config.quantize:
                 from crowdllama_tpu.ops.quant import quantize_params
@@ -242,54 +252,26 @@ class JaxEngine(Engine):
                 max_slots=self.config.max_batch_slots,
                 max_seq=cfg.max_context_length,
             )
-            kv_layout = self.config.kv_layout
-            if kv_layout == "paged" and self.config.mesh_shape:
-                import jax
-
-                from crowdllama_tpu.parallel.mesh import parse_mesh_spec
-
-                dp, pp, sp, _ep, _tp = parse_mesh_spec(
-                    self.config.mesh_shape, len(jax.devices()))
-                if dp > 1 or pp > 1 or sp > 1:
-                    # The shared page pool cannot shard over dp, and sp/pp
-                    # need the contiguous layout — honor the mesh request
-                    # rather than crash on the paged default.
-                    if (self.config.spec_decode == "ngram"
-                            and self.config.kv_dtype != "bf16"):
-                        # Downgrading would silently build a contiguous
-                        # spec runner that ignores the int8 KV request
-                        # (contiguous spec is bf16-only) — refuse loudly.
-                        raise ValueError(
-                            f"spec_decode + kv_dtype=int8 needs the paged "
-                            f"layout, which does not compose with mesh "
-                            f"{self.config.mesh_shape} (dp/sp/pp > 1); "
-                            f"drop one of spec_decode / int8 KV / the mesh")
-                    log.warning(
-                        "kv_layout=paged does not compose with mesh %s "
-                        "(dp/sp/pp > 1); using the contiguous layout",
-                        self.config.mesh_shape)
-                    kv_layout = "contiguous"
-            if kv_layout == "paged":
-                paged_kwargs = dict(
+            if plan.kv_layout == "paged":
+                kwargs.update(
                     page_size=self.config.kv_page_size,
                     pool_tokens=self.config.kv_pool_tokens,
                     prefix_cache=self.config.kv_prefix_cache,
-                    kv_dtype=self.config.kv_dtype, **kwargs)
-                if self.config.spec_decode == "ngram":
+                    kv_dtype=plan.kv_dtype)
+                if plan.runner == "SpecPagedModelRunner":
                     from crowdllama_tpu.engine.spec import SpecPagedModelRunner
 
                     return SpecPagedModelRunner(
-                        cfg, draft_len=self.config.spec_draft,
-                        **paged_kwargs)
+                        cfg, draft_len=self.config.spec_draft, **kwargs)
                 from crowdllama_tpu.engine.paged import PagedModelRunner
 
-                return PagedModelRunner(cfg, **paged_kwargs)
-            if self.config.spec_decode == "ngram":
+                return PagedModelRunner(cfg, **kwargs)
+            if plan.runner == "SpecModelRunner":
                 from crowdllama_tpu.engine.spec import SpecModelRunner
 
                 return SpecModelRunner(
                     cfg, draft_len=self.config.spec_draft, **kwargs)
-            return ModelRunner(cfg, kv_dtype=self.config.kv_dtype, **kwargs)
+            return ModelRunner(cfg, kv_dtype=plan.kv_dtype, **kwargs)
 
         self._runner = await loop.run_in_executor(None, _build)
         if self.config.warmup:

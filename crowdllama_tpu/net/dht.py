@@ -533,7 +533,13 @@ class DHTNode:
         payload = {"op": "add_provider", "key": key.hex(), "provider": me.to_dict()}
         results = await asyncio.gather(*(self._rpc(c, payload) for c in targets))
         accepted = sum(1 for r in results if r and r.get("ok"))
-        self._last_provide[key] = (time.monotonic(), fingerprint, accepted)
+        if accepted or not targets:
+            # Don't memoize a rejected-everywhere provide (dialable nodes
+            # that answered ok=false keep the fingerprint unchanged): the
+            # record exists on no remote node, so the next tick must retry
+            # instead of serving the cached zero for min_interval.
+            self._last_provide[key] = (time.monotonic(), fingerprint,
+                                       accepted)
         return accepted
 
     async def find_providers(self, key: bytes, limit: int = 10) -> list[Contact]:

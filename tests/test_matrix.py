@@ -1,0 +1,125 @@
+"""Feature composition matrix (VERDICT r3 #7): every cell of
+layout × kv_dtype × quantize × spec × mesh-kind either serves, falls back
+LOUDLY, or errors — exactly as `crowdllama_tpu/engine/plan.py` (the
+engine's real decision path) declares.
+
+The oracle below restates the composition rules independently of plan.py,
+so a rule change must be made twice deliberately (code + test) and the
+README table regenerated (`python -m crowdllama_tpu.engine.plan`).
+"""
+
+import pytest
+
+from crowdllama_tpu.config import Configuration
+from crowdllama_tpu.engine.plan import (
+    MESH_KINDS,
+    render_markdown,
+    resolve_serving_plan,
+    sweep,
+)
+
+AXES = [
+    (mesh_kind, mesh, layout, kv_dtype, quantize, spec)
+    for mesh_kind, mesh in MESH_KINDS
+    for layout in ("paged", "contiguous")
+    for kv_dtype in ("bf16", "int8")
+    for quantize in ("", "int8")
+    for spec in ("", "ngram")
+]
+
+
+def expected(mesh_kind, layout, kv_dtype, spec):
+    """Independent restatement of the matrix rules.
+
+    Returns ("ok"|"fallback", runner_name) or ("error", None).
+    Weight quantization composes with every cell (not part of the oracle).
+    """
+    sharded_kv = mesh_kind in ("dp", "pp", "sp")  # axes the pool can't use
+    if layout == "contiguous" or sharded_kv:
+        # Effective layout is contiguous (paged falls back on dp/pp/sp).
+        if spec == "ngram" and kv_dtype == "int8":
+            return ("error", None)  # contiguous spec needs the bf16 cache
+        if mesh_kind in ("pp", "sp"):
+            if kv_dtype == "int8" or spec == "ngram":
+                return ("error", None)
+        runner = "SpecModelRunner" if spec == "ngram" else "ModelRunner"
+        status = "fallback" if (layout == "paged" and sharded_kv) else "ok"
+        return (status, runner)
+    runner = "SpecPagedModelRunner" if spec == "ngram" else "PagedModelRunner"
+    return ("ok", runner)
+
+
+@pytest.mark.parametrize(
+    "mesh_kind,mesh,layout,kv_dtype,quantize,spec", AXES,
+    ids=[f"{m}-{l}-{k}-{q or 'bf16'}-{s or 'nospec'}"
+         for m, _, l, k, q, s in AXES])
+def test_matrix_cell(mesh_kind, mesh, layout, kv_dtype, quantize, spec):
+    want_status, want_runner = expected(mesh_kind, layout, kv_dtype, spec)
+    try:
+        cfg = Configuration.from_environment(
+            kv_layout=layout, kv_dtype=kv_dtype, quantize=quantize,
+            spec_decode=spec, mesh_shape=mesh)
+        plan = resolve_serving_plan(cfg, n_devices=8)
+    except ValueError:
+        assert want_status == "error", (
+            f"unexpected startup error for {mesh_kind}/{layout}/"
+            f"{kv_dtype}/{spec}")
+        return
+    assert want_status != "error", (
+        f"{mesh_kind}/{layout}/{kv_dtype}/{spec} must refuse, got {plan}")
+    assert plan.runner == want_runner
+    assert (plan.fallback) == (want_status == "fallback")
+    if plan.fallback:
+        # Loud: the note names the mesh and the fallback layout.
+        assert plan.kv_layout == "contiguous" and plan.notes
+    else:
+        assert plan.kv_layout == layout
+    assert plan.kv_dtype == kv_dtype and plan.quantize == quantize
+
+
+@pytest.mark.parametrize("runner_name,mesh_spec,kv_dtype", [
+    ("SpecModelRunner", "2x1x1x1x1", "bf16"),      # spec on dp2
+    ("SpecPagedModelRunner", "2", "int8"),          # paged spec on tp2
+])
+def test_matrix_promises_construct_and_decode(runner_name, mesh_spec,
+                                              kv_dtype):
+    """Cells the matrix marks ✓ that no other suite constructs must really
+    serve — a README promise that fails at runtime is exactly what this
+    matrix exists to prevent."""
+    import jax
+    import jax.numpy as jnp
+
+    from crowdllama_tpu.engine.spec import (
+        SpecModelRunner,
+        SpecPagedModelRunner,
+    )
+    from crowdllama_tpu.models.config import get_config
+
+    cls = {"SpecModelRunner": SpecModelRunner,
+           "SpecPagedModelRunner": SpecPagedModelRunner}[runner_name]
+    cfg = get_config("tiny-test", max_context_length=128)
+    kw = dict(max_slots=2, max_seq=128, mesh_spec=mesh_spec,
+              draft_len=3)
+    if cls is SpecPagedModelRunner:
+        kw.update(page_size=32, kv_dtype=kv_dtype)
+    else:
+        kw.update(dtype=jnp.float32)
+    r = cls(cfg, **kw)
+    st = r.init_state()
+    prompt = [5, 9, 5, 9, 5]
+    t, ks, vs, plen = r.prefill(prompt, 0.0, 1.0, jax.random.PRNGKey(0))
+    st = r.insert(st, 0, ks, vs, plen, t, 0.0, 1.0, prompt_tokens=prompt)
+    packed, st = r.decode_steps(st, 4)
+    # [K, 1 + J, B]: count row + (pending + draft_len) emit rows.
+    assert packed.shape[0] == 4 and packed.shape[1] == 1 + (1 + 3)
+    assert int(packed[0, 0, 0]) >= 1  # slot 0 emitted at least the pending
+
+
+def test_sweep_covers_every_cell_and_renders():
+    cells = list(sweep())
+    assert len(cells) == len(AXES) == 80
+    table = render_markdown()
+    # Every outcome kind appears and the table has one row per cell.
+    assert table.count("\n") == 81  # header + separator + 80 rows
+    for marker in ("✓", "⚠", "✗"):
+        assert marker in table
