@@ -6,8 +6,11 @@ Counterpart of /root/reference/cmd/crowdllama/main.go: one binary, two roles —
 plain ``start`` runs a consumer (gateway HTTP server) (main.go:184-190);
 optional IPC server from config/env (main.go:133-143); periodic stats logging
 (main.go:391-427); SIGINT/SIGTERM graceful shutdown (main.go:450-460).
-The reference's embedded Ollama CLI has no counterpart: the engine is
-in-process JAX, so there is nothing to embed or shell out to.
+The reference's embedded Ollama CLI surface (main.go:49-78) maps to native
+subcommands: ``run`` (streaming chat), ``pull`` (swarm checkpoint fetch),
+``list`` / ``show`` / ``rm`` (local checkpoint management; ``list
+--gateway`` for the swarm view) — the engine is in-process JAX, so there
+is nothing to embed or shell out to.
 """
 
 from __future__ import annotations
@@ -68,6 +71,20 @@ def build_parser() -> argparse.ArgumentParser:
     pull.add_argument("--models-dir", default="",
                       help="destination root (default ~/.crowdllama-tpu/models)")
     pull.add_argument("--key-path", default="")
+    # Model management (the reference rides the embedded Ollama CLI's
+    # list/show/rm, cmd/crowdllama/main.go:49-78).
+    lst = sub.add_parser("list", help="list local checkpoints (or the "
+                                      "swarm's models with --gateway)")
+    lst.add_argument("--models-dir", default="")
+    lst.add_argument("--gateway", default="",
+                     help="query this gateway's /api/tags instead")
+    show = sub.add_parser("show", help="model config + local checkpoint "
+                                       "details")
+    show.add_argument("model")
+    show.add_argument("--models-dir", default="")
+    rm = sub.add_parser("rm", help="delete a local pulled checkpoint")
+    rm.add_argument("model")
+    rm.add_argument("--models-dir", default="")
     return p
 
 
@@ -89,6 +106,12 @@ def main(argv: list[str] | None = None) -> int:
             return asyncio.run(_pull(args))
         except KeyboardInterrupt:
             return 1
+    if args.command == "list":
+        return asyncio.run(_list(args)) if args.gateway else _list_local(args)
+    if args.command == "show":
+        return _show(args)
+    if args.command == "rm":
+        return _rm(args)
     if args.command == "start":
         cfg = Configuration.from_flags(args)
         new_app_logger("crowdllama", cfg.verbose)
@@ -147,6 +170,121 @@ async def _pull(args) -> int:
         return 1
     finally:
         await host.close()
+
+
+def _models_root(args):
+    from pathlib import Path
+
+    cfg = Configuration.from_environment()
+    return Path(args.models_dir or cfg.models_dir).expanduser()
+
+
+def _dir_size(d) -> int:
+    return sum(p.stat().st_size for p in d.rglob("*") if p.is_file())
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{n} B"
+        n /= 1024
+    return f"{n:.1f} GiB"
+
+
+def _list_local(args) -> int:
+    """``list`` — local checkpoints under the models dir (the reference's
+    embedded `ollama list`, cmd/crowdllama/main.go:49-78)."""
+    root = _models_root(args)
+    rows = []
+    if root.is_dir():
+        for d in sorted(root.iterdir()):
+            if d.is_dir() and not d.name.endswith(".partial"):
+                st = list(d.glob("*.safetensors"))
+                if st:
+                    rows.append((d.name, _fmt_bytes(_dir_size(d)), len(st)))
+    if not rows:
+        print(f"no local checkpoints under {root}")
+        return 0
+    w = max(len(r[0]) for r in rows)
+    print(f"{'NAME'.ljust(w)}  SIZE        SHARDS")
+    for name, size, shards in rows:
+        print(f"{name.ljust(w)}  {size:<10}  {shards}")
+    return 0
+
+
+async def _list(args) -> int:
+    """``list --gateway`` — the swarm's served models via /api/tags."""
+    import aiohttp
+
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{args.gateway}/api/tags",
+                             timeout=aiohttp.ClientTimeout(total=5)) as resp:
+                body = await resp.json()
+    except Exception as e:
+        print(f"gateway unreachable: {e}", file=sys.stderr)
+        return 1
+    models = body.get("models", [])
+    if not models:
+        print("no models served by the swarm")
+        return 0
+    for m in models:
+        print(m.get("name", m.get("model", "?")))
+    return 0
+
+
+def _show(args) -> int:
+    """``show MODEL`` — registry config + local checkpoint details."""
+    from crowdllama_tpu.models.config import get_config, list_models
+    from crowdllama_tpu.net.model_share import dest_under_root
+
+    try:
+        cfg = get_config(args.model)
+    except KeyError:
+        cfg = None
+    if cfg is not None:
+        print(f"model:        {cfg.name} (family {cfg.family})")
+        print(f"layers:       {cfg.num_layers}")
+        print(f"hidden:       {cfg.hidden_size} "
+              f"(heads {cfg.num_heads}/{cfg.num_kv_heads} kv)")
+        print(f"context:      {cfg.max_context_length}")
+        if cfg.is_moe:
+            print(f"experts:      {cfg.num_experts} "
+                  f"(top-{cfg.num_experts_per_tok})")
+    else:
+        print(f"model:        {args.model} (not in the builtin registry; "
+              f"known: {', '.join(list_models())})")
+    try:
+        d = dest_under_root(_models_root(args), args.model)
+    except ValueError as e:
+        print(f"invalid model name: {e}", file=sys.stderr)
+        return 1
+    if d.is_dir() and list(d.glob("*.safetensors")):
+        print(f"checkpoint:   {d} ({_fmt_bytes(_dir_size(d))})")
+    else:
+        print("checkpoint:   none local (use `crowdllama-tpu pull`)")
+    return 0
+
+
+def _rm(args) -> int:
+    """``rm MODEL`` — delete a local pulled checkpoint (name-validated and
+    containment-checked like every other models-dir path)."""
+    import shutil
+
+    from crowdllama_tpu.net.model_share import dest_under_root
+
+    try:
+        d = dest_under_root(_models_root(args), args.model)
+    except ValueError as e:
+        print(f"invalid model name: {e}", file=sys.stderr)
+        return 1
+    if not d.is_dir():
+        print(f"no local checkpoint for {args.model!r} under {d.parent}",
+              file=sys.stderr)
+        return 1
+    shutil.rmtree(d)
+    print(f"removed {d}")
+    return 0
 
 
 async def _network_status(gateway: str) -> int:

@@ -129,8 +129,12 @@ class Configuration:
     # bootstrap node's dialback and relays only when unreachable; "always"
     # forces relaying (tests / known-NATed deployments); "off" disables.
     relay_mode: str = "auto"
-    spec_decode: str = ""  # "" | "ngram" speculative decode (engine/spec.py)
+    # "" | "ngram" (prompt-lookup drafts) | "draft" (a small draft MODEL
+    # proposes tokens; paged layout only) — engine/spec.py.
+    spec_decode: str = ""
     spec_draft: int = 4  # draft tokens per verify step
+    spec_draft_model: str = ""  # draft model registry name (spec "draft")
+    spec_draft_path: str = ""   # draft checkpoint dir (random-init if empty)
     drain_timeout: float = 30.0  # graceful-shutdown grace for in-flight reqs
     # Directory for jax.profiler traces; empty disables the profile surface
     # (SURVEY §5: "TPU build: JAX profiler traces + per-request timing").
@@ -195,6 +199,10 @@ class Configuration:
                                   cfg.spec_decode)
         cfg.spec_draft = int(env.get("CROWDLLAMA_TPU_SPEC_DRAFT",
                                      cfg.spec_draft))
+        cfg.spec_draft_model = env.get("CROWDLLAMA_TPU_SPEC_DRAFT_MODEL",
+                                       cfg.spec_draft_model)
+        cfg.spec_draft_path = env.get("CROWDLLAMA_TPU_SPEC_DRAFT_PATH",
+                                      cfg.spec_draft_path)
         cfg.drain_timeout = float(env.get("CROWDLLAMA_TPU_DRAIN_TIMEOUT",
                                           cfg.drain_timeout))
         cfg.profile_dir = env.get("CROWDLLAMA_TPU_PROFILE_DIR", cfg.profile_dir)
@@ -227,9 +235,9 @@ class Configuration:
             raise ValueError(f"unknown relay_mode {cfg.relay_mode!r} "
                              "(want 'auto', 'always' or 'off')")
         cfg.spec_decode = (cfg.spec_decode or "").strip().lower()
-        if cfg.spec_decode not in ("", "ngram"):
+        if cfg.spec_decode not in ("", "ngram", "draft"):
             raise ValueError(f"unknown spec_decode {cfg.spec_decode!r} "
-                             "(want '' or 'ngram')")
+                             "(want '', 'ngram' or 'draft')")
         if cfg.spec_decode:
             # Spec composes with BOTH layouts (VERDICT r3 #4): paged runs
             # SpecPagedModelRunner (bf16 or int8 pools); contiguous still
@@ -242,6 +250,16 @@ class Configuration:
                     "(paged spec verifies against int8 pools)")
             if cfg.spec_draft < 1:
                 raise ValueError("spec_draft must be >= 1")
+        if cfg.spec_decode == "draft":
+            if not cfg.spec_draft_model:
+                raise ValueError(
+                    "spec_decode=draft needs --spec-draft-model (the small "
+                    "model that proposes tokens)")
+            if cfg.kv_layout != "paged":
+                raise ValueError(
+                    "draft-model speculation runs on the paged layout only "
+                    "(the serving default); drop --kv-layout contiguous or "
+                    "use spec_decode=ngram")
         return cfg
 
     @staticmethod
@@ -289,10 +307,15 @@ class Configuration:
                             help="NAT relay through the bootstrap node "
                                  "(auto: only when unreachable)")
         parser.add_argument("--spec-decode", dest="spec_decode",
-                            choices=("", "ngram"),
-                            help="speculative decoding (ngram prompt lookup)")
+                            choices=("", "ngram", "draft"),
+                            help="speculative decoding: ngram prompt lookup "
+                                 "or a small draft model")
         parser.add_argument("--spec-draft", dest="spec_draft", type=int,
                             help="draft tokens per speculative verify step")
+        parser.add_argument("--spec-draft-model", dest="spec_draft_model",
+                            help="draft model name (spec_decode=draft)")
+        parser.add_argument("--spec-draft-path", dest="spec_draft_path",
+                            help="draft model checkpoint dir")
         parser.add_argument("--profile-dir", dest="profile_dir",
                             help="enable jax.profiler captures into this dir")
 
@@ -306,6 +329,7 @@ class Configuration:
                 "shard_group", "shard_index", "shard_count", "shard_strategy",
                 "quantize", "kv_layout", "kv_page_size", "kv_pool_tokens",
                 "kv_dtype", "relay_mode", "spec_decode", "spec_draft",
+                "spec_draft_model", "spec_draft_path",
                 "profile_dir",
             )
         }

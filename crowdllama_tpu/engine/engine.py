@@ -258,6 +258,22 @@ class JaxEngine(Engine):
                     pool_tokens=self.config.kv_pool_tokens,
                     prefix_cache=self.config.kv_prefix_cache,
                     kv_dtype=plan.kv_dtype)
+                if plan.runner == "DraftSpecPagedModelRunner":
+                    from crowdllama_tpu.engine.spec import (
+                        DraftSpecPagedModelRunner,
+                    )
+                    from crowdllama_tpu.models.config import get_config
+
+                    draft_cfg = get_config(
+                        self.config.spec_draft_model,
+                        max_context_length=cfg.max_context_length)
+                    draft_params = None
+                    if self.config.spec_draft_path:
+                        draft_params = load_or_init_params(
+                            draft_cfg, self.config.spec_draft_path)
+                    return DraftSpecPagedModelRunner(
+                        cfg, draft_cfg=draft_cfg, draft_params=draft_params,
+                        draft_len=self.config.spec_draft, **kwargs)
                 if plan.runner == "SpecPagedModelRunner":
                     from crowdllama_tpu.engine.spec import SpecPagedModelRunner
 
@@ -350,12 +366,20 @@ class JaxEngine(Engine):
                 "tokens_reused": self._runner.prefix_tokens_reused,
             }
         if self.scheduler is not None and self.scheduler.spec_steps:
+            steps = self.scheduler.spec_steps
+            emitted = self.scheduler.spec_emitted
             d["spec_decode"] = {
-                "verify_steps": self.scheduler.spec_steps,
-                "tokens_emitted": self.scheduler.spec_emitted,
-                "tokens_per_step": round(
-                    self.scheduler.spec_emitted / self.scheduler.spec_steps, 2),
+                "mode": self.config.spec_decode,
+                "verify_steps": steps,
+                "tokens_emitted": emitted,
+                "tokens_per_step": round(emitted / steps, 2),
+                # Fraction of offered draft tokens the verifier accepted.
+                "acceptance_rate": round(
+                    max(0, emitted - steps)
+                    / (steps * max(1, self.config.spec_draft)), 3),
             }
+            if self.config.spec_decode == "draft":
+                d["spec_decode"]["draft_model"] = self.config.spec_draft_model
         return d
 
     async def capture_profile(self, seconds: float = 3.0) -> str:

@@ -90,13 +90,18 @@ class PagedDecodeState:
     # Speculative decoding only (engine/spec.py SpecPagedModelRunner):
     # device-side token history [B, S] — the n-gram draft source.
     hist: jnp.ndarray | None = None
+    # Draft-model speculation only (DraftSpecPagedModelRunner): the draft
+    # model's own contiguous KV cache [Ld, B, Hkvd, S, Dhd].
+    draft_k: jnp.ndarray | None = None
+    draft_v: jnp.ndarray | None = None
 
 
 jax.tree_util.register_dataclass(
     PagedDecodeState,
     data_fields=["pool_k", "pool_v", "seq_lens", "tokens", "active",
                  "temperature", "top_p", "top_k", "repeat_penalty",
-                 "recent", "keys", "k_scale", "v_scale", "hist"],
+                 "recent", "keys", "k_scale", "v_scale", "hist",
+                 "draft_k", "draft_v"],
     meta_fields=[],
 )
 
@@ -264,7 +269,7 @@ class PagedModelRunner(ModelRunner):
             repeat_penalty=state.repeat_penalty.at[slot].set(repeat_penalty),
             recent=state.recent.at[slot].set(recent_row),
             keys=state.keys.at[slot].set(slot_key),
-            hist=state.hist,
+            hist=state.hist, draft_k=state.draft_k, draft_v=state.draft_v,
         )
 
     def _release_paged_impl(self, state: PagedDecodeState, slot):
@@ -277,6 +282,7 @@ class PagedModelRunner(ModelRunner):
             temperature=state.temperature, top_p=state.top_p,
             top_k=state.top_k, repeat_penalty=state.repeat_penalty,
             recent=state.recent, keys=state.keys, hist=state.hist,
+            draft_k=state.draft_k, draft_v=state.draft_v,
         )
 
     def _prefill_ctx_impl(self, params, tokens, slen, ctx_len, pool_k, pool_v,
@@ -626,6 +632,7 @@ class PagedModelRunner(ModelRunner):
                 temperature=st.temperature, top_p=st.top_p,
                 top_k=st.top_k, repeat_penalty=st.repeat_penalty,
                 recent=recent, keys=carry, hist=st.hist,
+                draft_k=st.draft_k, draft_v=st.draft_v,
             )
             return new_state, next_tokens
 

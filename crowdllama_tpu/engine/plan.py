@@ -54,6 +54,11 @@ def resolve_serving_plan(config, n_devices: int) -> ServingPlan:
     if kv_layout == "paged" and (dp > 1 or pp > 1 or sp > 1):
         # The shared page pool cannot shard over dp (pages belong to no
         # fixed slot) and sp/pp operate on the contiguous layout.
+        if spec == "draft":
+            raise ValueError(
+                f"draft-model speculation needs the paged layout, which "
+                f"does not compose with mesh {config.mesh_shape} "
+                f"(dp/sp/pp > 1)")
         if spec == "ngram" and config.kv_dtype != "bf16":
             # Downgrading would silently build a contiguous spec runner
             # that ignores the int8 KV request (contiguous spec is
@@ -69,6 +74,12 @@ def resolve_serving_plan(config, n_devices: int) -> ServingPlan:
         kv_layout = "contiguous"
 
     if kv_layout == "contiguous":
+        if spec == "draft":
+            # Normally rejected by Configuration validation; engines built
+            # from raw Configuration objects must still get the refusal,
+            # not a KeyError (plan.py is the single decision point).
+            raise ValueError(
+                "draft-model speculation runs on the paged layout only")
         if config.kv_dtype == "int8" and (pp > 1 or sp > 1):
             raise ValueError(
                 "int8 KV cache does not compose with sp/pp meshes yet")
@@ -79,6 +90,7 @@ def resolve_serving_plan(config, n_devices: int) -> ServingPlan:
     runner = {
         ("paged", ""): "PagedModelRunner",
         ("paged", "ngram"): "SpecPagedModelRunner",
+        ("paged", "draft"): "DraftSpecPagedModelRunner",
         ("contiguous", ""): "ModelRunner",
         ("contiguous", "ngram"): "SpecModelRunner",
     }[(kv_layout, spec)]
@@ -112,7 +124,7 @@ def sweep(n_devices: int = 8):
         for layout in ("paged", "contiguous"):
             for kv_dtype in ("bf16", "int8"):
                 for quantize in ("", "int8"):
-                    for spec in ("", "ngram"):
+                    for spec in ("", "ngram", "draft"):
                         axes = dict(mesh_kind=mesh_kind, mesh=mesh,
                                     layout=layout, kv_dtype=kv_dtype,
                                     quantize=quantize, spec=spec)
@@ -120,6 +132,8 @@ def sweep(n_devices: int = 8):
                             cfg = Configuration.from_environment(
                                 kv_layout=layout, kv_dtype=kv_dtype,
                                 quantize=quantize, spec_decode=spec,
+                                spec_draft_model=(
+                                    "tiny-test" if spec == "draft" else ""),
                                 mesh_shape=mesh)
                             plan = resolve_serving_plan(cfg, n_devices)
                         except ValueError as e:

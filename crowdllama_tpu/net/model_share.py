@@ -80,9 +80,10 @@ def safe_model_dirname(model: str) -> str:
     return "_".join(segs)
 
 
-def _dest_under_root(dest_root: str | Path, model: str) -> Path:
+def dest_under_root(dest_root: str | Path, model: str) -> Path:
     """``dest_root/<flattened model>`` with a belt-and-braces containment
-    assert (the dirname is already regex-validated)."""
+    assert (the dirname is already regex-validated).  The one resolver for
+    models-dir paths — fetch, rm, show all go through it."""
     root = Path(dest_root).expanduser().resolve()
     dest = (root / safe_model_dirname(model)).resolve()
     if dest.parent != root or dest == root:
@@ -223,7 +224,7 @@ async def fetch_model(host: Host, source: Contact, model: str,
     but corrupt checkpoint.  The model name is validated (it may come from
     an untrusted peer via the ``pull`` op) so ``dest`` can never resolve to
     the models root or escape it."""
-    dest = _dest_under_root(dest_root, model)
+    dest = dest_under_root(dest_root, model)
     staging = dest.with_name(dest.name + ".partial")
     if staging.exists():
         # A dirty staging dir from an aborted pull must not leak stale

@@ -36,6 +36,35 @@ def test_start_flags_parse():
     assert cfg.gateway_port == 9005
 
 
+def test_model_management_commands(tmp_path, capsys):
+    """list/show/rm against a local models dir (the reference rides the
+    embedded Ollama CLI's list/show/rm, cmd/crowdllama/main.go:49-78)."""
+    root = tmp_path / "models"
+    ck = root / "tiny-test"
+    ck.mkdir(parents=True)
+    (ck / "model.safetensors").write_bytes(b"x" * 2048)
+    (ck / "config.json").write_text("{}")
+    (root / "leftover.partial").mkdir()  # staging dirs must not list
+
+    assert main(["list", "--models-dir", str(root)]) == 0
+    out = capsys.readouterr().out
+    assert "tiny-test" in out and "leftover" not in out
+
+    assert main(["show", "tiny-test", "--models-dir", str(root)]) == 0
+    out = capsys.readouterr().out
+    assert "family llama" in out and str(ck) in out
+
+    # rm validates names (no traversal) and deletes only real checkpoints.
+    assert main(["rm", "..", "--models-dir", str(root)]) == 1
+    assert main(["rm", "absent", "--models-dir", str(root)]) == 1
+    capsys.readouterr()
+    assert main(["rm", "tiny-test", "--models-dir", str(root)]) == 0
+    assert not ck.exists() and root.exists()
+
+    assert main(["list", "--models-dir", str(root)]) == 0
+    assert "no local checkpoints" in capsys.readouterr().out
+
+
 def test_env_layering(monkeypatch):
     monkeypatch.setenv("CROWDLLAMA_TPU_MODEL", "mixtral-8x7b")
     monkeypatch.setenv("CROWDLLAMA_TPU_BOOTSTRAP_PEERS", "a:1, b:2 ,")

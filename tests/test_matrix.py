@@ -24,7 +24,7 @@ AXES = [
     for layout in ("paged", "contiguous")
     for kv_dtype in ("bf16", "int8")
     for quantize in ("", "int8")
-    for spec in ("", "ngram")
+    for spec in ("", "ngram", "draft")
 ]
 
 
@@ -35,6 +35,8 @@ def expected(mesh_kind, layout, kv_dtype, spec):
     Weight quantization composes with every cell (not part of the oracle).
     """
     sharded_kv = mesh_kind in ("dp", "pp", "sp")  # axes the pool can't use
+    if spec == "draft" and (layout != "paged" or sharded_kv):
+        return ("error", None)  # draft speculation is paged-only
     if layout == "contiguous" or sharded_kv:
         # Effective layout is contiguous (paged falls back on dp/pp/sp).
         if spec == "ngram" and kv_dtype == "int8":
@@ -45,7 +47,9 @@ def expected(mesh_kind, layout, kv_dtype, spec):
         runner = "SpecModelRunner" if spec == "ngram" else "ModelRunner"
         status = "fallback" if (layout == "paged" and sharded_kv) else "ok"
         return (status, runner)
-    runner = "SpecPagedModelRunner" if spec == "ngram" else "PagedModelRunner"
+    runner = {"ngram": "SpecPagedModelRunner",
+              "draft": "DraftSpecPagedModelRunner",
+              "": "PagedModelRunner"}[spec]
     return ("ok", runner)
 
 
@@ -58,7 +62,9 @@ def test_matrix_cell(mesh_kind, mesh, layout, kv_dtype, quantize, spec):
     try:
         cfg = Configuration.from_environment(
             kv_layout=layout, kv_dtype=kv_dtype, quantize=quantize,
-            spec_decode=spec, mesh_shape=mesh)
+            spec_decode=spec,
+            spec_draft_model="tiny-test" if spec == "draft" else "",
+            mesh_shape=mesh)
         plan = resolve_serving_plan(cfg, n_devices=8)
     except ValueError:
         assert want_status == "error", (
@@ -80,6 +86,7 @@ def test_matrix_cell(mesh_kind, mesh, layout, kv_dtype, quantize, spec):
 @pytest.mark.parametrize("runner_name,mesh_spec,kv_dtype", [
     ("SpecModelRunner", "2x1x1x1x1", "bf16"),      # spec on dp2
     ("SpecPagedModelRunner", "2", "int8"),          # paged spec on tp2
+    ("DraftSpecPagedModelRunner", "2", "bf16"),     # draft spec on tp2
 ])
 def test_matrix_promises_construct_and_decode(runner_name, mesh_spec,
                                               kv_dtype):
@@ -90,17 +97,23 @@ def test_matrix_promises_construct_and_decode(runner_name, mesh_spec,
     import jax.numpy as jnp
 
     from crowdllama_tpu.engine.spec import (
+        DraftSpecPagedModelRunner,
         SpecModelRunner,
         SpecPagedModelRunner,
     )
     from crowdllama_tpu.models.config import get_config
 
     cls = {"SpecModelRunner": SpecModelRunner,
-           "SpecPagedModelRunner": SpecPagedModelRunner}[runner_name]
+           "SpecPagedModelRunner": SpecPagedModelRunner,
+           "DraftSpecPagedModelRunner": DraftSpecPagedModelRunner}[
+        runner_name]
     cfg = get_config("tiny-test", max_context_length=128)
     kw = dict(max_slots=2, max_seq=128, mesh_spec=mesh_spec,
               draft_len=3)
-    if cls is SpecPagedModelRunner:
+    if cls is DraftSpecPagedModelRunner:
+        kw.update(page_size=32, kv_dtype=kv_dtype,
+                  draft_cfg=get_config("tiny-test", max_context_length=128))
+    elif cls is SpecPagedModelRunner:
         kw.update(page_size=32, kv_dtype=kv_dtype)
     else:
         kw.update(dtype=jnp.float32)
@@ -117,9 +130,9 @@ def test_matrix_promises_construct_and_decode(runner_name, mesh_spec,
 
 def test_sweep_covers_every_cell_and_renders():
     cells = list(sweep())
-    assert len(cells) == len(AXES) == 80
+    assert len(cells) == len(AXES) == 120
     table = render_markdown()
     # Every outcome kind appears and the table has one row per cell.
-    assert table.count("\n") == 81  # header + separator + 80 rows
+    assert table.count("\n") == 121  # header + separator + 120 rows
     for marker in ("✓", "⚠", "✗"):
         assert marker in table

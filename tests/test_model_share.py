@@ -32,7 +32,7 @@ def test_model_name_validation(tmp_path):
     models root — '.' or '..' would make fetch_model's promote-step rmtree
     delete the whole models dir (ADVICE r3, high)."""
     from crowdllama_tpu.net.model_share import (
-        _dest_under_root,
+        dest_under_root,
         safe_model_dirname,
     )
 
@@ -47,10 +47,10 @@ def test_model_name_validation(tmp_path):
 
     root = tmp_path / "models"
     root.mkdir()
-    dest = _dest_under_root(root, "org/name")
+    dest = dest_under_root(root, "org/name")
     assert dest.parent == root.resolve() and dest.name == "org_name"
     with pytest.raises(ValueError):
-        _dest_under_root(root, "..")
+        dest_under_root(root, "..")
 
 
 async def test_pull_op_gating(tiny_checkpoint, tmp_path):
