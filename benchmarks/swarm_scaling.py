@@ -115,13 +115,16 @@ async def run() -> dict:
         async with aiohttp.ClientSession() as session:
             for size in sizes:
                 t_grow = time.monotonic()
-                while len(workers) < size:
-                    w = Peer(Ed25519PrivateKey.generate(),
-                             cfg(bootstrap_peers=[bootstrap]),
-                             engine=FakeEngine(models=[model]),
-                             worker_mode=True)
-                    await w.start()
-                    workers.append(w)
+                new = [Peer(Ed25519PrivateKey.generate(),
+                            cfg(bootstrap_peers=[bootstrap]),
+                            engine=FakeEngine(models=[model]),
+                            worker_mode=True)
+                       for _ in range(size - len(workers))]
+                # Start the joiners concurrently — real swarm growth is
+                # parallel, and sequential starts inflate discovery_s with
+                # pure startup serialization.
+                await asyncio.gather(*(w.start() for w in new))
+                workers.extend(new)
                 # Wait until the gateway's manager sees all of them.
                 deadline = time.monotonic() + 60
                 while time.monotonic() < deadline:

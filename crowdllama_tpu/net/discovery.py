@@ -82,15 +82,18 @@ async def discover_peers(
     host: Host,
     dht: DHTNode,
     intervals: Intervals | None = None,
-    limit: int = 10,
+    limit: int = 32,
     skip_peer_ids: set[str] | None = None,
 ) -> list[Resource]:
     """Find namespace providers and fetch fresh metadata from each.
 
-    cf. discovery.go:278-366: FindProvidersAsync(namespace CID, 10), then per
-    provider fetch metadata and reject records older than 1 h.  ``skip_peer_ids``
-    carries the unhealthy/recently-removed filter the manager applies
-    (discovery.go:292).
+    cf. discovery.go:278-366: FindProvidersAsync(namespace CID, 10), then
+    per provider fetch metadata and reject records older than 1 h.
+    ``skip_peer_ids`` carries the manager's filter — since round 4 that is
+    EVERY known peer (their metadata refreshes via health probes), so the
+    provider limit is raised above the reference's 10: skipped providers
+    cost nothing, and a cap of 10 would starve discovery of joiners
+    beyond the first 10 in a growing swarm (the 16-worker discovery lag).
     """
     intervals = intervals or Intervals.default()
     skip = skip_peer_ids or set()
