@@ -122,9 +122,10 @@ async def run() -> dict:
                        for _ in range(size - len(workers))]
                 # Start the joiners concurrently — real swarm growth is
                 # parallel, and sequential starts inflate discovery_s with
-                # pure startup serialization.
-                await asyncio.gather(*(w.start() for w in new))
+                # pure startup serialization.  Extend FIRST so the finally
+                # block stops partially-started peers if a start raises.
                 workers.extend(new)
+                await asyncio.gather(*(w.start() for w in new))
                 # Wait until the gateway's manager sees all of them.
                 deadline = time.monotonic() + 60
                 while time.monotonic() < deadline:
@@ -137,6 +138,10 @@ async def run() -> dict:
                 else:
                     raise RuntimeError(f"discovery stalled at size {size}")
                 discovery_s = time.monotonic() - t_grow
+                # Let join-transient control traffic (re-provides, first
+                # health probes) settle: the phase measures steady-state
+                # serving throughput; convergence cost is discovery_s.
+                await asyncio.sleep(1.0)
 
                 sem = asyncio.Semaphore(concurrency)
                 hits: dict[str, int] = {}

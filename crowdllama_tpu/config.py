@@ -61,9 +61,10 @@ class Intervals:
     # drop their relay when a direct dialback starts succeeding.
     relay_reprobe: float = 60.0
     # Minimum age before the advertise/publish tickers actually re-provide
-    # their DHT records (membership or own-contact changes re-provide
-    # immediately; PROVIDER_TTL is 30 min, so 2 min keeps records fresh at
-    # ~1/100th of the naive per-tick chatter).
+    # their DHT records.  Membership/own-contact changes re-provide after
+    # at most reprovide/20 (the churn floor in DHTNode.provide);
+    # PROVIDER_TTL is 30 min, so 2 min keeps records fresh at ~1/100th of
+    # the naive per-tick chatter.
     reprovide: float = 120.0
 
     @classmethod
@@ -82,7 +83,11 @@ class Intervals:
                 dht_provider_check=2.0,
                 dht_bucket_refresh=5.0,
                 relay_reprobe=2.0,
-                reprovide=3.0,
+                # Change-driven re-provides (membership/contact
+                # fingerprint) wait at most reprovide/20 = 0.5 s, so
+                # tests stay fast; the periodic refresh only guards
+                # against record loss (TTL is 30 min either way).
+                reprovide=10.0,
             )
         return cls()
 

@@ -287,6 +287,8 @@ class DHTNode:
         self._maintenance: list[asyncio.Task] = []
         # provide() rate-limit memo: key -> (t, fingerprint, accepted).
         self._last_provide: dict[bytes, tuple] = {}
+        #: Max alpha-wide RPC rounds per find_providers call.
+        self._PROVIDER_ROUNDS = 4
         host.set_stream_handler(KAD_PROTOCOL, self._handle_stream)
 
     # ------------------------------------------------------------- liveness
@@ -526,8 +528,17 @@ class DHTNode:
         fingerprint = (me.host, me.port, me.relay, len(self.table))
         if min_interval:
             prev = self._last_provide.get(key)
+            age = time.monotonic() - prev[0] if prev is not None else 1e9
             if (prev is not None and prev[1] == fingerprint
-                    and time.monotonic() - prev[0] < min_interval):
+                    and age < min_interval):
+                return prev[2]
+            if prev is not None and age < min_interval / 20:
+                # Churn floor: during swarm growth every join changes the
+                # table size, which would otherwise invalidate the
+                # fingerprint on every tick and turn N joins into an
+                # O(N^2 x K) re-provide storm.  One re-provide per
+                # min_interval/20 propagates changes promptly without the
+                # storm.
                 return prev[2]
         targets = await self.lookup(key)
         payload = {"op": "add_provider", "key": key.hex(), "provider": me.to_dict()}
@@ -542,17 +553,31 @@ class DHTNode:
                                        accepted)
         return accepted
 
-    async def find_providers(self, key: bytes, limit: int = 10) -> list[Contact]:
-        """Iterative GET_PROVIDERS (cf. discovery.go:332-366, limit 10)."""
+    async def find_providers(self, key: bytes, limit: int = 10,
+                             skip: set[str] | None = None) -> list[Contact]:
+        """Iterative GET_PROVIDERS (cf. discovery.go:332-366, limit 10).
+
+        ``skip`` filters records BEFORE the limit applies, so the cap
+        bounds NEW providers: a caller that skips its already-known peers
+        (discovery's steady state) can keep a small limit without
+        starving joiner discovery once known peers outnumber it.  The
+        query work stays bounded either way: at most ``_PROVIDER_ROUNDS``
+        alpha-wide RPC rounds (provider records replicate to the K nodes
+        closest to the key, so a couple of navigation rounds reach holders
+        — a steady-state round with nothing new must NOT degenerate into a
+        full-table sweep every discovery tick)."""
+        skip = skip or set()
         found: dict[str, Contact] = {}
         for c in self.providers.get(key):
-            if c.peer_id != self.host.peer_id:
+            if c.peer_id != self.host.peer_id and c.peer_id not in skip:
                 found[c.peer_id] = c
         state = _LookupState(target=key)
         for c in self.table.closest(key):
             state.shortlist[c.peer_id] = c
 
-        while len(found) < limit:
+        rounds = 0
+        while len(found) < limit and rounds < self._PROVIDER_ROUNDS:
+            rounds += 1
             candidates = self._unqueried_in_top_k(state)[:ALPHA]
             if not candidates:
                 break
@@ -561,15 +586,24 @@ class DHTNode:
             results = await asyncio.gather(
                 *(self._rpc(c, {"op": "get_providers", "key": key.hex()}) for c in candidates)
             )
+            progressed = False
+            any_ok = False
             for resp in results:
                 if not resp or not resp.get("ok"):
                     continue
+                any_ok = True
                 for d in resp.get("providers", []):
                     try:
                         contact = Contact.from_dict(d)
                     except (KeyError, ValueError):
                         continue
-                    if contact.peer_id != self.host.peer_id:
+                    if (contact.peer_id != self.host.peer_id
+                            and contact.peer_id not in skip):
+                        if contact.peer_id not in found:
+                            progressed = True
+                        # Always (re)assign: a remote record may carry a
+                        # fresher address than our local store's (worker
+                        # restarted on a new port).
                         found[contact.peer_id] = contact
                 for d in resp.get("contacts", []):
                     try:
@@ -581,6 +615,14 @@ class DHTNode:
                         and contact.peer_id not in state.shortlist
                     ):
                         state.shortlist[contact.peer_id] = contact
+                        progressed = True
+            if any_ok and not progressed:
+                # A SUCCESSFUL round surfaced no new record and no closer
+                # node — steady state (everything known/skipped): end the
+                # lookup after one alpha-wide round instead of sweeping.
+                # An all-failed round is NOT steady state (crashed
+                # closest peers): keep walking toward live holders.
+                break
         out = list(found.values())
         if len(out) > limit:
             # More providers than the per-round cap: return a random subset

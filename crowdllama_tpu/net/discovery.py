@@ -90,14 +90,15 @@ async def discover_peers(
     cf. discovery.go:278-366: FindProvidersAsync(namespace CID, 10), then
     per provider fetch metadata and reject records older than 1 h.
     ``skip_peer_ids`` carries the manager's filter — since round 4 that is
-    EVERY known peer (their metadata refreshes via health probes), so the
-    provider limit is raised above the reference's 10: skipped providers
-    cost nothing, and a cap of 10 would starve discovery of joiners
-    beyond the first 10 in a growing swarm (the 16-worker discovery lag).
+    EVERY known peer (their metadata refreshes via health probes).  The
+    skip set is applied INSIDE find_providers, before its limit, so the
+    limit bounds NEW providers per round — a growing swarm's joiners are
+    found immediately no matter how many peers are already known.
     """
     intervals = intervals or Intervals.default()
     skip = skip_peer_ids or set()
-    providers = await dht.find_providers(namespace_key(), limit=limit)
+    providers = await dht.find_providers(namespace_key(), limit=limit,
+                                         skip=skip)
 
     async def _one(contact: Contact) -> Resource | None:
         if contact.peer_id in skip or contact.peer_id == host.peer_id:
