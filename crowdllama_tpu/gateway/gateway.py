@@ -20,6 +20,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import os
 import time
 
 from aiohttp import web
@@ -67,6 +68,15 @@ class Gateway:
         self.app.router.add_post("/api/embed", self.handle_embed)
         self.app.router.add_post("/api/embeddings", self.handle_embeddings)
         self.app.router.add_post("/api/pull", self.handle_pull)
+        # OpenAI-compatible surface (Ollama serves the same aliases; stock
+        # openai clients pointed at the gateway work unchanged).
+        self.app.router.add_post("/v1/chat/completions",
+                                 self.handle_openai_chat)
+        self.app.router.add_post("/v1/completions",
+                                 self.handle_openai_completions)
+        self.app.router.add_get("/v1/models", self.handle_openai_models)
+        self.app.router.add_post("/v1/embeddings",
+                                 self.handle_openai_embeddings)
         self.app.router.add_get("/metrics", self.handle_metrics)
         for route in ("/api/delete", "/api/create", "/api/copy", "/api/push"):
             self.app.router.add_route("*", route, self.handle_unsupported)
@@ -138,7 +148,8 @@ class Gateway:
         stream = bool(body.get("stream", False))
         options = body.get("options", {}) or {}
         return await self._route(
-            request, model, stream, options, messages=messages, chat=True)
+            request, model, stream, options, messages=messages,
+            shape="chat")
 
     async def handle_generate(self, request: web.Request) -> web.StreamResponse:
         """POST /api/generate — Ollama completion API (prompt in, text out)."""
@@ -154,7 +165,8 @@ class Gateway:
         stream = bool(body.get("stream", False))
         options = body.get("options", {}) or {}
         return await self._route(
-            request, model, stream, options, prompt=prompt, chat=False)
+            request, model, stream, options, prompt=prompt,
+            shape="generate")
 
     async def handle_health(self, request: web.Request) -> web.Response:
         """GET /api/health — per-worker health map (gateway.go:426-461)."""
@@ -471,8 +483,160 @@ class Gateway:
         return pm.find_best_worker(model, exclude=exclude,
                                    require_embeddings=require_embeddings)
 
+    # --------------------------------------------------- OpenAI-compat v1
+
+    @staticmethod
+    def _openai_error(message: str, status: int,
+                      err_type: str = "invalid_request_error"):
+        return web.json_response(
+            {"error": {"message": message, "type": err_type,
+                       "param": None, "code": None}}, status=status)
+
+    @staticmethod
+    def _openai_options(body: dict) -> dict:
+        """OpenAI top-level params → Ollama-style options dict.
+
+        Raises ``ValueError`` on wrong-typed params (handlers turn it into
+        a 400 invalid_request_error, never an aiohttp 500).  Explicit
+        ``null`` means "use the OpenAI default" — note `or`-folding would
+        also clobber a legitimate temperature of 0."""
+        def num(key, default, cast):
+            v = body.get(key)
+            return default if v is None else cast(v)
+
+        stops = body.get("stop") or []
+        if isinstance(stops, str):
+            stops = [stops]
+        elif not (isinstance(stops, list)
+                  and all(isinstance(x, str) for x in stops)):
+            raise ValueError("stop must be a string or list of strings")
+        if num("n", 1, int) != 1:
+            raise ValueError("only n=1 is supported")
+        return {
+            "num_predict": (num("max_completion_tokens", 0, int)
+                            or num("max_tokens", 0, int)),
+            # OpenAI's defaults (temperature 1, nucleus off).
+            "temperature": num("temperature", 1.0, float),
+            "top_p": num("top_p", 1.0, float),
+            "seed": num("seed", 0, int),
+            "stop": stops,
+        }
+
+    @staticmethod
+    def _openai_message_text(content) -> str:
+        """OpenAI message content may be a string OR a list of typed parts
+        ([{"type": "text", "text": ...}, ...]) — flatten to text."""
+        if isinstance(content, str):
+            return content
+        if isinstance(content, list):
+            parts = []
+            for p in content:
+                if isinstance(p, dict) and p.get("type") == "text":
+                    parts.append(str(p.get("text", "")))
+                elif not isinstance(p, dict):
+                    raise ValueError("invalid content part")
+                else:
+                    raise ValueError(
+                        f"unsupported content part type "
+                        f"{p.get('type')!r} (text only)")
+            return "".join(parts)
+        raise ValueError("message content must be a string or parts list")
+
+    async def handle_openai_chat(self, request: web.Request):
+        """POST /v1/chat/completions — the OpenAI chat API (Ollama serves
+        the same alias; stock openai clients work against the gateway)."""
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return self._openai_error("invalid JSON body", 400)
+        model = body.get("model", "")
+        messages = body.get("messages", [])
+        if not model or not isinstance(messages, list) or not messages:
+            return self._openai_error("model and messages are required", 400)
+        try:
+            options = self._openai_options(body)
+            messages = [
+                {"role": str(m.get("role", "user")),
+                 "content": self._openai_message_text(m.get("content", ""))}
+                for m in messages if isinstance(m, dict)]
+        except (ValueError, TypeError) as e:
+            return self._openai_error(str(e), 400)
+        if not messages:
+            return self._openai_error("messages are required", 400)
+        return await self._route(
+            request, model, bool(body.get("stream", False)),
+            options, messages=messages, shape="openai-chat")
+
+    async def handle_openai_completions(self, request: web.Request):
+        """POST /v1/completions — the legacy OpenAI completion API."""
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return self._openai_error("invalid JSON body", 400)
+        model = body.get("model", "")
+        prompt = body.get("prompt", "")
+        if isinstance(prompt, list):
+            if len(prompt) != 1 or not isinstance(prompt[0], str):
+                return self._openai_error(
+                    "only a single string prompt is supported", 400)
+            prompt = prompt[0]
+        if not model or not prompt:
+            return self._openai_error("model and prompt are required", 400)
+        try:
+            options = self._openai_options(body)
+        except (ValueError, TypeError) as e:
+            return self._openai_error(str(e), 400)
+        return await self._route(
+            request, model, bool(body.get("stream", False)),
+            options, prompt=prompt, shape="openai-completion")
+
+    async def handle_openai_models(self, request: web.Request):
+        """GET /v1/models — swarm-served models, OpenAI list shape."""
+        pm = self.peer.peer_manager
+        names: set[str] = set()
+        if pm is not None:
+            for p in pm.get_healthy_peers():
+                if p.is_worker:
+                    names.update(p.resource.supported_models)
+        now = int(time.time())
+        return web.json_response({
+            "object": "list",
+            "data": [{"id": m, "object": "model", "created": now,
+                      "owned_by": "crowdllama"} for m in sorted(names)],
+        })
+
+    async def handle_openai_embeddings(self, request: web.Request):
+        """POST /v1/embeddings — OpenAI embeddings shape over the swarm."""
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return self._openai_error("invalid JSON body", 400)
+        model = body.get("model", "")
+        inputs = body.get("input", "")
+        if isinstance(inputs, str):
+            inputs = [inputs]
+        if not model or not isinstance(inputs, list) or not inputs \
+                or not all(isinstance(t, str) for t in inputs):
+            return self._openai_error("model and input are required", 400)
+        resp, status = await self._route_embed(model, inputs)
+        if status != 200:
+            return self._openai_error(
+                str(resp.get("error", "failed")), status,
+                "invalid_request_error" if status < 500 else "server_error")
+        return web.json_response({
+            "object": "list",
+            "model": model,
+            "data": [{"object": "embedding", "index": i, "embedding": e}
+                     for i, e in enumerate(resp["embeddings"])],
+            "usage": {"prompt_tokens": resp.get("prompt_eval_count", 0),
+                      "total_tokens": resp.get("prompt_eval_count", 0)},
+        })
+
+    # ------------------------------------------------------------- routing
+
     async def _route(self, request, model, stream, options,
-                     messages=None, prompt="", chat=True) -> web.StreamResponse:
+                     messages=None, prompt="",
+                     shape="chat") -> web.StreamResponse:
         msg = create_generate_request(
             model=model,
             prompt=prompt,
@@ -505,7 +669,8 @@ class Gateway:
                 break
             tried.add(worker.peer_id)
             try:
-                return await self._forward(request, worker.peer_id, msg, stream, chat)
+                return await self._forward(request, worker.peer_id, msg,
+                                           stream, shape)
             except _StreamStarted as e:
                 # Headers/chunks already went out: no retry, no second
                 # response — the error frame was already written downstream.
@@ -514,19 +679,37 @@ class Gateway:
             except Exception as e:
                 last_err = str(e)
                 log.warning("worker %s failed: %s", worker.peer_id[:8], e)
+        if shape.startswith("openai"):
+            return self._openai_error(f"inference failed: {last_err}", 503,
+                                      "server_error")
         return web.json_response(
             {"error": f"inference failed: {last_err}", "model": model}, status=503)
 
     async def _forward(self, request, worker_id: str, msg, stream: bool,
-                       chat: bool) -> web.StreamResponse:
+                       shape: str) -> web.StreamResponse:
         """Open an inference stream to the worker and relay the reply
-        (gateway.go:243-298)."""
+        (gateway.go:243-298).  ``shape`` picks the client dialect:
+        Ollama NDJSON ("chat"/"generate") or OpenAI SSE ("openai-*")."""
+        openai = shape.startswith("openai")
+        rid = ("chatcmpl-" if shape == "openai-chat" else "cmpl-") \
+            + os.urandom(12).hex()
+        created = int(time.time())
+        nth = {"n": 0}
+
+        def render(resp, final: bool) -> dict:
+            if openai:
+                d = self._openai_json(resp, shape, final, stream, rid,
+                                      created, first=nth["n"] == 0)
+                nth["n"] += 1
+                return d
+            return self._ollama_json(resp, shape == "chat", final=final)
+
         if not stream:
             reply = await self._roundtrip(worker_id, msg)
             resp = extract_generate_response(reply)
             if resp.done_reason == "error":
                 raise RuntimeError(resp.response)
-            return web.json_response(self._ollama_json(resp, chat, final=True))
+            return web.json_response(render(resp, final=True))
 
         contact = await self.peer.dht.find_peer(worker_id)
         if contact is None:
@@ -534,39 +717,54 @@ class Gateway:
         s = await self.peer.host.new_stream(contact, INFERENCE_PROTOCOL)
         try:
             await wire.write_length_prefixed_pb(s.writer, msg)
-            # NDJSON streaming: one line per chunk, like Ollama.  Read the
-            # FIRST frame before sending headers, so a worker that dies
-            # immediately is still retryable by _route.
+            # Streamed: one NDJSON line (Ollama) or SSE data event (OpenAI)
+            # per chunk.  Read the FIRST frame before sending headers, so a
+            # worker that dies immediately is still retryable by _route.
             first = extract_generate_response(
                 await wire.read_length_prefixed_pb(s.reader, timeout=600))
             if first.done_reason == "error":
                 raise RuntimeError(first.response)
             out = web.StreamResponse(
                 status=200,
-                headers={"Content-Type": "application/x-ndjson"},
+                headers={"Content-Type": ("text/event-stream" if openai
+                                          else "application/x-ndjson")},
             )
             await out.prepare(request)
+
+            async def write_frame(payload: dict) -> None:
+                line = json.dumps(payload).encode()
+                if openai:
+                    await out.write(b"data: " + line + b"\n\n")
+                else:
+                    await out.write(line + b"\n")
+
             resp = first
             try:
                 while True:
                     if resp.done_reason == "error":
                         raise RuntimeError(resp.response)
-                    line = json.dumps(self._ollama_json(resp, chat, final=resp.done))
-                    await out.write(line.encode() + b"\n")
+                    await write_frame(render(resp, final=resp.done))
                     if resp.done:
                         break
                     resp = extract_generate_response(
                         await wire.read_length_prefixed_pb(s.reader, timeout=600))
+                if openai:
+                    await out.write(b"data: [DONE]\n\n")
             except Exception as e:
-                # Mid-stream failure: emit a terminal error line; wrap so
+                # Mid-stream failure: emit a terminal error frame; wrap so
                 # _route doesn't retry or double-respond.
                 try:
-                    err_line = json.dumps({
-                        "model": resp.model, "created_at": _now_rfc3339(),
-                        "done": True, "done_reason": "error",
-                        "error": str(e),
-                    })
-                    await out.write(err_line.encode() + b"\n")
+                    if openai:
+                        await write_frame({"error": {
+                            "message": str(e), "type": "server_error"}})
+                        await out.write(b"data: [DONE]\n\n")
+                    else:
+                        await write_frame({
+                            "model": resp.model,
+                            "created_at": _now_rfc3339(),
+                            "done": True, "done_reason": "error",
+                            "error": str(e),
+                        })
                 except Exception:
                     pass
                 raise _StreamStarted(out, e) from e
@@ -593,4 +791,44 @@ class Gateway:
             d["prompt_eval_count"] = resp.prompt_tokens
             d["eval_count"] = resp.completion_tokens
             d["worker_id"] = resp.worker_id
+        return d
+
+    @staticmethod
+    def _openai_json(resp, shape: str, final: bool, stream: bool,
+                     rid: str, created: int, first: bool = False) -> dict:
+        """PB → OpenAI-shaped JSON (chat.completion[.chunk] /
+        text_completion)."""
+        chat = shape == "openai-chat"
+        finish = ({"stop": "stop", "length": "length"}.get(
+            resp.done_reason or "stop", "stop") if final else None)
+        if chat:
+            if stream:
+                delta: dict = {}
+                if first:
+                    # OpenAI's first-chunk contract: the role arrives on
+                    # the opening delta (clients accumulate it).
+                    delta["role"] = "assistant"
+                    delta["content"] = ""
+                if resp.response:
+                    delta["content"] = resp.response
+                choice: dict = {"index": 0, "delta": delta,
+                                "finish_reason": finish}
+            else:
+                choice = {"index": 0,
+                          "message": {"role": "assistant",
+                                      "content": resp.response},
+                          "finish_reason": finish}
+            obj = "chat.completion.chunk" if stream else "chat.completion"
+        else:
+            choice = {"index": 0, "text": resp.response,
+                      "finish_reason": finish}
+            obj = "text_completion"
+        d = {"id": rid, "object": obj, "created": created,
+             "model": resp.model, "choices": [choice]}
+        if final:
+            d["usage"] = {
+                "prompt_tokens": resp.prompt_tokens,
+                "completion_tokens": resp.completion_tokens,
+                "total_tokens": resp.prompt_tokens + resp.completion_tokens,
+            }
         return d
