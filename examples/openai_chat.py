@@ -1,0 +1,67 @@
+"""Chat against a swarm gateway with the OpenAI API surface.
+
+The gateway serves OpenAI-compatible aliases (/v1/chat/completions,
+/v1/completions, /v1/models, /v1/embeddings) alongside the Ollama API —
+the same dual surface Ollama itself exposes.  If the ``openai`` package
+is installed this script uses the stock client (base_url pointed at the
+gateway, any api_key); otherwise it speaks the same HTTP+SSE protocol
+with stdlib urllib.
+
+Run a swarm first:
+    crowdllama-tpu-dht start &
+    crowdllama-tpu start --worker-mode --bootstrap-peers 127.0.0.1:9000 &
+    crowdllama-tpu start --bootstrap-peers 127.0.0.1:9000 &
+    python examples/openai_chat.py "why is the sky blue?"
+"""
+
+import json
+import sys
+import urllib.request
+
+GATEWAY = "http://localhost:9001"
+MODEL = "tinyllama-1.1b"
+
+
+def main() -> None:
+    prompt = " ".join(sys.argv[1:]) or "Why is the sky blue?"
+    messages = [{"role": "user", "content": prompt}]
+    try:
+        import openai  # stock client works against the gateway
+
+        client = openai.OpenAI(base_url=f"{GATEWAY}/v1", api_key="swarm")
+        stream = client.chat.completions.create(
+            model=MODEL, messages=messages, stream=True)
+        for chunk in stream:
+            delta = chunk.choices[0].delta.content or ""
+            print(delta, end="", flush=True)
+        print()
+        return
+    except ImportError:
+        pass
+
+    req = urllib.request.Request(
+        f"{GATEWAY}/v1/chat/completions",
+        data=json.dumps({"model": MODEL, "messages": messages,
+                         "stream": True}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req) as resp:
+        for raw in resp:
+            line = raw.decode().strip()
+            if not line.startswith("data: "):
+                continue
+            payload = line[len("data: "):]
+            if payload == "[DONE]":
+                break
+            chunk = json.loads(payload)
+            if "error" in chunk:
+                print(f"\nerror: {chunk['error'].get('message')}",
+                      file=sys.stderr)
+                return
+            delta = chunk["choices"][0]["delta"].get("content", "")
+            print(delta, end="", flush=True)
+    print()
+
+
+if __name__ == "__main__":
+    main()
