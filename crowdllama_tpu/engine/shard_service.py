@@ -125,8 +125,28 @@ class ShardStageRunner:
             return T.scan_decode_layers(layers, self.windows, cfg, x,
                                         positions, kc, vc, seq_lens)
 
+        def _verify(layers, x, start, kc, vc):
+            # J-token speculative window at positions start..start+J-1,
+            # attending jointly over the session cache as context (< start
+            # valid; rejected garbage beyond the last accepted token is
+            # masked out by the next call's smaller start) and causally
+            # within the window — the same prefix-context machinery the
+            # prefix cache uses (T.scan_prefill_layers ctx path).
+            j = x.shape[1]
+            positions = start + jnp.arange(j)[None, :]
+            ctx_valid = (jnp.arange(self.max_seq) < start)[None, :]
+            y, ks, vs = T.scan_prefill_layers(
+                layers, self.windows, cfg, x, positions,
+                ctx_k=kc, ctx_v=vc, ctx_valid=ctx_valid)
+            kc = jax.lax.dynamic_update_slice(
+                kc, ks.astype(kc.dtype), (0, 0, 0, start, 0))
+            vc = jax.lax.dynamic_update_slice(
+                vc, vs.astype(vc.dtype), (0, 0, 0, start, 0))
+            return y, kc, vc
+
         self._jprefill = jax.jit(_prefill)
         self._jdecode = jax.jit(_decode, donate_argnums=(3, 4))
+        self._jverify = jax.jit(_verify, donate_argnums=(3, 4))
 
     def prefill(self, session: str, x: np.ndarray, plen: int) -> np.ndarray:
         """x: [1, T, D] activations entering this stage; returns [1, T, D].
@@ -157,6 +177,19 @@ class ShardStageRunner:
             sess["kc"], sess["vc"],
             jnp.asarray([seq_len], jnp.int32),
         )
+        sess["kc"], sess["vc"] = kc, vc
+        return np.asarray(y, np.float32)
+
+    def verify(self, session: str, x: np.ndarray, start: int) -> np.ndarray:
+        """x: [1, J, D] activations of a pending+drafts window starting at
+        position ``start``; returns [1, J, D].  One network round trip
+        carries J tokens — cross-worker speculative decoding turns
+        per-token DCN latency into batched verification (PAPERS.md:
+        speculative decoding in decentralized inference)."""
+        sess = self._sessions[session]
+        y, kc, vc = self._jverify(
+            self.layers, jnp.asarray(x, self.dtype),
+            jnp.int32(start), sess["kc"], sess["vc"])
         sess["kc"], sess["vc"] = kc, vc
         return np.asarray(y, np.float32)
 
@@ -201,7 +234,7 @@ class ShardStageService:
                     op = header.get("op", "")
                     sid = header.get("session", "")
                     x = None
-                    if op in ("prefill", "decode"):
+                    if op in ("prefill", "decode", "verify"):
                         x = await read_tensor(stream.reader,
                                               timeout=self.idle_timeout)
                 except wire_errors:
@@ -223,6 +256,14 @@ class ShardStageService:
                         inflight = loop.run_in_executor(
                             None, self.runner.decode, sid, x,
                             int(header["position"]), int(header["seq_len"]))
+                        y = await inflight
+                        inflight = None
+                        await write_json_frame(stream.writer, {"ok": True})
+                        await write_tensor(stream.writer, y)
+                    elif op == "verify":
+                        inflight = loop.run_in_executor(
+                            None, self.runner.verify, sid, x,
+                            int(header["start"]))
                         y = await inflight
                         inflight = None
                         await write_json_frame(stream.writer, {"ok": True})
@@ -303,6 +344,11 @@ class RemoteStage:
             {"op": "decode", "session": session, "position": position,
              "seq_len": seq_len}, x, True)
 
+    async def verify(self, session: str, x: np.ndarray,
+                     start: int) -> np.ndarray:
+        return await self._call(
+            {"op": "verify", "session": session, "start": start}, x, True)
+
     async def release(self, session: str) -> None:
         await self._call({"op": "release", "session": session}, None, False)
 
@@ -328,6 +374,12 @@ class LocalStage:
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(None, self.runner.decode, session,
                                           x, position, seq_len)
+
+    async def verify(self, session: str, x: np.ndarray,
+                     start: int) -> np.ndarray:
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, self.runner.verify, session,
+                                          x, start)
 
     async def release(self, session: str) -> None:
         self.runner.release(session)
@@ -378,6 +430,20 @@ class SwarmPipeline:
             self._embed(np.asarray([token], np.int32)), np.float32)
         for stage in self.stages:
             x = await stage.decode(session, x, position, seq_len)
+        logits = self._unembed(jnp.asarray(x, self.dtype))
+        return np.asarray(logits[0], np.float32)
+
+    async def verify(self, session: str, tokens: list[int],
+                     start: int) -> np.ndarray:
+        """A pending+drafts window through all stages in ONE round trip
+        per stage; returns per-position logits [J, V].  The decentralized
+        speculative-decoding hot path: cross-worker decode is DCN-latency-
+        bound, so verifying J tokens per trip emits up to J tokens for
+        one token's latency (PAPERS.md)."""
+        x = np.asarray(
+            self._embed(np.asarray([tokens], np.int32)), np.float32)
+        for stage in self.stages:
+            x = await stage.verify(session, x, start)
         logits = self._unembed(jnp.asarray(x, self.dtype))
         return np.asarray(logits[0], np.float32)
 

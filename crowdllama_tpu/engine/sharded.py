@@ -47,6 +47,20 @@ from crowdllama_tpu.engine.engine import Chunk, Engine, StopMatcher
 log = logging.getLogger("crowdllama.engine.sharded")
 
 
+def _ngram_drafts(history: list[int], k: int) -> list[int]:
+    """Host-side bigram prompt-lookup drafts (the n-gram proposer of
+    engine/spec.py, B=1 on plain Python lists): find the LATEST earlier
+    occurrence of the trailing bigram and draft the k tokens that followed
+    it; no match → zero-padded drafts the first verify mismatch rejects."""
+    if len(history) >= 2:
+        a, b = history[-2], history[-1]
+        for i in range(len(history) - 3, -1, -1):
+            if history[i] == a and history[i + 1] == b:
+                cont = history[i + 2:i + 2 + k]
+                return (cont + [0] * k)[:k]
+    return [0] * k
+
+
 def sample_host(logits: np.ndarray, temperature: float, top_p: float,
                 rng: np.random.Generator, top_k: int = 0,
                 recent: "list[int] | None" = None,
@@ -123,6 +137,12 @@ class ShardedEngine(Engine):
         self._draining = False
         self._tput_ema = 0.0
         self._rng = np.random.default_rng(0)
+        # Cross-worker speculative decoding telemetry (pp groups).
+        self._spec_steps = 0
+        self._spec_emitted = 0
+        # Set when a group member rejects the 'verify' op (older release):
+        # later requests go per-token instead of failing on every try.
+        self._verify_unsupported = False
 
     # ----------------------------------------------------------- lifecycle
 
@@ -245,7 +265,17 @@ class ShardedEngine(Engine):
         self._peer = peer
 
     def describe(self) -> dict:
+        d = {}
+        if self._spec_steps:
+            d["spec_decode"] = {
+                "mode": "ngram (cross-worker verify)",
+                "verify_steps": self._spec_steps,
+                "tokens_emitted": self._spec_emitted,
+                "tokens_per_step": round(
+                    self._spec_emitted / self._spec_steps, 2),
+            }
         return {
+            **d,
             "models": self.models,
             "throughput": round(self._tput_ema, 2),
             # Sharded engines have no embeddings path (Engine.embed raises
@@ -402,6 +432,22 @@ class ShardedEngine(Engine):
                 history.append(token)
                 n = len(prompt_ids)
                 reason = "length"
+                # Cross-worker speculative decoding (PAPERS.md: speculation
+                # in decentralized inference): pp decode is DCN-latency-
+                # bound — one round trip per stage per token — so on greedy
+                # requests the leader drafts by n-gram lookup and verifies
+                # the whole window in ONE trip per stage, emitting up to
+                # 1+k tokens per round trip.  Greedy-exact (drafts change
+                # how many tokens per trip, never which); penalized or
+                # sampled requests keep the per-token path.
+                draft_k = max(1, self.config.spec_draft)
+                use_spec = (self.strategy == "pp"
+                            and self.config.spec_decode == "ngram"
+                            and temperature <= 0.0
+                            and repeat_penalty == 1.0
+                            and not self._verify_unsupported
+                            and hasattr(pipeline, "verify"))
+                pending: list[int] = []  # verified tokens awaiting emission
                 while True:
                     completion += 1
                     if token == self.tokenizer.eos_id:
@@ -418,6 +464,41 @@ class ShardedEngine(Engine):
                             yield Chunk(text=emit)
                     if completion >= budget:
                         break
+                    if pending:
+                        token = pending.pop(0)
+                        history.append(token)
+                        n += 1
+                        self._spec_emitted += 1  # consumed, counts at use
+                        continue
+                    if use_spec and n + draft_k + 1 <= max_seq:
+                        window = [token] + _ngram_drafts(history, draft_k)
+                        try:
+                            wlogits = await pipeline.verify(session, window,
+                                                            n)
+                        except RuntimeError as e:
+                            if "unknown op" in str(e):
+                                # A pre-verify group member: remember and
+                                # fail this request (the old handler left
+                                # the stream desynced); the gateway retry
+                                # and all later requests run per-token.
+                                self._verify_unsupported = True
+                                log.warning(
+                                    "group member lacks the verify op; "
+                                    "disabling cross-worker speculation")
+                            raise
+                        model_next = wlogits.argmax(axis=-1)
+                        a = 0
+                        while (a < draft_k
+                               and window[a + 1] == int(model_next[a])):
+                            a += 1
+                        self._spec_steps += 1
+                        self._spec_emitted += 1  # emitted[0], consumed now
+                        emitted = [int(t) for t in model_next[:a + 1]]
+                        token = emitted[0]
+                        pending = emitted[1:]
+                        history.append(token)
+                        n += 1
+                        continue
                     logits = await pipeline.decode(session, token, n, n + 1)
                     token = sample_host(logits, temperature, top_p, rng,
                                         top_k=top_k, recent=history,

@@ -93,6 +93,110 @@ async def test_swarm_pipeline_matches_dense():
         await worker_host.close()
 
 
+async def test_swarm_pipeline_verify_matches_per_token_decode():
+    """Cross-worker speculative verification (PAPERS.md: speculation in
+    decentralized inference): a pending+drafts window through
+    ``SwarmPipeline.verify`` must produce the same greedy continuation as
+    per-token decode — one DCN round trip per stage carrying J tokens —
+    whether the drafts are right (full acceptance) or garbage (window
+    position 0 still yields the correct next token)."""
+    cfg = get_config("tiny-test", max_context_length=32)
+    params = T.init_params(cfg, jax.random.PRNGKey(3), dtype=jnp.float32)
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+    steps = 5
+    want = _dense_greedy(cfg, params, prompt, steps)
+
+    remote_runner = ShardStageRunner(cfg, params, shard_index=1,
+                                     shard_count=2, dtype=jnp.float32)
+    service = ShardStageService(remote_runner)
+    worker_host = Host(Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    worker_host.set_stream_handler(SHARD_PROTOCOL, service.handle)
+    await worker_host.start()
+    leader_host = Host(Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    await leader_host.start()
+    try:
+        stream = await leader_host.new_stream(worker_host.contact,
+                                              SHARD_PROTOCOL)
+        stages = [
+            LocalStage(ShardStageRunner(cfg, params, shard_index=0,
+                                        shard_count=2, dtype=jnp.float32)),
+            RemoteStage(stream),
+        ]
+        pipe = SwarmPipeline(cfg, params, stages, dtype=jnp.float32)
+
+        sid = "sess-v"
+        logits = await pipe.prefill(sid, prompt, bucket=16)
+        got = [int(np.argmax(logits))]
+        n = len(prompt)
+        # CORRECT drafts (the dense continuation): every position of the
+        # window must verify, i.e. model_next matches the continuation.
+        window = [got[0]] + want[1:5]     # pending + 4 right drafts
+        wlogits = await pipe.verify(sid, window, n)
+        model_next = [int(t) for t in wlogits.argmax(axis=-1)]
+        assert model_next == want[1:6], (model_next, want[1:6])
+        await pipe.release(sid)
+
+        # GARBAGE drafts: position 0's logits are still exact (fresh
+        # session to keep the cache clean).
+        sid2 = "sess-g"
+        logits = await pipe.prefill(sid2, prompt, bucket=16)
+        first = int(np.argmax(logits))
+        wlogits = await pipe.verify(sid2, [first, 0, 0, 0, 0],
+                                    len(prompt))
+        assert int(wlogits[0].argmax()) == want[1]
+        await pipe.release(sid2)
+        assert remote_runner.session_count == 0
+    finally:
+        pipe.close()
+        await leader_host.close()
+        await worker_host.close()
+
+
+async def test_sharded_engine_spec_decode_matches_plain():
+    """End-to-end pp-group speculation through ShardedEngine: greedy
+    output with --spec-decode ngram equals the non-spec output
+    token-for-token, and the telemetry records multi-token verify
+    steps on a repetitive prompt."""
+    from crowdllama_tpu.config import Configuration, Intervals
+    from crowdllama_tpu.engine.sharded import ShardedEngine
+
+    def _cfg(**kw):
+        c = Configuration(model="tiny-test", max_context_length=32,
+                          shard_count=2, shard_strategy="pp",
+                          intervals=Intervals.default(), **kw)
+        return c
+
+    outs = {}
+    for spec in ("", "ngram"):
+        leader = ShardedEngine(_cfg(shard_index=0, spec_decode=spec,
+                                    spec_draft=3))
+        member = ShardedEngine(_cfg(shard_index=1, spec_decode=spec))
+        await leader.start()
+        await member.start()
+        # Wire the member's stage service to the leader directly (the
+        # swarm normally does this via SHARD_PROTOCOL streams).
+        from crowdllama_tpu.engine.shard_service import (
+            LocalStage,
+            SwarmPipeline,
+        )
+
+        leader._pipeline = SwarmPipeline(
+            leader.cfg, leader._embed_params,
+            [LocalStage(leader.runner), LocalStage(member.runner)])
+        text = []
+        async for c in leader.generate("ababababab", max_tokens=10):
+            text.append(c.text)
+        outs[spec] = "".join(text)
+        if spec == "ngram":
+            d = leader.describe()
+            assert d["spec_decode"]["verify_steps"] > 0
+            assert (d["spec_decode"]["tokens_emitted"]
+                    >= d["spec_decode"]["verify_steps"])
+        await leader.stop()
+        await member.stop()
+    assert outs["ngram"] == outs[""], outs
+
+
 async def test_shard_service_unknown_session_reports_error():
     cfg = get_config("tiny-test", max_context_length=32)
     params = T.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
