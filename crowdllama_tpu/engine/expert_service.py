@@ -44,7 +44,11 @@ from crowdllama_tpu.net.host import (
     read_json_frame,
     write_json_frame,
 )
-from crowdllama_tpu.ops.attention import decode_attention, prefill_attention
+from crowdllama_tpu.ops.attention import (
+    decode_attention,
+    prefill_attention,
+    prefill_attention_ctx,
+)
 from crowdllama_tpu.ops.norms import rms_norm
 from crowdllama_tpu.ops.rope import apply_rope, rope_table
 from crowdllama_tpu.engine.shard_service import (
@@ -283,10 +287,11 @@ class EPLeaderRunner:
             topw, topi = jax.lax.top_k(router_logits, K)
             return jax.nn.softmax(topw, axis=-1), topi
 
-        def _prefill_layer(layers, l, x, positions, kv_valid, kc, vc):
-            lp = jax.tree_util.tree_map(
-                lambda a: jax.lax.dynamic_index_in_dim(a, l, 0, keepdims=False),
-                layers)
+        def _qkv_window(lp, x, positions):
+            """Shared windowed qkv: norm → projections (+Qwen2 bias) →
+            heads (+Qwen3 qk-norm) → rope → head-major K/V.  ONE source of
+            truth for the prefill and verify layer bodies — the ordering
+            here must match models/transformer.py exactly."""
             b, t = x.shape[0], x.shape[1]
             h = rms_norm(x, lp["ln1"], cfg.rms_norm_eps)
             q = jnp.einsum("btd,dk->btk", h, lp["wq"])
@@ -302,8 +307,14 @@ class EPLeaderRunner:
                 k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
             q = apply_rope(q, positions, cos, sin)
             k = apply_rope(k, positions, cos, sin)
-            kh = k.transpose(0, 2, 1, 3)
-            vh = v.transpose(0, 2, 1, 3)
+            return q, k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3)
+
+        def _prefill_layer(layers, l, x, positions, kv_valid, kc, vc):
+            lp = jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, l, 0, keepdims=False),
+                layers)
+            b, t = x.shape[0], x.shape[1]
+            q, kh, vh = _qkv_window(lp, x, positions)
             attn = prefill_attention(q, kh, vh, positions, scale,
                                      kv_valid=kv_valid)
             x = x + jnp.einsum("btk,kd->btd", attn.reshape(b, t, -1), lp["wo"])
@@ -347,9 +358,39 @@ class EPLeaderRunner:
             vc = jax.lax.dynamic_update_slice(vc, vc_l[None], (l, 0, 0, 0, 0))
             return x, h2, topw, topi, kc, vc
 
+        def _verify_layer(layers, l, x, start, kc, vc):
+            # J-token speculative window at positions start..start+J-1
+            # attending over the session cache as context (< start valid)
+            # and causally within the window — the EP analog of
+            # shard_service's verify (one expert round trip per LAYER
+            # carries J tokens instead of 1).
+            lp = jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, l, 0, keepdims=False),
+                layers)
+            b, t = x.shape[0], x.shape[1]
+            positions = start + jnp.arange(t)[None, :]
+            q, kh, vh = _qkv_window(lp, x, positions)
+            kc_l = jax.lax.dynamic_index_in_dim(kc, l, 0, keepdims=False)
+            vc_l = jax.lax.dynamic_index_in_dim(vc, l, 0, keepdims=False)
+            ctx_valid = (jnp.arange(self.max_seq) < start)[None, :]
+            attn = prefill_attention_ctx(q, kh, vh, positions,
+                                         kc_l, vc_l, ctx_valid, scale)
+            x = x + jnp.einsum("btk,kd->btd", attn.reshape(b, t, -1),
+                               lp["wo"])
+            h2 = rms_norm(x, lp["ln2"], cfg.rms_norm_eps)
+            topw, topi = _route(lp, h2)
+            kc_l = jax.lax.dynamic_update_slice(
+                kc_l, kh.astype(dtype), (0, 0, start, 0))
+            vc_l = jax.lax.dynamic_update_slice(
+                vc_l, vh.astype(dtype), (0, 0, start, 0))
+            kc = jax.lax.dynamic_update_slice(kc, kc_l[None], (l, 0, 0, 0, 0))
+            vc = jax.lax.dynamic_update_slice(vc, vc_l[None], (l, 0, 0, 0, 0))
+            return x, h2, topw, topi, kc, vc
+
         self._jprefill_layer = jax.jit(_prefill_layer,
                                        donate_argnums=(5, 6))
         self._jdecode_layer = jax.jit(_decode_layer, donate_argnums=(5, 6))
+        self._jverify_layer = jax.jit(_verify_layer, donate_argnums=(4, 5))
         self._jembed = jax.jit(
             lambda tokens: T._embed(self.embed_params, cfg, tokens))
         self._junembed = jax.jit(
@@ -462,6 +503,31 @@ class EPPipeline:
             x = await loop.run_in_executor(None, r._jadd, x, jnp.asarray(moe))
         logits = await loop.run_in_executor(None, r._junembed, x)
         return np.asarray(logits[0], np.float32)
+
+    async def verify(self, session: str, tokens: list[int],
+                     start: int) -> np.ndarray:
+        """A pending+drafts window in one pass: each layer's expert
+        dispatch batches the J window rows, so the per-layer DCN round
+        trip to the banks carries J tokens instead of 1 (the decentralized
+        speculative-decoding pattern, PAPERS.md).  Returns [J, V]."""
+        loop = asyncio.get_running_loop()
+        r = self.runner
+        sess = r._sessions[session]
+        j = len(tokens)
+        x = await loop.run_in_executor(
+            None, r._jembed, jnp.asarray([tokens], jnp.int32))
+        for l in range(self.cfg.num_layers):
+            x, h2, topw, topi, sess["kc"], sess["vc"] = (
+                await loop.run_in_executor(
+                    None, r._jverify_layer, r.layers, jnp.int32(l), x,
+                    jnp.int32(start), sess["kc"], sess["vc"]))
+            moe = await self._moe(
+                l, np.asarray(h2[0], np.float32),
+                np.asarray(topw[0], np.float32), np.asarray(topi[0]))
+            x = await loop.run_in_executor(
+                None, r._jadd, x, jnp.asarray(moe[None]))
+        logits = await loop.run_in_executor(None, r._junembed, x)
+        return np.asarray(logits[0], np.float32).reshape(j, -1)
 
     async def release(self, session: str) -> None:
         self.runner.release(session)

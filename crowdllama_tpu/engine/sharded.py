@@ -433,16 +433,16 @@ class ShardedEngine(Engine):
                 n = len(prompt_ids)
                 reason = "length"
                 # Cross-worker speculative decoding (PAPERS.md: speculation
-                # in decentralized inference): pp decode is DCN-latency-
-                # bound — one round trip per stage per token — so on greedy
+                # in decentralized inference): cross-worker decode is DCN-
+                # latency-bound — one round trip per stage (pp) or per
+                # layer's expert dispatch (ep) per token — so on greedy
                 # requests the leader drafts by n-gram lookup and verifies
-                # the whole window in ONE trip per stage, emitting up to
-                # 1+k tokens per round trip.  Greedy-exact (drafts change
-                # how many tokens per trip, never which); penalized or
-                # sampled requests keep the per-token path.
+                # the whole window in ONE trip, emitting up to 1+k tokens
+                # per round trip.  Greedy-exact (drafts change how many
+                # tokens per trip, never which); penalized or sampled
+                # requests keep the per-token path.
                 draft_k = max(1, self.config.spec_draft)
-                use_spec = (self.strategy == "pp"
-                            and self.config.spec_decode == "ngram"
+                use_spec = (self.config.spec_decode == "ngram"
                             and temperature <= 0.0
                             and repeat_penalty == 1.0
                             and not self._verify_unsupported

@@ -165,6 +165,52 @@ async def test_ep_pipeline_matches_dense_qwen_moe():
         pipe.close()
 
 
+async def test_ep_pipeline_verify_matches_dense():
+    """EP cross-worker speculative verification: a pending+drafts window
+    through EPPipeline.verify yields the dense model's logits at every
+    accepted position (one expert round trip per layer carries the whole
+    window — the decentralized speculation pattern, PAPERS.md)."""
+    cfg = get_config("tiny-test-moe", max_context_length=32)
+    params = T.init_params(cfg, jax.random.PRNGKey(3), dtype=jnp.float32)
+    prompt = [3, 1, 4, 1, 5, 9]
+    want = _dense_greedy(cfg, params, prompt, steps=5)
+
+    leader = EPLeaderRunner(cfg, params, max_seq=32, dtype=jnp.float32)
+    banks = [LocalExpertBank(ExpertBankRunner(
+        cfg, params, assign_experts(4, 2, i), dtype=jnp.float32))
+        for i in range(2)]
+    pipe = EPPipeline(cfg, leader, banks)
+    try:
+        sid = "sess-epv"
+        logits = await pipe.prefill(sid, prompt, bucket=16)
+        first = int(np.argmax(logits))
+        assert first == want[0]
+        # Correct drafts: the whole window verifies.
+        window = [first] + want[1:4]
+        wlogits = await pipe.verify(sid, window, len(prompt))
+        model_next = [int(t) for t in wlogits.argmax(axis=-1)]
+        assert model_next == want[1:5], (model_next, want[1:5])
+        await pipe.release(sid)
+
+        # REJECTION path: garbage drafts leave stale KV at start+1.. that
+        # the next verify must mask (ctx_valid < start) and overwrite.
+        sid2 = "sess-epr"
+        logits = await pipe.prefill(sid2, prompt, bucket=16)
+        first = int(np.argmax(logits))
+        n = len(prompt)
+        bad = [first, 499, 498, 497]  # only position 0 will be accepted
+        wlogits = await pipe.verify(sid2, bad, n)
+        assert int(wlogits[0].argmax()) == want[1]  # exact despite garbage
+        # Next verify starts at n+1 (one accepted token) with correct
+        # drafts: rejected-garbage KV at n+1..n+3 must not leak into it.
+        wlogits = await pipe.verify(sid2, [want[1]] + want[2:4], n + 1)
+        model_next = [int(t) for t in wlogits.argmax(axis=-1)]
+        assert model_next == want[2:5], (model_next, want[2:5])
+        await pipe.release(sid2)
+    finally:
+        pipe.close()
+
+
 def test_ep_pipeline_requires_full_expert_coverage():
     cfg = get_config("tiny-test-moe", max_context_length=32)
     params = T.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
