@@ -15,7 +15,7 @@ from dataclasses import dataclass, replace
 @dataclass(frozen=True)
 class ModelConfig:
     name: str = "custom"
-    family: str = "llama"  # "llama" | "gemma2" | "mixtral" | "qwen2" | "qwen3"
+    family: str = "llama"  # "llama" | "mistral" | "gemma2" | "mixtral" | "qwen2" | "qwen3"
     vocab_size: int = 32000
     hidden_size: int = 2048
     intermediate_size: int = 5632
@@ -128,6 +128,13 @@ TINY_TEST_QWEN3 = _register(ModelConfig(
     head_dim=32, qk_norm=True, rms_norm_eps=1e-6, max_context_length=256,
 ))
 
+TINY_TEST_MISTRAL = _register(ModelConfig(
+    name="tiny-test-mistral", family="mistral", vocab_size=512,
+    hidden_size=64, intermediate_size=128, num_layers=2, num_heads=4,
+    num_kv_heads=2, sliding_window=16, rms_norm_eps=1e-6,
+    max_context_length=256,
+))
+
 # ---- production models (BASELINE.json configs) ----------------------------
 
 TINYLLAMA_1_1B = _register(ModelConfig(
@@ -140,6 +147,12 @@ LLAMA3_8B = _register(ModelConfig(
     name="llama-3-8b", family="llama", vocab_size=128256, hidden_size=4096,
     intermediate_size=14336, num_layers=32, num_heads=32, num_kv_heads=8,
     rope_theta=500000.0, max_context_length=8192,
+))
+
+MISTRAL_7B = _register(ModelConfig(
+    name="mistral-7b", family="mistral", vocab_size=32000, hidden_size=4096,
+    intermediate_size=14336, num_layers=32, num_heads=32, num_kv_heads=8,
+    rope_theta=10000.0, sliding_window=4096, max_context_length=8192,
 ))
 
 LLAMA3_70B = _register(ModelConfig(

@@ -93,14 +93,17 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
 def layer_sliding_windows(cfg: ModelConfig) -> jnp.ndarray:
     """Per-layer sliding-window size ([L] int32, 0 = global attention).
 
-    Gemma-2 interleaves sliding (even) and global (odd) layers; other
-    families are all-global.
+    Gemma-2 interleaves sliding (even) and global (odd) layers; Mistral
+    windows EVERY layer; other families are all-global.
     """
     if cfg.sliding_window > 0:
-        return jnp.asarray(
-            [cfg.sliding_window if i % 2 == 0 else 0 for i in range(cfg.num_layers)],
-            jnp.int32,
-        )
+        if cfg.family == "gemma2":
+            return jnp.asarray(
+                [cfg.sliding_window if i % 2 == 0 else 0
+                 for i in range(cfg.num_layers)],
+                jnp.int32,
+            )
+        return jnp.full((cfg.num_layers,), cfg.sliding_window, jnp.int32)
     return jnp.zeros((cfg.num_layers,), jnp.int32)
 
 

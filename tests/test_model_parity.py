@@ -113,6 +113,57 @@ def test_qwen3_parity():
     _compare(cfg, transformers.Qwen3ForCausalLM(hf_cfg))
 
 
+def test_mistral_parity():
+    """Mistral = llama math with an ALL-layer sliding window: parity is
+    checked at seq 24 > window 16 so the window mask itself is exercised
+    (transformers' masking_utils applies it in eager mode too)."""
+    cfg = get_config("tiny-test-mistral")
+    assert cfg.sliding_window < 24
+    hf_cfg = transformers.MistralConfig(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        num_hidden_layers=cfg.num_layers,
+        num_attention_heads=cfg.num_heads,
+        num_key_value_heads=cfg.num_kv_heads,
+        rms_norm_eps=cfg.rms_norm_eps, rope_theta=cfg.rope_theta,
+        sliding_window=cfg.sliding_window,
+        max_position_embeddings=cfg.max_context_length,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    hf = transformers.MistralForCausalLM(hf_cfg)
+    hf.eval()
+    params = params_from_hf(cfg, state_dict_source(hf.state_dict()),
+                            dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    seq = 24  # > sliding_window: the mask matters
+    tokens = rng.integers(0, cfg.vocab_size, (B, seq))
+    with torch.no_grad():
+        ref = hf(torch.tensor(tokens)).logits.float().numpy()
+    pos = jnp.broadcast_to(jnp.arange(seq), (B, seq))
+    logits, ks, vs = T.prefill(params, cfg, jnp.asarray(tokens), pos)
+    got = np.asarray(logits, dtype=np.float32)
+    np.testing.assert_allclose(got, ref, atol=8e-3, rtol=0)
+    assert (got.argmax(-1) == ref.argmax(-1)).mean() > 0.95
+
+    # Decode step past the window boundary.
+    nxt = rng.integers(0, cfg.vocab_size, (B,))
+    with torch.no_grad():
+        ref_step = hf(torch.tensor(
+            np.concatenate([tokens, nxt[:, None]], axis=1)
+        )).logits[:, -1].float().numpy()
+    S = seq + 8
+    L, hkv, dh = cfg.num_layers, cfg.num_kv_heads, cfg.resolved_head_dim()
+    kc = jnp.zeros((L, B, hkv, S, dh), jnp.float32).at[:, :, :, :seq].set(ks)
+    vc = jnp.zeros((L, B, hkv, S, dh), jnp.float32).at[:, :, :, :seq].set(vs)
+    step_logits, _, _ = T.decode_step(
+        params, cfg, jnp.asarray(nxt), jnp.full((B,), seq),
+        kc, vc, jnp.full((B,), seq + 1),
+    )
+    np.testing.assert_allclose(np.asarray(step_logits), ref_step,
+                               atol=8e-3, rtol=0)
+
+
 def test_gemma2_parity():
     cfg = get_config("tiny-test-gemma")
     hf_cfg = transformers.Gemma2Config(
