@@ -80,6 +80,7 @@ def config_from_hf_dir(path: str | Path) -> ModelConfig:
     arch = (d.get("architectures") or [""])[0].lower()
     family = ("gemma2" if "gemma2" in arch
               else "mixtral" if "mixtral" in arch
+              else "mistral" if "mistral" in arch
               else "qwen3" if "qwen3" in arch
               else "qwen2" if "qwen2" in arch else "llama")
     return ModelConfig(
@@ -99,7 +100,12 @@ def config_from_hf_dir(path: str | Path) -> ModelConfig:
         attn_logit_softcap=d.get("attn_logit_softcapping") or 0.0,
         final_logit_softcap=d.get("final_logit_softcapping") or 0.0,
         query_pre_attn_scalar=d.get("query_pre_attn_scalar") or 0.0,
-        sliding_window=(d.get("sliding_window") or 0) if family == "gemma2" else 0,
+        # gemma2 interleaves windowed layers, mistral windows all of them
+        # (transformer.layer_sliding_windows patterns by family); other
+        # families ignore config.json's value — their serving paths have
+        # no windowed variant.
+        sliding_window=((d.get("sliding_window") or 0)
+                        if family in ("gemma2", "mistral") else 0),
         post_norms=family == "gemma2",
         embedding_multiplier=(d["hidden_size"] ** 0.5) if family == "gemma2" else 0.0,
         num_experts=d.get("num_local_experts", 0),

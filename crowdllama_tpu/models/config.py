@@ -32,7 +32,10 @@ class ModelConfig:
     query_pre_attn_scalar: float = 0.0  # 0 → 1/sqrt(head_dim)
     attn_logit_softcap: float = 0.0  # 0 → disabled
     final_logit_softcap: float = 0.0
-    sliding_window: int = 0  # 0 → all layers global; else even layers sliding
+    # 0 → all layers global.  >0: family-patterned (gemma2 windows even
+    # layers, mistral windows every layer — transformer.py
+    # layer_sliding_windows is the source of truth).
+    sliding_window: int = 0
     post_norms: bool = False  # post-attention/post-mlp RMSNorms (Gemma-2)
     embedding_multiplier: float = 0.0  # 0 → disabled (Gemma scales by sqrt(D))
 

@@ -164,6 +164,31 @@ def test_mistral_parity():
                                atol=8e-3, rtol=0)
 
 
+def test_config_from_hf_dir_family_sniffing(tmp_path):
+    """The registry-less checkpoint path must detect every family and keep
+    the window only where a windowed serving variant exists (a Mistral
+    dir silently dropping sliding_window would diverge past 4096 tokens
+    with no error)."""
+    import json
+
+    from crowdllama_tpu.engine.weights import config_from_hf_dir
+
+    base = dict(vocab_size=512, hidden_size=64, intermediate_size=128,
+                num_hidden_layers=2, num_attention_heads=4,
+                num_key_value_heads=2, rms_norm_eps=1e-6,
+                max_position_embeddings=256)
+    for arch, family, window, want_window in (
+            ("MistralForCausalLM", "mistral", 4096, 4096),
+            ("LlamaForCausalLM", "llama", 4096, 0),
+            ("Gemma2ForCausalLM", "gemma2", 32, 32),
+            ("Qwen3ForCausalLM", "qwen3", 0, 0)):
+        (tmp_path / "config.json").write_text(json.dumps(
+            {**base, "architectures": [arch], "sliding_window": window}))
+        cfg = config_from_hf_dir(tmp_path)
+        assert cfg.family == family, arch
+        assert cfg.sliding_window == want_window, arch
+
+
 def test_gemma2_parity():
     cfg = get_config("tiny-test-gemma")
     hf_cfg = transformers.Gemma2Config(
