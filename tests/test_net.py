@@ -133,6 +133,78 @@ async def test_dht_provide_and_find_providers():
             await h.close()
 
 
+async def test_provide_rate_limit_and_churn_floor():
+    """provide(min_interval=...) skips the network while nothing changed,
+    re-provides after a membership change but no faster than the
+    min_interval/20 churn floor (N joins must not cascade into a
+    re-provide storm), and never memoizes a rejected-everywhere provide."""
+    import asyncio
+
+    boot_host, boot_dht = await _mknode()
+    addr = f"127.0.0.1:{boot_host.listen_port}"
+    h1, d1 = await _mknode(bootstrap=addr)
+    try:
+        key = namespace_key()
+        rpcs = []
+        orig = d1._rpc
+
+        async def counting(c, payload):
+            if payload.get("op") == "add_provider":
+                rpcs.append(1)
+            return await orig(c, payload)
+
+        d1._rpc = counting
+        await d1.provide(key, min_interval=1.0)
+        first = len(rpcs)
+        assert first >= 1
+        # Unchanged fingerprint within min_interval: no network traffic.
+        await d1.provide(key, min_interval=1.0)
+        assert len(rpcs) == first
+        # Membership change within the churn floor (1.0/20 = 50 ms):
+        # still suppressed...
+        h2, d2 = await _mknode(bootstrap=addr)
+        d1.table.update(h2.contact)  # simulate learning the joiner
+        await d1.provide(key, min_interval=1.0)
+        assert len(rpcs) == first
+        # ...but after the floor elapses, the change re-provides.
+        await asyncio.sleep(0.06)
+        await d1.provide(key, min_interval=1.0)
+        assert len(rpcs) > first
+        await h2.close()
+    finally:
+        for h in (boot_host, h1):
+            await h.close()
+
+
+async def test_find_providers_keeps_walking_past_dead_closest():
+    """An all-failed alpha round is NOT steady state: the lookup must keep
+    walking toward live record holders instead of breaking after one
+    round (the crashed-closest-peers case)."""
+    boot_host, boot_dht = await _mknode()
+    addr = f"127.0.0.1:{boot_host.listen_port}"
+    h1, d1 = await _mknode(bootstrap=addr)   # provider
+    h2, d2 = await _mknode(bootstrap=addr)   # searcher
+    dead = []
+    try:
+        key = namespace_key()
+        await d1.provide(key)
+        # Poison the searcher's routing table with dead contacts so its
+        # closest candidates all fail before it reaches live nodes.
+        from crowdllama_tpu.net.host import Contact
+
+        for i in range(3):
+            c = Contact(peer_id=f"{'%040x' % (i + 1)}", host="127.0.0.1",
+                        port=1)  # nothing listens on port 1
+            d2.table.update(c)
+            dead.append(c)
+        providers = await d2.find_providers(key)
+        ids = {c.peer_id for c in providers}
+        assert h1.peer_id in ids, "lookup stopped at the dead closest peers"
+    finally:
+        for h in (boot_host, h1, h2):
+            await h.close()
+
+
 async def test_dht_find_peer():
     boot_host, _ = await _mknode()
     addr = f"127.0.0.1:{boot_host.listen_port}"
