@@ -173,9 +173,6 @@ def flash_paged_decode_attention(
     def kv_map(bi, hi, pi, tr, sr, wr):
         return (tr[bi, pi], hi, 0, 0)
 
-    def sc_map(bi, hi, pi, tr, sr, wr):
-        return (tr[bi, pi], hi, 0)
-
     in_specs = [
         pl.BlockSpec((None, None, g, dh), q_map),
         pl.BlockSpec((None, None, page, dh), kv_map),
@@ -183,9 +180,16 @@ def flash_paged_decode_attention(
     ]
     operands = [qg, pool_k, pool_v]
     if quant:
-        # Scales [P, Hkv, page] block to a [1, page] tile per grid step.
-        in_specs += [pl.BlockSpec((None, None, page), sc_map)] * 2
-        operands += [k_scale, v_scale]
+        # Scales block to a [1, page] tile per grid step.  Mosaic requires
+        # the block's last-two dims to divide (8, 128) or equal the array
+        # dims, so the pool-shaped [P, Hkv, page] scales carry an explicit
+        # unit sublane dim ([P, Hkv, 1, page]; block (1,1,1,page)) — a
+        # squeezed Hkv in second-to-last position fails to lower on real
+        # TPU (caught by the first on-chip compile, BENCH r4).  With the
+        # unit dim the scale index map is identical to the KV one.
+        in_specs += [pl.BlockSpec((None, None, 1, page), kv_map)] * 2
+        operands += [k_scale.reshape(*k_scale.shape[:2], 1, page),
+                     v_scale.reshape(*v_scale.shape[:2], 1, page)]
 
     kernel = functools.partial(
         _decode_kernel if quant else _decode_kernel_noscale,
