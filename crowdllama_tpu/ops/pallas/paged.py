@@ -5,15 +5,18 @@ The jnp paged path (engine/paged.py round 2) materialized a
 ``pool[page_table]`` view per layer — [B, max_pages, Hkv, page, Dh] of HBM
 traffic and scratch for what should be a streaming read (VERDICT r2
 missing #3; PAPERS.md names ragged paged attention as the TPU north star).
-Here the page table is a scalar-prefetch operand, so each (batch, kv-head,
-page) grid step DMAs exactly one [page, Dh] K tile and one V tile straight
-from the slot's page in the pool; online softmax carries (m, l, acc) in
-VMEM scratch across the sequential innermost page dimension.  HBM traffic
-is one read of the LIVE pages (dead pages are compute-skipped) and one
-[G, Dh] output write per (b, h).
+Here the page table is a scalar-prefetch operand, so each (batch, page)
+grid step DMAs one [Hkv, page, Dh] K tile and one V tile straight from
+the slot's page in the pool — all kv heads at once, keeping the
+sequential grid short (serving-shape per-page compute is tiny, so grid
+bubbles, not bytes, set the kernel's speed); online softmax carries
+(m, l, acc) in VMEM scratch across the sequential innermost page
+dimension.  HBM traffic is one read of the LIVE pages (dead pages are
+compute-skipped) and one [Hkv, G, Dh] output write per slot.
 
 int8 pools: K/V tiles stay int8 through the DMA (the bandwidth-bound
-bytes) and dequantize on the fly — K scales on the [G, page] score plane,
+bytes) and dequantize on the fly — K scales on the [Hkv, G, page] score
+plane,
 V scales folded into the probabilities — mirroring the contiguous
 ``decode_attention_q`` math (ops/attention.py), so paged + int8 KV compose
 (VERDICT r2 weak #2: the features must stop being pairwise exclusive).
@@ -56,6 +59,14 @@ def paged_pallas_supported(page_size: int, head_dim: int,
         # the kernel per-shard via shard_map, which needs the kv-head dim
         # (pool axis 1) to split evenly so each shard's grid is whole heads.
         return False
+    # Per grid step the kernel holds [Hkv/shard, page, Dh] K and V tiles
+    # (double-buffered) in VMEM; gate wide-Hkv (MHA-style) configs that
+    # would blow the budget.  num_kv_heads=0 (a generic availability
+    # probe) checks the single-head minimum — callers deciding the REAL
+    # kernel path must pass the model's kv-head count.
+    hkv_local = max(num_kv_heads, 1) // max(n_shards, 1)
+    if 4 * max(hkv_local, 1) * page_size * head_dim * 2 > 8 * 1024 * 1024:
+        return False
     # Block last-two dims are (page, head_dim); Mosaic pads sub-tile
     # extents, so sublane alignment suffices (TinyLlama Dh=64, Llama 128).
     return page_size % 8 == 0 and page_size >= 32 and head_dim % 8 == 0
@@ -67,25 +78,25 @@ def _decode_kernel(
     seqlen_ref,   # [B] int32 — valid positions incl. the pending token
     window_ref,   # [1] int32 — sliding window (<=0 disables)
     # operands
-    q_ref,        # [G, Dh]
-    k_ref,        # [page, Dh] — this grid step's page (bf16 or int8)
-    v_ref,        # [page, Dh]
-    ks_ref,       # [1, page] K scales or None (int8 pools only)
-    vs_ref,       # [1, page]
+    q_ref,        # [Hkv, G, Dh] — ALL kv heads of this slot
+    k_ref,        # [Hkv, page, Dh] — this grid step's page (bf16 or int8)
+    v_ref,        # [Hkv, page, Dh]
+    ks_ref,       # [Hkv, 1, page] K scales or None (int8 pools only)
+    vs_ref,       # [Hkv, 1, page]
     # output
-    o_ref,        # [G, Dh]
+    o_ref,        # [Hkv, G, Dh]
     # scratch
-    acc_ref,      # [G, Dh] f32
-    m_ref,        # [G, LANES] f32 (col 0 live)
-    l_ref,        # [G, LANES] f32
+    acc_ref,      # [Hkv, G, Dh] f32
+    m_ref,        # [Hkv, G, LANES] f32 (col 0 live)
+    l_ref,        # [Hkv, G, LANES] f32
     *,
     scale: float,
     softcap: float,
     page: int,
 ):
     b = pl.program_id(0)
-    p = pl.program_id(2)
-    num_pages = pl.num_programs(2)
+    p = pl.program_id(1)
+    num_pages = pl.num_programs(1)
     seq_len = seqlen_ref[b]
     window = window_ref[0]
 
@@ -99,14 +110,20 @@ def _decode_kernel(
 
     @pl.when(base < seq_len)
     def _body():
-        q = q_ref[...].astype(jnp.float32)           # [G, Dh]
-        k_tile = k_ref[...].astype(jnp.float32)      # [page, Dh]
+        q = q_ref[...].astype(jnp.float32)           # [Hkv, G, Dh]
+        k_tile = k_ref[...].astype(jnp.float32)      # [Hkv, page, Dh]
         v_tile = v_ref[...].astype(jnp.float32)
-        kpos = base + jax.lax.broadcasted_iota(jnp.int32, (1, page), 1)
+        kpos = base + jax.lax.broadcasted_iota(jnp.int32, (1, 1, page), 2)
 
-        # [G, page] = [G, Dh] · [page, Dh]^T
+        # [Hkv, G, page] = [Hkv, G, Dh] · [Hkv, page, Dh]^T — one batched
+        # MXU issue for every kv head of the slot.  Batching heads into
+        # the grid step (grid (B, NP), not (B, Hkv, NP)) divides the
+        # sequential grid length by Hkv; at serving shapes the per-step
+        # compute is tiny and the kernel is bubble-bound, so fewer, fatter
+        # steps is the difference between losing to the XLA gather path
+        # and beating it (measured on-chip, BENCH r4).
         logits = jax.lax.dot_general(
-            q, k_tile, (((1,), (1,)), ((), ())),
+            q, k_tile, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         ) * scale
         if ks_ref is not None:
@@ -119,8 +136,8 @@ def _decode_kernel(
         mask &= (window <= 0) | (kpos > (seq_len - 1) - window)
         logits = jnp.where(mask, logits, NEG_INF)
 
-        m_prev = m_ref[:, :1]                        # [G, 1]
-        l_prev = l_ref[:, :1]
+        m_prev = m_ref[:, :, :1]                     # [Hkv, G, 1]
+        l_prev = l_ref[:, :, :1]
         m_new = jnp.maximum(m_prev, jnp.max(logits, axis=-1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
         pr = jnp.exp(logits - m_new) * mask.astype(jnp.float32)
@@ -128,7 +145,7 @@ def _decode_kernel(
         if vs_ref is not None:
             pr = pr * vs_ref[...].astype(jnp.float32)  # fold V scales
         pv = jax.lax.dot_general(
-            pr, v_tile, (((1,), (0,)), ((), ())),
+            pr, v_tile, (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         )
         acc_ref[...] = acc_ref[...] * alpha + pv
@@ -137,7 +154,7 @@ def _decode_kernel(
 
     @pl.when(p == num_pages - 1)
     def _finalize():
-        l = l_ref[:, :1]
+        l = l_ref[:, :, :1]
         l = jnp.where(l == 0.0, 1.0, l)
         o_ref[...] = (acc_ref[...] / l).astype(o_ref.dtype)
 
@@ -167,27 +184,26 @@ def flash_paged_decode_attention(
     window = jnp.asarray(sliding_window, jnp.int32).reshape(1)
 
     # Index maps receive (grid indices..., *scalar-prefetch refs).
-    def q_map(bi, hi, pi, tr, sr, wr):
-        return (bi, hi, 0, 0)
+    def q_map(bi, pi, tr, sr, wr):
+        return (bi, 0, 0, 0)
 
-    def kv_map(bi, hi, pi, tr, sr, wr):
-        return (tr[bi, pi], hi, 0, 0)
+    def kv_map(bi, pi, tr, sr, wr):
+        return (tr[bi, pi], 0, 0, 0)
 
     in_specs = [
-        pl.BlockSpec((None, None, g, dh), q_map),
-        pl.BlockSpec((None, None, page, dh), kv_map),
-        pl.BlockSpec((None, None, page, dh), kv_map),
+        pl.BlockSpec((None, hkv, g, dh), q_map),
+        pl.BlockSpec((None, hkv, page, dh), kv_map),
+        pl.BlockSpec((None, hkv, page, dh), kv_map),
     ]
     operands = [qg, pool_k, pool_v]
     if quant:
-        # Scales block to a [1, page] tile per grid step.  Mosaic requires
-        # the block's last-two dims to divide (8, 128) or equal the array
-        # dims, so the pool-shaped [P, Hkv, page] scales carry an explicit
-        # unit sublane dim ([P, Hkv, 1, page]; block (1,1,1,page)) — a
-        # squeezed Hkv in second-to-last position fails to lower on real
-        # TPU (caught by the first on-chip compile, BENCH r4).  With the
-        # unit dim the scale index map is identical to the KV one.
-        in_specs += [pl.BlockSpec((None, None, 1, page), kv_map)] * 2
+        # Scales block to a [Hkv, 1, page] tile per grid step.  Mosaic
+        # requires the block's last-two dims to divide (8, 128) or equal
+        # the array dims, so the pool-shaped [P, Hkv, page] scales carry
+        # an explicit unit sublane dim ([P, Hkv, 1, page]) — a squeezed
+        # dim in second-to-last position fails to lower on real TPU
+        # (caught by the first on-chip compile, BENCH r4).
+        in_specs += [pl.BlockSpec((None, hkv, 1, page), kv_map)] * 2
         operands += [k_scale.reshape(*k_scale.shape[:2], 1, page),
                      v_scale.reshape(*v_scale.shape[:2], 1, page)]
 
@@ -197,13 +213,13 @@ def flash_paged_decode_attention(
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
-        grid=(b, hkv, np_),
+        grid=(b, np_),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((None, None, g, dh), q_map),
+        out_specs=pl.BlockSpec((None, hkv, g, dh), q_map),
         scratch_shapes=[
-            pltpu.VMEM((g, dh), jnp.float32),
-            pltpu.VMEM((g, _LANES), jnp.float32),
-            pltpu.VMEM((g, _LANES), jnp.float32),
+            pltpu.VMEM((hkv, g, dh), jnp.float32),
+            pltpu.VMEM((hkv, g, _LANES), jnp.float32),
+            pltpu.VMEM((hkv, g, _LANES), jnp.float32),
         ],
     )
     out = pl.pallas_call(
@@ -230,7 +246,7 @@ def flash_paged_decode_attention_tp(
 ) -> jnp.ndarray:
     """The fused kernel on a tp-sharded pool, via ``shard_map``.
 
-    Every (batch, kv-head, page) grid cell is independent, and the engine
+    Every (batch, page) grid cell is independent, and the engine
     shards BOTH q's heads and the pool's kv heads over the same tp axis in
     the same kv-major order (engine/paged.py init_state / runner.py q
     projection) — so each shard just runs the kernel over its own heads
