@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from crowdllama_tpu.models.config import ModelConfig
-from crowdllama_tpu.ops.quant import dequant, quantize_kv
+from crowdllama_tpu.ops.quant import qeinsum, qragged_dot, quantize_kv
 from crowdllama_tpu.ops.attention import (
     decode_attention,
     decode_attention_q,
@@ -129,8 +129,8 @@ def _unembed(params: Params, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
         logits = jnp.einsum("...d,vd->...v", x.astype(jnp.float32),
                             params["embed"].astype(jnp.float32))
     else:
-        logits = jnp.einsum("...d,dv->...v", x.astype(jnp.float32),
-                            dequant(params["lm_head"]).astype(jnp.float32))
+        logits = qeinsum("...d,dv->...v", x.astype(jnp.float32),
+                         params["lm_head"], dtype=jnp.float32)
     if cfg.final_logit_softcap > 0:
         logits = cfg.final_logit_softcap * jnp.tanh(logits / cfg.final_logit_softcap)
     return logits
@@ -138,10 +138,10 @@ def _unembed(params: Params, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
 
 def _mlp(lp: Params, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
     """Dense SwiGLU (Llama) / GeGLU-tanh (Gemma) MLP. x: [..., D]."""
-    gate = jnp.einsum("...d,df->...f", x, dequant(lp["w_gate"]))
-    up = jnp.einsum("...d,df->...f", x, dequant(lp["w_up"]))
+    gate = qeinsum("...d,df->...f", x, lp["w_gate"])
+    up = qeinsum("...d,df->...f", x, lp["w_up"])
     act = jax.nn.gelu(gate, approximate=True) if cfg.family == "gemma2" else jax.nn.silu(gate)
-    return jnp.einsum("...f,fd->...d", act * up, dequant(lp["w_down"]))
+    return qeinsum("...f,fd->...d", act * up, lp["w_down"])
 
 
 def _route_topk(lp: Params, cfg: ModelConfig, x: jnp.ndarray):
@@ -161,10 +161,10 @@ def _moe_dense(lp: Params, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
     one_hot = jax.nn.one_hot(topi, cfg.num_experts, dtype=jnp.float32)  # [...,K,E]
     weights = jnp.einsum("...ke,...k->...e", one_hot, topw)
 
-    gate = jnp.einsum("...d,edf->...ef", x, dequant(lp["w_gate"]))
-    up = jnp.einsum("...d,edf->...ef", x, dequant(lp["w_up"]))
+    gate = qeinsum("...d,edf->...ef", x, lp["w_gate"])
+    up = qeinsum("...d,edf->...ef", x, lp["w_up"])
     act = jax.nn.silu(gate) * up
-    per_expert = jnp.einsum("...ef,efd->...ed", act, dequant(lp["w_down"]))  # [..., E, D]
+    per_expert = qeinsum("...ef,efd->...ed", act, lp["w_down"])  # [..., E, D]
     out = jnp.einsum("...ed,...e->...d", per_expert.astype(jnp.float32), weights)
     return out.astype(x.dtype)
 
@@ -195,11 +195,11 @@ def _moe_sorted(lp: Params, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
     xs = jnp.take(xf, t_flat[order], axis=0)       # [NK, D]
     group_sizes = jnp.bincount(e_flat, length=cfg.num_experts)
 
-    gate = jax.lax.ragged_dot(xs, dequant(lp["w_gate"]), group_sizes)
-    up = jax.lax.ragged_dot(xs, dequant(lp["w_up"]), group_sizes)
+    gate = qragged_dot(xs, lp["w_gate"], group_sizes)
+    up = qragged_dot(xs, lp["w_up"], group_sizes)
     act = jax.nn.silu(gate) * up
-    ys = jax.lax.ragged_dot(act.astype(xs.dtype), dequant(lp["w_down"]),
-                            group_sizes)           # [NK, D]
+    ys = qragged_dot(act.astype(xs.dtype), lp["w_down"],
+                     group_sizes)                  # [NK, D]
 
     contrib = ys.astype(jnp.float32) * w_flat[order][:, None]
     out = jnp.zeros((n, d), jnp.float32).at[t_flat[order]].add(contrib)
@@ -258,9 +258,9 @@ def scan_prefill_layers(
         else:
             lp, window = scanned
         h = rms_norm(x, lp["ln1"], cfg.rms_norm_eps, plus_one=cfg.family == "gemma2")
-        q = jnp.einsum("btd,dk->btk", h, dequant(lp["wq"]))
-        k = jnp.einsum("btd,dk->btk", h, dequant(lp["wk"]))
-        v = jnp.einsum("btd,dk->btk", h, dequant(lp["wv"]))
+        q = qeinsum("btd,dk->btk", h, lp["wq"])
+        k = qeinsum("btd,dk->btk", h, lp["wk"])
+        v = qeinsum("btd,dk->btk", h, lp["wv"])
         if "bq" in lp:  # Qwen2 qkv bias
             q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
         q = q.reshape(b, t, cfg.num_heads, dh)
@@ -288,7 +288,7 @@ def scan_prefill_layers(
                                      softcap=cfg.attn_logit_softcap,
                                      sliding_window=window, kv_valid=kv_valid,
                                      n_shards=n_shards)
-        attn = jnp.einsum("btk,kd->btd", attn.reshape(b, t, -1), dequant(lp["wo"]))
+        attn = qeinsum("btk,kd->btd", attn.reshape(b, t, -1), lp["wo"])
         if cfg.post_norms:
             attn = rms_norm(attn, lp["post_ln1"], cfg.rms_norm_eps, plus_one=True)
         x = x + attn
@@ -394,9 +394,9 @@ def decode_layer_body(
     b = x.shape[0]
     dh = cfg.resolved_head_dim()
     h = rms_norm(x, lp["ln1"], cfg.rms_norm_eps, plus_one=cfg.family == "gemma2")
-    q = jnp.einsum("bd,dk->bk", h, dequant(lp["wq"]))
-    k = jnp.einsum("bd,dk->bk", h, dequant(lp["wk"]))
-    v = jnp.einsum("bd,dk->bk", h, dequant(lp["wv"]))
+    q = qeinsum("bd,dk->bk", h, lp["wq"])
+    k = qeinsum("bd,dk->bk", h, lp["wk"])
+    v = qeinsum("bd,dk->bk", h, lp["wv"])
     if "bq" in lp:  # Qwen2 qkv bias
         q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
     q = q.reshape(b, cfg.num_heads, dh)
@@ -408,7 +408,7 @@ def decode_layer_body(
     q = apply_rope(q[:, None], positions[:, None], cos, sin)[:, 0]
     k = apply_rope(k[:, None], positions[:, None], cos, sin)[:, 0]
     attn = attn_fn(q, k, v)
-    attn = jnp.einsum("bk,kd->bd", attn.reshape(b, -1), dequant(lp["wo"]))
+    attn = qeinsum("bk,kd->bd", attn.reshape(b, -1), lp["wo"])
     if cfg.post_norms:
         attn = rms_norm(attn, lp["post_ln1"], cfg.rms_norm_eps, plus_one=True)
     x = x + attn

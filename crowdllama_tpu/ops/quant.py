@@ -54,15 +54,24 @@ class QTensor:
 @jax.tree_util.register_dataclass
 @dataclass
 class QTensor4:
-    """int4 weight + GROUP-wise scales (one per ``group`` input rows per
-    output channel).
+    """Nibble-packed int4 weight + GROUP-wise scales (one per ``group``
+    input rows per output channel).
 
-    ``q`` keeps the source shape [..., d_in, d_out]; ``s`` is
-    [..., d_in/group, d_out] — same rank as the weight, so the weight's
-    PartitionSpec applies to both.  int4 needs finer scale granularity than
-    int8's per-channel to hold accuracy; group-wise is the standard point
-    (AWQ/GPTQ-style), and the dequant reshape+broadcast still fuses into
-    the consumer matmul's operand read.
+    ``q`` is int8 of shape [..., d_in, d_out/2]: output columns 2j and
+    2j+1 pack into one byte (low/high nibble — XLA's own little-endian
+    sub-byte order, see quantize_weight_int4).  Packed int8 — not
+    ``jnp.int4``
+    — because (a) the bandwidth win comes from the BYTES streamed, which
+    sub-byte jnp arrays only deliver through layout paths that are
+    broken on the tunneled TPU platform (device_put recursion when an
+    int4 leaf crosses a jit boundary — found on-chip, BENCH r4), and
+    (b) the in-jit unpack (bitcast + trailing reshape) is zero-movement.
+    ``s`` is [..., d_in/group, d_out] — same rank as the weight, so the
+    weight's PartitionSpec applies to both (a tp shard of the packed
+    output dim keeps nibble pairs intact for any even per-shard extent).
+    int4 needs finer scale granularity
+    than int8's per-channel to hold accuracy; group-wise is the standard
+    point (AWQ/GPTQ-style).
     """
 
     q: jnp.ndarray
@@ -70,7 +79,8 @@ class QTensor4:
 
     @property
     def shape(self):
-        return self.q.shape
+        """LOGICAL (unpacked) weight shape."""
+        return (*self.q.shape[:-1], self.q.shape[-1] * 2)
 
 
 def quantize_weight(w: jnp.ndarray, scale_dtype=jnp.bfloat16) -> QTensor:
@@ -86,14 +96,28 @@ GROUP = 64  # int4 scale group (input rows per scale)
 
 def quantize_weight_int4(w: jnp.ndarray, group: int = GROUP,
                          scale_dtype=jnp.bfloat16) -> QTensor4:
-    """Symmetric group-wise int4 over the input dim (axis -2)."""
+    """Symmetric group-wise int4 over the input dim (axis -2), packed two
+    values per int8 byte (see QTensor4)."""
     a = jnp.asarray(w, jnp.float32)
     *batch, d_in, d_out = a.shape
+    if d_out % 2:
+        raise ValueError(f"int4 packing needs an even output dim, got {d_out}")
     g = group if d_in % group == 0 else d_in  # fall back to one group
     ar = a.reshape(*batch, d_in // g, g, d_out)
     s = jnp.max(jnp.abs(ar), axis=-2, keepdims=True) / 7.0 + 1e-12
-    q = jnp.clip(jnp.round(ar / s), -7, 7).astype(jnp.int4)
-    return QTensor4(q=q.reshape(*batch, d_in, d_out),
+    q = jnp.clip(jnp.round(ar / s), -7, 7).astype(jnp.int32)
+    q = q.reshape(*batch, d_in, d_out)
+    # COLUMN packing, matching XLA's little-endian sub-byte layout:
+    # output columns 2j (low nibble) and 2j+1 (high nibble) share a byte,
+    # so the unpack is ``lax.bitcast_convert_type(int8 -> int4)`` — shape
+    # [..., d_in, d_out/2, 2] — plus a trailing-dims reshape: both are
+    # zero-movement layout ops, and the remaining convert+scale is the
+    # same pattern as int8's dequant, which fuses into the consumer
+    # matmul's operand read.  Row-direction packings (interleave or
+    # halves + shifts/concat) all measured as materialization barriers
+    # on-chip.  The signed high nibble keeps packed values inside int8.
+    packed = ((q[..., 1::2] << 4) | (q[..., 0::2] & 0xF))
+    return QTensor4(q=packed.astype(jnp.int8),
                     s=s.squeeze(-2).astype(scale_dtype))
 
 
@@ -103,11 +127,30 @@ def dequant(t) -> jnp.ndarray:
     if isinstance(t, QTensor):
         return t.q.astype(t.s.dtype) * t.s[..., None, :]
     if isinstance(t, QTensor4):
-        *batch, d_in, d_out = t.q.shape
+        *batch, d_in, d_out = t.shape
+        w4 = jax.lax.bitcast_convert_type(t.q, jnp.int4)  # [.., di, do/2, 2]
         n_g = t.s.shape[-2]
-        w = t.q.astype(t.s.dtype).reshape(*batch, n_g, d_in // n_g, d_out)
+        w = w4.astype(t.s.dtype).reshape(*batch, n_g, d_in // n_g, d_out)
         return (w * t.s[..., :, None, :]).reshape(*batch, d_in, d_out)
     return t
+
+
+def qeinsum(subscript: str, x: jnp.ndarray, w, dtype=None) -> jnp.ndarray:
+    """``jnp.einsum`` against a possibly-quantized weight (QTensor,
+    QTensor4, or a plain array).  The dequant is expressed so XLA fuses
+    it into the matmul's operand read — for packed int4 that hinges on
+    the zero-movement bitcast unpack (see QTensor4); for int8 it is the
+    plain convert+scale."""
+    wd = dequant(w)
+    if dtype is not None:
+        wd = wd.astype(dtype)
+    return jnp.einsum(subscript, x, wd)
+
+
+def qragged_dot(xs: jnp.ndarray, w, group_sizes: jnp.ndarray) -> jnp.ndarray:
+    """``lax.ragged_dot`` against a possibly-quantized expert bank
+    ([E, d_in, d_out])."""
+    return jax.lax.ragged_dot(xs, dequant(w), group_sizes)
 
 
 def quantize_params(params: Params, extra_keys: tuple[str, ...] = ("lm_head",),
@@ -178,8 +221,9 @@ def random_quantized_params(cfg, key: jax.Array, dtype=jnp.bfloat16,
             d_in = sds.shape[-2]
             if mode == "int4":
                 g = GROUP if d_in % GROUP == 0 else d_in
-                q = jax.random.randint(k, sds.shape, -7, 8,
-                                       dtype=jnp.int32).astype(jnp.int4)
+                packed_shape = sds.shape[:-1] + (sds.shape[-1] // 2,)
+                q = jax.random.randint(k, packed_shape, -112, 128,
+                                       dtype=jnp.int32).astype(jnp.int8)
                 s = jnp.full(sds.shape[:-2] + (d_in // g, sds.shape[-1]),
                              1.0 / (7.0 * math.sqrt(d_in)), dtype)
                 return QTensor4(q=q, s=s)

@@ -209,7 +209,12 @@ def test_int4_roundtrip_and_groups():
 
     w = jax.random.normal(jax.random.PRNGKey(0), (128, 16), jnp.float32)
     qt = quantize_weight_int4(w, group=64)
-    assert qt.q.dtype == jnp.int4 and qt.s.shape == (2, 16)
+    # Nibble-packed: int8 carrier at half the output columns, logical
+    # shape preserved (sub-byte jnp dtypes cannot cross jit on the
+    # tunneled TPU platform, and the bitcast unpack is what keeps the
+    # dequant fused into the consumer matmul — see QTensor4).
+    assert qt.q.dtype == jnp.int8 and qt.q.shape == (128, 8)
+    assert qt.shape == (128, 16) and qt.s.shape == (2, 16)
     back = np.asarray(dequant(qt), np.float32)
     scale = np.repeat(np.asarray(qt.s, np.float32), 64, axis=0)
     err = np.abs(back - np.asarray(w))
