@@ -22,6 +22,13 @@ SHARD_PROTOCOL = "/crowdllama/shard/1.0.0"
 # The reference gets relay/hole-punch handling from libp2p
 # (/root/reference/pkg/dht/dht.go:386-395, internal/discovery/discovery.go:62).
 RELAY_PROTOCOL = "/crowdllama/relay/1.0.0"
+# DCUtR-style connection reversal (libp2p's hole-punch fast path,
+# internal/discovery/discovery.go:62): a NATed worker dials a PUBLIC
+# requester back directly, so only the signaling rides the relay — the
+# data path goes direct.  This is the plaintext opening marker the
+# reversed TCP connection presents at the requester's listener; the full
+# signed-hello + AEAD handshake then runs over it as usual.
+REVERSE_PROTOCOL = "/crowdllama/reverse/1.0.0"
 # Swarm model distribution: hash-verified safetensors transfer between
 # workers (net/model_share.py).  The reference inherits `ollama pull`
 # (/root/reference/cmd/crowdllama/main.go:49-78 embeds the Ollama CLI);

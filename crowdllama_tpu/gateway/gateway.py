@@ -463,6 +463,13 @@ class Gateway:
                 lines.append(
                     f'crowdllama_worker_healthy{{peer="{pid}"}} '
                     f'{1 if p.is_healthy else 0}')
+        # Stream-path counters (host-level): how this node's streams
+        # actually traveled — direct, relay-spliced, or reversed
+        # (net/relay.py connection reversal).
+        lines.append("# TYPE crowdllama_host_streams_total counter")
+        for k, v in sorted(self.peer.host.stats.items()):
+            lines.append(
+                f'crowdllama_host_streams_total{{kind="{k}"}} {v}')
         return web.Response(text="\n".join(lines) + "\n",
                             content_type="text/plain")
 

@@ -38,7 +38,7 @@ from cryptography.hazmat.primitives.serialization import (
     PublicFormat,
 )
 
-from crowdllama_tpu.core.protocol import RELAY_PROTOCOL
+from crowdllama_tpu.core.protocol import RELAY_PROTOCOL, REVERSE_PROTOCOL
 from crowdllama_tpu.net.secure import (
     SecureReader,
     SecureWriter,
@@ -51,6 +51,11 @@ _LEN = struct.Struct(">I")
 MAX_JSON_FRAME = 1 * 1024 * 1024
 HELLO_MAX_SKEW = 300.0  # seconds of clock skew tolerated in signed hellos
 HANDSHAKE_TIMEOUT = 10.0
+# Connection reversal: how long to wait for the reversed dial before the
+# splice fallback, and how long to stop trying a peer whose reversal
+# failed (its NAT filters egress, or its relay dropped the signal).
+REVERSE_WAIT = 4.0
+REVERSE_FAIL_COOLDOWN = 60.0
 
 log = logging.getLogger("crowdllama.net.host")
 
@@ -205,6 +210,17 @@ class Host:
         # peerstores never learn this node's (unreachable) direct address.
         self.relay_contact: Contact | None = None
         self.hello_dialable = True
+        # Connection reversal (REVERSE_PROTOCOL): True once a dialback
+        # probe confirmed OUR listen port is publicly reachable — only
+        # then do relayed dials ask the target to dial us back directly
+        # (None = unknown, False = confirmed NATed; both mean "splice").
+        self.reverse_dialable: bool | None = None
+        self._reverse_waiters: dict[str, asyncio.Future] = {}
+        # peer_id -> monotonic time of last failed reversal: a worker that
+        # cannot dial us back (egress-filtered NAT) must not cost every
+        # later stream the reversal wait — go straight to the splice for
+        # a cooldown instead.
+        self._reverse_failed_at: dict[str, float] = {}
         self._handlers: dict[str, StreamHandler] = {}
         self._server: asyncio.Server | None = None
         # peerstore: peer_id -> Contact learned from hellos / DHT results
@@ -377,7 +393,31 @@ class Host:
         """Open ``protocol`` to a NATed peer through its relay: dial the
         relay, ask it to splice us to ``target.peer_id``, then run the
         normal end-to-end handshake through the splice — the relay carries
-        only the inner ciphertext."""
+        only the inner ciphertext.
+
+        When OUR OWN listen port is dialback-confirmed public
+        (``reverse_dialable``), try connection reversal first: the relay
+        only signals the NATed peer to dial us back, and the data path
+        goes direct instead of hairpinning every byte through the relay
+        (libp2p's DCUtR fast path; the reference inherits hole punching
+        from libp2p, internal/discovery/discovery.go:62).  Any reversal
+        failure falls back to the splice."""
+        failed_at = self._reverse_failed_at.get(target.peer_id, 0.0)
+        if (self.reverse_dialable and self.listen_port
+                and time.monotonic() - failed_at > REVERSE_FAIL_COOLDOWN
+                and not os.environ.get("CROWDLLAMA_TPU_NO_REVERSE")):
+            try:
+                stream = await self._new_stream_reversed(target, protocol,
+                                                         timeout)
+                self._reverse_failed_at.pop(target.peer_id, None)
+                return stream
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                self._reverse_failed_at[target.peer_id] = time.monotonic()
+                log.debug("reverse connect to %s failed (%s); falling "
+                          "back to relay splice for %ds",
+                          target.peer_id[:8], e, int(REVERSE_FAIL_COOLDOWN))
         outer = await self.new_stream(f"{target.host}:{target.port}",
                                       RELAY_PROTOCOL, timeout)
         try:
@@ -397,6 +437,46 @@ class Host:
             outer.close()
             raise
 
+    async def _new_stream_reversed(self, target: Contact, protocol: str,
+                                   timeout: float) -> Stream:
+        """Connection reversal: ask the relay to have ``target`` dial OUR
+        listener directly, then run the normal client handshake over the
+        reversed TCP connection (we stay the protocol client even though
+        the TCP roles are swapped)."""
+        nonce = os.urandom(16).hex()
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._reverse_waiters[nonce] = fut
+        try:
+            outer = await self.new_stream(f"{target.host}:{target.port}",
+                                          RELAY_PROTOCOL, timeout)
+            try:
+                await write_json_frame(outer.writer, {
+                    "op": "connect_reverse", "target": target.peer_id,
+                    "port": self.listen_port, "nonce": nonce})
+                reply = await read_json_frame(outer.reader, timeout)
+                if not reply.get("ok"):
+                    raise HandshakeError(
+                        f"relay refused reversal: {reply.get('error')}")
+            finally:
+                outer.close()
+            # Cap the wait below the stream timeout: a failed reversal
+            # must leave room for the splice fallback even when the
+            # caller passed a short timeout.
+            reader, writer = await asyncio.wait_for(
+                fut, min(REVERSE_WAIT, timeout / 2))
+        finally:
+            self._reverse_waiters.pop(nonce, None)
+        try:
+            stream = await self._client_handshake(
+                reader, writer, protocol, target.peer_id, timeout,
+                contact=lambda rid: target)
+        except Exception:
+            writer.close()
+            raise
+        self.stats["streams_reversed_out"] = (
+            self.stats.get("streams_reversed_out", 0) + 1)
+        return stream
+
     # -- inbound -----------------------------------------------------------
 
     async def _on_connection(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
@@ -406,6 +486,21 @@ class Host:
             task.add_done_callback(self._conn_tasks.discard)
         peername = writer.get_extra_info("peername")
         await self._serve_pipe(reader, writer, peername)
+
+    async def serve_reversed(self, reader: asyncio.StreamReader,
+                             writer: asyncio.StreamWriter) -> None:
+        """Serve one OUTBOUND TCP connection we opened as a connection
+        reversal (net/relay.py RelayClient): after the REVERSE marker
+        frame, the remote requester runs the client handshake, so this
+        side serves the pipe exactly like an accepted connection."""
+        task = asyncio.current_task()
+        if task is not None:
+            self._conn_tasks.add(task)
+            task.add_done_callback(self._conn_tasks.discard)
+        self.stats["streams_reversed_in"] = (
+            self.stats.get("streams_reversed_in", 0) + 1)
+        await self._serve_pipe(reader, writer,
+                               writer.get_extra_info("peername"))
 
     async def serve_relayed(self, outer: Stream) -> None:
         """Serve one inbound stream arriving through a relay splice: run
@@ -424,11 +519,30 @@ class Host:
         pipe (direct TCP or relay splice — ``peername`` None for relayed
         pipes: the observed address would be the relay's, not the peer's)."""
         handshaked = False
+        handoff = False
         try:
             # Nonce exchange first (see new_stream).
             opening = await read_json_frame(reader, HANDSHAKE_TIMEOUT)
             proto = str(opening.get("proto", ""))
             client_nonce = str(opening.get("nonce", ""))
+            if proto == REVERSE_PROTOCOL:
+                # A reversed TCP connection we asked for: hand the raw
+                # pipe to the waiting dial, which runs the CLIENT
+                # handshake over it (_new_stream_reversed).  The nonce
+                # traveled to the dialing peer over the encrypted relay
+                # control stream, so it cannot be known to bystanders —
+                # and a forged claim would still fail the signed-hello
+                # identity check that follows.
+                fut = self._reverse_waiters.pop(client_nonce, None)
+                if fut is not None and not fut.done():
+                    handoff = True
+                    fut.set_result((reader, writer))
+                    return  # ownership transferred: do NOT close
+                self.stats["rejected"] += 1
+                await write_json_frame(
+                    writer, {"error": "unknown reversal nonce"})
+                writer.close()
+                return
             handler = self._handlers.get(proto)
             if handler is None:
                 self.stats["rejected"] += 1
@@ -508,10 +622,11 @@ class Host:
         except Exception:
             log.exception("stream handler error")
         finally:
-            try:
-                writer.close()
-            except Exception:
-                pass
+            if not handoff:
+                try:
+                    writer.close()
+                except Exception:
+                    pass
 
     def _pubkey_hex(self) -> str:
         from cryptography.hazmat.primitives import serialization

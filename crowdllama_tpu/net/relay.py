@@ -26,6 +26,16 @@ node:
 Reachability is probed with ``dialback``: the relay attempts a plain TCP
 connect to the worker's observed source IP + advertised port; workers in
 ``relay_mode=auto`` relay only when the dialback fails.
+
+Connection reversal (``connect_reverse`` + RelayClient._reverse) is the
+DCUtR-style hole-punch fast path: when the DIALING side's own listen
+port is dialback-confirmed public, the relay forwards one signaling
+frame and the NATed worker dials the requester back directly — outbound
+TCP traverses the worker's NAT unaided, so the data path (inference
+streams, model pulls) never hairpins through the relay.  Only the
+both-sides-NATed case still splices; a TCP simultaneous-open punch for
+that case is deliberately out of scope (unportable timing games for the
+minority topology).
 """
 
 from __future__ import annotations
@@ -52,6 +62,11 @@ CONTROL_IDLE = 3 * PING_INTERVAL
 SPLICE_CHUNK = 64 * 1024
 MAX_REGISTRATIONS = 10_000
 MAX_SPLICES_PER_PEER = 64
+# Worker-side cap on concurrent reverse-dial tasks: each is an outbound
+# TCP connect to a requester-chosen address, so without a bound a
+# flooding requester (or malicious relay) could drive unbounded dial
+# work from the NATed worker — the reversal analog of the splice cap.
+MAX_REVERSE_DIALS = 32
 
 
 class _Registration:
@@ -99,6 +114,10 @@ class RelayService:
                 await self._handle_register(stream)
             elif op == "connect":
                 await self._handle_connect(stream, str(req.get("target", "")))
+            elif op == "connect_reverse":
+                await self._handle_connect_reverse(
+                    stream, str(req.get("target", "")),
+                    int(req.get("port", 0)), str(req.get("nonce", "")))
             elif op == "accept":
                 await self._handle_accept(stream, str(req.get("conn_id", "")))
             elif op == "dialback":
@@ -181,6 +200,33 @@ class RelayService:
             reg.splices -= 1
             done.set()
 
+    async def _handle_connect_reverse(self, stream: Stream, target: str,
+                                      port: int, nonce: str) -> None:
+        """Connection reversal signaling (the DCUtR fast path): tell the
+        relayed ``target`` to dial the requester back directly at the
+        requester's socket-observed IP + advertised listen port.  The
+        relay carries ONE control frame — the data path never touches it.
+        The requester falls back to a normal ``connect`` splice if the
+        reverse dial doesn't arrive."""
+        reg = self._workers.get(target)
+        if reg is None:
+            await write_json_frame(
+                stream.writer,
+                {"ok": False, "error": f"peer {target[:8]} not relayed here"})
+            return
+        ip = stream.observed_ip
+        if not ip and stream.remote_contact is not None:
+            ip = stream.remote_contact.host
+        if not ip or not (0 < port < 65536) or not nonce:
+            await write_json_frame(
+                stream.writer,
+                {"ok": False, "error": "no dialable requester address"})
+            return
+        async with reg.lock:
+            await write_json_frame(reg.stream.writer, {
+                "op": "reverse", "addr": f"{ip}:{port}", "nonce": nonce})
+        await write_json_frame(stream.writer, {"ok": True})
+
     async def _handle_accept(self, stream: Stream, conn_id: str) -> None:
         fut = self._pending.pop(conn_id, None)
         if fut is None or fut.done():
@@ -261,7 +307,11 @@ class RelayClient:
         self.on_relay_change = on_relay_change
         self._task: asyncio.Task | None = None
         self._accepts: set[asyncio.Task] = set()
+        self._reverse_dials = 0  # in-flight reverse dials (MAX_REVERSE_DIALS)
         self.registered = asyncio.Event()
+
+    def _reverse_done(self, _task: asyncio.Task) -> None:
+        self._reverse_dials -= 1
 
     def _next_candidate(self) -> str:
         """Next failover relay, rotating past the current one."""
@@ -329,6 +379,18 @@ class RelayClient:
                                 self._accept(str(frame["conn_id"])))
                             self._accepts.add(t)
                             t.add_done_callback(self._accepts.discard)
+                        elif frame.get("op") == "reverse":
+                            if self._reverse_dials >= MAX_REVERSE_DIALS:
+                                log.warning("reverse dial cap reached; "
+                                            "dropping request")
+                                continue
+                            self._reverse_dials += 1
+                            t = asyncio.create_task(
+                                self._reverse(str(frame.get("addr", "")),
+                                              str(frame.get("nonce", ""))))
+                            self._accepts.add(t)
+                            t.add_done_callback(self._accepts.discard)
+                            t.add_done_callback(self._reverse_done)
                 finally:
                     ping.cancel()
             except asyncio.CancelledError:
@@ -382,6 +444,39 @@ class RelayClient:
             log.debug("relayed stream failed: %s", e)
         finally:
             outer.close()
+
+
+    async def _reverse(self, addr: str, nonce: str) -> None:
+        """Dial a PUBLIC requester back directly (connection reversal):
+        outbound TCP works from behind the NAT, so after the plaintext
+        REVERSE marker frame this side simply serves the connection — the
+        requester runs the client handshake over it and the relay never
+        sees the data."""
+        from crowdllama_tpu.core.protocol import REVERSE_PROTOCOL
+
+        rhost, _, port_s = addr.rpartition(":")
+        if not rhost or not nonce:
+            return
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(rhost, int(port_s)),
+                DIALBACK_TIMEOUT)
+        except Exception as e:
+            log.debug("reverse dial to %s failed: %s", addr, e)
+            return
+        try:
+            await write_json_frame(writer,
+                                   {"proto": REVERSE_PROTOCOL,
+                                    "nonce": nonce})
+            await self.host.serve_reversed(reader, writer)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            log.debug("reversed stream failed: %s", e)
+            try:
+                writer.close()
+            except Exception:
+                pass
 
 
 async def dialback_probe(host: Host, relay_addr: str) -> bool:

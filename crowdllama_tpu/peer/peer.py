@@ -215,10 +215,22 @@ class Peer:
         reachable workers instead HOST a RelayService themselves and
         advertise relay_capable, so the swarm's relay capacity scales with
         its public membership instead of hanging off bootstrap_peers[0]."""
-        if not self.worker_mode or self.config.relay_mode == "off":
+        if self.config.relay_mode == "off":
             return
         from crowdllama_tpu.net.relay import RelayClient, dialback_probe
 
+        if not self.worker_mode:
+            # Consumers never relay, but knowing whether OUR listen port
+            # is publicly dialable enables connection reversal on dials
+            # to relayed workers (host._new_stream_via_relay): the worker
+            # dials us back and the data path skips the relay hairpin.
+            if self.config.bootstrap_peers:
+                try:
+                    self.host.reverse_dialable = await dialback_probe(
+                        self.host, self.config.bootstrap_peers[0])
+                except Exception as e:
+                    log.debug("consumer dialback probe unavailable (%s)", e)
+            return
         if not self.config.bootstrap_peers:
             self._start_relay_service()
             return
@@ -227,6 +239,7 @@ class Peer:
             try:
                 if await dialback_probe(self.host, relay_addr):
                     # Directly reachable: no relay needed — serve as one.
+                    self.host.reverse_dialable = True
                     self._start_relay_service()
                     return
             except Exception as e:
@@ -258,6 +271,7 @@ class Peer:
             log.exception("relay registration failed; staying direct")
             return False
         self.relay_client = client
+        self.host.reverse_dialable = False  # confirmed not dialable
         if self.relay_service is not None:
             # A NATed node can't relay for others — stop advertising it.
             self.relay_service.close()
@@ -330,6 +344,7 @@ class Peer:
             self.relay_client = None
             self.host.relay_contact = None
             self.host.hello_dialable = True
+            self.host.reverse_dialable = True
             self.resource.reachability = "direct"
             self._start_relay_service()
             self.update_metadata()
@@ -345,6 +360,7 @@ class Peer:
         except Exception:
             return  # no relay service reachable to probe through
         if reachable:
+            self.host.reverse_dialable = True
             return
         log.info("direct dialback stopped succeeding; returning to relay")
         if await self._register_relay(cands[0]):

@@ -90,13 +90,15 @@ async def test_relay_reverse_stream_and_dialback():
         await relay_host.close()
 
 
-async def test_relayed_worker_serves_through_gateway():
+async def test_relayed_worker_serves_through_gateway(monkeypatch):
     """End-to-end VERDICT r3 done-criterion: a worker with an UNREACHABLE
     listen address still serves a gateway /api/chat request through the
     relay.  The worker binds to 127.0.0.1 but never advertises it
     (relay_mode=always -> hellos carry listen_port 0), so every inbound
     stream — metadata, health probes, inference — must arrive via the
-    relay splice."""
+    relay SPLICE (connection reversal is disabled here; the reversal
+    path has its own end-to-end test below)."""
+    monkeypatch.setenv("CROWDLLAMA_TPU_NO_REVERSE", "1")
     boot_host, _boot_dht = await new_host_and_dht(
         Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
     RelayService(boot_host)
@@ -134,11 +136,164 @@ async def test_relayed_worker_serves_through_gateway():
                 d = await resp.json()
                 assert "via relay" in d["message"]["content"]
                 assert d["worker_id"] == worker.peer_id
+        assert worker.host.stats.get("streams_reversed_in", 0) == 0
     finally:
         await gateway.stop()
         await consumer.stop()
         await worker.stop()
         await boot_host.close()
+
+
+async def test_connection_reversal_direct_data_path():
+    """DCUtR fast path: a dialback-confirmed-public requester dialing a
+    relayed worker gets a DIRECT reversed connection — the relay carries
+    one signaling frame, the splice is never used, and the stream still
+    authenticates as the worker end-to-end."""
+    relay_host = Host(Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    await relay_host.start()
+    RelayService(relay_host)
+    relay_addr = f"127.0.0.1:{relay_host.listen_port}"
+
+    worker_host = Host(Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    await worker_host.start()
+
+    async def echo_handler(stream):
+        data = await stream.reader.readexactly(5)
+        stream.writer.write(data[::-1])
+        await stream.writer.drain()
+
+    worker_host.set_stream_handler("/test/echo", echo_handler)
+
+    client_host = Host(Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    await client_host.start()
+    client_host.reverse_dialable = True  # what the startup probe sets
+
+    relay_client = RelayClient(worker_host, relay_addr)
+    try:
+        await relay_client.start()
+        target = Contact(peer_id=worker_host.peer_id, host="127.0.0.1",
+                         port=relay_host.listen_port, relay=True)
+        stream = await client_host.new_stream(target, "/test/echo")
+        assert stream.remote_peer_id == worker_host.peer_id
+        stream.writer.write(b"hello")
+        await stream.writer.drain()
+        assert await stream.reader.readexactly(5) == b"olleh"
+        stream.close()
+        # The data path was the reversed direct connection, not a splice.
+        assert client_host.stats.get("streams_reversed_out", 0) == 1
+        assert client_host.stats.get("streams_relayed_out", 0) == 0
+        assert worker_host.stats.get("streams_reversed_in", 0) == 1
+        assert worker_host.stats.get("streams_relayed_in", 0) == 0
+    finally:
+        await relay_client.stop()
+        for h in (client_host, worker_host, relay_host):
+            await h.close()
+
+
+async def test_gateway_chat_rides_reversed_connections():
+    """Full stack with reversal ON (the default): the consumer's startup
+    dialback probe marks it public, so its streams to the relayed worker
+    — discovery metadata AND the inference stream — arrive at the worker
+    as direct reversed connections."""
+    boot_host, _boot_dht = await new_host_and_dht(
+        Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    RelayService(boot_host)
+    bootstrap = f"127.0.0.1:{boot_host.listen_port}"
+
+    worker = Peer(Ed25519PrivateKey.generate(),
+                  _cfg(bootstrap, relay_mode="always"),
+                  engine=FakeEngine(models=["tiny-test"]), worker_mode=True)
+    await worker.start()
+    consumer = Peer(Ed25519PrivateKey.generate(), _cfg(bootstrap),
+                    engine=FakeEngine(models=[]), worker_mode=False)
+    await consumer.start()
+    assert consumer.host.reverse_dialable is True  # loopback probe
+    gateway = Gateway(consumer, port=0, host="127.0.0.1")
+    await gateway.start()
+    gw_port = gateway._runner.addresses[0][1]
+
+    try:
+        await _wait_for(
+            lambda: consumer.peer_manager.find_best_worker("tiny-test")
+            is not None,
+            what="consumer discovering relayed worker")
+        async with aiohttp.ClientSession() as s:
+            body = {"model": "tiny-test", "stream": False,
+                    "messages": [{"role": "user", "content": "reversed"}]}
+            async with s.post(f"http://127.0.0.1:{gw_port}/api/chat",
+                              json=body) as resp:
+                assert resp.status == 200, await resp.text()
+                d = await resp.json()
+                assert d["worker_id"] == worker.peer_id
+        assert worker.host.stats.get("streams_reversed_in", 0) >= 2
+        assert consumer.host.stats.get("streams_reversed_out", 0) >= 2
+    finally:
+        await gateway.stop()
+        await consumer.stop()
+        await worker.stop()
+        await boot_host.close()
+
+
+async def test_reversal_falls_back_to_splice(monkeypatch):
+    """A reversal that never arrives (worker can't dial back) must fall
+    back to the relay splice inside the same new_stream call."""
+    relay_host = Host(Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    await relay_host.start()
+    RelayService(relay_host)
+    relay_addr = f"127.0.0.1:{relay_host.listen_port}"
+
+    worker_host = Host(Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    await worker_host.start()
+
+    async def echo_handler(stream):
+        stream.writer.write(b"ok")
+        await stream.writer.drain()
+
+    worker_host.set_stream_handler("/test/echo", echo_handler)
+
+    client_host = Host(Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    await client_host.start()
+    client_host.reverse_dialable = True
+
+    relay_client = RelayClient(worker_host, relay_addr)
+    # The worker ignores reversal requests (e.g. egress-filtered NAT).
+    monkeypatch.setattr(RelayClient, "_reverse",
+                        lambda self, addr, nonce: asyncio.sleep(0))
+    try:
+        await relay_client.start()
+        target = Contact(peer_id=worker_host.peer_id, host="127.0.0.1",
+                         port=relay_host.listen_port, relay=True)
+        stream = await client_host.new_stream(target, "/test/echo",
+                                              timeout=8.0)
+        assert await stream.reader.readexactly(2) == b"ok"
+        stream.close()
+        assert client_host.stats.get("streams_relayed_out", 0) == 1
+        assert client_host.stats.get("streams_reversed_out", 0) == 0
+    finally:
+        await relay_client.stop()
+        for h in (client_host, worker_host, relay_host):
+            await h.close()
+
+
+async def test_reverse_marker_with_unknown_nonce_rejected():
+    """A forged/stale REVERSE opening frame must be refused without
+    touching any waiter state."""
+    from crowdllama_tpu.core.protocol import REVERSE_PROTOCOL
+    from crowdllama_tpu.net.host import read_json_frame, write_json_frame
+
+    h = Host(Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    await h.start()
+    try:
+        reader, writer = await asyncio.open_connection(
+            "127.0.0.1", h.listen_port)
+        await write_json_frame(writer, {"proto": REVERSE_PROTOCOL,
+                                        "nonce": "deadbeef"})
+        reply = await read_json_frame(reader, 5.0)
+        assert "error" in reply
+        writer.close()
+        assert h.stats["rejected"] >= 1
+    finally:
+        await h.close()
 
 
 async def test_direct_worker_stays_direct_in_auto_mode():
