@@ -10,6 +10,9 @@ Phases (CROWDLLAMA_BENCH_PHASES to select, comma-separated):
   decode_paged same config on the paged KV pool + fused pallas paged-decode
                kernel (the serving default) — must land within ~5% of decode
   decode8b     Llama-3-8B int8 decode throughput (BASELINE config 2 headline)
+  decode_kv8   TinyLlama int8 weights + int8 KV cache (the halved cache read)
+  decode8b_int4  Llama-3-8B int4 weights — Ollama's own 8B default is 4-bit
+               GGUF, so int4-vs-Q4 is the parity-honest quantization cell
   decode_spec  n-gram speculative decode on the paged pool over a
                repetitive workload — effective emitted tokens/sec/chip
                plus tokens-per-verify-step (the acceptance dividend)
@@ -64,7 +67,7 @@ PARTIAL_PATH = Path(__file__).resolve().parent / "BENCH_partial.jsonl"
 # if it fails, later phases run with CROWDLLAMA_NO_PALLAS=1 so a kernel
 # regression degrades to the XLA paths instead of zeroing the artifact.
 _ALL_PHASES = ("kernel", "decode", "decode_paged", "decode_spec",
-               "decode8b", "ttft", "swarm")
+               "decode_kv8", "decode8b", "decode8b_int4", "ttft", "swarm")
 
 # Honor JAX_PLATFORMS even though the image's sitecustomize pre-imports jax
 # pinned to the axon (TPU tunnel) platform — env vars alone are read too
@@ -158,8 +161,12 @@ def _clear_backends() -> None:
 
 
 def _decode_phase(model: str, layout: str = "contiguous",
-                  slots: int = 0) -> dict:
-    """Saturated-batch decode throughput (tokens/sec/chip) for ``model``."""
+                  slots: int = 0, quantize: str | None = None,
+                  kv: str | None = None) -> dict:
+    """Saturated-batch decode throughput (tokens/sec/chip) for ``model``.
+
+    ``quantize``/``kv`` override the env knobs for phases that pin a
+    specific config (decode_kv8, decode8b_int4)."""
     import jax
     import numpy as np
 
@@ -176,8 +183,9 @@ def _decode_phase(model: str, layout: str = "contiguous",
         slots = slots or int(os.environ.get("CROWDLLAMA_BENCH_SLOTS", "8"))
         steps = int(os.environ.get("CROWDLLAMA_BENCH_STEPS", "512"))
         ctx = int(os.environ.get("CROWDLLAMA_BENCH_CTX", "1024"))
-        quantize = os.environ.get("CROWDLLAMA_BENCH_QUANTIZE", "int8")
-        kv_dtype = os.environ.get("CROWDLLAMA_BENCH_KV", "bf16")
+        quantize = (quantize if quantize is not None
+                    else os.environ.get("CROWDLLAMA_BENCH_QUANTIZE", "int8"))
+        kv_dtype = kv or os.environ.get("CROWDLLAMA_BENCH_KV", "bf16")
         if quantize in ("none", "", "0"):
             quantize = ""
 
@@ -244,6 +252,10 @@ def _decode_phase(model: str, layout: str = "contiguous",
     per_chip = done * runner.max_slots / dt / n_chips
     on_tpu = platform == "tpu"
     name = model if layout == "contiguous" else f"{model} (paged KV)"
+    if kv_dtype == "int8":
+        name += " (int8 KV)"
+    if quantize == "int4":
+        name += " (int4 weights)"
     # Mean decode context during the timed window (prompt + warmup chunk +
     # half the timed steps) — the KV-read term of the step's byte budget.
     mean_len = min(24 + chunk + done / 2, cfg.max_context_length)
@@ -567,17 +579,26 @@ def main() -> None:
         pass
 
     devices = _wait_for_devices(budget)
-    if devices[0].platform != "tpu" and "decode8b" in phases:
+    if devices[0].platform != "tpu":
         # CPU fallback benches tiny-test either way — one copy is enough.
-        # Emit an explicit skip marker so the artifact distinguishes
+        # Emit explicit skip markers so the artifact distinguishes
         # "phase not runnable here" from "phase crashed" (VERDICT r3).
-        phases.remove("decode8b")
-        _emit({"metric": "llama-3-8b decode throughput", "value": None,
-               "unit": "tokens/sec/chip", "vs_baseline": None,
-               "skipped": True,
-               "extra": {"platform": devices[0].platform,
-                         "reason": "requires TPU (8B on CPU fallback "
-                                   "would take hours)"}})
+        kv8_model = os.environ.get("CROWDLLAMA_BENCH_MODEL",
+                                   "tinyllama-1.1b")
+        for ph, metric in (("decode8b", "llama-3-8b decode throughput"),
+                           ("decode8b_int4",
+                            "llama-3-8b (int4 weights) decode throughput"),
+                           ("decode_kv8",
+                            f"{kv8_model} (int8 KV) decode throughput")):
+            if ph in phases:
+                phases.remove(ph)
+                _emit({"metric": metric, "value": None,
+                       "unit": "tokens/sec/chip", "vs_baseline": None,
+                       "skipped": True,
+                       "extra": {"platform": devices[0].platform,
+                                 "reason": "requires TPU (real-size/"
+                                           "quantized decode on CPU "
+                                           "fallback is meaningless)"}})
 
     runners = {
         "decode": lambda: _decode_phase(
@@ -590,6 +611,16 @@ def main() -> None:
         # adds ~2.1 GB — still well inside a 16 GiB chip).
         "decode8b": lambda: _decode_phase(
             "llama-3-8b",
+            slots=int(os.environ.get("CROWDLLAMA_BENCH_SLOTS_8B")
+                      or os.environ.get("CROWDLLAMA_BENCH_SLOTS") or 16)),
+        # The quantized variants the scoreboard tracks separately: int8 KV
+        # (halves the cache read) and int4 weights (Ollama's own 8B
+        # default is 4-bit GGUF, so int4-vs-Q4 is the parity-honest cell).
+        "decode_kv8": lambda: _decode_phase(
+            os.environ.get("CROWDLLAMA_BENCH_MODEL", "tinyllama-1.1b"),
+            kv="int8"),
+        "decode8b_int4": lambda: _decode_phase(
+            "llama-3-8b", quantize="int4",
             slots=int(os.environ.get("CROWDLLAMA_BENCH_SLOTS_8B")
                       or os.environ.get("CROWDLLAMA_BENCH_SLOTS") or 16)),
         "decode_spec": _spec_phase,

@@ -217,13 +217,15 @@ class JaxEngine(Engine):
         from crowdllama_tpu.engine.runner import ModelRunner
         from crowdllama_tpu.engine.scheduler import Scheduler
         from crowdllama_tpu.engine.tokenizer import get_tokenizer
-        from crowdllama_tpu.engine.weights import load_or_init_params
-        from crowdllama_tpu.models.config import get_config
+        from crowdllama_tpu.engine.weights import (
+            load_or_init_params,
+            resolve_model_config,
+        )
 
-        cfg = get_config(self.config.model)
+        cfg = resolve_model_config(self.config.model, self.config.model_path)
         if self.config.max_context_length:
-            cfg = get_config(
-                self.config.model,
+            cfg = resolve_model_config(
+                self.config.model, self.config.model_path,
                 max_context_length=min(cfg.max_context_length,
                                        self.config.max_context_length),
             )

@@ -279,7 +279,8 @@ class EPLeaderRunner:
         hkv, heads = cfg.num_kv_heads, cfg.num_heads
         scale = T.attn_scale(cfg)
         K = cfg.num_experts_per_tok
-        cos, sin = rope_table(cfg.max_context_length, dh, cfg.rope_theta)
+        cos, sin = rope_table(cfg.max_context_length, dh, cfg.rope_theta,
+                          scaling=cfg.rope_scaling)
 
         def _route(lp, h):
             router_logits = jnp.einsum("...d,de->...e", h.astype(jnp.float32),

@@ -524,7 +524,8 @@ class PagedModelRunner(ModelRunner):
         dh = cfg.resolved_head_dim()
         hkv = cfg.num_kv_heads
         scale = T.attn_scale(cfg)
-        cos, sin = rope_table(cfg.max_context_length, dh, cfg.rope_theta)
+        cos, sin = rope_table(cfg.max_context_length, dh, cfg.rope_theta,
+                          scaling=cfg.rope_scaling)
         windows = T.layer_sliding_windows(cfg)
         view_len = self.max_pages_per_slot * pg
         slot_idx = jnp.arange(b)

@@ -148,13 +148,15 @@ class ShardedEngine(Engine):
 
     async def start(self) -> None:
         from crowdllama_tpu.engine.tokenizer import get_tokenizer
-        from crowdllama_tpu.engine.weights import load_or_init_params
-        from crowdllama_tpu.models.config import get_config
+        from crowdllama_tpu.engine.weights import (
+            load_or_init_params,
+            resolve_model_config,
+        )
 
-        cfg = get_config(self.config.model)
+        cfg = resolve_model_config(self.config.model, self.config.model_path)
         if self.config.max_context_length:
-            cfg = get_config(
-                self.config.model,
+            cfg = resolve_model_config(
+                self.config.model, self.config.model_path,
                 max_context_length=min(cfg.max_context_length,
                                        self.config.max_context_length))
         if self.strategy == "ep" and not cfg.is_moe:

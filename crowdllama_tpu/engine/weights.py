@@ -73,6 +73,52 @@ def _to_np(arr) -> np.ndarray:
     return a
 
 
+def _rope_scaling_from_hf(d: dict | None):
+    """Map config.json ``rope_scaling`` to a RopeScaling (None passes
+    through; "default" means no scaling).  Unsupported schemes (yarn,
+    dynamic, longrope) raise — serving with silently-wrong position
+    embeddings would corrupt every long-context generation."""
+    if not d:
+        return None
+    from crowdllama_tpu.models.config import RopeScaling
+
+    kind = d.get("rope_type") or d.get("type") or ""
+    if kind in ("", "default"):
+        return None
+    if kind == "llama3":
+        return RopeScaling(
+            rope_type="llama3", factor=float(d["factor"]),
+            low_freq_factor=float(d.get("low_freq_factor", 1.0)),
+            high_freq_factor=float(d.get("high_freq_factor", 4.0)),
+            original_max_position_embeddings=int(
+                d.get("original_max_position_embeddings", 8192)))
+    if kind == "linear":
+        return RopeScaling(rope_type="linear", factor=float(d["factor"]))
+    raise ValueError(f"unsupported rope_scaling type {kind!r} "
+                     f"(supported: llama3, linear)")
+
+
+def resolve_model_config(name: str, model_path: str = "",
+                         **overrides) -> ModelConfig:
+    """Registry lookup with a checkpoint-dir fallback: a model name not in
+    the registry serves from ``model_path``'s config.json (family sniffed,
+    rope scaling kept) under the requested name.  This is what lets an
+    operator serve a local fine-tune directory without editing the
+    registry (the reference inherits arbitrary-model serving from Ollama's
+    model store, /root/reference/pkg/crowdllama/api.go:108-160)."""
+    from dataclasses import replace as _replace
+
+    from crowdllama_tpu.models.config import _REGISTRY, get_config
+
+    if name in _REGISTRY or not model_path:
+        return get_config(name, **overrides)
+    path = Path(model_path).expanduser()
+    if not (path / "config.json").exists():
+        return get_config(name, **overrides)  # raises with the known list
+    cfg = _replace(config_from_hf_dir(path), name=name)
+    return _replace(cfg, **overrides) if overrides else cfg
+
+
 def config_from_hf_dir(path: str | Path) -> ModelConfig:
     """Derive a ModelConfig from a checkpoint's config.json (for models not
     in the registry)."""
@@ -94,6 +140,7 @@ def config_from_hf_dir(path: str | Path) -> ModelConfig:
         num_kv_heads=d.get("num_key_value_heads", d["num_attention_heads"]),
         head_dim=d.get("head_dim", 0),
         rope_theta=d.get("rope_theta", 10000.0),
+        rope_scaling=_rope_scaling_from_hf(d.get("rope_scaling")),
         rms_norm_eps=d.get("rms_norm_eps", 1e-5),
         tie_word_embeddings=d.get("tie_word_embeddings", False),
         max_context_length=d.get("max_position_embeddings", 4096),

@@ -13,6 +13,28 @@ from dataclasses import dataclass, replace
 
 
 @dataclass(frozen=True)
+class RopeScaling:
+    """Long-context RoPE scaling (HF config.json ``rope_scaling``).
+
+    ``rope_type`` "llama3" is the Llama-3.1/3.2 frequency-dependent
+    scheme; "linear" is plain position interpolation.  A frozen
+    dataclass (not a dict) so ModelConfig stays hashable.
+    """
+
+    rope_type: str = "llama3"
+    factor: float = 8.0
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+    original_max_position_embeddings: int = 8192
+
+    def __post_init__(self) -> None:
+        if self.rope_type not in ("llama3", "linear"):
+            raise ValueError(
+                f"unsupported rope scaling type {self.rope_type!r} "
+                f"(supported: llama3, linear)")
+
+
+@dataclass(frozen=True)
 class ModelConfig:
     name: str = "custom"
     family: str = "llama"  # "llama" | "mistral" | "gemma2" | "mixtral" | "qwen2" | "qwen3"
@@ -24,6 +46,7 @@ class ModelConfig:
     num_kv_heads: int = 4
     head_dim: int = 0  # 0 → hidden_size // num_heads
     rope_theta: float = 10000.0
+    rope_scaling: RopeScaling | None = None  # Llama-3.1-style long context
     rms_norm_eps: float = 1e-5
     tie_word_embeddings: bool = False
     max_context_length: int = 4096
@@ -150,6 +173,19 @@ LLAMA3_8B = _register(ModelConfig(
     name="llama-3-8b", family="llama", vocab_size=128256, hidden_size=4096,
     intermediate_size=14336, num_layers=32, num_heads=32, num_kv_heads=8,
     rope_theta=500000.0, max_context_length=8192,
+))
+
+# Llama-3.1: same weights shape as 3.0 plus the llama3 rope scaling that
+# stretches usable context to 128k.  Serving ctx defaults far below the
+# architectural maximum — one chip's KV budget is the real bound; callers
+# raise max_context_length per deployment.
+LLAMA31_8B = _register(ModelConfig(
+    name="llama-3.1-8b", family="llama", vocab_size=128256, hidden_size=4096,
+    intermediate_size=14336, num_layers=32, num_heads=32, num_kv_heads=8,
+    rope_theta=500000.0, max_context_length=16384,
+    rope_scaling=RopeScaling(rope_type="llama3", factor=8.0,
+                             low_freq_factor=1.0, high_freq_factor=4.0,
+                             original_max_position_embeddings=8192),
 ))
 
 MISTRAL_7B = _register(ModelConfig(

@@ -249,7 +249,8 @@ def scan_prefill_layers(
     dh = cfg.resolved_head_dim()
     hkv = cfg.num_kv_heads
     scale = attn_scale(cfg)
-    cos, sin = rope_table(cfg.max_context_length, dh, cfg.rope_theta)
+    cos, sin = rope_table(cfg.max_context_length, dh, cfg.rope_theta,
+                          scaling=cfg.rope_scaling)
     b, t = x.shape[0], x.shape[1]
 
     def body(x, scanned):
@@ -450,7 +451,8 @@ def scan_decode_layers(
         assert sp_mesh is None, "int8 KV cache does not compose with sp yet"
     dh = cfg.resolved_head_dim()
     scale = attn_scale(cfg)
-    cos, sin = rope_table(cfg.max_context_length, dh, cfg.rope_theta)
+    cos, sin = rope_table(cfg.max_context_length, dh, cfg.rope_theta,
+                          scaling=cfg.rope_scaling)
     b = x.shape[0]
     slot_idx = jnp.arange(b)
 

@@ -64,6 +64,35 @@ def test_llama_parity():
     _compare(cfg, transformers.LlamaForCausalLM(hf_cfg))
 
 
+def test_llama31_rope_scaling_parity():
+    """Llama-3.1-style long-context rope scaling (rope_type=llama3) must
+    match HF bit-for-bit.  The scaling rewrites inv_freq itself (not a
+    per-position correction), so every position's table changes and a
+    SEQ=12 compare exercises it; original_max_position_embeddings=16 puts
+    all three frequency bands (scaled/smoothed/untouched) in play at this
+    tiny head_dim."""
+    from crowdllama_tpu.models.config import RopeScaling
+
+    base = get_config("tiny-test", max_context_length=64)
+    from dataclasses import replace as _replace
+    cfg = _replace(base, rope_scaling=RopeScaling(
+        rope_type="llama3", factor=8.0, low_freq_factor=1.0,
+        high_freq_factor=4.0, original_max_position_embeddings=16))
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size, num_hidden_layers=cfg.num_layers,
+        num_attention_heads=cfg.num_heads, num_key_value_heads=cfg.num_kv_heads,
+        rms_norm_eps=cfg.rms_norm_eps, rope_theta=cfg.rope_theta,
+        max_position_embeddings=cfg.max_context_length, tie_word_embeddings=False,
+        attention_bias=False, mlp_bias=False,
+        rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                      "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                      "original_max_position_embeddings": 16},
+    )
+    torch.manual_seed(0)
+    _compare(cfg, transformers.LlamaForCausalLM(hf_cfg))
+
+
 def test_mixtral_parity():
     cfg = get_config("tiny-test-moe")
     hf_cfg = transformers.MixtralConfig(
@@ -187,6 +216,61 @@ def test_config_from_hf_dir_family_sniffing(tmp_path):
         cfg = config_from_hf_dir(tmp_path)
         assert cfg.family == family, arch
         assert cfg.sliding_window == want_window, arch
+
+
+def test_config_from_hf_dir_rope_scaling(tmp_path):
+    """A Llama-3.1 config.json's rope_scaling must survive the
+    registry-less path (or generations past 8k silently corrupt), and
+    unsupported schemes must refuse loudly."""
+    import json
+
+    from crowdllama_tpu.engine.weights import config_from_hf_dir
+
+    base = dict(vocab_size=512, hidden_size=64, intermediate_size=128,
+                num_hidden_layers=2, num_attention_heads=4,
+                num_key_value_heads=2, rms_norm_eps=1e-6,
+                max_position_embeddings=131072,
+                architectures=["LlamaForCausalLM"])
+    (tmp_path / "config.json").write_text(json.dumps({**base, "rope_scaling": {
+        "rope_type": "llama3", "factor": 8.0, "low_freq_factor": 1.0,
+        "high_freq_factor": 4.0, "original_max_position_embeddings": 8192}}))
+    cfg = config_from_hf_dir(tmp_path)
+    assert cfg.rope_scaling is not None
+    assert cfg.rope_scaling.rope_type == "llama3"
+    assert cfg.rope_scaling.factor == 8.0
+
+    (tmp_path / "config.json").write_text(json.dumps(
+        {**base, "rope_scaling": {"type": "default"}}))
+    assert config_from_hf_dir(tmp_path).rope_scaling is None
+
+    (tmp_path / "config.json").write_text(json.dumps(
+        {**base, "rope_scaling": {"rope_type": "yarn", "factor": 4.0}}))
+    with pytest.raises(ValueError, match="yarn"):
+        config_from_hf_dir(tmp_path)
+
+
+def test_resolve_model_config_checkpoint_fallback(tmp_path):
+    """Names outside the registry serve from the checkpoint dir's
+    config.json under the requested name; without a dir the known-models
+    error must still surface."""
+    import json
+
+    from crowdllama_tpu.engine.weights import resolve_model_config
+
+    (tmp_path / "config.json").write_text(json.dumps(dict(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        rms_norm_eps=1e-6, max_position_embeddings=256,
+        architectures=["LlamaForCausalLM"])))
+    cfg = resolve_model_config("my-finetune", str(tmp_path),
+                               max_context_length=128)
+    assert cfg.name == "my-finetune" and cfg.family == "llama"
+    assert cfg.max_context_length == 128
+    # Registry names win even with a model_path set.
+    assert resolve_model_config("tiny-test", str(tmp_path)) is get_config(
+        "tiny-test")
+    with pytest.raises(KeyError, match="unknown model"):
+        resolve_model_config("my-finetune", "")
 
 
 def test_gemma2_parity():
