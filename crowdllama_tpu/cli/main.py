@@ -118,6 +118,17 @@ def main(argv: list[str] | None = None) -> int:
         logging.getLogger().setLevel(
             logging.DEBUG if cfg.verbose else logging.INFO)
         logging.basicConfig(stream=sys.stderr)
+        if cfg.dist_coordinator:
+            # The multihost toolkit (parallel/multihost.py) provides the
+            # initialization, global meshes, and lockstep runner layer —
+            # but the ASYNC SERVING ENGINE is not leader-replicated yet:
+            # starting N full nodes would deadlock at the first global
+            # collective.  Refuse loudly instead of hanging.
+            print("error: --dist-coordinator serving is not wired into "
+                  "the async engine yet; multi-host today is the runner "
+                  "layer (parallel/multihost.py, tests/test_multihost.py)",
+                  file=sys.stderr)
+            return 2
         try:
             asyncio.run(run_node(cfg, worker_mode=args.worker_mode))
             return 0

@@ -157,6 +157,15 @@ class Configuration:
     shard_count: int = 1
     shard_strategy: str = "pp"  # "pp" | "ep"
 
+    # Multi-host single-worker serving (parallel/multihost.py): when a
+    # logical worker spans several hosts of a TPU pod slice, every process
+    # sets dist_coordinator to process 0's "host:port" and the mesh spans
+    # the GLOBAL device set (collectives ride ICI within a host, DCN
+    # between).  Empty = single-host (the common case).
+    dist_coordinator: str = ""
+    dist_num_processes: int = 0  # 0 = let jax.distributed infer
+    dist_process_id: int = -1    # -1 = let jax.distributed infer
+
     intervals: Intervals = field(default_factory=Intervals.default)
 
     @classmethod
@@ -189,6 +198,12 @@ class Configuration:
         cfg.shard_index = int(env.get("CROWDLLAMA_TPU_SHARD_INDEX", cfg.shard_index))
         cfg.shard_count = int(env.get("CROWDLLAMA_TPU_SHARD_COUNT", cfg.shard_count))
         cfg.shard_strategy = env.get("CROWDLLAMA_TPU_SHARD_STRATEGY", cfg.shard_strategy)
+        cfg.dist_coordinator = env.get("CROWDLLAMA_TPU_DIST_COORDINATOR",
+                                       cfg.dist_coordinator)
+        cfg.dist_num_processes = int(env.get(
+            "CROWDLLAMA_TPU_DIST_NUM_PROCESSES", cfg.dist_num_processes))
+        cfg.dist_process_id = int(env.get(
+            "CROWDLLAMA_TPU_DIST_PROCESS_ID", cfg.dist_process_id))
         cfg.quantize = env.get("CROWDLLAMA_TPU_QUANTIZE", cfg.quantize)
         cfg.kv_layout = env.get("CROWDLLAMA_TPU_KV_LAYOUT", cfg.kv_layout)
         cfg.kv_page_size = int(env.get("CROWDLLAMA_TPU_KV_PAGE_SIZE",
@@ -292,6 +307,13 @@ class Configuration:
         parser.add_argument("--shard-strategy", dest="shard_strategy",
                             choices=("pp", "ep"),
                             help="pp: layer slices; ep: MoE expert banks")
+        parser.add_argument("--dist-coordinator", dest="dist_coordinator",
+                            help="multi-host: process 0's host:port "
+                                 "(parallel/multihost.py)")
+        parser.add_argument("--dist-num-processes",
+                            dest="dist_num_processes", type=int)
+        parser.add_argument("--dist-process-id", dest="dist_process_id",
+                            type=int)
         parser.add_argument("--quantize", dest="quantize",
                             choices=("", "int8", "int4"),
                             help="weight-only quantization for the engine")
@@ -336,6 +358,7 @@ class Configuration:
                 "kv_dtype", "relay_mode", "spec_decode", "spec_draft",
                 "spec_draft_model", "spec_draft_path",
                 "profile_dir",
+                "dist_coordinator", "dist_num_processes", "dist_process_id",
             )
         }
         bp = getattr(args, "bootstrap_peers", None)

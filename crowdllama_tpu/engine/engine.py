@@ -10,6 +10,8 @@ reference mocks with an HTTP fake (test/integration_test.go:32-135).
 
 from __future__ import annotations
 
+from dataclasses import replace as dc_replace
+
 import asyncio
 import logging
 import time
@@ -224,11 +226,9 @@ class JaxEngine(Engine):
 
         cfg = resolve_model_config(self.config.model, self.config.model_path)
         if self.config.max_context_length:
-            cfg = resolve_model_config(
-                self.config.model, self.config.model_path,
-                max_context_length=min(cfg.max_context_length,
-                                       self.config.max_context_length),
-            )
+            cfg = dc_replace(
+                cfg, max_context_length=min(cfg.max_context_length,
+                                            self.config.max_context_length))
         self.tokenizer = get_tokenizer(self.config.model_path)
         loop = asyncio.get_running_loop()
 

@@ -32,6 +32,8 @@ sharding of any kind; this is part of the TPU-native superset.
 
 from __future__ import annotations
 
+from dataclasses import replace as dc_replace
+
 import asyncio
 import logging
 import time
@@ -155,10 +157,9 @@ class ShardedEngine(Engine):
 
         cfg = resolve_model_config(self.config.model, self.config.model_path)
         if self.config.max_context_length:
-            cfg = resolve_model_config(
-                self.config.model, self.config.model_path,
-                max_context_length=min(cfg.max_context_length,
-                                       self.config.max_context_length))
+            cfg = dc_replace(
+                cfg, max_context_length=min(cfg.max_context_length,
+                                            self.config.max_context_length))
         if self.strategy == "ep" and not cfg.is_moe:
             raise ValueError(
                 f"shard strategy 'ep' needs an MoE model; {cfg.name} is dense")

@@ -1,0 +1,114 @@
+"""Multi-host (multi-process) mesh support over DCN.
+
+The reference's distribution stops at whole-request routing between
+independent workers (libp2p streams; /root/reference/pkg/peermanager/
+manager.go:338-387) — every worker is one host.  TPU pods are different:
+one LOGICAL worker can span several hosts, each owning a slice of the
+chip mesh, with XLA collectives riding ICI within a host and DCN between
+hosts.  JAX's multi-controller model makes that almost free at the
+compute layer: after ``jax.distributed.initialize``, ``jax.devices()``
+returns the GLOBAL device list, so every existing mesh builder
+(parallel/mesh.py), sharding rule, and jitted step in this codebase
+spans hosts unchanged — XLA partitions collectives over ICI/DCN by
+device topology.
+
+What multi-controller DOES demand is SPMD discipline on the host side:
+every process must issue the same sequence of jitted calls with the same
+shapes.  The serving engine's driving model for that is
+leader-replicated dispatch:
+
+- process 0 runs the public surfaces (gateway, peer runtime, scheduler)
+  and makes every admission decision;
+- all processes execute the same runner calls in the same order, with
+  host-side inputs (prompt tokens, slot choices, chunk sizes) broadcast
+  from process 0 via :func:`broadcast_from_leader` before each dispatch;
+- per-host state (page-table bookkeeping, RNG seeding) is derived only
+  from broadcast inputs, so it stays bit-identical everywhere.
+
+This module is the initialization + synchronization toolkit for that
+model.  It is exercised for real by ``tests/test_multihost.py``, which
+runs a 2-process × 4-virtual-device global mesh on CPU.
+"""
+
+from __future__ import annotations
+
+import logging
+
+log = logging.getLogger("crowdllama.parallel.multihost")
+
+
+def initialize_from_config(config) -> bool:
+    """``jax.distributed.initialize`` from Configuration fields, if set.
+
+    MUST run before any JAX backend initializes (the CLI calls it right
+    after config parsing).  Returns True when distributed mode is active.
+    Fields: ``dist_coordinator`` ("host:port" of process 0),
+    ``dist_num_processes``, ``dist_process_id``.
+    """
+    coord = getattr(config, "dist_coordinator", "")
+    if not coord:
+        return False
+    import jax
+
+    n = int(getattr(config, "dist_num_processes", 0) or 0)
+    pid = int(getattr(config, "dist_process_id", -1))
+    kwargs = {"coordinator_address": coord}
+    if n > 0:
+        kwargs["num_processes"] = n
+    if pid >= 0:
+        kwargs["process_id"] = pid
+    try:
+        jax.distributed.initialize(**kwargs)
+    except RuntimeError as e:
+        # Already initialized (engine restart in-process) is fine; a
+        # mis-configured cluster is not.  jax's message is
+        # "distributed.initialize should only be called once." — match
+        # both phrasings defensively across versions.
+        msg = str(e).lower()
+        if "once" in msg or "already" in msg:
+            log.debug("jax.distributed already initialized: %s", e)
+        else:
+            raise
+    log.info("multi-host: process %d/%d, %d global / %d local devices",
+             jax.process_index(), jax.process_count(),
+             len(jax.devices()), len(jax.local_devices()))
+    return True
+
+
+def is_leader() -> bool:
+    """True on process 0 (or in single-process mode) — the process that
+    owns the gateway/peer/scheduler surfaces."""
+    import jax
+
+    return jax.process_index() == 0
+
+
+def process_count() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def broadcast_from_leader(value):
+    """Replicate a host-side pytree of arrays/scalars from process 0 to
+    every process (the admission-decision primitive of the leader-
+    replicated dispatch model).  No-op in single-process mode."""
+    import jax
+
+    if jax.process_count() == 1:
+        return value
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.broadcast_one_to_all(value)
+
+
+def barrier(name: str = "crowdllama") -> None:
+    """Block until every process reaches this point (shutdown ordering,
+    checkpoint promotion)."""
+    import jax
+
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
