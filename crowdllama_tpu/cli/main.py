@@ -119,16 +119,34 @@ def main(argv: list[str] | None = None) -> int:
             logging.DEBUG if cfg.verbose else logging.INFO)
         logging.basicConfig(stream=sys.stderr)
         if cfg.dist_coordinator:
-            # The multihost toolkit (parallel/multihost.py) provides the
-            # initialization, global meshes, and lockstep runner layer —
-            # but the ASYNC SERVING ENGINE is not leader-replicated yet:
-            # starting N full nodes would deadlock at the first global
-            # collective.  Refuse loudly instead of hanging.
-            print("error: --dist-coordinator serving is not wired into "
-                  "the async engine yet; multi-host today is the runner "
-                  "layer (parallel/multihost.py, tests/test_multihost.py)",
-                  file=sys.stderr)
-            return 2
+            # Multi-host pod-slice serving (parallel/replicated.py):
+            # initialize the global mesh BEFORE any backend touch, then
+            # process 0 runs the full node (its engine broadcasts every
+            # device-touching call) and every other process replays the
+            # frame stream.  v1 replicates exactly ONE JaxEngine's frame
+            # stream — refuse shapes that would start other engines
+            # (consumer FakeEngine path, sharded groups, multi-model
+            # lists) instead of deadlocking the first collective.
+            if (not args.worker_mode or cfg.shard_count > 1
+                    or "," in cfg.model):
+                print("error: --dist-coordinator serves exactly one "
+                      "worker-mode model per cluster (no consumer mode, "
+                      "--shard-count, or model lists)", file=sys.stderr)
+                return 2
+            # A swarm-pull hot-registering a SECOND engine would emit
+            # frames the single-runner follower loop cannot represent.
+            cfg.allow_swarm_pull = False
+            from crowdllama_tpu.parallel.multihost import (
+                initialize_from_config,
+                is_leader,
+            )
+
+            initialize_from_config(cfg)
+            if not is_leader():
+                from crowdllama_tpu.parallel.replicated import run_follower
+
+                run_follower(cfg)
+                return 0
         try:
             asyncio.run(run_node(cfg, worker_mode=args.worker_mode))
             return 0

@@ -10,8 +10,6 @@ reference mocks with an HTTP fake (test/integration_test.go:32-135).
 
 from __future__ import annotations
 
-from dataclasses import replace as dc_replace
-
 import asyncio
 import logging
 import time
@@ -220,15 +218,11 @@ class JaxEngine(Engine):
         from crowdllama_tpu.engine.scheduler import Scheduler
         from crowdllama_tpu.engine.tokenizer import get_tokenizer
         from crowdllama_tpu.engine.weights import (
-            load_or_init_params,
-            resolve_model_config,
+            load_params_for,
+            resolve_clamped_model_config,
         )
 
-        cfg = resolve_model_config(self.config.model, self.config.model_path)
-        if self.config.max_context_length:
-            cfg = dc_replace(
-                cfg, max_context_length=min(cfg.max_context_length,
-                                            self.config.max_context_length))
+        cfg = resolve_clamped_model_config(self.config)
         self.tokenizer = get_tokenizer(self.config.model_path)
         loop = asyncio.get_running_loop()
 
@@ -239,15 +233,12 @@ class JaxEngine(Engine):
 
             # The composition matrix's single decision point
             # (engine/plan.py; exhaustively swept by tests/test_matrix.py).
-            plan = resolve_serving_plan(self.config, len(jax.devices()))
+            plan = resolve_serving_plan(self.config, len(jax.devices()),
+                                        n_processes=jax.process_count())
             for note in plan.notes:
                 log.warning("%s", note)
 
-            params = load_or_init_params(cfg, self.config.model_path)
-            if self.config.quantize:
-                from crowdllama_tpu.ops.quant import quantize_params
-
-                params = quantize_params(params, mode=self.config.quantize)
+            params = load_params_for(self.config, cfg)
             kwargs = dict(
                 params=params,
                 mesh_spec=self.config.mesh_shape,
@@ -289,7 +280,20 @@ class JaxEngine(Engine):
 
                 return SpecModelRunner(
                     cfg, draft_len=self.config.spec_draft, **kwargs)
-            return ModelRunner(cfg, kv_dtype=plan.kv_dtype, **kwargs)
+            runner = ModelRunner(cfg, kv_dtype=plan.kv_dtype, **kwargs)
+            import jax
+
+            if jax.process_count() > 1:
+                # Multi-host pod-slice serving: wrap the runner so every
+                # device-touching call is broadcast to the follower
+                # processes before it dispatches (leader-replicated
+                # dispatch, parallel/replicated.py).
+                from crowdllama_tpu.parallel.replicated import (
+                    ReplicatedRunner,
+                )
+
+                runner = ReplicatedRunner(runner)
+            return runner
 
         self._runner = await loop.run_in_executor(None, _build)
         if self.config.warmup:
@@ -328,8 +332,16 @@ class JaxEngine(Engine):
             # prompt longer than one chunk that still fits under max_seq
             # (max_seq == prefill_chunk + 1 has no such prompt, ADVICE r3).
             job = r.prefill_begin(list(range(1, r.prefill_chunk + 2)))
-            r.prefill_step(job)
-        r.embed_prompts([[1, 2, 3]])
+            while not r.prefill_step(job):
+                pass
+            # Finish the job (also compiles the finish-sampling program):
+            # under multi-host replication an abandoned job would pin its
+            # KV accumulators on every follower indefinitely.
+            r.prefill_finish(job, 0.0, 1.0, jax.random.PRNGKey(0))
+        try:
+            r.embed_prompts([[1, 2, 3]])
+        except NotImplementedError:
+            pass  # multi-host v1 serves generate only (ReplicatedRunner)
         state = r.release(state, 0)
         log.info("warmup compile done")
 
@@ -340,8 +352,18 @@ class JaxEngine(Engine):
         return await self.scheduler.drain(timeout)
 
     async def stop(self) -> None:
+        exec_ = getattr(self.scheduler, "_exec", None)
         if self.scheduler is not None:
             await self.scheduler.stop()
+        if self._runner is not None and hasattr(self._runner, "shutdown"):
+            # Multi-host: release the follower frame loops — AFTER any
+            # in-flight dispatch on the scheduler's executor thread has
+            # finished, or the STOP broadcast would interleave with that
+            # dispatch's collectives mid-frame.
+            if exec_ is not None:
+                loop = asyncio.get_running_loop()
+                await loop.run_in_executor(None, exec_.shutdown, True)
+            self._runner.shutdown()
 
     def model_dir(self, model: str) -> str | None:
         from pathlib import Path
@@ -356,8 +378,12 @@ class JaxEngine(Engine):
         d = {"models": self.models, "throughput": 0.0, "load": 0.0}
         if self._runner is not None:
             # Every mesh kind has an embeddings forward now (pp runs the
-            # microbatch pipeline, sp the ring — runner.embed_prompts).
-            d["embeddings"] = True
+            # microbatch pipeline, sp the ring — runner.embed_prompts);
+            # EXCEPT multi-host leader-replicated serving (v1 is
+            # generate-only), which must not advertise the capability.
+            from crowdllama_tpu.parallel.replicated import ReplicatedRunner
+
+            d["embeddings"] = not isinstance(self._runner, ReplicatedRunner)
         if self.scheduler is not None:
             d["throughput"] = round(self.scheduler.throughput_ema, 2)
             d["load"] = round(self.scheduler.load, 3)

@@ -35,7 +35,8 @@ class ServingPlan:
         return bool(self.notes)
 
 
-def resolve_serving_plan(config, n_devices: int) -> ServingPlan:
+def resolve_serving_plan(config, n_devices: int,
+                         n_processes: int = 1) -> ServingPlan:
     """Decide runner class + effective KV layout for ``config``.
 
     Raises ``ValueError`` for combinations that must not serve silently
@@ -50,6 +51,20 @@ def resolve_serving_plan(config, n_devices: int) -> ServingPlan:
     kv_layout = config.kv_layout
     spec = config.spec_decode
     dp, pp, sp, _ep, _tp = parse_mesh_spec(config.mesh_shape, n_devices)
+
+    if n_processes > 1:
+        # Multi-host leader-replicated serving (parallel/replicated.py)
+        # v1: contiguous ModelRunner only — the frame protocol covers
+        # exactly that runner's surface.
+        if spec:
+            raise ValueError(
+                "spec_decode does not compose with multi-host serving "
+                "yet (leader-replicated dispatch covers the plain "
+                "ModelRunner only)")
+        if kv_layout == "paged":
+            notes.append("multi-host serving uses the contiguous layout "
+                         "(the paged runner is not leader-replicated yet)")
+            kv_layout = "contiguous"
 
     if kv_layout == "paged" and (dp > 1 or pp > 1 or sp > 1):
         # The shared page pool cannot shard over dp (pages belong to no

@@ -110,6 +110,7 @@ class Scheduler:
         # Long prompts popped while another chunked admission is running
         # (kept FIFO ahead of pending).
         self._deferred: collections.deque[GenRequest] = collections.deque()
+        self._to_release: list[int] = []
         self._draining = False
         self._embeds = 0  # embedding forwards in flight on the executor
         # Requests whose output queues drain must also see consumed (the
@@ -258,22 +259,38 @@ class Scheduler:
                 req.top_p, sub, state=self.state, top_k=req.top_k,
                 repeat_penalty=req.repeat_penalty),
         )
-        self._place(req, slot, ks, vs, plen, first)
+        await self._place(req, slot, ks, vs, plen, first)
 
-    def _place(self, req: GenRequest, slot: int, ks, vs, plen: int,
-               first: int) -> None:
+    async def _place(self, req: GenRequest, slot: int, ks, vs, plen: int,
+                     first: int) -> None:
         """Insert a prefilled request into its slot and emit its first
-        token (shared by monolithic and chunked admission)."""
-        self.state = self.runner.insert(
-            self.state, slot, ks, vs, plen, first, req.temperature,
-            req.top_p, prompt_tokens=req.prompt_ids,
-            slot_key=self._req_key(req, 1), top_k=req.top_k,
-            repeat_penalty=req.repeat_penalty,
-        )
+        token (shared by monolithic and chunked admission).  Runs the
+        insert on the dispatch executor: under multi-host serving
+        (parallel/replicated.py) every runner call is also a cross-host
+        broadcast, which must never block the event loop."""
+        import functools
+
+        loop = asyncio.get_running_loop()
+        self.state = await loop.run_in_executor(
+            self._exec, functools.partial(
+                self.runner.insert,
+                self.state, slot, ks, vs, plen, first, req.temperature,
+                req.top_p, prompt_tokens=req.prompt_ids,
+                slot_key=self._req_key(req, 1), top_k=req.top_k,
+                repeat_penalty=req.repeat_penalty))
         info = _SlotInfo(req=req, prompt_len=plen)
         self.slots[slot] = info
         req.first_token_at = time.monotonic()
         self._emit(req, first, info)
+        await self._flush_releases(loop)
+
+    async def _flush_releases(self, loop) -> None:
+        """Perform device releases queued by _emit (which runs in sync
+        emit loops) on the dispatch executor."""
+        while self._to_release:
+            slot = self._to_release.pop(0)
+            self.state = await loop.run_in_executor(
+                self._exec, self.runner.release, self.state, slot)
 
     def _emit(self, req: GenRequest, token: int, info: _SlotInfo) -> None:
         info.generated += 1
@@ -287,7 +304,16 @@ class Scheduler:
             req.out.put_nowait((_DONE, reason))
             slot = self.slots.index(info)
             self.slots[slot] = None
-            self.state = self.runner.release(self.state, slot)
+            if getattr(self.runner, "defer_release", False):
+                # Multi-host (parallel/replicated.py): a release is a
+                # cross-host broadcast and must not run inside this sync
+                # emit loop on the event loop — defer to _flush_releases.
+                self._to_release.append(slot)
+            else:
+                # Single-host: release immediately, exactly the pre-
+                # multi-host semantics (pages/slots reclaimed before the
+                # client's done is even consumed).
+                self.state = self.runner.release(self.state, slot)
             self.requests_served += 1
 
     def _chunk_size(self) -> int:
@@ -326,7 +352,9 @@ class Scheduler:
                 while not self.pending.empty():
                     self.pending.get_nowait().out.put_nowait(
                         (_DONE, "error: engine failure"))
-                self.state = self.runner.init_state()
+                self._to_release.clear()  # init_state replaces it all
+                self.state = await asyncio.get_running_loop(
+                ).run_in_executor(self._exec, self.runner.init_state)
 
     async def _loop_once(self) -> None:
         # Idle: wait for work (an undrained in-flight chunk or an
@@ -340,10 +368,12 @@ class Scheduler:
         # Free cancelled slots — only the loop touches device state, so a
         # release can never donate buffers out from under a dispatch, and
         # the slot stays occupied (unreusable) until exactly here.
+        loop_ = asyncio.get_running_loop()
         for i, info in enumerate(self.slots):
             if isinstance(info, _SlotInfo) and info.req.cancelled:
                 self.slots[i] = None
-                self.state = self.runner.release(self.state, i)
+                self.state = await loop_.run_in_executor(
+                    self._exec, self.runner.release, self.state, i)
                 self.requests_served += 1
 
         # Admit pending requests into free slots — but at most one prefill
@@ -383,7 +413,8 @@ class Scheduler:
                         info.req.out.put_nowait((_DONE, "length"))
                         self.slots[slot] = None
                         self.requests_served += 1
-                    self.state = self.runner.release(self.state, slot)
+                    self.state = await loop.run_in_executor(
+                        self._exec, self.runner.release, self.state, slot)
                     starved = check(k)
             if any(isinstance(s, _SlotInfo) for s in self.slots):
                 tokens_dev, self.state = await loop.run_in_executor(
@@ -412,7 +443,7 @@ class Scheduler:
                             req.temperature, req.top_p, sub,
                             top_k=req.top_k,
                             repeat_penalty=req.repeat_penalty))
-                    self._place(req, slot, ks, vs, plen, first)
+                    await self._place(req, slot, ks, vs, plen, first)
             except ValueError as e:
                 # Bad request / pool exhaustion at insert (PagesExhausted
                 # is a ValueError): fail THIS request, engine stays up —
@@ -546,10 +577,14 @@ class Scheduler:
                     emitted += 1
         if tokens.ndim == 3:
             # Acceptance telemetry: emitted / (verify steps × live slots)
-            # ≈ tokens per dispatch the speculation is buying.
+            # ≈ tokens per dispatch the speculation is buying.  Updated
+            # BEFORE the release flush's await point: a client observing
+            # its _DONE (queued in the emit loop above) may read
+            # describe() immediately.
             self.spec_steps += tokens.shape[0] * max(
                 1, sum(1 for s in fl.snapshot if isinstance(s, _SlotInfo)))
             self.spec_emitted += emitted
+        await self._flush_releases(loop)
         if emitted == 0:
             # Pure-overshoot chunk (dispatched before its slots' EOS was
             # discovered): not a throughput sample, don't drag the EMA down.

@@ -32,8 +32,6 @@ sharding of any kind; this is part of the TPU-native superset.
 
 from __future__ import annotations
 
-from dataclasses import replace as dc_replace
-
 import asyncio
 import logging
 import time
@@ -152,14 +150,10 @@ class ShardedEngine(Engine):
         from crowdllama_tpu.engine.tokenizer import get_tokenizer
         from crowdllama_tpu.engine.weights import (
             load_or_init_params,
-            resolve_model_config,
+            resolve_clamped_model_config,
         )
 
-        cfg = resolve_model_config(self.config.model, self.config.model_path)
-        if self.config.max_context_length:
-            cfg = dc_replace(
-                cfg, max_context_length=min(cfg.max_context_length,
-                                            self.config.max_context_length))
+        cfg = resolve_clamped_model_config(self.config)
         if self.strategy == "ep" and not cfg.is_moe:
             raise ValueError(
                 f"shard strategy 'ep' needs an MoE model; {cfg.name} is dense")

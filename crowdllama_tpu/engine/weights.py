@@ -98,6 +98,33 @@ def _rope_scaling_from_hf(d: dict | None):
                      f"(supported: llama3, linear)")
 
 
+def resolve_clamped_model_config(config) -> ModelConfig:
+    """The engine's model-config derivation from a node Configuration:
+    registry-or-checkpoint resolution plus the serving context clamp.
+    ONE implementation — the multi-host follower (parallel/replicated.py)
+    must build a runner bit-identical to the leader engine's, so the
+    derivation cannot be allowed to drift between copies."""
+    from dataclasses import replace as _replace
+
+    cfg = resolve_model_config(config.model, config.model_path)
+    if config.max_context_length:
+        cfg = _replace(cfg, max_context_length=min(
+            cfg.max_context_length, config.max_context_length))
+    return cfg
+
+
+def load_params_for(config, cfg: ModelConfig):
+    """Load-or-init + optional quantization, exactly as the engines do
+    (shared with the multi-host follower for the same reason as
+    :func:`resolve_clamped_model_config`)."""
+    params = load_or_init_params(cfg, config.model_path)
+    if config.quantize:
+        from crowdllama_tpu.ops.quant import quantize_params
+
+        params = quantize_params(params, mode=config.quantize)
+    return params
+
+
 def resolve_model_config(name: str, model_path: str = "",
                          **overrides) -> ModelConfig:
     """Registry lookup with a checkpoint-dir fallback: a model name not in
