@@ -231,6 +231,31 @@ def test_paged_fused_kernel_matches_gather(monkeypatch):
         assert outs["kernel"] == outs["gather"], (kvd, outs)
 
 
+def test_paged_kernel_odd_page_count_tail(monkeypatch):
+    """Page-PAIRED grid with an odd per-slot page count: the clamped tail
+    pair must not contribute (its duplicate page's compute is skipped by
+    the seq_len bound), matching the gather reference exactly."""
+    import jax.numpy as jnp
+
+    from crowdllama_tpu.ops.attention import decode_attention
+    from crowdllama_tpu.ops.pallas.paged import flash_paged_decode_attention
+
+    monkeypatch.setenv("CROWDLLAMA_PALLAS_INTERPRET", "1")
+    B, H, HKV, DH, PAGE, NP_ = 2, 8, 2, 32, 32, 3
+    P = B * NP_ + 1
+    key = jax.random.PRNGKey(1)
+    q = jax.random.normal(key, (B, H, DH), jnp.float32)
+    pk = jax.random.normal(key, (P, HKV, PAGE, DH), jnp.float32)
+    pv = jax.random.normal(key, (P, HKV, PAGE, DH), jnp.float32)
+    table = jnp.asarray([[0, 1, 2], [3, 4, 5]], jnp.int32)
+    lens = jnp.asarray([70, 95], jnp.int32)  # partial last pages
+    out = flash_paged_decode_attention(q, pk, pv, table, lens, DH ** -0.5)
+    kc = pk[table].transpose(0, 2, 1, 3, 4).reshape(B, HKV, NP_ * PAGE, DH)
+    vc = pv[table].transpose(0, 2, 1, 3, 4).reshape(B, HKV, NP_ * PAGE, DH)
+    ref = decode_attention(q, kc, vc, lens, DH ** -0.5)
+    assert float(jnp.max(jnp.abs(out - ref))) < 1e-5
+
+
 def test_paged_fused_kernel_tp_sharded(monkeypatch):
     """tp>1 meshes must take the fused kernel path via the shard_map
     wrapper — not the virtual-contiguous gather (VERDICT r3 missing #2) —
