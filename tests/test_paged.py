@@ -243,10 +243,19 @@ def test_paged_kernel_odd_page_count_tail(monkeypatch):
     monkeypatch.setenv("CROWDLLAMA_PALLAS_INTERPRET", "1")
     B, H, HKV, DH, PAGE, NP_ = 2, 8, 2, 32, 32, 3
     P = B * NP_ + 1
-    key = jax.random.PRNGKey(1)
-    q = jax.random.normal(key, (B, H, DH), jnp.float32)
-    pk = jax.random.normal(key, (P, HKV, PAGE, DH), jnp.float32)
-    pv = jax.random.normal(key, (P, HKV, PAGE, DH), jnp.float32)
+    kq, kk, kv_ = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = jax.random.normal(kq, (B, H, DH), jnp.float32)
+    pk = jax.random.normal(kk, (P, HKV, PAGE, DH), jnp.float32)
+    pv = jax.random.normal(kv_, (P, HKV, PAGE, DH), jnp.float32)
+    # Guard the test's purpose: this shape must actually select page
+    # PAIRING (the clamped tail path) — a budget/gating tweak that drops
+    # it to pairs=1 should fail here, not silently detune the test.
+    from crowdllama_tpu.ops.pallas.paged import (
+        _VMEM_TILE_BUDGET,
+        _pairs_bytes,
+    )
+
+    assert 4 * _pairs_bytes(HKV, PAGE, DH, 4) <= _VMEM_TILE_BUDGET
     table = jnp.asarray([[0, 1, 2], [3, 4, 5]], jnp.int32)
     lens = jnp.asarray([70, 95], jnp.int32)  # partial last pages
     out = flash_paged_decode_attention(q, pk, pv, table, lens, DH ** -0.5)

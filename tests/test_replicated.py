@@ -67,6 +67,92 @@ _FOLLOWER = _COMMON + textwrap.dedent("""
 """)
 
 
+_FAULT = textwrap.dedent("""
+    # Deterministic dispatch fault on BOTH processes: the first decode
+    # chunk of exactly 5 steps raises.  The leader's scheduler recovery
+    # fails the in-flight request, broadcasts INIT, and keeps serving;
+    # the follower must survive the SAME error and stay in lockstep.
+    from crowdllama_tpu.engine.runner import ModelRunner
+    _orig_dsd = ModelRunner.decode_steps_device
+    _fired = [False]
+    def _faulty(self, state, num_steps=1):
+        if num_steps == 5 and not _fired[0]:
+            _fired[0] = True
+            raise RuntimeError("injected dispatch fault")
+        return _orig_dsd(self, state, num_steps)
+    ModelRunner.decode_steps_device = _faulty
+""")
+
+_LEADER_FAULT = _COMMON + _FAULT + textwrap.dedent("""
+    import asyncio
+    from crowdllama_tpu.engine.engine import JaxEngine
+
+    async def main():
+        cfg.decode_chunk = 5
+        cfg.warmup = False  # warmup's chunk of decode_chunk would trip it
+        eng = JaxEngine(cfg)
+        await eng.start()
+        try:
+            async def one(prompt):
+                return [c async for c in eng.generate(
+                    prompt, max_tokens=8, temperature=0.0)]
+            try:
+                await one("doomed request")
+                raise SystemExit("expected the injected fault to surface")
+            except RuntimeError as e:
+                assert "engine failure" in str(e), e
+            second = await one("recovered request")
+            assert second[-1].done and not second[-1].done_reason.startswith(
+                "error"), second[-1]
+            assert second[-1].completion_tokens == 8
+            print("LEADER_RECOVERED_OK", flush=True)
+        finally:
+            await eng.stop()
+
+    asyncio.run(main())
+""")
+
+_FOLLOWER_FAULT = _COMMON + _FAULT + textwrap.dedent("""
+    from crowdllama_tpu.parallel.replicated import run_follower
+
+    run_follower(cfg)
+    print("FOLLOWER_OK", flush=True)
+""")
+
+
+def test_follower_survives_deterministic_dispatch_fault(tmp_path):
+    """A dispatch error that hits every process identically must leave
+    the cluster serving: leader recovery (fail requests + INIT) and the
+    follower's matching exception handler stay frame-synchronized."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        coord = f"127.0.0.1:{s.getsockname()[1]}"
+    (tmp_path / "leader.py").write_text(_LEADER_FAULT)
+    (tmp_path / "follower.py").write_text(_FOLLOWER_FAULT)
+    env = {**os.environ, "PYTHONPATH": str(REPO)}
+    env.pop("JAX_PLATFORMS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(tmp_path / name), coord, str(i)],
+            cwd=REPO, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        for i, name in enumerate(("leader.py", "follower.py"))
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=480)
+            outs.append(out)
+    finally:
+        for p in procs:
+            p.kill()
+    assert procs[0].returncode == 0, f"leader:\n{outs[0][-4000:]}"
+    assert "LEADER_RECOVERED_OK" in outs[0], outs[0][-2000:]
+    assert procs[1].returncode == 0, f"follower:\n{outs[1][-4000:]}"
+    assert "FOLLOWER_OK" in outs[1], outs[1][-2000:]
+    assert "awaiting leader recovery" in outs[1], outs[1][-2000:]
+
+
 def test_two_process_engine_serving(tmp_path):
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
