@@ -67,7 +67,8 @@ PARTIAL_PATH = Path(__file__).resolve().parent / "BENCH_partial.jsonl"
 # if it fails, later phases run with CROWDLLAMA_NO_PALLAS=1 so a kernel
 # regression degrades to the XLA paths instead of zeroing the artifact.
 _ALL_PHASES = ("kernel", "decode", "decode_paged", "decode_spec",
-               "decode_kv8", "decode8b", "decode8b_int4", "ttft", "swarm")
+               "decode_kv8", "decode8b", "decode8b_int4", "decode8b_ctx4k",
+               "ttft", "swarm")
 
 # Honor JAX_PLATFORMS even though the image's sitecustomize pre-imports jax
 # pinned to the axon (TPU tunnel) platform — env vars alone are read too
@@ -162,7 +163,7 @@ def _clear_backends() -> None:
 
 def _decode_phase(model: str, layout: str = "contiguous",
                   slots: int = 0, quantize: str | None = None,
-                  kv: str | None = None) -> dict:
+                  kv: str | None = None, ctx_override: int = 0) -> dict:
     """Saturated-batch decode throughput (tokens/sec/chip) for ``model``.
 
     ``quantize``/``kv`` override the env knobs for phases that pin a
@@ -182,7 +183,8 @@ def _decode_phase(model: str, layout: str = "contiguous",
     else:
         slots = slots or int(os.environ.get("CROWDLLAMA_BENCH_SLOTS", "8"))
         steps = int(os.environ.get("CROWDLLAMA_BENCH_STEPS", "512"))
-        ctx = int(os.environ.get("CROWDLLAMA_BENCH_CTX", "1024"))
+        ctx = ctx_override or int(os.environ.get("CROWDLLAMA_BENCH_CTX",
+                                                 "1024"))
         quantize = (quantize if quantize is not None
                     else os.environ.get("CROWDLLAMA_BENCH_QUANTIZE", "int8"))
         kv_dtype = kv or os.environ.get("CROWDLLAMA_BENCH_KV", "bf16")
@@ -256,6 +258,8 @@ def _decode_phase(model: str, layout: str = "contiguous",
         name += " (int8 KV)"
     if quantize == "int4":
         name += " (int4 weights)"
+    if ctx_override:
+        name += f" (ctx {ctx})"
     # Mean decode context during the timed window (prompt + warmup chunk +
     # half the timed steps) — the KV-read term of the step's byte budget.
     mean_len = min(24 + chunk + done / 2, cfg.max_context_length)
@@ -588,6 +592,8 @@ def main() -> None:
         for ph, metric in (("decode8b", "llama-3-8b decode throughput"),
                            ("decode8b_int4",
                             "llama-3-8b (int4 weights) decode throughput"),
+                           ("decode8b_ctx4k",
+                            "llama-3-8b (ctx 4096) decode throughput"),
                            ("decode_kv8",
                             f"{kv8_model} (int8 KV) decode throughput")):
             if ph in phases:
@@ -623,6 +629,10 @@ def main() -> None:
             "llama-3-8b", quantize="int4",
             slots=int(os.environ.get("CROWDLLAMA_BENCH_SLOTS_8B")
                       or os.environ.get("CROWDLLAMA_BENCH_SLOTS") or 16)),
+        # Long-context evidence: 4k context quadruples the per-step KV
+        # read (2.6 GB/step at bs=8) on top of the 8.5 GB weight stream.
+        "decode8b_ctx4k": lambda: _decode_phase(
+            "llama-3-8b", slots=8, ctx_override=4096),
         "decode_spec": _spec_phase,
         "kernel": _kernel_parity_phase,
         "ttft": _ttft_phase,

@@ -52,6 +52,17 @@ class MultiEngine(Engine):
         return eng
 
     async def start(self) -> None:
+        import jax
+
+        if jax.process_count() > 1 and len(self._engines) > 1:
+            # Each child would wrap its runner in a ReplicatedRunner and
+            # interleave frame streams the single follower replay loop
+            # (parallel/replicated.py) cannot represent — programmatic
+            # twin of the CLI's --dist-coordinator shape check.  A
+            # SINGLE-model container is fine: one child, one stream.
+            raise ValueError(
+                "multi-model workers do not compose with multi-host "
+                "serving (one replicated engine per cluster)")
         # Sequential start: children compile on the same device; parallel
         # starts would interleave big compilations for no wall-clock win.
         for name, eng in self._engines.items():

@@ -86,6 +86,13 @@ class Gateway:
         # endpoint") — this is part of the TPU-native superset.
         self._req_count: dict[tuple[str, int], int] = {}
         self._req_seconds: dict[tuple[str, int], float] = {}
+        # Streamed-inference time-to-first-frame histogram (Prometheus
+        # buckets, seconds): the gateway-side TTFT the operator actually
+        # controls — from admission to the worker's first token frame.
+        self._ttfb_le = (0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+        self._ttfb_buckets = [0] * (len(self._ttfb_le) + 1)
+        self._ttfb_sum = 0.0
+        self._ttfb_count = 0
         # Label hygiene: only registered routes become label values —
         # scanner probes of arbitrary paths must not grow the counter maps
         # without bound or inject quotes into the exposition format.
@@ -319,6 +326,7 @@ class Gateway:
     async def _route_embed(self, model: str, inputs: list[str],
                            truncate: bool = True) -> tuple[dict, int]:
         msg = create_embed_request(model, inputs, truncate=truncate)
+        t0 = time.monotonic()  # TTFB measures from ADMISSION, retries included
         tried: set[str] = set()
         last_err = "no workers available for model"
         for _attempt in range(2):  # retry once on next-best worker
@@ -466,6 +474,23 @@ class Gateway:
         # Stream-path counters (host-level): how this node's streams
         # actually traveled — direct, relay-spliced, or reversed
         # (net/relay.py connection reversal).
+        # Emitted unconditionally (zeros before the first streamed
+        # request): an absent series breaks absent()-style alerts and
+        # rate() windows across restarts.
+        lines.append("# TYPE crowdllama_gateway_ttfb_seconds histogram")
+        acc = 0
+        for le, n in zip(self._ttfb_le, self._ttfb_buckets):
+            acc += n
+            lines.append(
+                f'crowdllama_gateway_ttfb_seconds_bucket{{le="{le}"}} '
+                f"{acc}")
+        lines.append(
+            f'crowdllama_gateway_ttfb_seconds_bucket{{le="+Inf"}} '
+            f"{self._ttfb_count}")
+        lines.append(
+            f"crowdllama_gateway_ttfb_seconds_sum {self._ttfb_sum:.6f}")
+        lines.append(
+            f"crowdllama_gateway_ttfb_seconds_count {self._ttfb_count}")
         lines.append("# TYPE crowdllama_host_streams_total counter")
         for k, v in sorted(self.peer.host.stats.items()):
             lines.append(
@@ -668,6 +693,7 @@ class Gateway:
             repeat_penalty=max(0.0, float(
                 options.get("repeat_penalty", 1.0) or 1.0)),
         )
+        t0 = time.monotonic()  # TTFB measures from ADMISSION, retries included
         tried: set[str] = set()
         last_err = "no workers available for model"
         for _attempt in range(2):  # retry once on next-best worker
@@ -677,7 +703,7 @@ class Gateway:
             tried.add(worker.peer_id)
             try:
                 return await self._forward(request, worker.peer_id, msg,
-                                           stream, shape)
+                                           stream, shape, t0)
             except _StreamStarted as e:
                 # Headers/chunks already went out: no retry, no second
                 # response — the error frame was already written downstream.
@@ -692,11 +718,23 @@ class Gateway:
         return web.json_response(
             {"error": f"inference failed: {last_err}", "model": model}, status=503)
 
+    def _observe_ttfb(self, dt: float) -> None:
+        for i, le in enumerate(self._ttfb_le):
+            if dt <= le:
+                self._ttfb_buckets[i] += 1
+                break
+        else:
+            self._ttfb_buckets[-1] += 1
+        self._ttfb_sum += dt
+        self._ttfb_count += 1
+
     async def _forward(self, request, worker_id: str, msg, stream: bool,
-                       shape: str) -> web.StreamResponse:
+                       shape: str, t0: float) -> web.StreamResponse:
         """Open an inference stream to the worker and relay the reply
         (gateway.go:243-298).  ``shape`` picks the client dialect:
-        Ollama NDJSON ("chat"/"generate") or OpenAI SSE ("openai-*")."""
+        Ollama NDJSON ("chat"/"generate") or OpenAI SSE ("openai-*").
+        ``t0`` is the _route admission time: the TTFB histogram must
+        charge failed-worker retries to the request, not reset on them."""
         openai = shape.startswith("openai")
         rid = ("chatcmpl-" if shape == "openai-chat" else "cmpl-") \
             + os.urandom(12).hex()
@@ -731,6 +769,7 @@ class Gateway:
                 await wire.read_length_prefixed_pb(s.reader, timeout=600))
             if first.done_reason == "error":
                 raise RuntimeError(first.response)
+            self._observe_ttfb(time.monotonic() - t0)
             out = web.StreamResponse(
                 status=200,
                 headers={"Content-Type": ("text/event-stream" if openai

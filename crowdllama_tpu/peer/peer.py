@@ -38,6 +38,16 @@ from crowdllama_tpu.version import VERSION
 log = logging.getLogger("crowdllama.peer")
 
 
+def _single_process() -> bool:
+    """Swarm pull hot-registers a second engine, which multi-host
+    leader-replicated serving cannot represent (parallel/replicated.py)
+    — the pull op is disabled on multi-process clusters at the SERVICE,
+    so programmatic workers are covered, not just the CLI."""
+    import jax
+
+    return jax.process_count() == 1
+
+
 def _tpu_capabilities() -> dict:
     """Real accelerator capabilities introspected from the JAX runtime.
 
@@ -141,7 +151,9 @@ class Peer:
 
             self._model_share = ModelShareService(
                 model_dir=self.engine.model_dir, pull=self.pull_model,
-                allow_pull=getattr(self.config, "allow_swarm_pull", True))
+                allow_pull=(
+                    getattr(self.config, "allow_swarm_pull", True)
+                    and _single_process()))
             self.host.set_stream_handler(MODEL_PROTOCOL,
                                          self._model_share.handle)
         shard_service = getattr(self.engine, "shard_service", None)

@@ -406,14 +406,21 @@ async def test_metrics_endpoint():
             async with s.post(f"http://127.0.0.1:{gw_port}/api/chat",
                               json=body) as resp:
                 assert resp.status == 200
+            # One STREAMED request feeds the time-to-first-frame histogram.
+            async with s.post(f"http://127.0.0.1:{gw_port}/api/chat",
+                              json={**body, "stream": True}) as resp:
+                assert resp.status == 200
+                await resp.read()
             async with s.get(f"http://127.0.0.1:{gw_port}/metrics") as resp:
                 assert resp.status == 200
                 text = await resp.text()
         assert ('crowdllama_gateway_requests_total{path="/api/chat",'
-                'status="200"} 1') in text
+                'status="200"} 2') in text
         assert "crowdllama_workers_healthy 1" in text
         assert "crowdllama_worker_load{" in text
         assert "crowdllama_gateway_request_seconds_total{" in text
+        assert "crowdllama_gateway_ttfb_seconds_count 1" in text
+        assert 'crowdllama_gateway_ttfb_seconds_bucket{le="+Inf"} 1' in text
     finally:
         await teardown()
 
