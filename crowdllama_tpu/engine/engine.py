@@ -214,15 +214,6 @@ class JaxEngine(Engine):
 
     async def start(self) -> None:
         """Build tokenizer/params/runner (compiles on first use)."""
-        import jax as _jax
-
-        if _jax.process_count() > 1 and self.config.allow_swarm_pull:
-            # A swarm pull hot-registers a SECOND engine, whose frames
-            # the single-runner follower loop cannot represent
-            # (parallel/replicated.py) — programmatic twin of the CLI
-            # guard.
-            log.info("multi-host serving: disabling swarm pull")
-            self.config.allow_swarm_pull = False
         from crowdllama_tpu.engine.runner import ModelRunner
         from crowdllama_tpu.engine.scheduler import Scheduler
         from crowdllama_tpu.engine.tokenizer import get_tokenizer

@@ -326,7 +326,6 @@ class Gateway:
     async def _route_embed(self, model: str, inputs: list[str],
                            truncate: bool = True) -> tuple[dict, int]:
         msg = create_embed_request(model, inputs, truncate=truncate)
-        t0 = time.monotonic()  # TTFB measures from ADMISSION, retries included
         tried: set[str] = set()
         last_err = "no workers available for model"
         for _attempt in range(2):  # retry once on next-best worker
@@ -474,9 +473,10 @@ class Gateway:
         # Stream-path counters (host-level): how this node's streams
         # actually traveled — direct, relay-spliced, or reversed
         # (net/relay.py connection reversal).
-        # Emitted unconditionally (zeros before the first streamed
-        # request): an absent series breaks absent()-style alerts and
-        # rate() windows across restarts.
+        # Time-to-first-frame histogram for streamed inference, emitted
+        # unconditionally (zeros before the first streamed request): an
+        # absent series breaks absent()-style alerts and rate() windows
+        # across restarts.
         lines.append("# TYPE crowdllama_gateway_ttfb_seconds histogram")
         acc = 0
         for le, n in zip(self._ttfb_le, self._ttfb_buckets):

@@ -207,33 +207,36 @@ def flash_prefill_attention(
 def _decode_kernel(
     window_ref,   # SMEM [1, 1] int32
     seqlen_ref,   # SMEM [1, B] int32 — valid cache length per slot
-    q_ref,        # [G, Dh]
-    k_ref,        # [S, Dh]
-    v_ref,        # [S, Dh]
-    o_ref,        # [G, Dh]
+    q_ref,        # [HB, G, Dh] — HB kv heads per grid step
+    k_ref,        # [HB, S, Dh]
+    v_ref,        # [HB, S, Dh]
+    o_ref,        # [HB, G, Dh]
     *,
     scale: float,
     softcap: float,
     tk: int,
 ):
-    g, dh = q_ref.shape
-    q = q_ref[:].astype(jnp.float32)
+    hb, g, dh = q_ref.shape
+    q = q_ref[...].astype(jnp.float32)
     seq_len = seqlen_ref[0, pl.program_id(0)]
     window = window_ref[0, 0]
 
-    # Dynamic bound skips COMPUTE past seq_len (the full K/V row is still
+    # Dynamic bound skips COMPUTE past seq_len (the full K/V rows are still
     # block-copied to VMEM by the BlockSpec — this saves MXU/VPU time only).
     num_tiles = pl.cdiv(jnp.maximum(seq_len, 1), tk)
 
     def body(j, carry):
         acc, m, l = carry
-        k_tile = k_ref[pl.ds(j * tk, tk), :].astype(jnp.float32)
-        v_tile = v_ref[pl.ds(j * tk, tk), :].astype(jnp.float32)
-        kpos = j * tk + jax.lax.broadcasted_iota(jnp.int32, (1, tk), 1)
+        k_tile = k_ref[:, pl.ds(j * tk, tk), :].astype(jnp.float32)
+        v_tile = v_ref[:, pl.ds(j * tk, tk), :].astype(jnp.float32)
+        kpos = j * tk + jax.lax.broadcasted_iota(jnp.int32, (1, 1, tk), 2)
 
-        # [G, TK] = [G, Dh] · [TK, Dh]^T
+        # [HB, G, TK] = [HB, G, Dh] · [HB, TK, Dh]^T — every kv head in
+        # this grid step as one batched MXU issue (same bubble-bound
+        # reasoning as the paged kernel's head batching: fewer, fatter
+        # sequential grid steps).
         logits = jax.lax.dot_general(
-            q, k_tile, (((1,), (1,)), ((), ())),
+            q, k_tile, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         ) * scale
         logits = _softcap(logits, softcap)
@@ -247,18 +250,18 @@ def _decode_kernel(
         p = jnp.exp(logits - m_new) * mask.astype(jnp.float32)
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
         pv = jax.lax.dot_general(
-            p, v_tile, (((1,), (0,)), ((), ())),
+            p, v_tile, (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         )
         return acc * alpha + pv, m_new, l_new
 
-    acc = jnp.zeros((g, dh), jnp.float32)
-    m = jnp.full((g, 1), NEG_INF, jnp.float32)
-    l = jnp.zeros((g, 1), jnp.float32)
+    acc = jnp.zeros((hb, g, dh), jnp.float32)
+    m = jnp.full((hb, g, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((hb, g, 1), jnp.float32)
     acc, m, l = jax.lax.fori_loop(0, num_tiles, body, (acc, m, l))
 
     l = jnp.where(l == 0.0, 1.0, l)
-    o_ref[:] = (acc / l).astype(o_ref.dtype)
+    o_ref[...] = (acc / l).astype(o_ref.dtype)
 
 
 def flash_decode_attention(
@@ -277,6 +280,17 @@ def flash_decode_attention(
     g = h // hkv
     tk = _tile(s, 512)
 
+    # Heads per sequential grid step: the largest divisor of Hkv whose
+    # double-buffered K+V blocks stay inside the VMEM budget (hb=1 is the
+    # old per-head grid and always fits when pallas_supported said yes).
+    hb = 1
+    itemsize = k_cache.dtype.itemsize
+    for cand in range(hkv, 0, -1):
+        if (hkv % cand == 0
+                and 4 * cand * s * dh * itemsize <= _VMEM_KV_BUDGET_BYTES):
+            hb = cand
+            break
+
     qg = q.reshape(b, hkv, g, dh)
     window = jnp.asarray(sliding_window, jnp.int32).reshape(1, 1)
     seq_lens = seq_lens.astype(jnp.int32).reshape(1, b)
@@ -286,17 +300,17 @@ def flash_decode_attention(
     )
     out = pl.pallas_call(
         kernel,
-        grid=(b, hkv),
+        grid=(b, hkv // hb),
         in_specs=[
             pl.BlockSpec((1, 1), lambda bi, hi: (0, 0),
                          memory_space=pltpu.SMEM),
             pl.BlockSpec((1, b), lambda bi, hi: (0, 0),
                          memory_space=pltpu.SMEM),
-            pl.BlockSpec((None, None, g, dh), lambda bi, hi: (bi, hi, 0, 0)),
-            pl.BlockSpec((None, None, s, dh), lambda bi, hi: (bi, hi, 0, 0)),
-            pl.BlockSpec((None, None, s, dh), lambda bi, hi: (bi, hi, 0, 0)),
+            pl.BlockSpec((None, hb, g, dh), lambda bi, hi: (bi, hi, 0, 0)),
+            pl.BlockSpec((None, hb, s, dh), lambda bi, hi: (bi, hi, 0, 0)),
+            pl.BlockSpec((None, hb, s, dh), lambda bi, hi: (bi, hi, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((None, None, g, dh),
+        out_specs=pl.BlockSpec((None, hb, g, dh),
                                lambda bi, hi: (bi, hi, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((b, hkv, g, dh), q.dtype),
         interpret=_interpret(),
