@@ -66,9 +66,13 @@ PARTIAL_PATH = Path(__file__).resolve().parent / "BENCH_partial.jsonl"
 # kernel runs FIRST: it proves the Mosaic-compiled kernels on this chip;
 # if it fails, later phases run with CROWDLLAMA_NO_PALLAS=1 so a kernel
 # regression degrades to the XLA paths instead of zeroing the artifact.
-_ALL_PHASES = ("kernel", "decode", "decode_paged", "decode_spec",
-               "decode_kv8", "decode8b", "decode8b_int4", "decode8b_ctx4k",
-               "ttft", "swarm")
+# BASELINE-metric phases run FIRST (decode configs, ttft, swarm): if the
+# run is cut short, the partials already hold the scoreboard; the
+# quantization/context variants are the long tail (each 8B phase pays
+# ~3 min of on-chip param init alone).
+_ALL_PHASES = ("kernel", "decode", "decode_paged", "decode8b", "ttft",
+               "swarm", "decode_spec", "decode_kv8", "decode8b_int4",
+               "decode8b_ctx4k")
 
 # Honor JAX_PLATFORMS even though the image's sitecustomize pre-imports jax
 # pinned to the axon (TPU tunnel) platform — env vars alone are read too
