@@ -124,8 +124,20 @@ class PagedModelRunner(ModelRunner):
         if kwargs.get("mesh") is None and not kwargs.get("mesh_spec"):
             from crowdllama_tpu.parallel.mesh import largest_tp
 
-            kwargs["mesh_spec"] = (
-                f"1x{largest_tp(len(jax.devices()), cfg.num_kv_heads)}")
+            tp = largest_tp(len(jax.devices()), cfg.num_kv_heads)
+            if tp < len(jax.devices()):
+                # Paged cannot absorb the spare devices as dp, so they
+                # IDLE on this auto mesh.  Be loud: the operator's best
+                # moves are an explicit MoE/ep mesh, or
+                # --kv-layout contiguous (whose auto mesh spills to dp —
+                # full device usage, no prefix cache).
+                log.warning(
+                    "paged auto mesh uses tp=%d of %d devices (kv heads "
+                    "limit tp; the page pool cannot shard over dp) — %d "
+                    "devices idle.  Consider an explicit --mesh or "
+                    "--kv-layout contiguous for dp batching.",
+                    tp, len(jax.devices()), len(jax.devices()) - tp)
+            kwargs["mesh_spec"] = f"1x{tp}"
         super().__init__(cfg, *args, **kwargs)
         from crowdllama_tpu.parallel.mesh import AXIS_DP
 
