@@ -553,7 +553,10 @@ class PagedModelRunner(ModelRunner):
         # shard_map wrapper: a raw pallas_call can't be partitioned by
         # GSPMD, and shard_map is also what replicates it over ep.
         sharded = self.mesh.size > 1
-        use_kernel = paged_pallas_supported(pg, dh, tp, hkv)
+        pool_itemsize = jnp.dtype(
+            jnp.int8 if quant else self.dtype).itemsize  # = init_state's pool
+        use_kernel = paged_pallas_supported(
+            pg, dh, tp, hkv, itemsize=pool_itemsize, quant=quant)
         if not use_kernel and self.mesh.size > 1:
             log.info("paged decode: fused kernel unavailable on this "
                      "mesh/backend; using the jnp gather view")

@@ -493,8 +493,16 @@ class Gateway:
             f"crowdllama_gateway_ttfb_seconds_count {self._ttfb_count}")
         lines.append("# TYPE crowdllama_host_streams_total counter")
         for k, v in sorted(self.peer.host.stats.items()):
-            lines.append(
-                f'crowdllama_host_streams_total{{kind="{k}"}} {v}')
+            # Only the stream-kind counters belong under this metric;
+            # non-stream stats (e.g. "rejected") get their own series so
+            # new Host stats keys can't silently change its meaning.
+            if k.startswith("streams_"):
+                lines.append(
+                    f'crowdllama_host_streams_total{{kind="{k}"}} {v}')
+        lines.append("# TYPE crowdllama_host_rejected_total counter")
+        lines.append(
+            f"crowdllama_host_rejected_total "
+            f"{self.peer.host.stats.get('rejected', 0)}")
         return web.Response(text="\n".join(lines) + "\n",
                             content_type="text/plain")
 

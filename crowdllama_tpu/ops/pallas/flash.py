@@ -48,14 +48,16 @@ def pallas_supported(seq_len: int, head_dim: int, itemsize: int = 2,
     (``pallas_call`` cannot be auto-partitioned by GSPMD — multi-chip
     callers stay on the XLA path until the kernels are shard_map-wrapped),
     a hardware-sized tile (≥32; odd/prime extents would degenerate), and
-    K+V rows fitting the VMEM budget."""
+    DOUBLE-BUFFERED K+V rows fitting the VMEM budget (the 4x bound is what
+    the decode kernel's head-batch loop actually requires at hb=1 — a 2x
+    gate here let the hb=1 grid run over budget in the gap, ADVICE r4)."""
     if env_flag("CROWDLLAMA_NO_PALLAS"):
         return False
     if not _interpret() and jax.default_backend() != "tpu":
         return False
     if n_shards > 1:
         return False
-    if 2 * seq_len * head_dim * itemsize > _VMEM_KV_BUDGET_BYTES:
+    if 4 * seq_len * head_dim * itemsize > _VMEM_KV_BUDGET_BYTES:
         return False
     return _tile(seq_len) >= 32
 
@@ -282,7 +284,8 @@ def flash_decode_attention(
 
     # Heads per sequential grid step: the largest divisor of Hkv whose
     # double-buffered K+V blocks stay inside the VMEM budget (hb=1 is the
-    # old per-head grid and always fits when pallas_supported said yes).
+    # old per-head grid; pallas_supported gates on the same 4x
+    # double-buffered bound, so hb=1 always passes this check).
     hb = 1
     itemsize = k_cache.dtype.itemsize
     for cand in range(hkv, 0, -1):

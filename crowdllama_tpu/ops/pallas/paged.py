@@ -53,11 +53,17 @@ def _pairs_bytes(hkv: int, page: int, dh: int, itemsize: int) -> int:
 
 def paged_pallas_supported(page_size: int, head_dim: int,
                            n_shards: int = 1,
-                           num_kv_heads: int = 0) -> bool:
+                           num_kv_heads: int = 0,
+                           itemsize: int = 2,
+                           quant: bool = False) -> bool:
     """The fused paged kernel applies on TPU (or forced interpret mode)
     with hardware-aligned page tiles.  tp-sharded pools are supported via
     the shard_map wrapper (:func:`flash_paged_decode_attention_tp`) when
-    every shard owns whole kv heads; ``n_shards`` is the TP axis extent."""
+    every shard owns whole kv heads; ``n_shards`` is the TP axis extent.
+    ``itemsize`` is the KV POOL's element size (1 for int8 pools — gating
+    on the bf16 size refused the kernel for wide-Hkv int8 configs that
+    actually fit, ADVICE r4); ``quant`` adds the int8 scale tiles to the
+    VMEM budget, matching the kernel's real footprint."""
     if env_flag("CROWDLLAMA_NO_PALLAS"):
         return False
     if not _interpret() and jax.default_backend() != "tpu":
@@ -73,7 +79,12 @@ def paged_pallas_supported(page_size: int, head_dim: int,
     # probe) checks the single-head minimum — callers deciding the REAL
     # kernel path must pass the model's kv-head count.
     hkv_local = max(max(num_kv_heads, 1) // max(n_shards, 1), 1)
-    if 2 * _pairs_bytes(hkv_local, page_size, head_dim, 2) > _VMEM_TILE_BUDGET:
+    step_bytes = 2 * _pairs_bytes(hkv_local, page_size, head_dim, itemsize)
+    if quant:
+        # Two [Hkv, 1, page] bf16 scale tiles (K + V) per page, double-
+        # buffered like the KV tiles they ride with.
+        step_bytes += 2 * 2 * hkv_local * page_size * 2
+    if step_bytes > _VMEM_TILE_BUDGET:
         return False
     # Block last-two dims are (page, head_dim); Mosaic pads sub-tile
     # extents, so sublane alignment suffices (TinyLlama Dh=64, Llama 128).

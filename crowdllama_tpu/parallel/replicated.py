@@ -49,6 +49,7 @@ _OP_PREFILL_BEGIN = 6
 _OP_PREFILL_STEP = 7
 _OP_PREFILL_FINISH = 8
 _OP_STOP = 9
+_OP_PREFILL_ABORT = 10
 
 _NI, _NF, _NK = 8, 4, 4  # frame scalar-int / float / key-word capacities
 
@@ -179,6 +180,12 @@ class ReplicatedRunner:
                                          top_k=top_k,
                                          repeat_penalty=repeat_penalty)
 
+    def prefill_abort(self, job) -> None:
+        """Leader abandoned a chunked prefill (client cancelled mid-
+        admission): tell followers to drop the job, or they keep its KV
+        accumulators pinned until the next PREFILL_BEGIN replaces them."""
+        self._bcast(_OP_PREFILL_ABORT)
+
     def insert(self, state, slot, ks, vs, plen, first, temperature, top_p,
                prompt_tokens=None, slot_key=None, top_k: int = 0,
                repeat_penalty: float = 1.0):
@@ -266,6 +273,16 @@ def run_follower(config) -> None:
     state = None
     pending = None  # last prefill result awaiting insert
     job = None      # current chunked-prefill job
+    # Set when an op failed here: a DETERMINISTIC error is mirrored on the
+    # leader, whose recovery broadcasts INIT as its very next frame — so a
+    # poisoned follower accepts only INIT (and NOOP/STOP).  Any other op
+    # means the failure was follower-local (transient device error, local
+    # OOM): per-shard state has diverged, and replaying frames against it
+    # would make every collectively-computed decode silently corrupt the
+    # tokens the LEADER serves.  Fail loudly instead — terminating the
+    # follower turns the leader's next broadcast into a distributed-runtime
+    # error rather than wrong output (ADVICE r4 medium).
+    poisoned = False
     zero = {"op": np.int32(0), "i32": np.zeros((_NI,), np.int32),
             "f32": np.zeros((_NF,), np.float32),
             "key": np.zeros((_NK,), np.uint32)}
@@ -284,18 +301,25 @@ def run_follower(config) -> None:
             return
         if op in (_OP_NOOP,):
             continue
+        if poisoned and op != _OP_INIT:
+            raise RuntimeError(
+                f"follower {jax.process_index()} state diverged from the "
+                f"leader (a local op failure was not mirrored — next frame "
+                f"was op {op}, not INIT); terminating so the divergence "
+                f"fails loudly instead of serving corrupted tokens")
         try:
             state, pending, job = _apply(runner, state, pending, job, op,
                                          frame, i32, f32)
+            poisoned = False
         except Exception:
-            # The leader's scheduler survives dispatch errors (it fails
-            # in-flight requests, broadcasts INIT, and keeps serving) —
-            # the follower must survive the SAME deterministic error or
-            # the next broadcast hangs on a dead participant.  Clear the
-            # transient op state; the leader's recovery INIT replaces the
-            # decode state.
+            # A deterministic error is survivable: the leader fails its
+            # in-flight requests and broadcasts INIT, which rebuilds state
+            # here.  Mark poisoned and clear transient op state; the check
+            # above decides on the NEXT frame whether the leader actually
+            # mirrored the failure.
             log.exception("follower op %d failed; awaiting leader recovery",
                           op)
+            poisoned = True
             pending = None
             job = None
 
@@ -319,6 +343,8 @@ def _apply(runner, state, pending, job, op, frame, i32, f32):
         job = runner.prefill_begin(prompt, state=state)
     elif op == _OP_PREFILL_STEP:
         runner.prefill_step(job)
+    elif op == _OP_PREFILL_ABORT:
+        job = None
     elif op == _OP_PREFILL_FINISH:
         pending = runner.prefill_finish(
             job, float(f32[0]), float(f32[1]),

@@ -153,6 +153,106 @@ def test_follower_survives_deterministic_dispatch_fault(tmp_path):
     assert "awaiting leader recovery" in outs[1], outs[1][-2000:]
 
 
+def _frame(op, ints=()):
+    import numpy as np
+
+    from crowdllama_tpu.parallel import replicated as R
+
+    f = {"op": np.int32(op), "i32": np.zeros((R._NI,), np.int32),
+         "f32": np.zeros((R._NF,), np.float32),
+         "key": np.zeros((R._NK,), np.uint32)}
+    f["i32"][: len(ints)] = list(ints)
+    return f
+
+
+def _scripted_follower(monkeypatch, frames):
+    """Run run_follower against a scripted frame stream (no real DCN):
+    broadcast_from_leader pops the next scripted frame."""
+    from crowdllama_tpu.config import Configuration
+    from crowdllama_tpu.parallel import multihost, replicated
+
+    script = list(frames)
+
+    def fake_broadcast(_template):
+        assert script, "follower consumed frames past the script"
+        return script.pop(0)
+
+    monkeypatch.setattr(multihost, "broadcast_from_leader", fake_broadcast)
+    cfg = Configuration(model="tiny-test", max_batch_slots=2,
+                        max_context_length=128, kv_layout="contiguous",
+                        mesh_shape="1")
+    return replicated.run_follower(cfg)
+
+
+def _inject_one_decode_fault(monkeypatch):
+    from crowdllama_tpu.engine.runner import ModelRunner
+
+    real = ModelRunner.decode_steps_device
+    fired = {"n": 0}
+
+    def flaky(self, state, num_steps=1):
+        fired["n"] += 1
+        if fired["n"] == 1:
+            raise RuntimeError("injected follower-local fault")
+        return real(self, state, num_steps)
+
+    monkeypatch.setattr(ModelRunner, "decode_steps_device", flaky)
+
+
+def test_follower_local_failure_fails_loudly(monkeypatch):
+    """A failure NOT mirrored by the leader (no INIT follows) means the
+    follower's per-shard state has diverged — replaying further frames
+    would let the leader serve silently corrupted tokens.  The follower
+    must terminate instead (ADVICE r4 medium)."""
+    import pytest
+
+    from crowdllama_tpu.parallel import replicated as R
+
+    _inject_one_decode_fault(monkeypatch)
+    with pytest.raises(RuntimeError, match="diverged"):
+        _scripted_follower(monkeypatch, [
+            _frame(R._OP_INIT, (0,)),
+            _frame(R._OP_DECODE, (1,)),   # fails follower-side only
+            _frame(R._OP_DECODE, (1,)),   # leader continued: divergence
+        ])
+
+
+def test_follower_recovers_when_leader_mirrors_failure(monkeypatch):
+    """The deterministic-failure path stays survivable: when the next
+    frame after a local failure IS the leader's recovery INIT, the
+    follower rebuilds state and keeps replaying."""
+    from crowdllama_tpu.parallel import replicated as R
+
+    _inject_one_decode_fault(monkeypatch)
+    _scripted_follower(monkeypatch, [
+        _frame(R._OP_INIT, (0,)),
+        _frame(R._OP_DECODE, (1,)),   # fails (injected)
+        _frame(R._OP_INIT, (0,)),     # leader recovery
+        _frame(R._OP_DECODE, (1,)),   # poisoned cleared: executes fine
+        _frame(R._OP_STOP),
+    ])  # returns without raising
+
+
+def test_prefill_abort_frame_drops_follower_job(monkeypatch):
+    """PREFILL_ABORT broadcasts from the leader proxy and clears the
+    follower's chunked-prefill job (ADVICE r4: abandoned jobs pinned
+    follower KV accumulators)."""
+    from crowdllama_tpu.parallel import multihost
+    from crowdllama_tpu.parallel import replicated as R
+
+    sent = []
+    monkeypatch.setattr(multihost, "broadcast_from_leader", sent.append)
+    R.ReplicatedRunner(inner=object()).prefill_abort(job=object())
+    assert len(sent) == 1 and int(sent[0]["op"]) == R._OP_PREFILL_ABORT
+
+    job_sentinel = object()
+    state, pending, job = R._apply(
+        runner=None, state="st", pending=None, job=job_sentinel,
+        op=R._OP_PREFILL_ABORT, frame=sent[0],
+        i32=sent[0]["i32"], f32=sent[0]["f32"])
+    assert job is None and state == "st"
+
+
 def test_two_process_engine_serving(tmp_path):
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
