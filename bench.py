@@ -10,6 +10,9 @@ Phases (CROWDLLAMA_BENCH_PHASES to select, comma-separated):
   decode_paged same config on the paged KV pool + fused pallas paged-decode
                kernel (the serving default) — must land within ~5% of decode
   decode8b     Llama-3-8B int8 decode throughput (BASELINE config 2 headline)
+  decode8b_paged  the same 8B config on the PRODUCTION-DEFAULT serving path
+               (paged KV + fused pallas kernel), swept over batch slots
+               (CROWDLLAMA_BENCH_SLOTS_SWEEP, default 16,32,64)
   decode_kv8   TinyLlama int8 weights + int8 KV cache (the halved cache read)
   decode8b_int4  Llama-3-8B int4 weights — Ollama's own 8B default is 4-bit
                GGUF, so int4-vs-Q4 is the parity-honest quantization cell
@@ -30,13 +33,27 @@ is therefore measured tokens/sec/chip divided by that advertised 150 tok/s
 where comparable, null elsewhere.
 
 Resilience: the chip sits behind a network tunnel that can drop for many
-minutes (BENCH_r02 lost the whole round to a 300 s budget).  The device
-wait budget is now 25 min by default (CROWDLLAMA_BENCH_BUDGET_S) and on
-final failure the suite falls back to CPU with a tiny model so the run
-still produces a parseable artifact (clearly labeled platform=cpu).
+minutes (BENCH_r02 lost the whole round to a 300 s budget; BENCH_r04 fell
+back to CPU at startup and never looked again — VERDICT r4 #1).  The
+suite now:
+  - waits a bounded slice of the budget at startup, then falls back to
+    CPU so the run always produces a parseable artifact;
+  - RE-PROBES the tunnel (bounded subprocess) at every phase boundary —
+    a mid-run tunnel-up window flips the suite back to TPU, runs the
+    deferred TPU-only phases in BASELINE-priority order (decode8b first),
+    and re-runs the phases that executed on the CPU fallback;
+  - defers TPU-only phases behind the CPU-runnable ones instead of
+    skipping them at startup, so the tunnel gets the whole run's
+    duration to come back;
+  - on final skip, emits markers carrying the per-phase probe evidence
+    and the newest builder-session TPU artifact's path + sha256, so the
+    provenance chain to the last real on-chip numbers is explicit.
 
 Env knobs:
-  CROWDLLAMA_BENCH_BUDGET_S   device-wait budget seconds (default 1500)
+  CROWDLLAMA_BENCH_BUDGET_S   device-wait budget seconds (default 1500;
+                              up to 600 s of it waits at startup, and the
+                              full budget then backs per-phase re-probes)
+  CROWDLLAMA_BENCH_SLOTS_SWEEP  decode8b_paged slot sweep (default 16,32,64)
   CROWDLLAMA_BENCH_PHASES     comma list (default all)
   CROWDLLAMA_BENCH_SLOTS      batch slots        (default 8; 16 for the
                               decode8b phase, whose weight-bandwidth-bound
@@ -70,9 +87,28 @@ PARTIAL_PATH = Path(__file__).resolve().parent / "BENCH_partial.jsonl"
 # run is cut short, the partials already hold the scoreboard; the
 # quantization/context variants are the long tail (each 8B phase pays
 # ~3 min of on-chip param init alone).
-_ALL_PHASES = ("kernel", "decode", "decode_paged", "decode8b", "ttft",
-               "swarm", "decode_spec", "decode_kv8", "decode8b_int4",
-               "decode8b_ctx4k")
+_ALL_PHASES = ("kernel", "decode", "decode_paged", "decode8b",
+               "decode8b_paged", "decode8b_ctx4k", "ttft", "swarm",
+               "decode_spec", "decode_kv8", "decode8b_int4")
+
+# Phases meaningless on the CPU fallback (real-size or quantized decode).
+_TPU_ONLY_PHASES = frozenset(
+    {"decode8b", "decode8b_paged", "decode8b_int4", "decode8b_ctx4k",
+     "decode_kv8"})
+# When a tunnel-up window opens mid-run, spend it on the BASELINE
+# scoreboard first: kernel parity FIRST (its CPU run was interpret-mode;
+# the on-chip Mosaic compile must validate the kernels before any phase
+# relies on them — the suite's standing kernel-gate invariant), then the
+# 8B headline, then the production-default paged 8B (whose int8 params
+# are then already resident for ctx4k).
+_TPU_WINDOW_PRIORITY = {"kernel": -1, "decode8b": 0, "decode8b_paged": 1,
+                        "decode8b_ctx4k": 2, "decode_kv8": 3,
+                        "decode8b_int4": 4}
+# CPU-fallback executions of these phases are re-run when the tunnel
+# returns (their CPU numbers are tiny-model stand-ins); swarm is a
+# control-plane metric and CPU by design.
+_RERUN_ON_TPU = frozenset({"kernel", "decode", "decode_paged",
+                           "decode_spec", "ttft"})
 
 # Honor JAX_PLATFORMS even though the image's sitecustomize pre-imports jax
 # pinned to the axon (TPU tunnel) platform — env vars alone are read too
@@ -98,36 +134,71 @@ def _emit(result: dict) -> None:
         print(f"# partial persist failed: {e}", file=sys.stderr)
 
 
-def _wait_for_devices(budget_s: float):
-    """The chip sits behind a network tunnel that occasionally drops and
-    needs minutes to recover; retry backend init instead of failing the
-    whole benchmark run on a transient.  After the budget, fall back to the
-    CPU backend so the run still emits parseable (clearly-labeled) lines
-    rather than rc=1 with nothing (BENCH_r02 postmortem, VERDICT r2 #1).
+class _Platform:
+    """Tracks intended vs current jax platform across the run.
 
-    Probes run in SUBPROCESSES with a hard per-attempt timeout: a downed
-    tunnel can make backend init HANG indefinitely inside the C extension
-    (observed 20+ min, uninterruptible in-process) rather than raise — an
-    in-process retry loop would never regain control."""
-    import jax
+    The chip sits behind a network tunnel that occasionally drops and
+    needs minutes to recover; probes run in SUBPROCESSES with a hard
+    per-attempt timeout because a downed tunnel can make backend init
+    HANG indefinitely inside the C extension (observed 20+ min,
+    uninterruptible in-process).  After the startup budget the suite
+    falls back to CPU — but keeps RE-PROBING at phase boundaries
+    (VERDICT r4 #1: BENCH_r04 fell back at startup and missed the
+    mid-run tunnel-up window the builder's own session caught)."""
 
-    if jax.config.jax_platforms == "cpu":
-        return jax.devices()  # explicitly pinned (tests / CPU runs)
-    deadline = time.monotonic() + budget_s
-    delay = 5.0
-    while True:
-        remaining = deadline - time.monotonic()
-        if remaining <= 0:
-            print("# device budget exhausted; falling back to CPU",
-                  file=sys.stderr)
-            break
+    def __init__(self):
+        import jax
+
+        self.original = jax.config.jax_platforms  # axon/TPU unless pinned
+        self.want_tpu = (self.original or "") != "cpu"
+        self.on_cpu_fallback = False
+        self.probe_attempts = 0
+        self.probe_log: list[str] = []  # ISO timestamps of failed re-probes
+
+    @staticmethod
+    def _subprocess_probe(timeout_s: float) -> tuple[bool, str]:
         try:
             probe = subprocess.run(
                 [sys.executable, "-c",
-                 "import jax; print(len(jax.devices()))"],
-                timeout=min(120.0, max(remaining, 10.0)),
-                capture_output=True, text=True)
-            if probe.returncode == 0 and probe.stdout.strip().isdigit():
+                 "import jax; d = jax.devices(); "
+                 "print(d[0].platform, len(d))"],
+                timeout=timeout_s, capture_output=True, text=True)
+        except subprocess.TimeoutExpired:
+            return False, "backend init hung (tunnel down)"
+        out = probe.stdout.strip().split()
+        if probe.returncode == 0 and out and out[0] == "tpu":
+            return True, ""
+        detail = (probe.stderr or "").strip().splitlines()
+        return False, (detail[-1] if detail
+                       else f"rc={probe.returncode} out={out}")
+
+    def _fall_back_to_cpu(self):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        _clear_backends()
+        self.on_cpu_fallback = True
+        return jax.devices()
+
+    def startup_wait(self, budget_s: float):
+        """Bounded wait for the TPU backend; CPU fallback after it."""
+        import jax
+
+        if not self.want_tpu:
+            return jax.devices()  # explicitly pinned (tests / CPU runs)
+        deadline = time.monotonic() + budget_s
+        delay = 5.0
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                print("# startup device budget exhausted; falling back to "
+                      "CPU (will re-probe at phase boundaries)",
+                      file=sys.stderr)
+                return self._fall_back_to_cpu()
+            self.probe_attempts += 1
+            ok, detail = self._subprocess_probe(
+                min(120.0, max(remaining, 10.0)))
+            if ok:
                 try:
                     # Tunnel is up per the probe: init in-process.  A drop
                     # in the gap between probe and init must re-enter the
@@ -136,18 +207,38 @@ def _wait_for_devices(budget_s: float):
                 except RuntimeError as e:
                     _clear_backends()
                     detail = f"post-probe init failed: {e}"
-            else:
-                detail = (probe.stderr or "").strip().splitlines()
-                detail = detail[-1] if detail else f"rc={probe.returncode}"
-        except subprocess.TimeoutExpired:
-            detail = "backend init hung (tunnel down)"
-        print(f"# devices unavailable ({detail}); retrying in {delay:.0f}s",
-              file=sys.stderr)
-        time.sleep(delay)
-        delay = min(delay * 2, 60.0)
-    jax.config.update("jax_platforms", "cpu")
-    _clear_backends()
-    return jax.devices()
+            print(f"# devices unavailable ({detail}); retrying in "
+                  f"{delay:.0f}s", file=sys.stderr)
+            time.sleep(delay)
+            delay = min(delay * 2, 60.0)
+
+    def reprobe(self, timeout_s: float = 60.0) -> bool:
+        """One bounded attempt to regain the TPU at a phase boundary.
+        True when the suite is (back) on the real chip."""
+        import jax
+
+        if not self.want_tpu:
+            return False
+        if not self.on_cpu_fallback:
+            return True
+        self.probe_attempts += 1
+        ok, detail = self._subprocess_probe(timeout_s)
+        if not ok:
+            self.probe_log.append(
+                time.strftime("%Y-%m-%dT%H:%M:%S") + f" {detail}")
+            return False
+        try:
+            jax.config.update("jax_platforms", self.original)
+            _clear_backends()
+            if jax.devices()[0].platform == "tpu":
+                self.on_cpu_fallback = False
+                print("# tunnel back up: TPU backend restored",
+                      file=sys.stderr)
+                return True
+        except Exception as e:  # dropped again in the probe→init gap
+            print(f"# post-probe TPU init failed: {e}", file=sys.stderr)
+        self._fall_back_to_cpu()
+        return False
 
 
 def _clear_backends() -> None:
@@ -163,6 +254,31 @@ def _clear_backends() -> None:
 
 
 # ----------------------------------------------------------------- decode
+
+#: One quantized parameter tree, keyed (platform, model, mode): 8B param
+#: init costs ~3 min of the tunnel window, and consecutive 8B phases
+#: (decode8b -> decode8b_paged slot sweep -> ctx4k) share the same int8
+#: weights.  Single-entry: two 8B trees cannot coexist on a 16 GB chip.
+_PARAM_CACHE: dict[tuple, object] = {}
+
+
+def _quantized_params(cfg, model: str, quantize: str, platform: str):
+    import jax
+
+    from crowdllama_tpu.ops.quant import random_quantized_params
+
+    key = (platform, model, quantize)
+    if key not in _PARAM_CACHE:
+        _PARAM_CACHE.clear()  # free the previous tree BEFORE allocating
+        t0 = time.monotonic()
+        # Leaf-by-leaf quantized init: never materializes the bf16 tree, so
+        # an 8B model (16 GB bf16) can be benched on the 16 GB chip it
+        # serves from.  Throughput-identical to quantize_params(init(...)).
+        _PARAM_CACHE[key] = random_quantized_params(
+            cfg, jax.random.PRNGKey(0), mode=quantize)
+        print(f"# param init ({model}, {quantize}): "
+              f"{time.monotonic() - t0:.0f}s", file=sys.stderr)
+    return _PARAM_CACHE[key]
 
 
 def _decode_phase(model: str, layout: str = "contiguous",
@@ -208,19 +324,20 @@ def _decode_phase(model: str, layout: str = "contiguous",
     t0 = time.monotonic()
     params = None
     if quantize in ("int8", "int4"):
-        from crowdllama_tpu.ops.quant import random_quantized_params
-
-        # Leaf-by-leaf quantized init: never materializes the bf16 tree, so
-        # an 8B model (16 GB bf16) can be benched on the 16 GB chip it
-        # serves from.  Throughput-identical to quantize_params(init(...)).
-        params = random_quantized_params(cfg, jax.random.PRNGKey(0),
-                                         mode=quantize)
+        params = _quantized_params(cfg, model, quantize, platform)
     if layout == "paged":
         from crowdllama_tpu.engine.paged import PagedModelRunner
 
+        # Size the pool for what this run actually touches (prompt page +
+        # warmup + timed steps + one page of margin) instead of
+        # slots x max_seq: the slot sweep's bs=64 x 8B config only fits the
+        # 16 GB chip because pages the run can never reach are not
+        # allocated.  Growth past the pool raises PagesExhausted loudly.
+        per_slot = min(cfg.max_context_length, 128 + steps + 32 + 128)
         runner = PagedModelRunner(cfg, params=params, max_slots=slots,
                                   max_seq=cfg.max_context_length,
-                                  kv_dtype=kv_dtype)
+                                  kv_dtype=kv_dtype,
+                                  pool_tokens=slots * per_slot)
     else:
         runner = ModelRunner(cfg, params=params, max_slots=slots,
                              max_seq=cfg.max_context_length,
@@ -284,6 +401,67 @@ def _decode_phase(model: str, layout: str = "contiguous",
                       runner, cfg, kv_dtype, mean_len, done, dt, n_chips,
                       on_tpu)},
     }
+
+
+def _decode8b_paged_phase() -> dict:
+    """8B on the PRODUCTION-DEFAULT path: paged KV + fused pallas kernel +
+    int8 weights — the serving plan every Configuration resolves to —
+    swept over batch slots (VERDICT r4 #2: the only 8B numbers ever
+    captured were contiguous with pallas disabled; and at 59% of the
+    practical HBM ceiling, bigger batches should push the amortized
+    weight stream toward it).  Emits the best config as the headline with
+    the whole sweep in extra; configs that do not fit the chip record
+    "oom" instead of killing the phase.  The int8 param tree is shared
+    across the sweep (and with decode8b / decode8b_ctx4k) via
+    _PARAM_CACHE, so each extra config costs ~15 s, not ~3 min."""
+    import jax
+
+    sweep_env = os.environ.get("CROWDLLAMA_BENCH_SLOTS_SWEEP", "16,32,64")
+    sweep = [int(s) for s in sweep_env.split(",") if s.strip()]
+    results: dict[str, object] = {}
+    best: dict | None = None
+    for slots in sweep:
+        try:
+            r = _decode_phase("llama-3-8b", layout="paged", slots=slots)
+        except Exception as e:
+            # OOM (RESOURCE_EXHAUSTED) at bs=64 x bf16 KV is a plausible
+            # outcome on a 16 GiB chip — record it, keep the smaller
+            # configs' numbers.
+            results[str(slots)] = f"failed: {type(e).__name__}: {e}"[:200]
+            print(f"# paged-8B slots={slots} failed: {e}", file=sys.stderr)
+            continue
+        results[str(slots)] = {
+            "tok_s_chip": r["value"],
+            "pct_of_practical_ceiling":
+                r["extra"]["roofline"]["pct_of_practical_ceiling"],
+        }
+        if best is None or (r["value"] or 0) > (best["value"] or 0):
+            best = r
+            best["extra"]["slots"] = slots
+        if jax.devices()[0].platform != "tpu":
+            break  # CPU fallback benches tiny-test; one copy is enough
+    if best is None:
+        raise RuntimeError(f"every sweep config failed: {results}")
+    best["metric"] = "llama-3-8b (paged KV + fused kernel) decode throughput"
+    best["extra"]["slots_sweep"] = results
+    return best
+
+
+def _latest_session_artifact() -> dict | None:
+    """Newest builder-session on-chip artifact, for skip-marker provenance
+    (VERDICT r4 #1: make the chain to the last real TPU numbers explicit
+    when the tunnel stays down for the whole driver run)."""
+    import hashlib
+
+    results_dir = Path(__file__).resolve().parent / "benchmarks" / "results"
+    candidates = sorted(results_dir.glob("BENCH_tpu_*.jsonl"))
+    if not candidates:
+        return None
+    newest = candidates[-1]
+    data = newest.read_bytes()
+    return {"path": str(newest.relative_to(Path(__file__).resolve().parent)),
+            "sha256": hashlib.sha256(data).hexdigest(),
+            "lines": data.count(b"\n")}
 
 
 #: Practical HBM ceiling measured on the attached v5e for B=8 skinny GEMMs
@@ -576,6 +754,21 @@ def _swarm_phase() -> dict:
 # ------------------------------------------------------------------- main
 
 
+def _skip_metric(phase: str) -> str:
+    """Skip markers must carry the SAME metric name a real run of the
+    phase emits, so artifact consumers can correlate the series across
+    runs (decode_kv8's name includes the configured model)."""
+    kv8_model = os.environ.get("CROWDLLAMA_BENCH_MODEL", "tinyllama-1.1b")
+    return {
+        "decode8b": "llama-3-8b decode throughput",
+        "decode8b_paged":
+            "llama-3-8b (paged KV + fused kernel) decode throughput",
+        "decode8b_int4": "llama-3-8b (int4 weights) decode throughput",
+        "decode8b_ctx4k": "llama-3-8b (ctx 4096) decode throughput",
+        "decode_kv8": f"{kv8_model} (int8 KV) decode throughput",
+    }.get(phase, phase)
+
+
 def main() -> None:
     budget = float(os.environ.get("CROWDLLAMA_BENCH_BUDGET_S", "1500"))
     phases = [p.strip() for p in os.environ.get(
@@ -586,29 +779,12 @@ def main() -> None:
     except OSError:
         pass
 
-    devices = _wait_for_devices(budget)
-    if devices[0].platform != "tpu":
-        # CPU fallback benches tiny-test either way — one copy is enough.
-        # Emit explicit skip markers so the artifact distinguishes
-        # "phase not runnable here" from "phase crashed" (VERDICT r3).
-        kv8_model = os.environ.get("CROWDLLAMA_BENCH_MODEL",
-                                   "tinyllama-1.1b")
-        for ph, metric in (("decode8b", "llama-3-8b decode throughput"),
-                           ("decode8b_int4",
-                            "llama-3-8b (int4 weights) decode throughput"),
-                           ("decode8b_ctx4k",
-                            "llama-3-8b (ctx 4096) decode throughput"),
-                           ("decode_kv8",
-                            f"{kv8_model} (int8 KV) decode throughput")):
-            if ph in phases:
-                phases.remove(ph)
-                _emit({"metric": metric, "value": None,
-                       "unit": "tokens/sec/chip", "vs_baseline": None,
-                       "skipped": True,
-                       "extra": {"platform": devices[0].platform,
-                                 "reason": "requires TPU (real-size/"
-                                           "quantized decode on CPU "
-                                           "fallback is meaningless)"}})
+    plat = _Platform()
+    # Spend at most 10 min of the budget waiting up front; the rest backs
+    # the per-phase re-probes (the CPU-runnable phases keep the run
+    # productive while the tunnel gets the whole run's duration to heal).
+    plat.startup_wait(min(budget, 600.0))
+    probe_deadline = time.monotonic() + budget
 
     runners = {
         "decode": lambda: _decode_phase(
@@ -623,6 +799,8 @@ def main() -> None:
             "llama-3-8b",
             slots=int(os.environ.get("CROWDLLAMA_BENCH_SLOTS_8B")
                       or os.environ.get("CROWDLLAMA_BENCH_SLOTS") or 16)),
+        # The production-default serving path, swept over batch slots.
+        "decode8b_paged": _decode8b_paged_phase,
         # The quantized variants the scoreboard tracks separately: int8 KV
         # (halves the cache read) and int4 weights (Ollama's own 8B
         # default is 4-bit GGUF, so int4-vs-Q4 is the parity-honest cell).
@@ -642,22 +820,75 @@ def main() -> None:
         "ttft": _ttft_phase,
         "swarm": _swarm_phase,
     }
+
+    remaining = [p for p in phases if p in runners]
+    for p in phases:
+        if p not in runners:
+            print(f"# unknown phase {p!r} (skipped)", file=sys.stderr)
+    ran_on_cpu: list[str] = []  # re-run candidates if the tunnel returns
+    deferred: set[str] = set()
     ok = 0
-    for phase in phases:
-        fn = runners.get(phase)
-        if fn is None:
-            print(f"# unknown phase {phase!r} (skipped)", file=sys.stderr)
+    while remaining:
+        phase = remaining.pop(0)
+        # Phase-boundary re-probe: a mid-run tunnel-up window must not be
+        # missed (VERDICT r4 #1).  Bounded to one subprocess attempt so a
+        # dead tunnel costs ~45 s per boundary, within the probe budget.
+        if (plat.want_tpu and plat.on_cpu_fallback
+                and time.monotonic() < probe_deadline
+                and plat.reprobe(45.0)):
+            # Window open: re-enqueue the phases whose CPU executions were
+            # stand-ins, then order the whole window by BASELINE priority
+            # (kernel parity first — it gates the fused-kernel phases).
+            for p in ran_on_cpu:
+                if p in _RERUN_ON_TPU and p not in remaining:
+                    remaining.append(p)
+            ran_on_cpu = []
+            remaining.sort(key=lambda p: _TPU_WINDOW_PRIORITY.get(p, 50))
+            print(f"# TPU window open: phase order now "
+                  f"{[phase] + remaining}", file=sys.stderr)
+        if phase in _TPU_ONLY_PHASES and (plat.on_cpu_fallback
+                                          or not plat.want_tpu):
+            if (plat.want_tpu and phase not in deferred
+                    and any(p not in _TPU_ONLY_PHASES for p in remaining)
+                    and time.monotonic() < probe_deadline):
+                # Push behind the CPU-runnable phases: every boundary in
+                # between is another probe, so the tunnel gets the whole
+                # run's duration to come back before we give up.
+                deferred.add(phase)
+                remaining.append(phase)
+                print(f"# phase {phase} deferred (tunnel down; re-probing "
+                      f"at each phase boundary)", file=sys.stderr)
+                continue
+            _emit({"metric": _skip_metric(phase), "value": None,
+                   "unit": "tokens/sec/chip", "vs_baseline": None,
+                   "skipped": True,
+                   "extra": {
+                       "platform": "cpu",
+                       "reason": "requires TPU (real-size/quantized decode "
+                                 "on CPU fallback is meaningless)",
+                       "deferred_behind_cpu_phases": phase in deferred,
+                       "tunnel_probe_attempts": plat.probe_attempts,
+                       "failed_probes_tail": plat.probe_log[-5:],
+                       # The newest builder-session on-chip artifact: the
+                       # explicit provenance chain to the last real
+                       # numbers for this phase.
+                       "last_session_artifact": _latest_session_artifact(),
+                   }})
             continue
         t0 = time.monotonic()
-        print(f"# phase {phase} starting", file=sys.stderr)
+        print(f"# phase {phase} starting (platform="
+              f"{'tpu' if plat.want_tpu and not plat.on_cpu_fallback else 'cpu'})",
+              file=sys.stderr)
         kernel_ok = True
         try:
-            result = fn()
+            result = runners[phase]()
             _emit(result)
             ok += 1
             print(f"# phase {phase} done in {time.monotonic() - t0:.0f}s",
                   file=sys.stderr)
             kernel_ok = phase != "kernel" or result.get("value") == 1.0
+            if plat.on_cpu_fallback:
+                ran_on_cpu.append(phase)
         except Exception:
             print(f"# phase {phase} FAILED after "
                   f"{time.monotonic() - t0:.0f}s:", file=sys.stderr)

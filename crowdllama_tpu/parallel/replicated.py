@@ -311,12 +311,24 @@ def run_follower(config) -> None:
             state, pending, job = _apply(runner, state, pending, job, op,
                                          frame, i32, f32)
             poisoned = False
+        except ValueError:
+            # Request-level deterministic error: the leader catches exactly
+            # ValueError at its admission sites (engine/scheduler.py), fails
+            # only that request, and does NOT broadcast INIT — so the same
+            # error here is mirrored, state has not diverged, and the
+            # follower must keep replaying (poisoning would kill the
+            # cluster on the next frame).  Device-local transients raise
+            # XlaRuntimeError/OOM classes, never ValueError.
+            log.warning("follower op %d: request-level error (mirrored on "
+                        "the leader); continuing", op, exc_info=True)
+            pending = None
+            job = None
         except Exception:
-            # A deterministic error is survivable: the leader fails its
-            # in-flight requests and broadcasts INIT, which rebuilds state
-            # here.  Mark poisoned and clear transient op state; the check
-            # above decides on the NEXT frame whether the leader actually
-            # mirrored the failure.
+            # Engine-level error: IF it was deterministic, the leader's
+            # loop recovery mirrors it and broadcasts INIT, which rebuilds
+            # state here.  Mark poisoned and clear transient op state; the
+            # check above decides on the NEXT frame whether the leader
+            # actually mirrored the failure.
             log.exception("follower op %d failed; awaiting leader recovery",
                           op)
             poisoned = True

@@ -614,8 +614,10 @@ async def test_cancelled_chunked_admission_aborts_runner_job():
             await asyncio.sleep(0.01)
         assert sched._chunking is not None, "chunked admission never started"
         sched.cancel(req)
+        # _chunking clears before the abort's executor hop completes —
+        # poll for the abort itself, not just the cleared reservation.
         for _ in range(600):
-            if sched._chunking is None:
+            if aborted and sched._chunking is None:
                 break
             await asyncio.sleep(0.01)
         assert sched._chunking is None

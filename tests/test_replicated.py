@@ -217,6 +217,32 @@ def test_follower_local_failure_fails_loudly(monkeypatch):
         ])
 
 
+def test_follower_continues_after_request_level_valueerror(monkeypatch):
+    """A ValueError is the request-level error class the LEADER catches
+    without broadcasting INIT (it fails one request and keeps serving) —
+    the follower must treat it as mirrored and keep replaying, NOT poison
+    itself (poisoning would kill the cluster on the next frame)."""
+    from crowdllama_tpu.engine.runner import ModelRunner
+    from crowdllama_tpu.parallel import replicated as R
+
+    real = ModelRunner.decode_steps_device
+    fired = {"n": 0}
+
+    def flaky(self, state, num_steps=1):
+        fired["n"] += 1
+        if fired["n"] == 1:
+            raise ValueError("injected request-level error")
+        return real(self, state, num_steps)
+
+    monkeypatch.setattr(ModelRunner, "decode_steps_device", flaky)
+    _scripted_follower(monkeypatch, [
+        _frame(R._OP_INIT, (0,)),
+        _frame(R._OP_DECODE, (1,)),   # ValueError: mirrored, survivable
+        _frame(R._OP_DECODE, (1,)),   # leader continued — so do we
+        _frame(R._OP_STOP),
+    ])  # returns without raising
+
+
 def test_follower_recovers_when_leader_mirrors_failure(monkeypatch):
     """The deterministic-failure path stays survivable: when the next
     frame after a local failure IS the leader's recovery INIT, the
