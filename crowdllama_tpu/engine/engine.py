@@ -214,7 +214,6 @@ class JaxEngine(Engine):
 
     async def start(self) -> None:
         """Build tokenizer/params/runner (compiles on first use)."""
-        from crowdllama_tpu.engine.runner import ModelRunner
         from crowdllama_tpu.engine.scheduler import Scheduler
         from crowdllama_tpu.engine.tokenizer import get_tokenizer
         from crowdllama_tpu.engine.weights import (
@@ -229,6 +228,7 @@ class JaxEngine(Engine):
         def _build():
             import jax
 
+            from crowdllama_tpu.engine.factory import build_runner
             from crowdllama_tpu.engine.plan import resolve_serving_plan
 
             # The composition matrix's single decision point
@@ -239,55 +239,16 @@ class JaxEngine(Engine):
                 log.warning("%s", note)
 
             params = load_params_for(self.config, cfg)
-            kwargs = dict(
-                params=params,
-                mesh_spec=self.config.mesh_shape,
-                max_slots=self.config.max_batch_slots,
-                max_seq=cfg.max_context_length,
-            )
-            if plan.kv_layout == "paged":
-                kwargs.update(
-                    page_size=self.config.kv_page_size,
-                    pool_tokens=self.config.kv_pool_tokens,
-                    prefix_cache=self.config.kv_prefix_cache,
-                    kv_dtype=plan.kv_dtype)
-                if plan.runner == "DraftSpecPagedModelRunner":
-                    from crowdllama_tpu.engine.spec import (
-                        DraftSpecPagedModelRunner,
-                    )
-                    from crowdllama_tpu.models.config import get_config
-
-                    draft_cfg = get_config(
-                        self.config.spec_draft_model,
-                        max_context_length=cfg.max_context_length)
-                    draft_params = None
-                    if self.config.spec_draft_path:
-                        draft_params = load_or_init_params(
-                            draft_cfg, self.config.spec_draft_path)
-                    return DraftSpecPagedModelRunner(
-                        cfg, draft_cfg=draft_cfg, draft_params=draft_params,
-                        draft_len=self.config.spec_draft, **kwargs)
-                if plan.runner == "SpecPagedModelRunner":
-                    from crowdllama_tpu.engine.spec import SpecPagedModelRunner
-
-                    return SpecPagedModelRunner(
-                        cfg, draft_len=self.config.spec_draft, **kwargs)
-                from crowdllama_tpu.engine.paged import PagedModelRunner
-
-                return PagedModelRunner(cfg, **kwargs)
-            if plan.runner == "SpecModelRunner":
-                from crowdllama_tpu.engine.spec import SpecModelRunner
-
-                return SpecModelRunner(
-                    cfg, draft_len=self.config.spec_draft, **kwargs)
-            runner = ModelRunner(cfg, kv_dtype=plan.kv_dtype, **kwargs)
-            import jax
-
+            # ONE builder shared with run_follower: leader and followers
+            # must construct bit-identical runners (engine/factory.py).
+            runner = build_runner(self.config, plan, cfg, params)
             if jax.process_count() > 1:
                 # Multi-host pod-slice serving: wrap the runner so every
                 # device-touching call is broadcast to the follower
                 # processes before it dispatches (leader-replicated
-                # dispatch, parallel/replicated.py).
+                # dispatch, parallel/replicated.py).  plan rejects spec
+                # under multi-host, so the wrapped surface is exactly the
+                # ModelRunner/PagedModelRunner one the frames cover.
                 from crowdllama_tpu.parallel.replicated import (
                     ReplicatedRunner,
                 )
@@ -338,10 +299,7 @@ class JaxEngine(Engine):
             # under multi-host replication an abandoned job would pin its
             # KV accumulators on every follower indefinitely.
             r.prefill_finish(job, 0.0, 1.0, jax.random.PRNGKey(0))
-        try:
-            r.embed_prompts([[1, 2, 3]])
-        except NotImplementedError:
-            pass  # multi-host v1 serves generate only (ReplicatedRunner)
+        r.embed_prompts([[1, 2, 3]])
         state = r.release(state, 0)
         log.info("warmup compile done")
 
@@ -377,13 +335,11 @@ class JaxEngine(Engine):
     def describe(self) -> dict:
         d = {"models": self.models, "throughput": 0.0, "load": 0.0}
         if self._runner is not None:
-            # Every mesh kind has an embeddings forward now (pp runs the
-            # microbatch pipeline, sp the ring — runner.embed_prompts);
-            # EXCEPT multi-host leader-replicated serving (v1 is
-            # generate-only), which must not advertise the capability.
-            from crowdllama_tpu.parallel.replicated import ReplicatedRunner
-
-            d["embeddings"] = not isinstance(self._runner, ReplicatedRunner)
+            # Every mesh kind has an embeddings forward (pp runs the
+            # microbatch pipeline, sp the ring — runner.embed_prompts),
+            # including multi-host leader-replicated serving since v2
+            # (the EMBED frame replays the forward on every process).
+            d["embeddings"] = True
         if self.scheduler is not None:
             d["throughput"] = round(self.scheduler.throughput_ema, 2)
             d["load"] = round(self.scheduler.load, 3)

@@ -54,17 +54,17 @@ def resolve_serving_plan(config, n_devices: int,
 
     if n_processes > 1:
         # Multi-host leader-replicated serving (parallel/replicated.py)
-        # v1: contiguous ModelRunner only — the frame protocol covers
-        # exactly that runner's surface.
+        # v2: the contiguous AND paged runners (incl. prefix cache,
+        # chunked prefill, embeddings) — the paged allocator is host-side
+        # and deterministic, so replaying the frame stream keeps every
+        # process's page tables bit-identical.  Speculative runners stay
+        # out: their packed [K, 1+J, B] emission layout and draft-model
+        # second param tree are not framed yet.
         if spec:
             raise ValueError(
                 "spec_decode does not compose with multi-host serving "
-                "yet (leader-replicated dispatch covers the plain "
-                "ModelRunner only)")
-        if kv_layout == "paged":
-            notes.append("multi-host serving uses the contiguous layout "
-                         "(the paged runner is not leader-replicated yet)")
-            kv_layout = "contiguous"
+                "yet (leader-replicated dispatch covers the plain and "
+                "paged runners only)")
 
     if kv_layout == "paged" and (dp > 1 or pp > 1 or sp > 1):
         # The shared page pool cannot shard over dp (pages belong to no
@@ -118,13 +118,16 @@ def resolve_serving_plan(config, n_devices: int,
 
 #: Representative mesh per kind (8 devices); ep rides along with tp for
 #: MoE models and changes nothing about the KV axes, so it is not a
-#: separate row.
+#: separate row.  The multihost-tp kind runs the same tp mesh with
+#: n_processes=2 (leader-replicated pod-slice serving) — since v2 it
+#: serves the paged default, so its cells mirror tp's except spec.
 MESH_KINDS = (
     ("single", "1"),
     ("tp", "2"),
     ("dp", "2x1x1x1x1"),
     ("pp", "1x2x1x1x1"),
     ("sp", "1x1x2x1x1"),
+    ("multihost-tp", "2"),
 )
 
 
@@ -150,7 +153,11 @@ def sweep(n_devices: int = 8):
                                 spec_draft_model=(
                                     "tiny-test" if spec == "draft" else ""),
                                 mesh_shape=mesh)
-                            plan = resolve_serving_plan(cfg, n_devices)
+                            plan = resolve_serving_plan(
+                                cfg, n_devices,
+                                n_processes=(
+                                    2 if mesh_kind.startswith("multihost")
+                                    else 1))
                         except ValueError as e:
                             yield axes, ("error", str(e))
                             continue

@@ -405,13 +405,17 @@ class Scheduler:
             # pages often let the remaining starved slots continue.
             check = getattr(self.runner, "pre_decode_check", None)
             if check is not None:
-                starved = check(k)
+                # Executor, not the loop: under multi-host serving the
+                # check broadcasts a frame (page growth must replay on
+                # followers in stream order) and must not block the loop.
+                starved = await loop.run_in_executor(self._exec, check, k)
                 if starved and self._inflight is not None:
                     # Drain the in-flight chunk first: force-finishing a
                     # starved slot now would drop its already-generated
                     # tokens, and retirement can itself free pages (EOS).
                     await self._retire_inflight(loop)
-                    starved = check(k)
+                    starved = await loop.run_in_executor(self._exec,
+                                                         check, k)
                 while starved:
                     slot = starved[0]
                     info = self.slots[slot]
@@ -423,7 +427,8 @@ class Scheduler:
                         self.requests_served += 1
                     self.state = await loop.run_in_executor(
                         self._exec, self.runner.release, self.state, slot)
-                    starved = check(k)
+                    starved = await loop.run_in_executor(self._exec,
+                                                         check, k)
             if any(isinstance(s, _SlotInfo) for s in self.slots):
                 tokens_dev, self.state = await loop.run_in_executor(
                     self._exec, self.runner.decode_steps_device,

@@ -25,8 +25,13 @@ frame stream symmetrically); that readback is synchronous, which gives
 up the single-host double-buffered chunk overlap — the documented v1
 cost of multi-host serving.
 
-v1 scope: the contiguous ModelRunner only (resolve_serving_plan forces
-it loudly); embeddings raise; spec decode is rejected.  The reference
+v2 scope: the contiguous ModelRunner AND the PagedModelRunner — paged
+allocator state (free-page list, prefix-cache index, LRU ticks) is
+host-side and derived ONLY from the op stream, so replaying frames keeps
+every process's page tables bit-identical; pre_decode_check growth and
+the warmup ctx-prefill compile broadcast as their own ops, and batch
+embeddings ride one length-prefixed EMBED frame.  Speculative runners
+remain out (their packed emission layout is not framed).  The reference
 has no analog at any scope — its worker is always one host
 (/root/reference/pkg/peer/peer.go:42-68).
 """
@@ -50,11 +55,17 @@ _OP_PREFILL_STEP = 7
 _OP_PREFILL_FINISH = 8
 _OP_STOP = 9
 _OP_PREFILL_ABORT = 10
+_OP_EMBED = 11
+_OP_PRE_DECODE = 12
+_OP_WARMUP_CTX = 13
 
 _NI, _NF, _NK = 8, 4, 4  # frame scalar-int / float / key-word capacities
 
 # Which header slot carries the prompt length for ops that stream one.
-_PROMPT_LEN_SLOT = {_OP_PREFILL: 0, _OP_PREFILL_BEGIN: 0, _OP_INSERT: 4}
+# EMBED streams a length-prefixed FLAT batch ([len0, t0.., len1, t1..]);
+# slot 1 holds the flat array's total length (slot 0 = prompt count).
+_PROMPT_LEN_SLOT = {_OP_PREFILL: 0, _OP_PREFILL_BEGIN: 0, _OP_INSERT: 4,
+                    _OP_EMBED: 1}
 
 
 def _prompt_len_of(op: int, i32) -> int:
@@ -113,6 +124,11 @@ class ReplicatedRunner:
 
     def __init__(self, inner):
         self.inner = inner
+        if not hasattr(inner, "pre_decode_check"):
+            # The scheduler feature-gates on this attribute being present
+            # and non-None; shadow the class method for contiguous inners
+            # (instance attribute wins the lookup).
+            self.pre_decode_check = None
 
     def __getattr__(self, name):
         return getattr(self.inner, name)
@@ -222,16 +238,36 @@ class ReplicatedRunner:
         tokens, state = self.decode_steps_device(state, num_steps)
         return np.asarray(tokens), state
 
-    # Multi-host v1 serves generate only.
+    def pre_decode_check(self, steps: int):
+        """Paged page-table growth is dispatch-time HOST bookkeeping that
+        allocates pool pages — followers must replay it in stream order or
+        their free lists (and thus page ids) diverge from the leader's."""
+        self._bcast(_OP_PRE_DECODE, ints=(int(steps),))
+        return self.inner.pre_decode_check(steps)
+
+    def warmup_ctx_prefill(self, state) -> None:
+        """Engine warmup compiles the suffix-over-cached-context program —
+        a device computation, so every process must issue it."""
+        self._bcast(_OP_WARMUP_CTX)
+        return self.inner.warmup_ctx_prefill(state)
+
     def embed_prompts(self, prompts):
-        raise NotImplementedError(
-            "embeddings are not leader-replicated yet (multi-host v1 "
-            "serves generate only)")
+        """Batch embeddings (multi-host v2): the whole batch rides one
+        frame as a length-prefixed flat token stream, so the follower's
+        inner call keeps the same per-bucket batching as the leader's."""
+        if not prompts:
+            # No frame for an empty batch: the follower's decode of the
+            # flat stream assumes at least one length prefix.
+            return self.inner.embed_prompts(prompts)
+        flat: list[int] = []
+        for ids in prompts:
+            flat.append(len(ids))
+            flat.extend(int(t) for t in ids)
+        self._bcast(_OP_EMBED, ints=(len(prompts), len(flat)), prompt=flat)
+        return self.inner.embed_prompts(prompts)
 
     def embed_prompt(self, prompt_ids):
-        raise NotImplementedError(
-            "embeddings are not leader-replicated yet (multi-host v1 "
-            "serves generate only)")
+        return self.embed_prompts([prompt_ids])[0]
 
 
 def run_follower(config) -> None:
@@ -245,30 +281,26 @@ def run_follower(config) -> None:
     import jax
     from jax.experimental import multihost_utils
 
+    from crowdllama_tpu.engine.factory import build_runner
     from crowdllama_tpu.engine.plan import resolve_serving_plan
-    from crowdllama_tpu.engine.runner import ModelRunner
     from crowdllama_tpu.engine.weights import (
         load_params_for,
         resolve_clamped_model_config,
     )
     from crowdllama_tpu.parallel.multihost import broadcast_from_leader
 
-    # The SAME plan/config/params derivation as the leader's engine
-    # (multi-host forces the contiguous ModelRunner) via the shared
-    # helpers — the frame protocol depends on both sides building
-    # bit-identical runners.
+    # The SAME plan/config/params derivation as the leader's engine, via
+    # the shared factory (engine/factory.py) — the frame protocol depends
+    # on both sides building bit-identical runners (v2: contiguous or
+    # paged; plan rejects spec under multi-host).
     plan = resolve_serving_plan(config, len(jax.devices()),
                                 n_processes=jax.process_count())
-    assert plan.kv_layout == "contiguous", plan
     cfg = resolve_clamped_model_config(config)
     params = load_params_for(config, cfg)
-    runner = ModelRunner(cfg, params=params,
-                         max_slots=config.max_batch_slots,
-                         max_seq=cfg.max_context_length,
-                         mesh_spec=config.mesh_shape,
-                         kv_dtype=plan.kv_dtype)
-    log.info("follower %d up: %s on %d global devices",
-             jax.process_index(), cfg.name, len(jax.devices()))
+    runner = build_runner(config, plan, cfg, params)
+    log.info("follower %d up: %s (%s) on %d global devices",
+             jax.process_index(), cfg.name, plan.runner,
+             len(jax.devices()))
 
     state = None
     pending = None  # last prefill result awaiting insert
@@ -381,6 +413,20 @@ def _apply(runner, state, pending, job, op, frame, i32, f32):
     elif op == _OP_DECODE:
         toks, state = runner.decode_steps_device(state, int(i32[0]))
         multihost_utils.process_allgather(toks, tiled=True)
+    elif op == _OP_PRE_DECODE:
+        runner.pre_decode_check(int(i32[0]))
+    elif op == _OP_WARMUP_CTX:
+        runner.warmup_ctx_prefill(state)
+    elif op == _OP_EMBED:
+        n, total = int(i32[0]), int(i32[1])
+        flat = ([int(t) for t in np.asarray(frame["prompt"])[:total]]
+                if n else [])
+        prompts, pos = [], 0
+        for _ in range(n):
+            ln = flat[pos]
+            prompts.append(flat[pos + 1: pos + 1 + ln])
+            pos += 1 + ln
+        runner.embed_prompts(prompts)
     else:
         raise RuntimeError(f"unknown replicated op {op}")
     return state, pending, job

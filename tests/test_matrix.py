@@ -34,6 +34,10 @@ def expected(mesh_kind, layout, kv_dtype, spec):
     Returns ("ok"|"fallback", runner_name) or ("error", None).
     Weight quantization composes with every cell (not part of the oracle).
     """
+    if mesh_kind == "multihost-tp" and spec:
+        # Leader-replicated dispatch (v2) serves the plain and paged
+        # runners; the speculative packed layout is not framed.
+        return ("error", None)
     sharded_kv = mesh_kind in ("dp", "pp", "sp")  # axes the pool can't use
     if spec == "draft" and (layout != "paged" or sharded_kv):
         return ("error", None)  # draft speculation is paged-only
@@ -65,7 +69,9 @@ def test_matrix_cell(mesh_kind, mesh, layout, kv_dtype, quantize, spec):
             spec_decode=spec,
             spec_draft_model="tiny-test" if spec == "draft" else "",
             mesh_shape=mesh)
-        plan = resolve_serving_plan(cfg, n_devices=8)
+        plan = resolve_serving_plan(
+            cfg, n_devices=8,
+            n_processes=2 if mesh_kind == "multihost-tp" else 1)
     except ValueError:
         assert want_status == "error", (
             f"unexpected startup error for {mesh_kind}/{layout}/"
@@ -130,9 +136,14 @@ def test_matrix_promises_construct_and_decode(runner_name, mesh_spec,
 
 def test_sweep_covers_every_cell_and_renders():
     cells = list(sweep())
-    assert len(cells) == len(AXES) == 120
+    assert len(cells) == len(AXES) == 144
     table = render_markdown()
     # Every outcome kind appears and the table has one row per cell.
-    assert table.count("\n") == 121  # header + separator + 120 rows
+    assert table.count("\n") == 145  # header + separator + 144 rows
     for marker in ("✓", "⚠", "✗"):
         assert marker in table
+    # The v2 flip: multi-host serves the paged runner (was a ⚠ fallback).
+    assert any(a["mesh_kind"] == "multihost-tp"
+               and a["layout"] == "paged" and not a["spec"]
+               and s == "ok" and p.runner == "PagedModelRunner"
+               for a, (s, p) in cells if s != "error")

@@ -279,6 +279,111 @@ def test_prefill_abort_frame_drops_follower_job(monkeypatch):
     assert job is None and state == "st"
 
 
+# Paged multi-host v2: one virtual device per process so the tp=2 mesh
+# SPANS both hosts (the paged pool shards over tp only — dp would leave
+# the second process without mesh devices).
+_COMMON_PAGED = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from crowdllama_tpu.config import Configuration
+    from crowdllama_tpu.parallel import multihost
+
+    cfg = Configuration(
+        dist_coordinator=sys.argv[1], dist_num_processes=2,
+        dist_process_id=int(sys.argv[2]),
+        model="tiny-test", max_batch_slots=4, max_context_length=256,
+        mesh_shape="1x2", decode_chunk=4,
+        kv_layout="paged", kv_page_size=32,
+    )
+    assert multihost.initialize_from_config(cfg) is True
+""")
+
+_LEADER_PAGED = _COMMON_PAGED + textwrap.dedent("""
+    import asyncio
+    from crowdllama_tpu.engine.engine import JaxEngine
+
+    async def main():
+        eng = JaxEngine(cfg)
+        await eng.start()
+        try:
+            from crowdllama_tpu.engine.paged import PagedModelRunner
+            assert isinstance(eng._runner.inner, PagedModelRunner), \\
+                type(eng._runner.inner)
+
+            async def one(prompt):
+                return "".join(
+                    [c.text async for c in eng.generate(
+                        prompt, max_tokens=10, temperature=0.0)])
+            # Concurrent requests through the continuous-batching path.
+            a, b = await asyncio.gather(
+                one("alpha beta gamma"), one("delta"))
+            a2 = await one("alpha beta gamma")
+            assert a == a2, (a, a2)  # greedy-deterministic across admits
+
+            # Prefix cache across the pod: a shared >=1-page (32-token)
+            # prefix registered by the first request seeds the second.
+            shared = "s" * 70
+            await one(shared + " first tail")
+            hits0 = eng._runner.prefix_hits
+            await one(shared + " second tail")
+            assert eng._runner.prefix_hits > hits0, (
+                hits0, eng._runner.prefix_hits)
+
+            # Batch embeddings ride the EMBED frame (multi-host v2).
+            vecs, toks = await eng.embed(["hello pod", "second text"])
+            assert len(vecs) == 2 and toks > 0
+            print("LEADER_PAGED_OK", flush=True)
+        finally:
+            await eng.stop()
+
+    asyncio.run(main())
+""")
+
+_FOLLOWER_PAGED = _COMMON_PAGED + textwrap.dedent("""
+    from crowdllama_tpu.parallel.replicated import run_follower
+
+    run_follower(cfg)
+    print("FOLLOWER_OK", flush=True)
+""")
+
+
+def test_two_process_paged_engine_serving(tmp_path):
+    """Multi-host v2: the PRODUCTION-DEFAULT paged runner (prefix cache,
+    page-table growth, embeddings) served leader-replicated on a tp mesh
+    spanning two processes (VERDICT r4 #3: the pod-slice path must not
+    cost the engine's headline features)."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        coord = f"127.0.0.1:{s.getsockname()[1]}"
+    (tmp_path / "leader.py").write_text(_LEADER_PAGED)
+    (tmp_path / "follower.py").write_text(_FOLLOWER_PAGED)
+    env = {**os.environ, "PYTHONPATH": str(REPO)}
+    env.pop("JAX_PLATFORMS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(tmp_path / name), coord, str(i)],
+            cwd=REPO, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        for i, name in enumerate(("leader.py", "follower.py"))
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=480)
+            outs.append(out)
+    finally:
+        for p in procs:
+            p.kill()
+    assert procs[0].returncode == 0, f"leader:\n{outs[0][-4000:]}"
+    assert "LEADER_PAGED_OK" in outs[0], outs[0][-2000:]
+    assert procs[1].returncode == 0, f"follower:\n{outs[1][-4000:]}"
+    assert "FOLLOWER_OK" in outs[1], outs[1][-2000:]
+
+
 def test_two_process_engine_serving(tmp_path):
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
