@@ -508,12 +508,20 @@ def _roofline_accounting(runner, cfg, kv_dtype: str, mean_len: float,
     }
 
 
+#: A natural-text prompt (byte-tokenized English prose, no templating):
+#: bigram lookup has no echo to replay, so this measures the dividend a
+#: NON-templated workload actually gets (VERDICT r4 #4: the repetitive
+#: workload is speculation's best case and must not be the headline).
+_NATURAL_TEXT = (b"The quick brown fox jumps over the lazy dog while "
+                 b"autumn rain taps gently on the old tin roof.")
+
+
 def _spec_phase() -> dict:
-    """Speculative decode (ngram, paged pools) on a REPETITIVE workload:
-    effective emitted tokens/sec/chip and the acceptance dividend
-    (tokens per verify dispatch).  Repetition is speculation's home turf —
-    the honest framing is 'best case'; the `decode_paged` phase is the
-    no-acceptance floor (same dispatch cost, 1 token/step)."""
+    """Speculative decode (ngram, paged pools) on TWO workloads: the
+    headline is a NATURAL (non-repetitive) prompt — the honest number —
+    with the repetitive best case and the prompt-echo vs generative
+    acceptance split in extra.  `decode_paged` is the no-spec floor the
+    uplift compares against."""
     import jax
     import numpy as np
 
@@ -537,63 +545,81 @@ def _spec_phase() -> dict:
     cfg = get_config(model)
     if ctx < cfg.max_context_length:
         cfg = replace(cfg, max_context_length=ctx)
-    # Worst case each verify step advances 1+draft tokens — keep the run
-    # inside the context window.
-    steps = min(steps, max(4, (ctx - 48) // (1 + draft)))
     n_chips = max(1, len(jax.devices()))
 
     params = None
     if quantize in ("int8", "int4"):
-        from crowdllama_tpu.ops.quant import random_quantized_params
-
-        params = random_quantized_params(cfg, jax.random.PRNGKey(0),
-                                         mode=quantize)
+        params = _quantized_params(cfg, model, quantize, platform)
     runner = SpecPagedModelRunner(cfg, params=params, max_slots=slots,
                                   max_seq=cfg.max_context_length,
                                   kv_dtype=kv_dtype, draft_len=draft)
-    state = runner.init_state()
+
     motif = [7, 3, 11, 2]
-    prompt = (motif * 8)[:24]  # repetitive: bigram lookup accepts
-    key = jax.random.PRNGKey(0)
-    for slot in range(runner.max_slots):
-        key, sub = jax.random.split(key)
-        first, ks, vs, plen = runner.prefill(prompt, 0.0, 1.0, sub,
-                                             state=state)
-        state = runner.insert(state, slot, ks, vs, plen, first, 0.0, 1.0,
-                              prompt_tokens=prompt)
+    workloads = {
+        "natural": [t % cfg.vocab_size for t in _NATURAL_TEXT],
+        "repetitive_best_case": (motif * 8)[:24],
+    }
+    # Worst case every verify step (INCLUDING the untimed warmup chunk of
+    # 8) advances 1+draft tokens — budget the longest prompt + first
+    # token + warmup against the context window or the tail of the run
+    # silently clamp-overwrites the last KV position.
+    prompt_max = max(len(p) for p in workloads.values())
+    steps = min(steps, max(4, (ctx - prompt_max - 2
+                               - 8 * (1 + draft)) // (1 + draft)))
 
-    chunk = min(8, steps)
-    packed, state = runner.decode_steps(state, chunk)  # warmup + compile
-    emitted_warm = int(np.asarray(packed)[:, 0, :].sum())
+    def run_workload(prompt):
+        state = runner.init_state()
+        key = jax.random.PRNGKey(0)
+        for slot in range(runner.max_slots):
+            key, sub = jax.random.split(key)
+            first, ks, vs, plen = runner.prefill(prompt, 0.0, 1.0, sub,
+                                                 state=state)
+            state = runner.insert(state, slot, ks, vs, plen, first,
+                                  0.0, 1.0, prompt_tokens=prompt)
+        chunk = min(8, steps)
+        packed, state = runner.decode_steps(state, chunk)  # warmup+compile
+        t0 = time.monotonic()
+        chunks, done = [], 0
+        while chunk > 0 and done + chunk <= steps:
+            packed, state = runner.decode_steps_device(state, chunk)
+            chunks.append(packed)
+            done += chunk
+        rows = [np.asarray(p) for p in chunks]  # sync
+        dt = time.monotonic() - t0
+        counts = np.concatenate([r[:, 0, :] for r in rows])
+        srcs = np.concatenate([r[:, -1, :] for r in rows])
+        accepted = np.maximum(counts - 1, 0)
+        emitted = int(counts.sum())
+        for slot in range(runner.max_slots):
+            state = runner.release(state, slot)
+        return {
+            "emitted_tok_s_chip": round(emitted / dt / n_chips, 2),
+            "verify_steps": done,
+            "tokens_per_step": round(
+                emitted / max(1, done * runner.max_slots), 2),
+            "accepted_prompt_echo": int((accepted * (srcs == 1)).sum()),
+            "accepted_generative": int((accepted * (srcs == 2)).sum()),
+        }
 
-    t0 = time.monotonic()
-    chunks = []
-    done = 0
-    while chunk > 0 and done + chunk <= steps:
-        packed, state = runner.decode_steps_device(state, chunk)
-        chunks.append(packed)
-        done += chunk
-    counts = np.concatenate([np.asarray(p)[:, 0, :] for p in chunks])  # sync
-    dt = time.monotonic() - t0
-    emitted = int(counts.sum())
-    per_chip = emitted / dt / n_chips
+    results = {name: run_workload(p) for name, p in workloads.items()}
+    nat = results["natural"]
     on_tpu = platform == "tpu"
     return {
-        "metric": f"{model} speculative (ngram, paged) emitted tokens/sec",
-        "value": round(per_chip, 2),
+        "metric": f"{model} speculative (ngram, paged) emitted tokens/sec"
+                  f" — natural workload",
+        "value": nat["emitted_tok_s_chip"],
         "unit": "tokens/sec/chip",
-        "vs_baseline": (round(per_chip / BASELINE_ADVERTISED_TOKS, 3)
+        "vs_baseline": (round(nat["emitted_tok_s_chip"]
+                              / BASELINE_ADVERTISED_TOKS, 3)
                         if on_tpu else None),
         "extra": {"platform": platform, "slots": runner.max_slots,
-                  "verify_steps": done, "draft_len": draft,
-                  "ctx": cfg.max_context_length,
+                  "draft_len": draft, "ctx": cfg.max_context_length,
                   "quantize": quantize or "bf16", "kv_dtype": kv_dtype,
-                  "tokens_per_step": round(
-                      emitted / max(1, done * runner.max_slots), 2),
-                  "workload": "repetitive prompt, random weights — "
-                              "acceptance as measured (tokens_per_step "
-                              "1.0 = no dividend)",
-                  "warmup_emitted": emitted_warm},
+                  "workloads": results,
+                  "reading": "tokens_per_step 1.0 = no dividend (spec "
+                             "pays only when > the ~same-cost plain "
+                             "paged decode); echo acceptance exists only "
+                             "on traffic that replays its prompt"},
     }
 
 

@@ -352,15 +352,26 @@ class JaxEngine(Engine):
         if self.scheduler is not None and self.scheduler.spec_steps:
             steps = self.scheduler.spec_steps
             emitted = self.scheduler.spec_emitted
+            offered = steps * max(1, self.config.spec_draft)
+            echo = self.scheduler.spec_accept_echo
+            gen = self.scheduler.spec_accept_gen
             d["spec_decode"] = {
                 "mode": self.config.spec_decode,
                 "verify_steps": steps,
                 "tokens_emitted": emitted,
                 "tokens_per_step": round(emitted / steps, 2),
-                # Fraction of offered draft tokens the verifier accepted.
-                "acceptance_rate": round(
-                    max(0, emitted - steps)
-                    / (steps * max(1, self.config.spec_draft)), 3),
+                # Fraction of offered draft tokens the verifier accepted,
+                # split by proposal source: prompt-echo acceptance only
+                # exists on templated/retrieval traffic that replays its
+                # input — operators reading one blended rate would enable
+                # spec expecting 2x and get 1.1x on generative chat.
+                # Derived from the per-emission split (NOT emitted-steps,
+                # which pure-overshoot chunks skew).
+                "acceptance_rate": round((echo + gen) / offered, 3),
+                "accepted_prompt_echo": echo,
+                "accepted_generative": gen,
+                "acceptance_rate_prompt_echo": round(echo / offered, 3),
+                "acceptance_rate_generative": round(gen / offered, 3),
             }
             if self.config.spec_decode == "draft":
                 d["spec_decode"]["draft_model"] = self.config.spec_draft_model

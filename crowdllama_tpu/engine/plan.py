@@ -58,7 +58,7 @@ def resolve_serving_plan(config, n_devices: int,
         # chunked prefill, embeddings) — the paged allocator is host-side
         # and deterministic, so replaying the frame stream keeps every
         # process's page tables bit-identical.  Speculative runners stay
-        # out: their packed [K, 1+J, B] emission layout and draft-model
+        # out: their packed [K, 2+J, B] emission layout and draft-model
         # second param tree are not framed yet.
         if spec:
             raise ValueError(

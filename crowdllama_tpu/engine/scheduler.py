@@ -125,6 +125,12 @@ class Scheduler:
         self.requests_served = 0
         self.spec_steps = 0    # speculative verify dispatches retired
         self.spec_emitted = 0  # tokens those dispatches emitted
+        # Accepted-draft split by proposal source (packed row -1): echo =
+        # the match replayed PROMPT content, generative = it matched
+        # generated history.  Operators need the split — echo dividends
+        # exist only on templated/retrieval traffic (VERDICT r4 weak #4).
+        self.spec_accept_echo = 0
+        self.spec_accept_gen = 0
 
     # ---------------------------------------------------------------- public
 
@@ -582,14 +588,25 @@ class Scheduler:
                 if not isinstance(info, _SlotInfo) or self.slots[i] is not info:
                     continue
                 if tokens.ndim == 3:
-                    # Speculative packed layout [K, 1+J, B] (engine/spec.py):
-                    # row 0 = emit count, rows 1.. = tokens for this step.
+                    # Speculative packed layout [K, 2+J, B] (engine/spec.py):
+                    # row 0 = emit count, rows 1..J+1 = tokens for this
+                    # step, row -1 = acceptance source.
+                    step_emitted = 0
                     for jj in range(int(tokens[step, 0, i])):
                         if self.slots[i] is not info:  # retired mid-step
                             break
                         self._emit(info.req, int(tokens[step, 1 + jj, i]),
                                    info)
                         emitted += 1
+                        step_emitted += 1
+                    # Split by source, counting only tokens actually
+                    # emitted (consistent with spec_emitted) — the packed
+                    # counts row includes post-retirement steps.
+                    if step_emitted > 1:
+                        if int(tokens[step, -1, i]) == 1:
+                            self.spec_accept_echo += step_emitted - 1
+                        else:
+                            self.spec_accept_gen += step_emitted - 1
                 else:
                     self._emit(info.req, int(tokens[step, i]), info)
                     emitted += 1

@@ -52,18 +52,26 @@ from crowdllama_tpu.models import transformer as T
 log = logging.getLogger("crowdllama.engine.spec")
 
 
-def propose_ngram_drafts(hist, seq_lens, draft_len: int, max_seq: int):
-    """Bigram prompt-lookup drafts [B, draft_len] from per-slot history.
+def propose_ngram_drafts(hist, seq_lens, draft_len: int, max_seq: int,
+                         prompt_lens=None):
+    """Bigram prompt-lookup drafts from per-slot history.
 
     For each slot: find the LATEST j with hist[j] == hist[cur-1] and
     hist[j+1] == hist[cur] (cur = seq_lens, the pending token's position),
     j+1 < cur; draft the k tokens that followed it.  No match → garbage
     drafts (the first verify comparison rejects them).  Shared by the
-    contiguous and paged spec runners."""
+    contiguous and paged spec runners.
+
+    Returns ``(drafts [B, draft_len], from_prompt [B] bool)`` —
+    ``from_prompt`` marks matches whose bigram lies inside the PROMPT
+    (positions < prompt_lens): acceptance telemetry must separate
+    prompt-echo hits (templated/retrieval traffic replaying its input)
+    from generative hits, or operators enable spec expecting the echo
+    dividend on traffic that has none (VERDICT r4 weak #4)."""
     k = draft_len
     s = max_seq
 
-    def one(row, cur):
+    def one(row, cur, plen):
         idx = jnp.arange(s)
         prev = row[jnp.maximum(cur - 1, 0)]
         pend = row[cur]
@@ -71,11 +79,14 @@ def propose_ngram_drafts(hist, seq_lens, draft_len: int, max_seq: int):
         m &= (idx + 1 < cur) & (cur >= 1)
         j = jnp.max(jnp.where(m, idx, -1))
         start = jnp.where(j >= 0, j + 2, cur + 1)
-        return jax.lax.dynamic_slice(row, (jnp.clip(start, 0, s - k),),
-                                     (k,))
+        drafts = jax.lax.dynamic_slice(row, (jnp.clip(start, 0, s - k),),
+                                       (k,))
+        return drafts, (j >= 0) & (j + 1 < plen)
 
     cur = jnp.minimum(seq_lens, s - 1)
-    return jax.vmap(one)(hist, cur)
+    if prompt_lens is None:
+        prompt_lens = jnp.zeros_like(cur)
+    return jax.vmap(one)(hist, cur, prompt_lens)
 
 
 def _verify_accept_emit(st, logits, drafts, j: int, s_max: int):
@@ -124,9 +135,11 @@ def _verify_accept_emit(st, logits, drafts, j: int, s_max: int):
 class SpecModelRunner(ModelRunner):
     """ModelRunner with n-gram speculative decode (contiguous KV only).
 
-    ``decode_steps_device`` returns a PACKED int32 block [K, 1+J, B]: row 0
-    is the per-slot emit count for that verify step, rows 1..J the emitted
-    tokens (valid up to the count).  The scheduler detects the 3-D layout.
+    ``decode_steps_device`` returns a PACKED int32 block [K, 2+J, B]:
+    row 0 is the per-slot emit count for that verify step, rows 1..J the
+    emitted tokens (valid up to the count), and the LAST row the
+    acceptance source (0 = no draft accepted, 1 = prompt-echo match,
+    2 = generative match).  The scheduler detects the 3-D layout.
     """
 
     def __init__(self, cfg, *args, draft_len: int = 4, **kwargs):
@@ -137,8 +150,11 @@ class SpecModelRunner(ModelRunner):
             "speculative decode requires the bf16 KV cache (the verify "
             "forward reads the cache as bf16 attention context)")
         self.draft_len = max(1, draft_len)
+        # Per-slot prompt lengths (host-side, mirrored at insert) let the
+        # proposer attribute matches to prompt-echo vs generative history.
+        self._spec_plens = np.zeros((self.max_slots,), np.int32)
         self._spec_decode = jax.jit(self._spec_decode_impl,
-                                    donate_argnums=(1,), static_argnums=(2,))
+                                    donate_argnums=(1,), static_argnums=(3,))
         self._set_hist = jax.jit(self._set_hist_impl, donate_argnums=(0,))
 
     # ------------------------------------------------------------------ state
@@ -164,18 +180,20 @@ class SpecModelRunner(ModelRunner):
             row[:plen] = prompt_tokens[:plen]
         if plen < self.max_seq:
             row[plen] = first_token  # the pending token's sequence position
+        self._spec_plens[slot] = plen
         return self._set_hist(state, jnp.int32(slot), jnp.asarray(row))
 
     # ---------------------------------------------------------------- drafts
 
-    def _propose(self, hist, seq_lens):
+    def _propose(self, hist, seq_lens, prompt_lens):
         return propose_ngram_drafts(hist, seq_lens, self.draft_len,
-                                    self.max_seq)
+                                    self.max_seq, prompt_lens)
 
     # ---------------------------------------------------------------- decode
 
-    def _spec_decode_impl(self, params, state: DecodeState, num_steps: int):
-        """``num_steps`` verify steps; returns (packed [K, 1+J, B], state)."""
+    def _spec_decode_impl(self, params, state: DecodeState, prompt_lens,
+                          num_steps: int):
+        """``num_steps`` verify steps; returns (packed [K, 2+J, B], state)."""
         cfg = self.cfg
         b = self.max_slots
         j = 1 + self.draft_len
@@ -183,7 +201,8 @@ class SpecModelRunner(ModelRunner):
         bidx = jnp.arange(b)
 
         def step(st: DecodeState, _):
-            drafts = self._propose(st.hist, st.seq_lens)        # [B, k]
+            drafts, from_prompt = self._propose(st.hist, st.seq_lens,
+                                                prompt_lens)    # [B, k]
             seq_tok = jnp.concatenate([st.tokens[:, None], drafts], 1)  # [B,J]
             positions = jnp.minimum(st.seq_lens[:, None] + jnp.arange(j),
                                     s_max - 1)                  # [B, J]
@@ -212,19 +231,22 @@ class SpecModelRunner(ModelRunner):
                 recent=st.recent, keys=carry,
                 hist=hist,
             )
+            src = jnp.where(counts > 1,
+                            jnp.where(from_prompt, 1, 2), 0)    # [B]
             packed = jnp.concatenate(
-                [counts[None, :], emit.T], axis=0)              # [1+J, B]
+                [counts[None, :], emit.T, src[None, :]], axis=0)  # [2+J, B]
             return new_state, packed
 
         new_state, packed = jax.lax.scan(step, state, length=num_steps)
-        return packed, new_state  # packed [K, 1+J, B]
+        return packed, new_state  # packed [K, 2+J, B]
 
     def decode_steps(self, state: DecodeState, num_steps: int = 1):
-        tokens, new_state = self._spec_decode(self.params, state, num_steps)
+        tokens, new_state = self.decode_steps_device(state, num_steps)
         return np.asarray(tokens), new_state
 
     def decode_steps_device(self, state: DecodeState, num_steps: int = 1):
-        return self._spec_decode(self.params, state, num_steps)
+        return self._spec_decode(self.params, state,
+                                 jnp.asarray(self._spec_plens), num_steps)
 
 
 class SpecPagedModelRunner(PagedModelRunner):
@@ -233,7 +255,7 @@ class SpecPagedModelRunner(PagedModelRunner):
     included).
 
     Same contract as :class:`SpecModelRunner` — ``decode_steps_device``
-    returns the packed [K, 1+J, B] layout the scheduler detects — but the
+    returns the packed [K, 2+J, B] layout the scheduler detects — but the
     verify forward attends over the slot's POOL PAGES as context (the
     dequantized virtual-contiguous view, exactly what the paged jnp decode
     fallback reads) and the J new KV entries scatter back into pages,
@@ -251,8 +273,9 @@ class SpecPagedModelRunner(PagedModelRunner):
     def __init__(self, cfg, *args, draft_len: int = 4, **kwargs):
         super().__init__(cfg, *args, **kwargs)
         self.draft_len = max(1, draft_len)
+        self._spec_plens = np.zeros((self.max_slots,), np.int32)
         self._spec_decode = jax.jit(self._spec_decode_impl,
-                                    donate_argnums=(1,), static_argnums=(3,))
+                                    donate_argnums=(1,), static_argnums=(4,))
         self._set_hist = jax.jit(self._set_hist_impl, donate_argnums=(0,))
 
     # ------------------------------------------------------------------ state
@@ -274,6 +297,7 @@ class SpecPagedModelRunner(PagedModelRunner):
                                prompt_tokens=prompt_tokens,
                                slot_key=slot_key, top_k=top_k,
                                repeat_penalty=repeat_penalty)
+        self._spec_plens[slot] = plen
         if state.hist is None:  # draft-model runner: no n-gram history
             return state
         row = np.zeros((self.max_seq,), np.int32)
@@ -285,8 +309,9 @@ class SpecPagedModelRunner(PagedModelRunner):
 
     # ---------------------------------------------------------------- decode
 
-    def _spec_decode_impl(self, params, state, page_table, num_steps: int):
-        """``num_steps`` verify steps; returns (packed [K, 1+J, B], state)."""
+    def _spec_decode_impl(self, params, state, page_table, prompt_lens,
+                          num_steps: int):
+        """``num_steps`` verify steps; returns (packed [K, 2+J, B], state)."""
         cfg = self.cfg
         b = self.max_slots
         j = 1 + self.draft_len
@@ -299,7 +324,8 @@ class SpecPagedModelRunner(PagedModelRunner):
         quant = self.kv_dtype == "int8"
 
         def step(st, _):
-            drafts, draft_k, draft_v = self._propose_in_step(st)
+            drafts, from_prompt, draft_k, draft_v = self._propose_in_step(
+                st, prompt_lens)
             seq_tok = jnp.concatenate([st.tokens[:, None], drafts], 1)
             positions = jnp.minimum(st.seq_lens[:, None] + jnp.arange(j),
                                     s_max - 1)                  # [B, J]
@@ -363,20 +389,23 @@ class SpecPagedModelRunner(PagedModelRunner):
                 recent=st.recent, keys=carry, hist=hist,
                 draft_k=draft_k, draft_v=draft_v,
             )
+            src = jnp.where(counts > 1,
+                            jnp.where(from_prompt, 1, 2), 0)    # [B]
             packed = jnp.concatenate(
-                [counts[None, :], emit.T], axis=0)              # [1+J, B]
+                [counts[None, :], emit.T, src[None, :]], axis=0)  # [2+J, B]
             return new_state, packed
 
         new_state, packed = jax.lax.scan(step, state, length=num_steps)
-        return packed, new_state  # packed [K, 1+J, B]
+        return packed, new_state  # packed [K, 2+J, B]
 
-    def _propose_in_step(self, st):
+    def _propose_in_step(self, st, prompt_lens):
         """Traced draft proposal for one verify step: returns
-        ([B, draft_len] drafts, draft_k, draft_v) — the base runner drafts
-        by n-gram lookup and carries no draft cache."""
-        return (propose_ngram_drafts(st.hist, st.seq_lens, self.draft_len,
-                                     self.max_seq),
-                st.draft_k, st.draft_v)
+        ([B, draft_len] drafts, from_prompt [B], draft_k, draft_v) — the
+        base runner drafts by n-gram lookup and carries no draft cache."""
+        drafts, from_prompt = propose_ngram_drafts(
+            st.hist, st.seq_lens, self.draft_len, self.max_seq,
+            prompt_lens)
+        return drafts, from_prompt, st.draft_k, st.draft_v
 
     # Each verify step advances a slot by up to 1+draft tokens — page
     # capacity (scheduler hook AND dispatch-time growth) scales by that.
@@ -388,7 +417,8 @@ class SpecPagedModelRunner(PagedModelRunner):
         j = 1 + self.draft_len
         self._ensure_capacity(num_steps * j)
         packed, new_state = self._spec_decode(
-            self.params, state, jnp.asarray(self.page_table), num_steps)
+            self.params, state, jnp.asarray(self.page_table),
+            jnp.asarray(self._spec_plens), num_steps)
         for slot in self._slot_pages:
             self._host_seq[slot] = min(self._host_seq[slot] + num_steps * j,
                                        self.max_seq)
@@ -489,9 +519,11 @@ class DraftSpecPagedModelRunner(SpecPagedModelRunner):
 
     # ---------------------------------------------------------------- drafts
 
-    def _propose_in_step(self, st):
+    def _propose_in_step(self, st, prompt_lens):
         """Autoregressive greedy draft rollout: ``draft_len`` small-model
-        decode steps from the pending token, extending the draft cache."""
+        decode steps from the pending token, extending the draft cache.
+        Draft-model proposals are GENERATIVE by definition (no prompt-echo
+        attribution), so ``from_prompt`` is always False."""
         k = self.draft_len
         s_max = self.max_seq
 
@@ -518,4 +550,5 @@ class DraftSpecPagedModelRunner(SpecPagedModelRunner):
             self.draft_params, self.draft_cfg, last,
             jnp.minimum(pos, s_max - 1), draft_k, draft_v,
             jnp.minimum(pos + 1, s_max), n_shards=self.mesh.size)
-        return drafts.T, draft_k, draft_v  # [B, k]
+        from_prompt = jnp.zeros(st.tokens.shape[0], bool)
+        return drafts.T, from_prompt, draft_k, draft_v  # [B, k]

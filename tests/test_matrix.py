@@ -129,8 +129,9 @@ def test_matrix_promises_construct_and_decode(runner_name, mesh_spec,
     t, ks, vs, plen = r.prefill(prompt, 0.0, 1.0, jax.random.PRNGKey(0))
     st = r.insert(st, 0, ks, vs, plen, t, 0.0, 1.0, prompt_tokens=prompt)
     packed, st = r.decode_steps(st, 4)
-    # [K, 1 + J, B]: count row + (pending + draft_len) emit rows.
-    assert packed.shape[0] == 4 and packed.shape[1] == 1 + (1 + 3)
+    # [K, 2 + J, B]: count row + (pending + draft_len) emit rows + the
+    # acceptance-source row (0 none / 1 prompt-echo / 2 generative).
+    assert packed.shape[0] == 4 and packed.shape[1] == 1 + (1 + 3) + 1
     assert int(packed[0, 0, 0]) >= 1  # slot 0 emitted at least the pending
 
 

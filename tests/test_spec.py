@@ -85,8 +85,10 @@ def test_spec_history_proposals():
     hist = jnp.asarray([[7, 8, 21, 22, 23, 7, 8, 0, 0, 0]
                         + [0] * 118], jnp.int32)
     # cur=6: pending bigram (7, 8) matches positions 0-1 → draft 21, 22, 23.
-    drafts = spec._propose(hist, jnp.asarray([6]))
+    drafts, from_prompt = spec._propose(hist, jnp.asarray([6]),
+                                        jnp.asarray([7]))
     assert drafts.tolist() == [[21, 22, 23]]
+    assert bool(from_prompt[0]) is True  # matched inside the prompt region
 
 
 async def test_spec_scheduler_end_to_end():
@@ -316,3 +318,40 @@ async def test_paged_spec_engine_config_path():
         assert d["spec_decode"]["verify_steps"] > 0
     finally:
         await eng.stop()
+
+
+def test_ngram_acceptance_source_attribution():
+    """propose_ngram_drafts attributes matches to prompt-echo (bigram
+    inside the prompt region) vs generative (match arose in generated
+    history) — the telemetry split operators read before enabling spec
+    (VERDICT r4 weak #4)."""
+    from crowdllama_tpu.engine.spec import propose_ngram_drafts
+
+    s = 16
+    # Slot 0: prompt [1,2,9,1], pending token 2 at position 4 — bigram
+    # (1,2) matches at j=0, inside plen=5.
+    # Slot 1: prompt [9,8] then generated 1,2,9,1, pending 2 at pos 6 —
+    # the (1,2) match (j=2) lies past plen=2: generative.
+    hist = np.zeros((2, s), np.int32)
+    hist[0, :5] = [1, 2, 9, 1, 2]
+    hist[1, :7] = [9, 8, 1, 2, 9, 1, 2]
+    seq_lens = jnp.asarray([4, 6], jnp.int32)
+    plens = jnp.asarray([5, 2], jnp.int32)
+    drafts, from_prompt = propose_ngram_drafts(
+        jnp.asarray(hist), seq_lens, 3, s, plens)
+    assert bool(from_prompt[0]) is True
+    assert bool(from_prompt[1]) is False
+    # Drafts follow the matched bigram: slot 0 j=0 -> row[2:5] = 9,1,2.
+    np.testing.assert_array_equal(np.asarray(drafts[0]), [9, 1, 2])
+
+
+def test_packed_source_row_marks_echo_acceptance():
+    """End to end: a repetitive PROMPT makes accepting steps carry source
+    code 1 (prompt-echo) in the packed block's last row."""
+    _, spec = _runners()
+    toks, packed = _spec_rollout(spec, [3, 1, 4, 1, 5] * 4, steps=6)
+    counts = packed[:, 0, 0]
+    srcs = packed[:, -1, 0]
+    # Wherever a draft was accepted, the source must be attributed (1 or
+    # 2, never 0); steps with no acceptance must carry 0.
+    assert ((counts > 1) == (srcs > 0)).all(), (counts, srcs)
