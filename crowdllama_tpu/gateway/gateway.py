@@ -99,6 +99,18 @@ class Gateway:
         self._known_paths = {r.resource.canonical
                              for r in self.app.router.routes()
                              if r.resource is not None}
+        # Inference-stream pool: a request to a worker reuses an idle
+        # encrypted stream instead of paying TCP connect + signed-hello
+        # handshake (Ed25519 sign/verify + X25519) per request — the
+        # per-request analog of the reference's O(1) routing
+        # (manager.go:338-387; libp2p reuses connections the same way).
+        # Workers loop on the stream (peer._handle_inference_stream) with
+        # an idle window outlasting the pool's, so one stream serves many
+        # sequential requests; stale entries (worker restarted) are
+        # detected by the first failed roundtrip and retried fresh.
+        from crowdllama_tpu.net.host import StreamPool
+
+        self._stream_pool = StreamPool(max_per_key=4)
 
     # ----------------------------------------------------------- lifecycle
 
@@ -113,6 +125,26 @@ class Gateway:
         if self._runner is not None:
             await self._runner.cleanup()
             self._runner = None
+        # The pool stays a null sink afterwards: an in-flight request
+        # finishing post-stop closes its stream instead of repooling it.
+        self._stream_pool.close()
+
+    # ------------------------------------------------------- stream pool
+
+    def _pool_get(self, worker_id: str):
+        """Pop a live pooled stream for ``worker_id`` (None on miss)."""
+        return self._stream_pool.get(worker_id)
+
+    def _pool_put(self, worker_id: str, s) -> None:
+        """Return a stream whose last request completed CLEANLY (a
+        mid-response abort leaves unread frames — close those instead)."""
+        self._stream_pool.put(worker_id, s)
+
+    async def _dial(self, worker_id: str):
+        contact = await self.peer.dht.find_peer(worker_id)
+        if contact is None:
+            raise LookupError(f"worker {worker_id[:8]} not resolvable")
+        return await self.peer.host.new_stream(contact, INFERENCE_PROTOCOL)
 
     # ---------------------------------------------------------- middleware
 
@@ -358,16 +390,36 @@ class Gateway:
                 "model": model}, 503
 
     async def _roundtrip(self, worker_id: str, msg, timeout: float = 600):
-        """One-shot request/reply over a fresh inference stream."""
-        contact = await self.peer.dht.find_peer(worker_id)
-        if contact is None:
-            raise LookupError(f"worker {worker_id[:8]} not resolvable")
-        s = await self.peer.host.new_stream(contact, INFERENCE_PROTOCOL)
+        """Request/reply over a pooled (or fresh) inference stream.
+
+        A pooled stream can be stale (worker idled it out or restarted):
+        generation/embedding requests are stateless, so the failed attempt
+        retries once on a fresh dial before surfacing the error."""
+        s = self._pool_get(worker_id)
+        if s is not None:
+            try:
+                await wire.write_length_prefixed_pb(s.writer, msg)
+                reply = await wire.read_length_prefixed_pb(s.reader,
+                                                           timeout=timeout)
+                self._pool_put(worker_id, s)
+                return reply
+            except asyncio.CancelledError:
+                s.close()
+                raise
+            except Exception as e:
+                s.close()
+                log.debug("pooled stream to %s stale (%s); redialing",
+                          worker_id[:8], e)
+        s = await self._dial(worker_id)
         try:
             await wire.write_length_prefixed_pb(s.writer, msg)
-            return await wire.read_length_prefixed_pb(s.reader, timeout=timeout)
-        finally:
+            reply = await wire.read_length_prefixed_pb(s.reader,
+                                                       timeout=timeout)
+        except BaseException:
             s.close()
+            raise
+        self._pool_put(worker_id, s)
+        return reply
 
     async def handle_pull(self, request: web.Request) -> web.Response:
         """POST /api/pull — Ollama clients call this when a model is absent.
@@ -764,17 +816,40 @@ class Gateway:
                 raise RuntimeError(resp.response)
             return web.json_response(render(resp, final=True))
 
-        contact = await self.peer.dht.find_peer(worker_id)
-        if contact is None:
-            raise LookupError(f"worker {worker_id[:8]} not resolvable")
-        s = await self.peer.host.new_stream(contact, INFERENCE_PROTOCOL)
+        # Streamed: one NDJSON line (Ollama) or SSE data event (OpenAI)
+        # per chunk.  Read the FIRST frame before sending headers, so a
+        # worker that dies immediately is still retryable by _route — and
+        # so a STALE pooled stream is detected while a fresh redial is
+        # still possible.
+        s = self._pool_get(worker_id)
+        first = None
+        if s is not None:
+            try:
+                await wire.write_length_prefixed_pb(s.writer, msg)
+                first = extract_generate_response(
+                    await wire.read_length_prefixed_pb(s.reader, timeout=600))
+            except asyncio.CancelledError:
+                s.close()
+                raise
+            except Exception as e:
+                s.close()
+                s = None
+                log.debug("pooled stream to %s stale (%s); redialing",
+                          worker_id[:8], e)
+        if s is None:
+            s = await self._dial(worker_id)
+            try:
+                await wire.write_length_prefixed_pb(s.writer, msg)
+                first = extract_generate_response(
+                    await wire.read_length_prefixed_pb(s.reader, timeout=600))
+            except BaseException:
+                s.close()
+                raise
+        # Pool the stream back only after the worker's terminal frame was
+        # READ (a mid-response abort leaves frames in flight — closing is
+        # the only safe disposal).
+        clean = False
         try:
-            await wire.write_length_prefixed_pb(s.writer, msg)
-            # Streamed: one NDJSON line (Ollama) or SSE data event (OpenAI)
-            # per chunk.  Read the FIRST frame before sending headers, so a
-            # worker that dies immediately is still retryable by _route.
-            first = extract_generate_response(
-                await wire.read_length_prefixed_pb(s.reader, timeout=600))
             if first.done_reason == "error":
                 raise RuntimeError(first.response)
             self._observe_ttfb(time.monotonic() - t0)
@@ -799,6 +874,7 @@ class Gateway:
                         raise RuntimeError(resp.response)
                     await write_frame(render(resp, final=resp.done))
                     if resp.done:
+                        clean = True  # terminal frame read: stream reusable
                         break
                     resp = extract_generate_response(
                         await wire.read_length_prefixed_pb(s.reader, timeout=600))
@@ -825,7 +901,10 @@ class Gateway:
             await out.write_eof()
             return out
         finally:
-            s.close()
+            if clean:
+                self._pool_put(worker_id, s)
+            else:
+                s.close()
 
     @staticmethod
     def _ollama_json(resp, chat: bool, final: bool) -> dict:

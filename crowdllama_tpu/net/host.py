@@ -189,6 +189,69 @@ def _hello_signing_bytes(
 StreamHandler = Callable[[Stream], Awaitable[None]]
 
 
+#: Default idle window for pooled streams; the SERVING side of a pooled
+#: protocol must hold its read loop open at least this long (plus slack)
+#: or every pool hit after a short pause is guaranteed-stale.
+STREAM_POOL_IDLE_S = 30.0
+
+
+class StreamPool:
+    """Idle-stream reuse keyed by remote: amortizes TCP + signed-hello
+    (Ed25519 sign/verify + X25519) over many exchanges — measured at
+    ~214 handshakes/s of pure control-plane churn across a 16-worker
+    swarm before pooling.  One shared mechanism for the gateway's
+    inference streams and the DHT's KAD RPCs (each caller keeps its own
+    borrow/retry protocol — the framing differs; the container and its
+    lifecycle must not).
+
+    Borrowing is exclusive (``get`` pops), so a pooled stream never has
+    two concurrent users.  After ``close()`` the pool stays usable as a
+    null sink: late ``put`` calls from in-flight exchanges close their
+    stream instead of repopulating a cleared dict (shutdown leak)."""
+
+    def __init__(self, max_per_key: int = 2,
+                 idle_s: float = STREAM_POOL_IDLE_S):
+        self.max_per_key = max_per_key
+        self.idle_s = idle_s
+        self._pools: dict[str, list] = {}
+        self._closed = False
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key: str) -> Stream | None:
+        pool = self._pools.get(key, [])
+        while pool:
+            s, ts = pool.pop()
+            if (time.monotonic() - ts < self.idle_s
+                    and not s.writer.is_closing()):
+                self.hits += 1
+                return s
+            s.close()
+        self.misses += 1
+        return None
+
+    def put(self, key: str, s: Stream) -> None:
+        if self._closed or s.writer.is_closing():
+            s.close()
+            return
+        pool = self._pools.setdefault(key, [])
+        if len(pool) >= self.max_per_key:
+            s.close()
+            return
+        pool.append((s, time.monotonic()))
+
+    def close_key(self, key: str) -> None:
+        for s, _ts in self._pools.pop(key, []):
+            s.close()
+
+    def close(self) -> None:
+        self._closed = True
+        for pool in self._pools.values():
+            for s, _ts in pool:
+                s.close()
+        self._pools.clear()
+
+
 class Host:
     """One listening node; opens/accepts authenticated protocol streams."""
 

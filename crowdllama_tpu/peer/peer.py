@@ -141,6 +141,11 @@ class Peer:
         self.update_metadata()
 
         self.host.set_stream_handler(METADATA_PROTOCOL, self._handle_metadata_stream)
+        # Health probes and discovery prefer the pooled KAD "metadata" op
+        # (one frame each way over a reused stream) — the legacy
+        # read-to-EOF stream above stays served for wire parity
+        # (discovery.go:186-275) and as the fallback path.
+        self.dht.metadata_provider = self._metadata_snapshot
         self.host.set_stream_handler(INFERENCE_PROTOCOL, self._handle_inference_stream)
         if self.worker_mode:
             # Swarm model distribution (net/model_share.py): share local
@@ -171,6 +176,9 @@ class Peer:
             # records / routing entry from our DHT view immediately.
             on_peer_removed=self.dht.evict_peer,
         )
+        # Served RPCs prove the caller alive (replaces the per-probe
+        # metadata-stream mark_seen the RPC pool elides).
+        self.dht.peer_seen = self.peer_manager.mark_seen
 
         if self.config.bootstrap_peers:
             n = await self.dht.bootstrap(self.config.bootstrap_peers)
@@ -495,36 +503,60 @@ class Peer:
 
     # ------------------------------------------------------------- streams
 
+    def _metadata_snapshot(self) -> bytes:
+        """CURRENT Resource JSON for the pooled KAD metadata op — same
+        live refresh the legacy stream handler performs, or probes would
+        serve load/throughput frozen at the last refresh tick and
+        find_best_worker would rank saturated workers as idle."""
+        self.update_metadata()
+        return self.resource.to_json()
+
     async def _handle_metadata_stream(self, stream: Stream) -> None:
         """Serve Resource JSON and close (peer.go:284-316)."""
-        self.update_metadata()
-        stream.writer.write(self.resource.to_json())
+        stream.writer.write(self._metadata_snapshot())
         await stream.writer.drain()
         stream.writer.write_eof()
         if self.peer_manager is not None:
             self.peer_manager.mark_seen(stream.remote_peer_id)
 
     async def _handle_inference_stream(self, stream: Stream) -> None:
-        """Serve one inference request per stream (peer.go:190-256).
+        """Serve inference requests on one stream until the client closes
+        or idles out (peer.go:190-256 serves exactly one per stream; the
+        loop is what lets the gateway's stream pool amortize the TCP +
+        signed-hello handshake over many requests).
 
         Non-streaming: one request frame in, one response frame out.
         Streaming (req.stream=true): one frame per token chunk, done on last —
         the superset the reference never implements (its TTFT == total
         latency, SURVEY §3.3).
         """
+        while True:
+            if not await self._serve_one_inference(stream):
+                return
+
+    async def _serve_one_inference(self, stream: Stream) -> bool:
+        """One request/reply exchange; False ends the stream's loop."""
+        from crowdllama_tpu.net.host import STREAM_POOL_IDLE_S
+
         try:
+            # Idle window must OUTLAST the gateway pool's (plus slack), or
+            # every pooled stream the gateway still considers fresh would
+            # already be dead on this side and each hit would pay a failed
+            # roundtrip before the redial.
             msg = await wire.read_length_prefixed_pb(
-                stream.reader, timeout=self.config.intervals.stream_read_timeout
+                stream.reader,
+                timeout=max(self.config.intervals.stream_read_timeout,
+                            STREAM_POOL_IDLE_S + 5.0),
             )
-        except wire.WireError as e:
-            log.debug("inference stream read failed: %s", e)
-            return
+        except (wire.WireError, asyncio.TimeoutError, OSError) as e:
+            log.debug("inference stream read ended: %s", e)
+            return False
         try:
             which = msg.WhichOneof("message")
             if which == "embed_request":
                 reply = await self.engine.handle(msg, worker_id=self.peer_id)
                 await wire.write_length_prefixed_pb(stream.writer, reply)
-                return
+                return True
             req = msg.generate_request
             if which != "generate_request":
                 raise ValueError("expected GenerateRequest")
@@ -534,6 +566,7 @@ class Peer:
             else:
                 reply = await self.engine.handle(msg, worker_id=self.peer_id)
                 await wire.write_length_prefixed_pb(stream.writer, reply)
+            return True
         except Exception as e:
             # Synthesize an error response (peer.go:233-243).
             log.warning("inference failed: %s", e)
@@ -568,7 +601,8 @@ class Peer:
             try:
                 await wire.write_length_prefixed_pb(stream.writer, err)
             except Exception:
-                pass
+                return False  # writer dead: end the stream's serve loop
+            return True  # error frame delivered; the exchange is complete
 
     # ----------------------------------------------------------- discovery
 
@@ -576,6 +610,17 @@ class Peer:
         contact = await self.dht.find_peer(peer_id)
         if contact is None:
             raise LookupError(f"peer {peer_id[:8]} not resolvable")
+        # Pooled KAD op first (health probes are the steady-state churn);
+        # legacy metadata stream as the fallback for peers not serving it.
+        raw = await self.dht.request_metadata(contact)
+        if raw is not None:
+            resource = Resource.from_json(raw.encode()
+                                          if isinstance(raw, str) else raw)
+            if resource.peer_id and resource.peer_id != contact.peer_id:
+                raise ValueError(
+                    f"metadata peer_id {resource.peer_id[:8]} does not "
+                    f"match peer {contact.peer_id[:8]}")
+            return resource
         return await request_peer_metadata(
             self.host, contact, timeout=self.config.intervals.metadata_timeout
         )

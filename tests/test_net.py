@@ -323,3 +323,58 @@ async def _echo(stream):
     stream.writer.write(b"ok")
     await stream.writer.drain()
     stream.writer.write_eof()
+
+
+async def test_kad_rpc_stream_pool_reuse():
+    """Sequential RPCs to the same peer ride ONE pooled stream: the
+    steady-state control plane must not pay a TCP + signed-hello
+    handshake per exchange (measured at ~214 streams/s across a
+    16-worker swarm before pooling)."""
+    boot_host, boot_dht = await _mknode()
+    addr = f"127.0.0.1:{boot_host.listen_port}"
+    h1, d1 = await _mknode(bootstrap=addr)
+    try:
+        contact = boot_host.contact
+        before = h1.stats["streams_out"]
+        for _ in range(5):
+            resp = await d1._rpc(contact, {"op": "ping"})
+            assert resp and resp.get("ok")
+        assert h1.stats["streams_out"] - before <= 1, (
+            "pings opened a fresh stream each — the RPC pool is not "
+            "reusing streams")
+
+        # Stale pooled stream (remote closed it): the RPC retries on a
+        # fresh dial instead of failing, and the peer is NOT evicted.
+        for s, _ts in d1._rpc_pool._pools.get(boot_host.peer_id, []):
+            s.close()
+        resp = await d1._rpc(contact, {"op": "ping"})
+        assert resp and resp.get("ok")
+        assert any(c.peer_id == boot_host.peer_id
+                   for c in d1.table.contacts()), "peer was evicted"
+    finally:
+        for h in (boot_host, h1):
+            await h.close()
+
+
+async def test_pooled_metadata_rpc():
+    """Health probes fetch metadata over the pooled KAD op when the peer
+    serves it, with the legacy read-to-EOF stream as fallback."""
+    boot_host, boot_dht = await _mknode()
+    addr = f"127.0.0.1:{boot_host.listen_port}"
+    h1, d1 = await _mknode(bootstrap=addr)
+    h2, d2 = await _mknode(bootstrap=addr)
+    try:
+        resource = Resource(peer_id=h1.peer_id,
+                            supported_models=["tinyllama-1.1b"],
+                            worker_mode=True)
+        resource.touch()
+        d1.metadata_provider = lambda: resource.to_json()
+        raw = await d2.request_metadata(h1.contact)
+        assert raw is not None
+        got = Resource.from_json(raw.encode())
+        assert got.supported_models == ["tinyllama-1.1b"]
+        # A peer without the op (provider unset) yields None -> fallback.
+        assert await d1.request_metadata(h2.contact) is None
+    finally:
+        for h in (boot_host, h1, h2):
+            await h.close()
