@@ -454,3 +454,41 @@ async def test_gateway_options_stop_parsed():
                 assert d["done_reason"] == "stop"
     finally:
         await teardown()
+
+
+async def test_pooled_inference_stream_reuse_and_stale_redial():
+    """Sequential chats reuse ONE pooled inference stream (no per-request
+    handshake), and a stale pooled entry (worker closed it) is detected
+    and redialed transparently instead of failing the request."""
+    worker, consumer, gateway, gw_port, teardown = await _topology()
+    try:
+        await _wait_for(
+            lambda: consumer.peer_manager.find_best_worker("tiny-test")
+            is not None, what="worker discovery")
+        url = f"http://127.0.0.1:{gw_port}/api/chat"
+        body = {"model": "tiny-test",
+                "messages": [{"role": "user", "content": "hi"}]}
+        async with aiohttp.ClientSession() as s:
+            async with s.post(url, json=body) as resp:
+                assert resp.status == 200
+            out0 = consumer.host.stats["streams_out"]
+            hits0 = gateway._stream_pool.hits
+            for _ in range(3):
+                async with s.post(url, json=body) as resp:
+                    assert resp.status == 200
+            assert gateway._stream_pool.hits - hits0 == 3
+            assert consumer.host.stats["streams_out"] == out0, (
+                "pooled requests must not open new streams")
+
+            # Kill the pooled streams worker-side: the next request sees
+            # a stale entry, redials, and still succeeds.
+            for pool in list(gateway._stream_pool._pools.values()):
+                for st, _ts in pool:
+                    st.writer._w.transport.abort()  # sever the raw TCP pipe
+            await asyncio.sleep(0.05)
+            async with s.post(url, json=body) as resp:
+                assert resp.status == 200
+                d = await resp.json()
+                assert d["done"] is True
+    finally:
+        await teardown()
