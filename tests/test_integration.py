@@ -465,30 +465,44 @@ async def test_pooled_inference_stream_reuse_and_stale_redial():
         await _wait_for(
             lambda: consumer.peer_manager.find_best_worker("tiny-test")
             is not None, what="worker discovery")
+        from crowdllama_tpu.core.protocol import INFERENCE_PROTOCOL
+
         url = f"http://127.0.0.1:{gw_port}/api/chat"
         body = {"model": "tiny-test",
                 "messages": [{"role": "user", "content": "hi"}]}
+
+        def inference_streams_in() -> int:
+            # Worker-side inbound count for the inference protocol only:
+            # host-wide streams_out on the consumer would race with its
+            # background control-plane dials.
+            return worker.host.stats_by_protocol.get(INFERENCE_PROTOCOL, 0)
+
         async with aiohttp.ClientSession() as s:
             async with s.post(url, json=body) as resp:
                 assert resp.status == 200
-            out0 = consumer.host.stats["streams_out"]
+            in0 = inference_streams_in()
             hits0 = gateway._stream_pool.hits
             for _ in range(3):
                 async with s.post(url, json=body) as resp:
                     assert resp.status == 200
             assert gateway._stream_pool.hits - hits0 == 3
-            assert consumer.host.stats["streams_out"] == out0, (
-                "pooled requests must not open new streams")
+            assert inference_streams_in() == in0, (
+                "pooled requests must not open new inference streams")
 
-            # Kill the pooled streams worker-side: the next request sees
-            # a stale entry, redials, and still succeeds.
+            # Stale-redial path: feed EOF into the pooled streams' READER
+            # side so the pool's is_closing() pre-check still passes, the
+            # write succeeds, and the subsequent read fails — exactly the
+            # worker-went-away shape the redial branch exists for (a
+            # local transport abort would be caught by the pre-check and
+            # never exercise it).
             for pool in list(gateway._stream_pool._pools.values()):
                 for st, _ts in pool:
-                    st.writer._w.transport.abort()  # sever the raw TCP pipe
-            await asyncio.sleep(0.05)
+                    st.reader._r.feed_eof()
             async with s.post(url, json=body) as resp:
                 assert resp.status == 200
                 d = await resp.json()
                 assert d["done"] is True
+            assert inference_streams_in() > in0, (
+                "the stale roundtrip must have redialed a fresh stream")
     finally:
         await teardown()
