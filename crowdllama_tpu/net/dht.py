@@ -442,6 +442,13 @@ class DHTNode:
                 data = self.metadata_provider()
                 resp["metadata"] = (data.decode()
                                     if isinstance(data, bytes) else data)
+                # CURRENT advertised contact: a pooled probe stream can
+                # outlive the dial path it was opened through (e.g. the
+                # peer failed over to another relay), so the prober needs
+                # the fresh contact to refresh its peerstore — otherwise
+                # liveness-over-a-zombie-stream pins a stale address
+                # forever.
+                resp["contact"] = self.host.contact.to_dict()
             else:
                 raise ValueError(f"unknown op {op!r}")
         except Exception as e:
@@ -516,10 +523,26 @@ class DHTNode:
     async def request_metadata(self, contact: Contact) -> str | None:
         """The peer's Resource JSON via the pooled RPC path; None on any
         failure or when the remote serves no metadata op (caller falls
-        back to the legacy read-to-EOF metadata stream)."""
+        back to the legacy read-to-EOF metadata stream).
+
+        The response's self-reported CURRENT contact refreshes our
+        peerstore: the pooled stream this rides may have been opened
+        through a dial path that no longer works (relay failover), and
+        find_peer prefers the peerstore — without the refresh, a live
+        peer's address would stay stale for as long as the zombie stream
+        survives.  Same trust model as hellos advertising listen_port
+        (the stream is authenticated to exactly this peer)."""
         resp = await self._rpc(contact, {"op": "metadata"})
         if not resp or not resp.get("ok") or not resp.get("metadata"):
             return None
+        fresh = resp.get("contact")
+        if fresh:
+            try:
+                c = Contact.from_dict(fresh)
+                if c.peer_id == contact.peer_id and c.port:
+                    self.host.peerstore[c.peer_id] = c
+            except (KeyError, ValueError, TypeError):
+                pass
         return str(resp["metadata"])
 
     # ------------------------------------------------------------- lookups

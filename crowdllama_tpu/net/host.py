@@ -56,6 +56,15 @@ HANDSHAKE_TIMEOUT = 10.0
 # failed (its NAT filters egress, or its relay dropped the signal).
 REVERSE_WAIT = 4.0
 REVERSE_FAIL_COOLDOWN = 60.0
+# Hole punch (TCP simultaneous open): per-attempt connect budget, retry
+# count, and the per-peer cooldown after a failed punch (fall back to the
+# relay splice meanwhile).  Works for endpoint-independent-mapping
+# ("cone") NAT pairs — the class connection reversal cannot cover because
+# reversal needs ONE side publicly dialable; symmetric NATs still splice
+# (port prediction is a lottery; libp2p falls back to relay there too).
+PUNCH_ATTEMPTS = 6
+PUNCH_CONNECT_TIMEOUT = 0.7
+PUNCH_FAIL_COOLDOWN = 60.0
 
 log = logging.getLogger("crowdllama.net.host")
 
@@ -134,11 +143,13 @@ class Stream:
     remote_contact: Contact | None  # None when the remote is not listening
     reader: "asyncio.StreamReader"
     writer: "asyncio.StreamWriter"
-    # Socket-observed source IP of an INBOUND stream ("" for outbound):
-    # unlike remote_contact it survives non-dialable hellos (listen_port
-    # 0), which is what the relay's dialback probe needs — a relaying
-    # worker's hello is deliberately non-dialable.
+    # Socket-observed source IP/port of an INBOUND stream ("" / 0 for
+    # outbound): unlike remote_contact they survive non-dialable hellos
+    # (listen_port 0) — the relay's dialback probe needs the IP, and the
+    # hole-punch coordination needs the full observed endpoint (it IS the
+    # peer's NAT mapping for that socket).
     observed_ip: str = ""
+    observed_port: int = 0
 
     def close(self) -> None:
         try:
@@ -187,6 +198,113 @@ def _hello_signing_bytes(
 
 
 StreamHandler = Callable[[Stream], Awaitable[None]]
+
+
+def _reuse_socket(local_port: int, remote_host: str = ""):
+    """A SO_REUSEADDR/SO_REUSEPORT TCP socket bound to ``local_port`` on
+    the wildcard address of the family ``remote_host`` implies (IPv6
+    literals get an AF_INET6 socket — the relay control stream dials
+    through here, and an IPv6 relay must keep working)."""
+    import socket as _socket
+
+    v6 = ":" in remote_host
+    sock = _socket.socket(
+        _socket.AF_INET6 if v6 else _socket.AF_INET, _socket.SOCK_STREAM)
+    sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+    if hasattr(_socket, "SO_REUSEPORT"):
+        sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEPORT, 1)
+    sock.setblocking(False)
+    sock.bind(("::" if v6 else "0.0.0.0", local_port))
+    return sock
+
+
+async def punch_establish(local_port: int, host: str, port: int,
+                          on_established, attempts: int = PUNCH_ATTEMPTS,
+                          listen_sock=None):
+    """Classic TCP hole punch from ``local_port`` toward ``host:port``:
+    LISTEN on the port (SO_REUSEADDR/SO_REUSEPORT — it is already in use
+    by the live signaling stream whose NAT mapping we are reusing) while
+    repeatedly CONNECTing to the remote endpoint.  The outbound SYNs open
+    our NAT's filter toward the remote even when they are themselves
+    dropped; the connection that lands first — accepted OR outbound —
+    is handed to ``on_established(reader, writer)`` (a SYNC callback —
+    spawn tasks, don't block — called for EVERY establishment: crossed
+    punches can yield one connection per direction, and only the
+    opening-frame exchange decides which one carries the protocol; the
+    orphan idles out at the handshake timeout).
+
+    Pure simultaneous open (connect-only on both sides) is NOT workable:
+    the SYNs must cross in flight, which loopback and low-latency paths
+    essentially never achieve.  Returns when at least one connection
+    established, raising after the attempt budget otherwise.
+
+    ``listen_sock``: a pre-bound reuse socket to listen on (the punch
+    REQUESTER binds its listener before dialing the relay, so the port
+    is conflict-free by construction).  Without one, a wildcard listener
+    is attempted on ``local_port`` — and a bind conflict (a TIME_WAIT
+    stranger without SO_REUSEPORT can block the share) degrades to
+    connect-only, which still succeeds whenever the other side listens.
+    """
+    loop = asyncio.get_running_loop()
+    established = asyncio.Event()
+
+    async def _accepted(reader, writer):
+        established.set()
+        on_established(reader, writer)
+
+    if listen_sock is not None:
+        try:
+            server = await asyncio.start_server(_accepted, sock=listen_sock)
+        except BaseException:
+            listen_sock.close()
+            raise
+    else:
+        try:
+            server = await asyncio.start_server(
+                _accepted, "::" if ":" in host else "0.0.0.0", local_port,
+                reuse_address=True,
+                reuse_port=hasattr(__import__("socket"), "SO_REUSEPORT"))
+        except OSError:
+            server = None  # connect-only
+    last: Exception | None = None
+    try:
+        for _ in range(attempts):
+            sock = _reuse_socket(local_port, host)
+            try:
+                await asyncio.wait_for(
+                    loop.sock_connect(sock, (host, port)),
+                    PUNCH_CONNECT_TIMEOUT)
+                reader, writer = await asyncio.open_connection(sock=sock)
+                established.set()
+                on_established(reader, writer)
+                return
+            except asyncio.CancelledError:
+                sock.close()
+                raise
+            except Exception as e:
+                last = e
+                sock.close()
+            try:
+                await asyncio.wait_for(established.wait(), 0.15)
+                return  # the listener side landed one
+            except asyncio.TimeoutError:
+                pass
+        if established.is_set():
+            return
+        # Last chance: a crossed inbound may land moments after our final
+        # connect attempt failed — waiting HERE (before deciding failure)
+        # means a late establishment becomes success instead of a leaked
+        # connection delivered during a raised exception.
+        try:
+            await asyncio.wait_for(established.wait(), 0.3)
+            return
+        except asyncio.TimeoutError:
+            pass
+        raise HandshakeError(f"hole punch to {host}:{port} failed: {last}")
+    finally:
+        # Served/handed-off connections continue independently.
+        if server is not None:
+            server.close()
 
 
 #: Default idle window for pooled streams; the SERVING side of a pooled
@@ -284,6 +402,7 @@ class Host:
         # later stream the reversal wait — go straight to the splice for
         # a cooldown instead.
         self._reverse_failed_at: dict[str, float] = {}
+        self._punch_failed_at: dict[str, float] = {}
         self._handlers: dict[str, StreamHandler] = {}
         self._server: asyncio.Server | None = None
         # peerstore: peer_id -> Contact learned from hellos / DHT results
@@ -362,13 +481,22 @@ class Host:
     # -- outbound ----------------------------------------------------------
 
     async def new_stream(
-        self, target: Contact | str, protocol: str, timeout: float = HANDSHAKE_TIMEOUT
+        self, target: Contact | str, protocol: str,
+        timeout: float = HANDSHAKE_TIMEOUT, reuse_sock: bool = False,
+        local_port: int = 0,
     ) -> Stream:
         """Dial a peer and open an authenticated stream for ``protocol``.
 
         ``target`` may be a Contact (identity verified against its peer_id) or
         a bare "host:port" address (identity learned from the remote hello, as
         when dialing a bootstrap address, cf. discovery.go:92-141).
+
+        ``reuse_sock`` dials from a SO_REUSEADDR/SO_REUSEPORT socket:
+        hole punching rebinds the LOCAL port of a live signaling stream
+        (its NAT mapping is the punch target), which the kernel only
+        allows when the original socket carried the reuse options too.
+        ``local_port`` pins that socket's local bind (the punch requester
+        dials the relay FROM the port its pre-bound listener owns).
         """
         if isinstance(target, Contact) and target.relay:
             return await self._new_stream_via_relay(target, protocol, timeout)
@@ -378,9 +506,21 @@ class Host:
             host, _, port_s = target.rpartition(":")
             host, port, expect_id = host or "127.0.0.1", int(port_s), None
 
-        reader, writer = await asyncio.wait_for(
-            asyncio.open_connection(host, port), timeout
-        )
+        if reuse_sock:
+            sock = _reuse_socket(local_port, host)
+            try:
+                await asyncio.wait_for(
+                    asyncio.get_running_loop().sock_connect(sock,
+                                                            (host, port)),
+                    timeout)
+                reader, writer = await asyncio.open_connection(sock=sock)
+            except BaseException:
+                sock.close()
+                raise
+        else:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(host, port), timeout
+            )
         try:
             return await self._client_handshake(
                 reader, writer, protocol, expect_id, timeout,
@@ -463,8 +603,13 @@ class Host:
         only signals the NATed peer to dial us back, and the data path
         goes direct instead of hairpinning every byte through the relay
         (libp2p's DCUtR fast path; the reference inherits hole punching
-        from libp2p, internal/discovery/discovery.go:62).  Any reversal
-        failure falls back to the splice."""
+        from libp2p, internal/discovery/discovery.go:62).  When reversal
+        does not apply (BOTH sides NATed), try a relay-coordinated TCP
+        simultaneous open (hole punch): each side redials the other's
+        relay-observed endpoint FROM the local port whose NAT mapping the
+        relay observed — cone-NAT pairs get a direct data path the splice
+        would otherwise hairpin forever.  Any failure falls back to the
+        splice."""
         failed_at = self._reverse_failed_at.get(target.peer_id, 0.0)
         if (self.reverse_dialable and self.listen_port
                 and time.monotonic() - failed_at > REVERSE_FAIL_COOLDOWN
@@ -481,6 +626,21 @@ class Host:
                 log.debug("reverse connect to %s failed (%s); falling "
                           "back to relay splice for %ds",
                           target.peer_id[:8], e, int(REVERSE_FAIL_COOLDOWN))
+        punch_failed_at = self._punch_failed_at.get(target.peer_id, 0.0)
+        if (time.monotonic() - punch_failed_at > PUNCH_FAIL_COOLDOWN
+                and not os.environ.get("CROWDLLAMA_TPU_NO_PUNCH")):
+            try:
+                stream = await self._new_stream_punched(target, protocol,
+                                                        timeout)
+                self._punch_failed_at.pop(target.peer_id, None)
+                return stream
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                self._punch_failed_at[target.peer_id] = time.monotonic()
+                log.debug("hole punch to %s failed (%s); falling back to "
+                          "relay splice for %ds",
+                          target.peer_id[:8], e, int(PUNCH_FAIL_COOLDOWN))
         outer = await self.new_stream(f"{target.host}:{target.port}",
                                       RELAY_PROTOCOL, timeout)
         try:
@@ -499,6 +659,75 @@ class Host:
         except Exception:
             outer.close()
             raise
+
+    async def _new_stream_punched(self, target: Contact, protocol: str,
+                                  timeout: float) -> Stream:
+        """Hole punch: ask the relay for the target's observed endpoint
+        (and to signal the target ours), then run a coordinated TCP
+        simultaneous open — both sides connect() to each other FROM the
+        local ports whose NAT mappings the relay observed, so cone NATs
+        route the SYNs without any listener.  We stay the protocol
+        client; the target serves the pipe (relay.py RelayClient._punch).
+        """
+        # Bind the punch listener FIRST (port 0: kernel-assigned,
+        # conflict-free by construction), then dial the relay FROM that
+        # same port — the relay observes the NAT mapping of the very
+        # port we are listening on.
+        lsock = _reuse_socket(0, target.host)
+        lport = lsock.getsockname()[1]
+        try:
+            outer = await self.new_stream(f"{target.host}:{target.port}",
+                                          RELAY_PROTOCOL, timeout,
+                                          reuse_sock=True, local_port=lport)
+        except BaseException:
+            lsock.close()
+            raise
+        consumed = False  # punch_establish owns lsock once called
+        try:
+            # No nonce: the punched connection is authenticated solely by
+            # the signed-hello handshake's expect_id (unlike reversal,
+            # nothing here needs correlating to a waiter).
+            await write_json_frame(outer.writer, {
+                "op": "punch", "target": target.peer_id})
+            reply = await read_json_frame(outer.reader, timeout)
+            if not reply.get("ok"):
+                raise HandshakeError(
+                    f"relay refused punch: {reply.get('error', 'unknown')}")
+            r_host, _, r_port = str(reply.get("addr", "")).rpartition(":")
+            if not r_host or not r_port.isdigit():
+                raise HandshakeError(f"bad punch endpoint {reply!r}")
+            # The outer stream stays open through the punch (its liveness
+            # is what keeps aggressive NATs from expiring the mapping).
+            # We are the protocol CLIENT: take the first established
+            # connection; crossed extras are closed (the target serves
+            # every one it sees, so an orphan just idles out there).
+            first: asyncio.Future = asyncio.get_running_loop(
+            ).create_future()
+
+            def on_est(reader, writer):
+                if first.done():
+                    writer.close()
+                else:
+                    first.set_result((reader, writer))
+
+            consumed = True
+            await punch_establish(lport, r_host, int(r_port), on_est,
+                                  listen_sock=lsock)
+            reader, writer = await first
+        finally:
+            if not consumed:
+                lsock.close()
+            outer.close()
+        try:
+            stream = await self._client_handshake(
+                reader, writer, protocol, target.peer_id, timeout,
+                contact=lambda rid: target)
+        except Exception:
+            writer.close()
+            raise
+        self.stats["streams_punched_out"] = (
+            self.stats.get("streams_punched_out", 0) + 1)
+        return stream
 
     async def _new_stream_reversed(self, target: Contact, protocol: str,
                                    timeout: float) -> Stream:
@@ -550,32 +779,42 @@ class Host:
         peername = writer.get_extra_info("peername")
         await self._serve_pipe(reader, writer, peername)
 
+    async def _serve_inbound(self, reader, writer, stat_key: str,
+                             peername) -> None:
+        """Shared bookkeeping for every non-accepted inbound pipe
+        (reversed / punched / relay-spliced): task tracking, the
+        path-specific stat, then the standard server-side handshake +
+        handler dispatch."""
+        task = asyncio.current_task()
+        if task is not None:
+            self._conn_tasks.add(task)
+            task.add_done_callback(self._conn_tasks.discard)
+        self.stats[stat_key] = self.stats.get(stat_key, 0) + 1
+        await self._serve_pipe(reader, writer, peername)
+
     async def serve_reversed(self, reader: asyncio.StreamReader,
                              writer: asyncio.StreamWriter) -> None:
         """Serve one OUTBOUND TCP connection we opened as a connection
         reversal (net/relay.py RelayClient): after the REVERSE marker
         frame, the remote requester runs the client handshake, so this
         side serves the pipe exactly like an accepted connection."""
-        task = asyncio.current_task()
-        if task is not None:
-            self._conn_tasks.add(task)
-            task.add_done_callback(self._conn_tasks.discard)
-        self.stats["streams_reversed_in"] = (
-            self.stats.get("streams_reversed_in", 0) + 1)
-        await self._serve_pipe(reader, writer,
-                               writer.get_extra_info("peername"))
+        await self._serve_inbound(reader, writer, "streams_reversed_in",
+                                  writer.get_extra_info("peername"))
+
+    async def serve_punched(self, reader: asyncio.StreamReader,
+                            writer: asyncio.StreamWriter) -> None:
+        """Serve one hole-punched connection (we are the punch TARGET):
+        the requester runs the client handshake over the punched pipe, so
+        this side serves it exactly like an accepted connection."""
+        await self._serve_inbound(reader, writer, "streams_punched_in",
+                                  writer.get_extra_info("peername"))
 
     async def serve_relayed(self, outer: Stream) -> None:
         """Serve one inbound stream arriving through a relay splice: run
         the server-side handshake and handler over the already-open pipe
         (the worker side of net/relay.py reverse connections)."""
-        task = asyncio.current_task()
-        if task is not None:
-            self._conn_tasks.add(task)
-            task.add_done_callback(self._conn_tasks.discard)
-        self.stats["streams_relayed_in"] = (
-            self.stats.get("streams_relayed_in", 0) + 1)
-        await self._serve_pipe(outer.reader, outer.writer, None)
+        await self._serve_inbound(outer.reader, outer.writer,
+                                  "streams_relayed_in", None)
 
     async def _serve_pipe(self, reader, writer, peername) -> None:
         """Server side of the handshake + handler dispatch over any byte
@@ -668,6 +907,7 @@ class Host:
                 reader=SecureReader(reader, c2s),
                 writer=SecureWriter(writer, s2c),
                 observed_ip=peername[0] if peername else "",
+                observed_port=peername[1] if peername else 0,
             )
             self.stats["streams_in"] += 1
             self.stats_by_protocol[proto] = (

@@ -32,10 +32,17 @@ DCUtR-style hole-punch fast path: when the DIALING side's own listen
 port is dialback-confirmed public, the relay forwards one signaling
 frame and the NATed worker dials the requester back directly — outbound
 TCP traverses the worker's NAT unaided, so the data path (inference
-streams, model pulls) never hairpins through the relay.  Only the
-both-sides-NATed case still splices; a TCP simultaneous-open punch for
-that case is deliberately out of scope (unportable timing games for the
-minority topology).
+streams, model pulls) never hairpins through the relay.
+
+For the BOTH-sides-NATed case (``punch`` + RelayClient._punch +
+host.punch_connect) the relay coordinates a TCP simultaneous open: it
+hands each side the other's socket-observed endpoint — the live NAT
+mapping of the socket involved — and both sides connect() to each other
+FROM those same local ports (SO_REUSEADDR/SO_REUSEPORT) until the SYNs
+cross.  Endpoint-independent-mapping ("cone") NAT pairs get a direct
+data path; symmetric NATs (per-destination mappings, unpredictable
+ports) still fall back to the splice — the same limit libp2p's hole
+punching has.
 """
 
 from __future__ import annotations
@@ -118,6 +125,8 @@ class RelayService:
                 await self._handle_connect_reverse(
                     stream, str(req.get("target", "")),
                     int(req.get("port", 0)), str(req.get("nonce", "")))
+            elif op == "punch":
+                await self._handle_punch(stream, str(req.get("target", "")))
             elif op == "accept":
                 await self._handle_accept(stream, str(req.get("conn_id", "")))
             elif op == "dialback":
@@ -226,6 +235,41 @@ class RelayService:
             await write_json_frame(reg.stream.writer, {
                 "op": "reverse", "addr": f"{ip}:{port}", "nonce": nonce})
         await write_json_frame(stream.writer, {"ok": True})
+
+    async def _handle_punch(self, stream: Stream, target: str) -> None:
+        """Hole-punch coordination (TCP simultaneous open) for the
+        both-sides-NATed case reversal cannot cover: hand each side the
+        OTHER's socket-observed endpoint.  Those observed endpoints ARE
+        the live NAT mappings of the sockets involved (requester: this
+        stream; target: its control stream), so each side redialing FROM
+        the same local port reuses its mapping on cone NATs.  The relay
+        carries two signaling frames — the punched data path never
+        touches it."""
+        reg = self._workers.get(target)
+        if reg is None:
+            await write_json_frame(
+                stream.writer,
+                {"ok": False, "error": f"peer {target[:8]} not relayed here"})
+            return
+        t_ip, t_port = reg.stream.observed_ip, reg.stream.observed_port
+        r_ip, r_port = stream.observed_ip, stream.observed_port
+        if not (t_ip and t_port and r_ip and r_port):
+            await write_json_frame(
+                stream.writer,
+                {"ok": False, "error": "observed endpoints unavailable"})
+            return
+        async with reg.lock:
+            await write_json_frame(reg.stream.writer, {
+                "op": "punch", "addr": f"{r_ip}:{r_port}"})
+        await write_json_frame(stream.writer,
+                               {"ok": True, "addr": f"{t_ip}:{t_port}"})
+        # Park until the requester closes: its NAT mapping for THIS
+        # socket is what the target is dialing — dropping our end early
+        # could expire it on aggressive NATs mid-punch.
+        try:
+            await read_json_frame(stream.reader, ACCEPT_TIMEOUT)
+        except Exception:
+            pass
 
     async def _handle_accept(self, stream: Stream, conn_id: str) -> None:
         fut = self._pending.pop(conn_id, None)
@@ -353,8 +397,11 @@ class RelayClient:
         while True:
             control: Stream | None = None
             try:
+                # reuse_sock: the control stream's local port is what punch
+                # dials rebind (host.new_stream docstring).
                 control = await self.host.new_stream(self.relay_addr,
-                                                     RELAY_PROTOCOL)
+                                                     RELAY_PROTOCOL,
+                                                     reuse_sock=True)
                 await write_json_frame(control.writer, {"op": "register"})
                 reply = await read_json_frame(control.reader, ACCEPT_TIMEOUT)
                 if not reply.get("ok"):
@@ -388,6 +435,20 @@ class RelayClient:
                             t = asyncio.create_task(
                                 self._reverse(str(frame.get("addr", "")),
                                               str(frame.get("nonce", ""))))
+                            self._accepts.add(t)
+                            t.add_done_callback(self._accepts.discard)
+                            t.add_done_callback(self._reverse_done)
+                        elif frame.get("op") == "punch":
+                            # Bounded like reverse dials: each punch is
+                            # outbound connect work to a relay-supplied
+                            # address.
+                            if self._reverse_dials >= MAX_REVERSE_DIALS:
+                                log.warning("punch cap reached; dropping")
+                                continue
+                            self._reverse_dials += 1
+                            t = asyncio.create_task(
+                                self._punch(str(frame.get("addr", "")),
+                                            control))
                             self._accepts.add(t)
                             t.add_done_callback(self._accepts.discard)
                             t.add_done_callback(self._reverse_done)
@@ -477,6 +538,33 @@ class RelayClient:
                 writer.close()
             except Exception:
                 pass
+
+
+    async def _punch(self, addr: str, control: Stream) -> None:
+        """Our half of a coordinated hole punch: listen+connect FROM the
+        control stream's local port (the NAT mapping the relay told the
+        requester about) toward the requester's observed endpoint.  The
+        requester runs the client handshake on the connection of ITS
+        choice, so this side SERVES every connection that establishes —
+        a crossed orphan never receives an opening frame and idles out."""
+        from crowdllama_tpu.net.host import punch_establish
+
+        rhost, _, port_s = addr.rpartition(":")
+        sockname = control.writer.get_extra_info("sockname")
+        if not rhost or not port_s.isdigit() or not sockname:
+            log.debug("punch signal with unusable addr %r", addr)
+            return
+
+        def on_est(reader, writer):
+            t = asyncio.create_task(self.host.serve_punched(reader, writer))
+            self._accepts.add(t)
+            t.add_done_callback(self._accepts.discard)
+
+        try:
+            await punch_establish(int(sockname[1]), rhost, int(port_s),
+                                  on_est)
+        except Exception as e:
+            log.debug("punch dial to %s failed: %s", addr, e)
 
 
 async def dialback_probe(host: Host, relay_addr: str) -> bool:

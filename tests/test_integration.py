@@ -494,15 +494,22 @@ async def test_pooled_inference_stream_reuse_and_stale_redial():
             # write succeeds, and the subsequent read fails — exactly the
             # worker-went-away shape the redial branch exists for (a
             # local transport abort would be caught by the pre-check and
-            # never exercise it).
+            # never exercise it).  pause_reading first: the worker's
+            # reply to the stale write would otherwise hit asyncio's
+            # feed_data-after-feed_eof assertion on the live transport.
+            severed = []
             for pool in list(gateway._stream_pool._pools.values()):
                 for st, _ts in pool:
+                    st.writer._w.transport.pause_reading()
                     st.reader._r.feed_eof()
+                    severed.append(st)
             async with s.post(url, json=body) as resp:
                 assert resp.status == 200
                 d = await resp.json()
                 assert d["done"] is True
             assert inference_streams_in() > in0, (
                 "the stale roundtrip must have redialed a fresh stream")
+            for st in severed:
+                st.writer._w.transport.abort()
     finally:
         await teardown()

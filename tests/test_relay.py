@@ -42,7 +42,10 @@ async def _wait_for(cond, timeout=20.0, what="condition"):
     raise AssertionError(f"timed out waiting for {what}")
 
 
-async def test_relay_reverse_stream_and_dialback():
+async def test_relay_reverse_stream_and_dialback(monkeypatch):
+    # Pin the relay-splice path: this test exercises it specifically,
+    # and the hole punch would otherwise win on loopback.
+    monkeypatch.setenv("CROWDLLAMA_TPU_NO_PUNCH", "1")
     """Protocol-level: register + connect splices an end-to-end
     authenticated stream; dialback reports loopback reachability."""
     relay_host = Host(Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
@@ -91,6 +94,9 @@ async def test_relay_reverse_stream_and_dialback():
 
 
 async def test_relayed_worker_serves_through_gateway(monkeypatch):
+    # Pin the relay-splice path: this test exercises it specifically,
+    # and the hole punch would otherwise win on loopback.
+    monkeypatch.setenv("CROWDLLAMA_TPU_NO_PUNCH", "1")
     """End-to-end VERDICT r3 done-criterion: a worker with an UNREACHABLE
     listen address still serves a gateway /api/chat request through the
     relay.  The worker binds to 127.0.0.1 but never advertises it
@@ -235,6 +241,9 @@ async def test_gateway_chat_rides_reversed_connections():
 
 
 async def test_reversal_falls_back_to_splice(monkeypatch):
+    # Pin the relay-splice path: this test exercises it specifically,
+    # and the hole punch would otherwise win on loopback.
+    monkeypatch.setenv("CROWDLLAMA_TPU_NO_PUNCH", "1")
     """A reversal that never arrives (worker can't dial back) must fall
     back to the relay splice inside the same new_stream call."""
     relay_host = Host(Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
@@ -430,7 +439,10 @@ async def test_relay_client_fails_over_to_candidate_relay():
             await h.close()
 
 
-async def test_worker_fails_over_to_peer_relay_and_serves():
+async def test_worker_fails_over_to_peer_relay_and_serves(monkeypatch):
+    # Pin the relay-splice path: this test exercises it specifically,
+    # and the hole punch would otherwise win on loopback.
+    monkeypatch.setenv("CROWDLLAMA_TPU_NO_PUNCH", "1")
     """Swarm-level failover: the bootstrap relay closes, and the NATed
     worker re-relays through a PUBLIC WORKER advertising relay_capable
     (candidates resolved from the peer table + DHT contacts), still
@@ -546,3 +558,89 @@ async def test_auto_worker_upgrades_to_direct(monkeypatch):
     finally:
         await worker.stop()
         await boot_host.close()
+
+
+async def test_hole_punch_direct_path():
+    """Both-sides-NATed shape (requester NOT reverse_dialable): the relay
+    coordinates a TCP simultaneous open and the data path goes direct —
+    no splice, one authenticated punched stream on each side."""
+    relay_host = Host(Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    await relay_host.start()
+    RelayService(relay_host)
+    relay_addr = f"127.0.0.1:{relay_host.listen_port}"
+
+    worker_host = Host(Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    await worker_host.start()
+
+    async def echo_handler(stream):
+        data = await stream.reader.readexactly(5)
+        stream.writer.write(data[::-1])
+        await stream.writer.drain()
+
+    worker_host.set_stream_handler("/test/echo", echo_handler)
+
+    client_host = Host(Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    await client_host.start()
+    assert not client_host.reverse_dialable  # both sides "NATed"
+
+    relay_client = RelayClient(worker_host, relay_addr)
+    try:
+        await relay_client.start()
+        target = Contact(peer_id=worker_host.peer_id, host="127.0.0.1",
+                         port=relay_host.listen_port, relay=True)
+        stream = await client_host.new_stream(target, "/test/echo",
+                                              timeout=10.0)
+        assert stream.remote_peer_id == worker_host.peer_id
+        stream.writer.write(b"hello")
+        await stream.writer.drain()
+        assert await stream.reader.readexactly(5) == b"olleh"
+        stream.close()
+        assert client_host.stats.get("streams_punched_out", 0) == 1
+        assert client_host.stats.get("streams_relayed_out", 0) == 0
+        assert worker_host.stats.get("streams_punched_in", 0) == 1
+        assert worker_host.stats.get("streams_relayed_in", 0) == 0
+    finally:
+        await relay_client.stop()
+        for h in (client_host, worker_host, relay_host):
+            await h.close()
+
+
+async def test_punch_falls_back_to_splice(monkeypatch):
+    """A punch whose far side never dials (symmetric NAT shape) must fall
+    back to the relay splice inside the same new_stream call."""
+    relay_host = Host(Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    await relay_host.start()
+    RelayService(relay_host)
+    relay_addr = f"127.0.0.1:{relay_host.listen_port}"
+
+    worker_host = Host(Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    await worker_host.start()
+
+    async def echo_handler(stream):
+        stream.writer.write(b"ok")
+        await stream.writer.drain()
+
+    worker_host.set_stream_handler("/test/echo", echo_handler)
+
+    client_host = Host(Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    await client_host.start()
+
+    # The worker never dials its half of the punch (e.g. symmetric NAT
+    # made the observed endpoint useless).
+    monkeypatch.setattr(RelayClient, "_punch",
+                        lambda self, addr, control: asyncio.sleep(0))
+    relay_client = RelayClient(worker_host, relay_addr)
+    try:
+        await relay_client.start()
+        target = Contact(peer_id=worker_host.peer_id, host="127.0.0.1",
+                         port=relay_host.listen_port, relay=True)
+        stream = await client_host.new_stream(target, "/test/echo",
+                                              timeout=15.0)
+        assert await stream.reader.readexactly(2) == b"ok"
+        stream.close()
+        assert client_host.stats.get("streams_relayed_out", 0) == 1
+        assert client_host.stats.get("streams_punched_out", 0) == 0
+    finally:
+        await relay_client.stop()
+        for h in (client_host, worker_host, relay_host):
+            await h.close()
