@@ -246,9 +246,8 @@ class JaxEngine(Engine):
                 # Multi-host pod-slice serving: wrap the runner so every
                 # device-touching call is broadcast to the follower
                 # processes before it dispatches (leader-replicated
-                # dispatch, parallel/replicated.py).  plan rejects spec
-                # under multi-host, so the wrapped surface is exactly the
-                # ModelRunner/PagedModelRunner one the frames cover.
+                # dispatch, parallel/replicated.py); the frames cover
+                # every runner surface the matrix serves, spec included.
                 from crowdllama_tpu.parallel.replicated import (
                     ReplicatedRunner,
                 )

@@ -52,19 +52,17 @@ def resolve_serving_plan(config, n_devices: int,
     spec = config.spec_decode
     dp, pp, sp, _ep, _tp = parse_mesh_spec(config.mesh_shape, n_devices)
 
-    if n_processes > 1:
-        # Multi-host leader-replicated serving (parallel/replicated.py)
-        # v2: the contiguous AND paged runners (incl. prefix cache,
-        # chunked prefill, embeddings) — the paged allocator is host-side
-        # and deterministic, so replaying the frame stream keeps every
-        # process's page tables bit-identical.  Speculative runners stay
-        # out: their packed [K, 2+J, B] emission layout and draft-model
-        # second param tree are not framed yet.
-        if spec:
-            raise ValueError(
-                "spec_decode does not compose with multi-host serving "
-                "yet (leader-replicated dispatch covers the plain and "
-                "paged runners only)")
+    # Multi-host (n_processes > 1) imposes NO extra composition rules
+    # since v2: leader-replicated dispatch (parallel/replicated.py)
+    # covers every runner the single-host matrix serves.  The paged
+    # allocator and the spec runners' host state (hist rows, per-slot
+    # prompt lengths, draft caches) are all derived from the framed op
+    # stream — insert carries the prompt + plen, pre_decode_check
+    # broadcasts its step count, and the packed [K, 2+J, B] emission
+    # block rides the same collective readback as plain tokens — and
+    # followers build bit-identical runners (draft params included,
+    # seeded init or checkpoint bytes) through engine/factory.py.
+    del n_processes
 
     if kv_layout == "paged" and (dp > 1 or pp > 1 or sp > 1):
         # The shared page pool cannot shard over dp (pages belong to no

@@ -62,9 +62,13 @@ REVERSE_FAIL_COOLDOWN = 60.0
 # ("cone") NAT pairs — the class connection reversal cannot cover because
 # reversal needs ONE side publicly dialable; symmetric NATs still splice
 # (port prediction is a lottery; libp2p falls back to relay there too).
-PUNCH_ATTEMPTS = 6
-PUNCH_CONNECT_TIMEOUT = 0.7
+PUNCH_ATTEMPTS = 4
+PUNCH_CONNECT_TIMEOUT = 0.5
 PUNCH_FAIL_COOLDOWN = 60.0
+# Hard cap on one whole punch attempt (signaling + listen/connect
+# dance): a peer whose punch can never land (symmetric NAT) must not
+# stall the caller much before the splice fallback starts.
+PUNCH_TOTAL_BUDGET = 3.5
 
 log = logging.getLogger("crowdllama.net.host")
 
@@ -507,12 +511,22 @@ class Host:
             host, port, expect_id = host or "127.0.0.1", int(port_s), None
 
         if reuse_sock:
-            sock = _reuse_socket(local_port, host)
+            # Resolve BEFORE picking the socket family: an IPv6-only
+            # hostname must get an AF_INET6 socket (the plain
+            # open_connection path handled this via happy eyeballs; the
+            # reuse path constrains the family at socket creation).
+            import socket as _socket
+
+            loop = asyncio.get_running_loop()
+            infos = await asyncio.wait_for(
+                loop.getaddrinfo(host, port, type=_socket.SOCK_STREAM),
+                timeout)
+            family, _t, _p, _cn, sockaddr = infos[0]
+            sock = _reuse_socket(
+                local_port, "::" if family == _socket.AF_INET6 else "")
             try:
                 await asyncio.wait_for(
-                    asyncio.get_running_loop().sock_connect(sock,
-                                                            (host, port)),
-                    timeout)
+                    loop.sock_connect(sock, sockaddr[:2]), timeout)
                 reader, writer = await asyncio.open_connection(sock=sock)
             except BaseException:
                 sock.close()
@@ -630,8 +644,12 @@ class Host:
         if (time.monotonic() - punch_failed_at > PUNCH_FAIL_COOLDOWN
                 and not os.environ.get("CROWDLLAMA_TPU_NO_PUNCH")):
             try:
-                stream = await self._new_stream_punched(target, protocol,
-                                                        timeout)
+                # Bounded: a never-landing punch (symmetric NAT) costs at
+                # most PUNCH_TOTAL_BUDGET before the splice fallback, and
+                # the per-peer cooldown amortizes it to once a minute.
+                stream = await asyncio.wait_for(
+                    self._new_stream_punched(target, protocol, timeout),
+                    min(PUNCH_TOTAL_BUDGET, timeout / 2))
                 self._punch_failed_at.pop(target.peer_id, None)
                 return stream
             except asyncio.CancelledError:

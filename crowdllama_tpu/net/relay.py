@@ -35,7 +35,7 @@ TCP traverses the worker's NAT unaided, so the data path (inference
 streams, model pulls) never hairpins through the relay.
 
 For the BOTH-sides-NATed case (``punch`` + RelayClient._punch +
-host.punch_connect) the relay coordinates a TCP simultaneous open: it
+host.punch_establish) the relay coordinates a TCP hole punch: it
 hands each side the other's socket-observed endpoint — the live NAT
 mapping of the socket involved — and both sides connect() to each other
 FROM those same local ports (SO_REUSEADDR/SO_REUSEPORT) until the SYNs

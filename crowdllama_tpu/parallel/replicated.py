@@ -25,15 +25,17 @@ frame stream symmetrically); that readback is synchronous, which gives
 up the single-host double-buffered chunk overlap — the documented v1
 cost of multi-host serving.
 
-v2 scope: the contiguous ModelRunner AND the PagedModelRunner — paged
-allocator state (free-page list, prefix-cache index, LRU ticks) is
-host-side and derived ONLY from the op stream, so replaying frames keeps
-every process's page tables bit-identical; pre_decode_check growth and
-the warmup ctx-prefill compile broadcast as their own ops, and batch
-embeddings ride one length-prefixed EMBED frame.  Speculative runners
-remain out (their packed emission layout is not framed).  The reference
-has no analog at any scope — its worker is always one host
-(/root/reference/pkg/peer/peer.go:42-68).
+Scope: EVERY runner the single-host matrix serves — contiguous, paged,
+and the speculative runners.  All replicated host state (the paged
+allocator's free-page list / prefix-cache index / LRU ticks, the spec
+runners' hist rows and per-slot prompt lengths, the draft model's
+cache) is derived ONLY from the op stream, so replaying frames keeps
+every process bit-identical: pre_decode_check growth and the warmup
+ctx-prefill compile broadcast as their own ops, batch embeddings ride
+one length-prefixed EMBED frame, and the spec runners' packed
+[K, 2+J, B] emission block rides the same collective readback as plain
+tokens.  The reference has no analog at any scope — its worker is
+always one host (/root/reference/pkg/peer/peer.go:42-68).
 """
 
 from __future__ import annotations
@@ -291,8 +293,9 @@ def run_follower(config) -> None:
 
     # The SAME plan/config/params derivation as the leader's engine, via
     # the shared factory (engine/factory.py) — the frame protocol depends
-    # on both sides building bit-identical runners (v2: contiguous or
-    # paged; plan rejects spec under multi-host).
+    # on both sides building bit-identical runners (contiguous, paged,
+    # or speculative; draft params come from the same seeded init or
+    # checkpoint bytes).
     plan = resolve_serving_plan(config, len(jax.devices()),
                                 n_processes=jax.process_count())
     cfg = resolve_clamped_model_config(config)

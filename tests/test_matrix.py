@@ -34,10 +34,8 @@ def expected(mesh_kind, layout, kv_dtype, spec):
     Returns ("ok"|"fallback", runner_name) or ("error", None).
     Weight quantization composes with every cell (not part of the oracle).
     """
-    if mesh_kind == "multihost-tp" and spec:
-        # Leader-replicated dispatch (v2) serves the plain and paged
-        # runners; the speculative packed layout is not framed.
-        return ("error", None)
+    # multihost-tp composes exactly like tp: leader-replicated dispatch
+    # frames every runner surface, spec included.
     sharded_kv = mesh_kind in ("dp", "pp", "sp")  # axes the pool can't use
     if spec == "draft" and (layout != "paged" or sharded_kv):
         return ("error", None)  # draft speculation is paged-only

@@ -597,7 +597,10 @@ async def test_hole_punch_direct_path():
         stream.close()
         assert client_host.stats.get("streams_punched_out", 0) == 1
         assert client_host.stats.get("streams_relayed_out", 0) == 0
-        assert worker_host.stats.get("streams_punched_in", 0) == 1
+        # >= 1: a crossed punch legitimately establishes one connection
+        # per direction, and the worker serves (and counts) both — the
+        # orphan idles out at the handshake timeout.
+        assert worker_host.stats.get("streams_punched_in", 0) >= 1
         assert worker_host.stats.get("streams_relayed_in", 0) == 0
     finally:
         await relay_client.stop()

@@ -351,6 +351,103 @@ _FOLLOWER_PAGED = _COMMON_PAGED + textwrap.dedent("""
 """)
 
 
+_COMMON_SPEC = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from crowdllama_tpu.config import Configuration
+    from crowdllama_tpu.parallel import multihost
+
+    cfg = Configuration(
+        dist_coordinator=sys.argv[1], dist_num_processes=2,
+        dist_process_id=int(sys.argv[2]),
+        model="tiny-test", max_batch_slots=2, max_context_length=128,
+        mesh_shape="1x2", decode_chunk=2,
+        kv_layout="paged", kv_page_size=32,
+        spec_decode=os.environ["SPEC_MODE"],
+        spec_draft_model=("tiny-test"
+                          if os.environ["SPEC_MODE"] == "draft" else ""),
+    )
+    assert multihost.initialize_from_config(cfg) is True
+""")
+
+_LEADER_SPEC = _COMMON_SPEC + textwrap.dedent("""
+    import asyncio
+    from crowdllama_tpu.engine.engine import JaxEngine
+
+    async def main():
+        eng = JaxEngine(cfg)
+        await eng.start()
+        try:
+            from crowdllama_tpu.engine.spec import SpecPagedModelRunner
+            assert isinstance(eng._runner.inner, SpecPagedModelRunner), \\
+                type(eng._runner.inner)  # DraftSpec subclasses it
+
+            async def one(prompt):
+                return "".join(
+                    [c.text async for c in eng.generate(
+                        prompt, max_tokens=8, temperature=0.0)])
+            # Repetitive prompt: the n-gram verifier accepts multi-token
+            # steps, and the packed [K, 2+J, B] block rides the
+            # collective readback to both processes.
+            a = await one("ababababab")
+            a2 = await one("ababababab")
+            assert a == a2 and len(a) > 0, (a, a2)
+            print("LEADER_SPEC_OK", flush=True)
+        finally:
+            await eng.stop()
+
+    asyncio.run(main())
+""")
+
+_FOLLOWER_SPEC = _COMMON_SPEC + textwrap.dedent("""
+    from crowdllama_tpu.parallel.replicated import run_follower
+
+    run_follower(cfg)
+    print("FOLLOWER_OK", flush=True)
+""")
+
+
+import pytest
+
+
+@pytest.mark.parametrize("mode", ["ngram", "draft"])
+def test_two_process_spec_engine_serving(tmp_path, mode):
+    """Speculative decode (paged) leader-replicated across two
+    processes: the spec runners' host state (hist rows, prompt lengths,
+    the draft model's cache) derives from the framed op stream, so
+    followers stay in lockstep through multi-token verify steps."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        coord = f"127.0.0.1:{s.getsockname()[1]}"
+    (tmp_path / "leader.py").write_text(_LEADER_SPEC)
+    (tmp_path / "follower.py").write_text(_FOLLOWER_SPEC)
+    env = {**os.environ, "PYTHONPATH": str(REPO), "SPEC_MODE": mode}
+    env.pop("JAX_PLATFORMS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(tmp_path / name), coord, str(i)],
+            cwd=REPO, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        for i, name in enumerate(("leader.py", "follower.py"))
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=480)
+            outs.append(out)
+    finally:
+        for p in procs:
+            p.kill()
+    assert procs[0].returncode == 0, f"leader:\n{outs[0][-4000:]}"
+    assert "LEADER_SPEC_OK" in outs[0], outs[0][-2000:]
+    assert procs[1].returncode == 0, f"follower:\n{outs[1][-4000:]}"
+    assert "FOLLOWER_OK" in outs[1], outs[1][-2000:]
+
+
 def test_two_process_paged_engine_serving(tmp_path):
     """Multi-host v2: the PRODUCTION-DEFAULT paged runner (prefix cache,
     page-table growth, embeddings) served leader-replicated on a tp mesh
