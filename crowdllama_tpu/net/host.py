@@ -515,22 +515,42 @@ class Host:
             # hostname must get an AF_INET6 socket (the plain
             # open_connection path handled this via happy eyeballs; the
             # reuse path constrains the family at socket creation).
+            # AI_ADDRCONFIG drops families this host has no address for,
+            # and every returned address is tried in order — all under
+            # ONE deadline, so this path's budget matches the plain one.
             import socket as _socket
 
             loop = asyncio.get_running_loop()
+            deadline = loop.time() + timeout
             infos = await asyncio.wait_for(
-                loop.getaddrinfo(host, port, type=_socket.SOCK_STREAM),
+                loop.getaddrinfo(
+                    host, port, type=_socket.SOCK_STREAM,
+                    flags=getattr(_socket, "AI_ADDRCONFIG", 0)),
                 timeout)
-            family, _t, _p, _cn, sockaddr = infos[0]
-            sock = _reuse_socket(
-                local_port, "::" if family == _socket.AF_INET6 else "")
-            try:
-                await asyncio.wait_for(
-                    loop.sock_connect(sock, sockaddr[:2]), timeout)
-                reader, writer = await asyncio.open_connection(sock=sock)
-            except BaseException:
-                sock.close()
-                raise
+            last_err: Exception | None = None
+            reader = writer = None
+            for family, _t, _p, _cn, sockaddr in infos:
+                if family not in (_socket.AF_INET, _socket.AF_INET6):
+                    continue
+                remaining = deadline - loop.time()
+                if remaining <= 0:
+                    break
+                sock = _reuse_socket(
+                    local_port, "::" if family == _socket.AF_INET6 else "")
+                try:
+                    await asyncio.wait_for(
+                        loop.sock_connect(sock, sockaddr[:2]), remaining)
+                    reader, writer = await asyncio.open_connection(sock=sock)
+                    break
+                except asyncio.CancelledError:
+                    sock.close()
+                    raise
+                except Exception as e:
+                    last_err = e
+                    sock.close()
+            if writer is None:
+                raise last_err or asyncio.TimeoutError(
+                    f"dial to {host}:{port} timed out")
         else:
             reader, writer = await asyncio.wait_for(
                 asyncio.open_connection(host, port), timeout
