@@ -15,7 +15,7 @@ holds the full scaling curve.
 
 Env overrides:
   CROWDLLAMA_BENCH_SIZES       comma list        (default "1,2,4,8,16")
-  CROWDLLAMA_BENCH_REQUESTS    requests per size (default 60)
+  CROWDLLAMA_BENCH_REQUESTS    requests per size (default 150)
   CROWDLLAMA_BENCH_CONCURRENCY in-flight cap     (default 8)
 """
 
@@ -47,7 +47,9 @@ async def run() -> dict:
 
     sizes = [int(x) for x in os.environ.get(
         "CROWDLLAMA_BENCH_SIZES", "1,2,4,8,16").split(",")]
-    n_requests = int(os.environ.get("CROWDLLAMA_BENCH_REQUESTS", "60"))
+    # 150: at ~1000 req/s the 60-request window was ~60 ms — too short
+    # for a stable per-size number on the 1-core host.
+    n_requests = int(os.environ.get("CROWDLLAMA_BENCH_REQUESTS", "150"))
     concurrency = int(os.environ.get("CROWDLLAMA_BENCH_CONCURRENCY", "8"))
     model = "bench-model"
 
