@@ -111,6 +111,16 @@ class Gateway:
         from crowdllama_tpu.net.host import StreamPool
 
         self._stream_pool = StreamPool(max_per_key=4)
+        # Prefix-affinity routing: multi-turn chats replay their history
+        # verbatim, so turn N shares its leading tokens with turn 1 — the
+        # engine's automatic prefix cache only pays if the continuation
+        # lands on the SAME worker.  Conversation fingerprint (model +
+        # first message head) -> (worker_id, ts); honored while the
+        # worker is healthy and not near-saturated, otherwise scoring
+        # wins (affinity is a tiebreak on top of manager.go:338-387's
+        # throughput/(1+load), never a replacement for health).
+        self._affinity: dict[str, tuple[str, float]] = {}
+        self._affinity_hits = 0
 
     # ----------------------------------------------------------- lifecycle
 
@@ -543,6 +553,18 @@ class Gateway:
             f"crowdllama_gateway_ttfb_seconds_sum {self._ttfb_sum:.6f}")
         lines.append(
             f"crowdllama_gateway_ttfb_seconds_count {self._ttfb_count}")
+        lines.append("# TYPE crowdllama_gateway_stream_pool_hits_total counter")
+        lines.append(
+            f"crowdllama_gateway_stream_pool_hits_total "
+            f"{self._stream_pool.hits}")
+        lines.append(
+            "# TYPE crowdllama_gateway_stream_pool_misses_total counter")
+        lines.append(
+            f"crowdllama_gateway_stream_pool_misses_total "
+            f"{self._stream_pool.misses}")
+        lines.append("# TYPE crowdllama_gateway_affinity_hits_total counter")
+        lines.append(
+            f"crowdllama_gateway_affinity_hits_total {self._affinity_hits}")
         lines.append("# TYPE crowdllama_host_streams_total counter")
         for k, v in sorted(self.peer.host.stats.items()):
             # Only the stream-kind counters belong under this metric;
@@ -726,6 +748,72 @@ class Gateway:
 
     # ------------------------------------------------------------- routing
 
+    # --------------------------------------------------- prefix affinity
+
+    _AFFINITY_TTL_S = 600.0  # engine prefix pages churn on LRU anyway
+    _AFFINITY_MAX = 4096
+    _AFFINITY_LOAD_CAP = 0.9
+
+    @staticmethod
+    def _affinity_key(model: str, messages, prompt: str):
+        """Conversation fingerprint + whether this request is a
+        CONTINUATION.
+
+        The key hashes model + first message head + FIRST USER message
+        head: a shared system prompt alone must not collapse every
+        distinct conversation (and the scaling benchmark's identical
+        single-message requests) onto one worker — different users of the
+        same app differ in their first user turn, which every later turn
+        of that conversation replays verbatim.  Affinity is only APPLIED
+        to continuations (a second non-system turn exists): turn 1 has no
+        cached prefix to reuse, so it routes by scoring and merely
+        records where the conversation landed."""
+        import hashlib
+
+        if messages:
+            m0 = messages[0]
+            head = f"{m0.get('role', '')}:{str(m0.get('content', ''))[:256]}"
+            users = [m for m in messages
+                     if m.get("role", "") != "system"]
+            if users:
+                head += f"|u0:{str(users[0].get('content', ''))[:256]}"
+            continuation = len(users) >= 2
+        else:
+            head = prompt[:256]
+            continuation = False  # /api/generate carries no turn structure
+        if not head:
+            return None, False
+        return (hashlib.sha1(f"{model}|{head}".encode()).hexdigest(),
+                continuation)
+
+    def _affinity_get(self, akey: str | None, model: str):
+        """The remembered worker for this conversation, if it is still a
+        routable (healthy, complete-group leader), non-saturated server
+        of ``model``."""
+        if akey is None:
+            return None
+        entry = self._affinity.get(akey)
+        if entry is None or time.monotonic() - entry[1] > self._AFFINITY_TTL_S:
+            self._affinity.pop(akey, None)
+            return None
+        pm = self.peer.peer_manager
+        cand = pm.is_routable(entry[0], model) if pm is not None else None
+        if (cand is not None
+                and getattr(cand.resource, "load", 0.0)
+                < self._AFFINITY_LOAD_CAP):
+            return cand
+        return None
+
+    def _affinity_put(self, akey: str | None, worker_id: str) -> None:
+        if akey is None:
+            return
+        if len(self._affinity) >= self._AFFINITY_MAX:
+            # Drop the older half (insertion-ordered enough: entries are
+            # re-put on every successful request).
+            items = sorted(self._affinity.items(), key=lambda kv: kv[1][1])
+            self._affinity = dict(items[self._AFFINITY_MAX // 2:])
+        self._affinity[akey] = (worker_id, time.monotonic())
+
     async def _route(self, request, model, stream, options,
                      messages=None, prompt="",
                      shape="chat") -> web.StreamResponse:
@@ -754,19 +842,32 @@ class Gateway:
                 options.get("repeat_penalty", 1.0) or 1.0)),
         )
         t0 = time.monotonic()  # TTFB measures from ADMISSION, retries included
+        akey, continuation = self._affinity_key(model, messages, prompt)
         tried: set[str] = set()
         last_err = "no workers available for model"
         for _attempt in range(2):  # retry once on next-best worker
-            worker = self._find_worker(model, exclude=tried)
+            worker = None
+            affine = (self._affinity_get(akey, model)
+                      if continuation else None)
+            if affine is not None and affine.peer_id not in tried:
+                worker = affine
+                self._affinity_hits += 1
+            if worker is None:
+                worker = self._find_worker(model, exclude=tried)
             if worker is None:
                 break
             tried.add(worker.peer_id)
             try:
-                return await self._forward(request, worker.peer_id, msg,
+                resp = await self._forward(request, worker.peer_id, msg,
                                            stream, shape, t0)
+                self._affinity_put(akey, worker.peer_id)
+                return resp
             except _StreamStarted as e:
                 # Headers/chunks already went out: no retry, no second
                 # response — the error frame was already written downstream.
+                # The prefill still populated this worker's prefix cache,
+                # so the affinity record stays useful.
+                self._affinity_put(akey, worker.peer_id)
                 log.warning("stream to client aborted mid-flight: %s", e.cause)
                 return e.response
             except Exception as e:

@@ -159,6 +159,29 @@ class PeerManager:
 
     # ------------------------------------------------------------ scheduler
 
+    def is_routable(self, peer_id: str, model: str,
+                    _groups: set | None = None) -> "PeerInfo | None":
+        """The PeerInfo for ``peer_id`` iff requests for ``model`` may be
+        sent to it RIGHT NOW — the same predicate find_best_worker scores
+        over (healthy worker, serves the model, complete shard group,
+        group leader).  Used by affinity-style callers that want to pin a
+        specific worker without bypassing routability.  ``_groups`` lets
+        the scoring loop precompute the complete-group set once."""
+        p = self.peers.get(peer_id)
+        if p is None or not p.is_healthy or not p.is_worker:
+            return None
+        r = p.resource
+        if model and model not in r.supported_models:
+            return None
+        if r.shard_group is not None:
+            groups = (_groups if _groups is not None
+                      else self._complete_groups(model))
+            if r.shard_group.group_id not in groups:
+                return None
+            if r.shard_group.shard_index != 0:
+                return None
+        return p
+
     def find_best_worker(
         self, model: str, exclude: set[str] = frozenset(),
         require_embeddings: bool = False,
@@ -170,18 +193,13 @@ class PeerManager:
         groups = self._complete_groups(model)
         best, best_score = [], -1.0
         for p in self.get_healthy_peers():
-            if not p.is_worker or p.peer_id in exclude:
+            if p.peer_id in exclude:
+                continue
+            if self.is_routable(p.peer_id, model, _groups=groups) is None:
                 continue
             r = p.resource
-            if model and model not in r.supported_models:
-                continue
             if require_embeddings and not r.embeddings:
                 continue
-            if r.shard_group is not None:
-                if r.shard_group.group_id not in groups:
-                    continue
-                if r.shard_group.shard_index != 0:
-                    continue  # group leader routes for the whole group
             score = r.tokens_throughput / (1.0 + max(r.load, 0.0))
             if score > best_score:
                 best, best_score = [p], score

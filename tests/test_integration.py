@@ -513,3 +513,68 @@ async def test_pooled_inference_stream_reuse_and_stale_redial():
                 st.writer._w.transport.abort()
     finally:
         await teardown()
+
+
+async def test_prefix_affinity_routes_conversation_to_same_worker():
+    """Multi-turn conversations (same leading message, growing tail) must
+    land on ONE worker so its prefix cache pays; a dead affinity worker
+    falls back to scoring."""
+    boot_host, _ = await new_host_and_dht(
+        Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    bootstrap = f"127.0.0.1:{boot_host.listen_port}"
+    workers = [Peer(Ed25519PrivateKey.generate(), _cfg(bootstrap),
+                    engine=FakeEngine(models=["tiny-test"]),
+                    worker_mode=True) for _ in range(2)]
+    for w in workers:
+        await w.start()
+    consumer = Peer(Ed25519PrivateKey.generate(), _cfg(bootstrap),
+                    engine=FakeEngine(models=[]), worker_mode=False)
+    await consumer.start()
+    gateway = Gateway(consumer, port=0, host="127.0.0.1")
+    await gateway.start()
+    gw_port = gateway._runner.addresses[0][1]
+    try:
+        await _wait_for(
+            lambda: len({p.peer_id for p in
+                         consumer.peer_manager.get_healthy_peers()
+                         if p.is_worker}) == 2,
+            what="both workers discovered")
+
+        def body(turn: int) -> dict:
+            msgs = [{"role": "system", "content": "You are a helpful bot."}]
+            for t in range(turn + 1):
+                msgs.append({"role": "user", "content": f"question {t}"})
+            return {"model": "tiny-test", "messages": msgs, "stream": False}
+
+        async with aiohttp.ClientSession() as s:
+            url = f"http://127.0.0.1:{gw_port}/api/chat"
+            hit: list[str] = []
+            for turn in range(6):
+                async with s.post(url, json=body(turn)) as resp:
+                    assert resp.status == 200
+                    hit.append((await resp.json())["worker_id"])
+            assert len(set(hit)) == 1, (
+                f"conversation turns scattered across workers: {hit}")
+            assert gateway._affinity_hits >= 5
+
+            # The affinity worker dies: the conversation fails over.
+            dead = hit[0]
+            for w in workers:
+                if w.peer_id == dead:
+                    await w.stop()
+            await _wait_for(
+                lambda: all(p.peer_id != dead for p in
+                            consumer.peer_manager.get_healthy_peers()),
+                timeout=40.0, what="dead worker evicted")
+            async with s.post(url, json=body(6)) as resp:
+                assert resp.status == 200
+                assert (await resp.json())["worker_id"] != dead
+    finally:
+        await gateway.stop()
+        await consumer.stop()
+        for w in workers:
+            try:
+                await w.stop()
+            except Exception:
+                pass
+        await boot_host.close()
