@@ -26,6 +26,9 @@ Phases (CROWDLLAMA_BENCH_PHASES to select, comma-separated):
             (benchmarks/ttft.py as a subprocess)
   swarm     swarm scaling 1->16 FakeEngine workers
             (benchmarks/swarm_scaling.py as a subprocess, CPU)
+  ep_dispatch  cross-worker expert-parallel decode through a 2-bank MoE
+            group on real loopback streams — the per-MoE-layer dispatch
+            hop price (BASELINE config 4; subprocess, CPU)
 
 The reference publishes no measured numbers (SURVEY §6); the only
 throughput figure in its tree is the hardcoded 150 tokens/sec a worker
@@ -90,7 +93,8 @@ PARTIAL_PATH = Path(__file__).resolve().parent / "BENCH_partial.jsonl"
 # ~3 min of on-chip param init alone).
 _ALL_PHASES = ("kernel", "decode", "decode_paged", "decode8b",
                "decode8b_paged", "decode8b_ctx4k", "ttft", "swarm",
-               "decode_spec", "decode_kv8", "decode8b_int4")
+               "ep_dispatch", "decode_spec", "decode_kv8",
+               "decode8b_int4")
 
 # Phases meaningless on the CPU fallback (real-size or quantized decode).
 _TPU_ONLY_PHASES = frozenset(
@@ -823,6 +827,12 @@ def _swarm_phase() -> dict:
     return _subprocess_phase("swarm_scaling.py", {"JAX_PLATFORMS": "cpu"})
 
 
+def _ep_dispatch_phase() -> dict:
+    # Control-plane metric (the per-MoE-layer DCN hop price): CPU by
+    # design, like swarm.
+    return _subprocess_phase("ep_dispatch.py", {"JAX_PLATFORMS": "cpu"})
+
+
 # ------------------------------------------------------------------- main
 
 
@@ -891,6 +901,7 @@ def main() -> None:
         "kernel": _kernel_parity_phase,
         "ttft": _ttft_phase,
         "swarm": _swarm_phase,
+        "ep_dispatch": _ep_dispatch_phase,
     }
 
     remaining = [p for p in phases if p in runners]

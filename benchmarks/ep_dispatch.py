@@ -1,0 +1,157 @@
+"""Cross-worker expert-parallel dispatch benchmark (BASELINE config 4).
+
+A 2-member MoE expert group on real loopback streams: the leader runs
+attention/router and dispatches per-layer (token, expert) batches to a
+remote expert bank over SHARD_PROTOCOL — one DCN round trip per MoE
+layer per decode step, the intrinsic cost of cross-worker EP.  This
+measures the CONTROL-PLANE price of that hop (framing, AEAD, asyncio)
+with a tiny model so compute does not mask it; the dominant term on a
+real deployment is the same per-layer round trip over real DCN RTTs.
+
+Prints ONE JSON line; value is decode steps/sec through the 2-worker
+pipeline, extra carries per-step latency and the single-worker (local
+banks only) comparison.
+
+Env overrides:
+  CROWDLLAMA_BENCH_EP_STEPS   timed decode steps (default 64)
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+import _common  # noqa: F401,E402 - repo path + JAX platform bootstrap
+
+import asyncio
+import json
+import os
+import time
+
+
+async def run() -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+    )
+
+    from crowdllama_tpu.core.protocol import SHARD_PROTOCOL
+    from crowdllama_tpu.engine.expert_service import (
+        EPLeaderRunner,
+        EPPipeline,
+        ExpertBankRunner,
+        ExpertBankService,
+        LocalExpertBank,
+        RemoteExpertBank,
+        assign_experts,
+    )
+    from crowdllama_tpu.models import transformer as T
+    from crowdllama_tpu.models.config import get_config
+    from crowdllama_tpu.net.host import Host
+
+    steps = int(os.environ.get("CROWDLLAMA_BENCH_EP_STEPS", "64"))
+    cfg = get_config("tiny-test-moe", max_context_length=256)
+    params = T.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+
+    async def decode_run(pipe, sid: str) -> tuple[float, list[float]]:
+        logits = await pipe.prefill(sid, prompt, bucket=16)
+        tok = int(np.argmax(logits))
+        n = len(prompt)
+        # Warmup (compile) steps, then timed.
+        for _ in range(4):
+            logits = await pipe.decode(sid, tok, n, n + 1)
+            tok = int(np.argmax(logits))
+            n += 1
+        lat: list[float] = []
+        t0 = time.monotonic()
+        for _ in range(steps):
+            t1 = time.monotonic()
+            logits = await pipe.decode(sid, tok, n, n + 1)
+            tok = int(np.argmax(logits))
+            n += 1
+            lat.append((time.monotonic() - t1) * 1000)
+        dt = time.monotonic() - t0
+        await pipe.release(sid)
+        return dt, lat
+
+    # Cross-worker: remote bank behind a REAL authenticated stream.
+    remote_runner = ExpertBankRunner(cfg, params, assign_experts(4, 2, 1),
+                                     dtype=jnp.float32)
+    worker_host = Host(Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    worker_host.set_stream_handler(
+        SHARD_PROTOCOL, ExpertBankService(remote_runner).handle)
+    await worker_host.start()
+    leader_host = Host(Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    await leader_host.start()
+    pipe = None
+    try:
+        stream = await leader_host.new_stream(worker_host.contact,
+                                              SHARD_PROTOCOL)
+        leader = EPLeaderRunner(cfg, params, max_seq=256, dtype=jnp.float32)
+        local = LocalExpertBank(
+            ExpertBankRunner(cfg, params, assign_experts(4, 2, 0),
+                             dtype=jnp.float32))
+        pipe = EPPipeline(cfg, leader, [
+            local, RemoteExpertBank(stream, remote_runner.expert_ids)])
+        dt, lat = await decode_run(pipe, "bench-ep")
+    finally:
+        if pipe is not None:
+            pipe.close()
+        await leader_host.close()
+        await worker_host.close()
+
+    # Single-worker comparison: both banks local (no DCN hop) — the
+    # delta per step IS the cross-worker dispatch price.
+    leader2 = EPLeaderRunner(cfg, params, max_seq=256, dtype=jnp.float32)
+    pipe2 = EPPipeline(cfg, leader2, [
+        LocalExpertBank(ExpertBankRunner(cfg, params,
+                                         assign_experts(4, 2, 0),
+                                         dtype=jnp.float32)),
+        LocalExpertBank(ExpertBankRunner(cfg, params,
+                                         assign_experts(4, 2, 1),
+                                         dtype=jnp.float32)),
+    ])
+    try:
+        dt_local, lat_local = await decode_run(pipe2, "bench-ep-local")
+    finally:
+        pipe2.close()
+
+    lat.sort()
+    lat_local.sort()
+    p50 = lat[len(lat) // 2]
+    p50_local = lat_local[len(lat_local) // 2]
+    n_moe = cfg.num_layers  # every tiny-test-moe layer is MoE
+    return {
+        "metric": "cross-worker EP decode (2 expert banks over loopback "
+                  "streams), steps/sec",
+        "value": round(steps / dt, 1),
+        "unit": "steps/sec",
+        "vs_baseline": None,  # the reference has no model parallelism
+        "extra": {
+            "step_p50_ms": round(p50, 2),
+            "local_only_step_p50_ms": round(p50_local, 2),
+            "dispatch_overhead_ms_per_step": round(p50 - p50_local, 2),
+            "moe_layers_per_step": n_moe,
+            "dispatch_overhead_ms_per_layer_hop": round(
+                (p50 - p50_local) / max(1, n_moe), 3),
+            "timed_steps": steps,
+            "model": cfg.name,
+            "note": "loopback RTT; a real deployment adds its DCN RTT "
+                    "per MoE layer per step on top of this floor",
+        },
+    }
+
+
+def main() -> None:
+    os.environ.setdefault("CROWDLLAMA_TPU_TEST_MODE", "1")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    result = asyncio.run(run())
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -779,8 +779,10 @@ class Gateway:
                 head += f"|u0:{str(users[0].get('content', ''))[:256]}"
             continuation = len(users) >= 2
         else:
-            head = prompt[:256]
-            continuation = False  # /api/generate carries no turn structure
+            # /api/generate carries no turn structure: a key here would be
+            # write-only (never consulted) and its churn would evict live
+            # chat conversations from the bounded map.
+            return None, False
         if not head:
             return None, False
         return (hashlib.sha1(f"{model}|{head}".encode()).hexdigest(),
@@ -847,11 +849,12 @@ class Gateway:
         last_err = "no workers available for model"
         for _attempt in range(2):  # retry once on next-best worker
             worker = None
+            used_affinity = False
             affine = (self._affinity_get(akey, model)
                       if continuation else None)
             if affine is not None and affine.peer_id not in tried:
                 worker = affine
-                self._affinity_hits += 1
+                used_affinity = True
             if worker is None:
                 worker = self._find_worker(model, exclude=tried)
             if worker is None:
@@ -861,6 +864,11 @@ class Gateway:
                 resp = await self._forward(request, worker.peer_id, msg,
                                            stream, shape, t0)
                 self._affinity_put(akey, worker.peer_id)
+                if used_affinity:
+                    # Counted only when the pinned route actually served:
+                    # a failed forward falls back to scoring and must not
+                    # inflate the hit counter.
+                    self._affinity_hits += 1
                 return resp
             except _StreamStarted as e:
                 # Headers/chunks already went out: no retry, no second
@@ -868,6 +876,8 @@ class Gateway:
                 # The prefill still populated this worker's prefix cache,
                 # so the affinity record stays useful.
                 self._affinity_put(akey, worker.peer_id)
+                if used_affinity:
+                    self._affinity_hits += 1
                 log.warning("stream to client aborted mid-flight: %s", e.cause)
                 return e.response
             except Exception as e:
