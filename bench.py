@@ -29,6 +29,8 @@ Phases (CROWDLLAMA_BENCH_PHASES to select, comma-separated):
   ep_dispatch  cross-worker expert-parallel decode through a 2-bank MoE
             group on real loopback streams — the per-MoE-layer dispatch
             hop price (BASELINE config 4; subprocess, CPU)
+  capacity  static params+KV HBM accounting per registry model against
+            the attached chip (largest-servable report; subprocess)
 
 The reference publishes no measured numbers (SURVEY §6); the only
 throughput figure in its tree is the hardcoded 150 tokens/sec a worker
@@ -93,7 +95,7 @@ PARTIAL_PATH = Path(__file__).resolve().parent / "BENCH_partial.jsonl"
 # ~3 min of on-chip param init alone).
 _ALL_PHASES = ("kernel", "decode", "decode_paged", "decode8b",
                "decode8b_paged", "decode8b_ctx4k", "ttft", "swarm",
-               "ep_dispatch", "decode_spec", "decode_kv8",
+               "ep_dispatch", "capacity", "decode_spec", "decode_kv8",
                "decode8b_int4")
 
 # Phases meaningless on the CPU fallback (real-size or quantized decode).
@@ -833,6 +835,13 @@ def _ep_dispatch_phase() -> dict:
     return _subprocess_phase("ep_dispatch.py", {"JAX_PLATFORMS": "cpu"})
 
 
+def _capacity_phase() -> dict:
+    # Static HBM accounting per registry model (BASELINE config 2/3
+    # feasibility); reads the attached chip's HBM, assumes one v5e on
+    # the CPU fallback.
+    return _subprocess_phase("capacity.py", {})
+
+
 # ------------------------------------------------------------------- main
 
 
@@ -902,6 +911,7 @@ def main() -> None:
         "ttft": _ttft_phase,
         "swarm": _swarm_phase,
         "ep_dispatch": _ep_dispatch_phase,
+        "capacity": _capacity_phase,
     }
 
     remaining = [p for p in phases if p in runners]
