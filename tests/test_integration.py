@@ -421,6 +421,13 @@ async def test_metrics_endpoint():
         assert "crowdllama_gateway_request_seconds_total{" in text
         assert "crowdllama_gateway_ttfb_seconds_count 1" in text
         assert 'crowdllama_gateway_ttfb_seconds_bucket{le="+Inf"} 1' in text
+        # Round-5 series: stream-pool reuse, affinity, per-path host
+        # counters, and the rejected counter split out of streams_total.
+        assert "crowdllama_gateway_stream_pool_hits_total" in text
+        assert "crowdllama_gateway_stream_pool_misses_total" in text
+        assert "crowdllama_gateway_affinity_hits_total" in text
+        assert "crowdllama_host_rejected_total" in text
+        assert 'crowdllama_host_streams_total{kind="rejected"}' not in text
     finally:
         await teardown()
 
