@@ -1,0 +1,96 @@
+"""Unit coverage for bench.py's tunnel-resilience machinery (VERDICT r4
+#1): the platform manager's fallback/re-probe bookkeeping, skip-metric
+naming, and the session-artifact provenance helper.  The live phase
+behavior is exercised by running ``python bench.py`` end to end; these
+tests pin the pieces a refactor could silently break."""
+
+import hashlib
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import bench  # noqa: E402
+
+
+def test_platform_startup_falls_back_and_counts_probes(monkeypatch):
+    plat = bench._Platform()
+    plat.want_tpu = True  # conftest pins cpu; simulate a TPU-intent run
+    monkeypatch.setattr(
+        bench._Platform, "_subprocess_probe",
+        staticmethod(lambda timeout_s: (False, "tunnel down")))
+    devices = plat.startup_wait(0.1)
+    assert devices and plat.on_cpu_fallback is True
+    assert plat.probe_attempts >= 1
+
+
+def test_platform_reprobe_failure_logs_evidence(monkeypatch):
+    plat = bench._Platform()
+    plat.want_tpu = True
+    plat.on_cpu_fallback = True
+    monkeypatch.setattr(
+        bench._Platform, "_subprocess_probe",
+        staticmethod(lambda timeout_s: (False, "still down")))
+    before = plat.probe_attempts
+    assert plat.reprobe(0.1) is False
+    assert plat.probe_attempts == before + 1
+    assert plat.probe_log and "still down" in plat.probe_log[-1]
+    # Not wanting TPU at all short-circuits without probing.
+    plat2 = bench._Platform()
+    plat2.want_tpu = False
+    assert plat2.reprobe(0.1) is False
+    assert plat2.probe_attempts == 0
+
+
+def test_skip_metric_matches_real_phase_names(monkeypatch):
+    """Skip markers must carry the SAME metric string a real run emits,
+    or artifact consumers cannot correlate the series across runs."""
+    monkeypatch.delenv("CROWDLLAMA_BENCH_MODEL", raising=False)
+    assert bench._skip_metric("decode8b") == "llama-3-8b decode throughput"
+    assert bench._skip_metric("decode_kv8") == (
+        "tinyllama-1.1b (int8 KV) decode throughput")
+    monkeypatch.setenv("CROWDLLAMA_BENCH_MODEL", "gemma-2-9b")
+    assert bench._skip_metric("decode_kv8") == (
+        "gemma-2-9b (int8 KV) decode throughput")
+    # Unknown phases fall through to their own name.
+    assert bench._skip_metric("mystery") == "mystery"
+
+
+def test_latest_session_artifact_provenance():
+    art = bench._latest_session_artifact()
+    results = sorted((REPO / "benchmarks" / "results").glob(
+        "BENCH_tpu_*.jsonl"))
+    if not results:
+        assert art is None
+        return
+    assert art is not None
+    newest = results[-1]
+    assert art["path"] == str(newest.relative_to(REPO))
+    assert art["sha256"] == hashlib.sha256(newest.read_bytes()).hexdigest()
+    assert art["lines"] == newest.read_bytes().count(b"\n")
+
+
+def test_tpu_window_priority_orders_kernel_and_baseline_first():
+    """The mid-run tunnel-window sort must put kernel parity ahead of the
+    8B phases (the kernel-gate invariant) and all TPU-only BASELINE
+    phases ahead of unknown/CPU phases."""
+    remaining = ["decode_spec", "decode8b_int4", "decode8b", "kernel",
+                 "swarm", "decode8b_paged"]
+    remaining.sort(key=lambda p: bench._TPU_WINDOW_PRIORITY.get(p, 50))
+    assert remaining[0] == "kernel"
+    assert remaining[1] == "decode8b"
+    assert remaining[2] == "decode8b_paged"
+    assert set(remaining[-2:]) == {"decode_spec", "swarm"}
+
+
+def test_all_phases_have_runners_and_skip_names():
+    """Every TPU-only phase must be in the phase list with a real
+    skip-metric name (not the bare phase id), and every prioritized
+    phase must exist — a rename that misses one map would silently drop
+    a scoreboard phase."""
+    for phase in bench._TPU_ONLY_PHASES:
+        assert phase in bench._ALL_PHASES
+        assert bench._skip_metric(phase) != phase
+    for phase in bench._TPU_WINDOW_PRIORITY:
+        assert phase in bench._ALL_PHASES
