@@ -8,12 +8,21 @@ measures the CONTROL-PLANE price of that hop (framing, AEAD, asyncio)
 with a tiny model so compute does not mask it; the dominant term on a
 real deployment is the same per-layer round trip over real DCN RTTs.
 
+Loopback RTT is ~0, which understates a real deployment, so the bench
+also SWEEPS injected RTT: a transparent TCP delay relay sits between
+leader and expert bank and delivers each chunk one-way-delay late
+(injected RTT = 2x the one-way delay).  The sweep reports steps/sec vs
+RTT and the break-even RTT against the local-only pipeline — the
+injected RTT at which dispatch overhead equals the whole local-only
+step cost (i.e. cross-worker EP halves decode throughput).
+
 Prints ONE JSON line; value is decode steps/sec through the 2-worker
-pipeline, extra carries per-step latency and the single-worker (local
-banks only) comparison.
+pipeline at RTT 0, extra carries the RTT sweep, per-step latency and
+the single-worker (local banks only) comparison.
 
 Env overrides:
-  CROWDLLAMA_BENCH_EP_STEPS   timed decode steps (default 64)
+  CROWDLLAMA_BENCH_EP_STEPS   timed decode steps per point (default 64)
+  CROWDLLAMA_BENCH_EP_RTTS    injected RTT sweep, ms (default "0,1,5,10,20")
 """
 
 from __future__ import annotations
@@ -28,15 +37,98 @@ import asyncio
 import json
 import os
 import time
+from dataclasses import replace
+
+
+class DelayProxy:
+    """Transparent TCP relay that delivers every chunk ``delay_s`` after it
+    was read, per direction (injected RTT = 2 * delay_s per round trip).
+
+    Delivery is timestamp-scheduled (reader task enqueues, writer task
+    sleeps until due), so reads never stall behind the sleep: a multi-chunk
+    message pays the delay ONCE, not once per chunk."""
+
+    def __init__(self, target_port: int, delay_s: float):
+        self._target = target_port
+        self._delay = delay_s
+        self._server: asyncio.base_events.Server | None = None
+        self._tasks: set[asyncio.Task] = set()
+
+    async def start(self) -> int:
+        self._server = await asyncio.start_server(
+            self._on_conn, "127.0.0.1", 0)
+        return self._server.sockets[0].getsockname()[1]
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        for t in list(self._tasks):
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+
+    def _track(self, coro) -> None:
+        t = asyncio.create_task(coro)
+        self._tasks.add(t)
+        t.add_done_callback(self._tasks.discard)
+
+    async def _on_conn(self, reader, writer):
+        try:
+            up_r, up_w = await asyncio.open_connection(
+                "127.0.0.1", self._target)
+        except OSError:
+            writer.close()
+            return
+        self._track(self._pump(reader, up_w))
+        self._track(self._pump(up_r, writer))
+
+    async def _pump(self, reader, writer):
+        loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue()
+
+        async def drain_delayed():
+            while True:
+                item = await q.get()
+                if item is None:
+                    break
+                due, data = item
+                dt = due - loop.time()
+                if dt > 0:
+                    await asyncio.sleep(dt)
+                try:
+                    writer.write(data)
+                    await writer.drain()
+                except (ConnectionError, OSError):
+                    return
+            try:
+                if writer.can_write_eof():
+                    writer.write_eof()  # propagate half-close
+            except (ConnectionError, OSError):
+                pass
+
+        w = asyncio.create_task(drain_delayed())
+        try:
+            while True:
+                chunk = await reader.read(65536)
+                if not chunk:
+                    break
+                q.put_nowait((loop.time() + self._delay, chunk))
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            q.put_nowait(None)
+            try:
+                await w
+            except asyncio.CancelledError:
+                w.cancel()
+                raise
 
 
 async def run() -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
-    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-        Ed25519PrivateKey,
-    )
+    from crowdllama_tpu.utils.crypto_compat import Ed25519PrivateKey
 
     from crowdllama_tpu.core.protocol import SHARD_PROTOCOL
     from crowdllama_tpu.engine.expert_service import (
@@ -53,6 +145,8 @@ async def run() -> dict:
     from crowdllama_tpu.net.host import Host
 
     steps = int(os.environ.get("CROWDLLAMA_BENCH_EP_STEPS", "64"))
+    rtts = [float(x) for x in os.environ.get(
+        "CROWDLLAMA_BENCH_EP_RTTS", "0,1,5,10,20").split(",") if x.strip()]
     cfg = get_config("tiny-test-moe", max_context_length=256)
     params = T.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
     prompt = [3, 1, 4, 1, 5, 9, 2, 6]
@@ -78,7 +172,11 @@ async def run() -> dict:
         await pipe.release(sid)
         return dt, lat
 
-    # Cross-worker: remote bank behind a REAL authenticated stream.
+    # Cross-worker: remote bank behind a REAL authenticated stream, once
+    # per injected RTT.  Leader runner, local bank, hosts and the remote
+    # bank runner are shared across sweep points (compiled fns are reused,
+    # so only the first point pays XLA compilation); each point dials a
+    # fresh stream — through a DelayProxy when rtt > 0.
     remote_runner = ExpertBankRunner(cfg, params, assign_experts(4, 2, 1),
                                      dtype=jnp.float32)
     worker_host = Host(Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
@@ -87,20 +185,45 @@ async def run() -> dict:
     await worker_host.start()
     leader_host = Host(Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
     await leader_host.start()
-    pipe = None
+    leader = EPLeaderRunner(cfg, params, max_seq=256, dtype=jnp.float32)
+    local = LocalExpertBank(
+        ExpertBankRunner(cfg, params, assign_experts(4, 2, 0),
+                         dtype=jnp.float32))
+    sweep: list[dict] = []
+    lat: list[float] = []
+    dt = 1.0
     try:
-        stream = await leader_host.new_stream(worker_host.contact,
-                                              SHARD_PROTOCOL)
-        leader = EPLeaderRunner(cfg, params, max_seq=256, dtype=jnp.float32)
-        local = LocalExpertBank(
-            ExpertBankRunner(cfg, params, assign_experts(4, 2, 0),
-                             dtype=jnp.float32))
-        pipe = EPPipeline(cfg, leader, [
-            local, RemoteExpertBank(stream, remote_runner.expert_ids)])
-        dt, lat = await decode_run(pipe, "bench-ep")
+        for rtt_ms in rtts:
+            proxy = None
+            target = worker_host.contact
+            if rtt_ms > 0:
+                proxy = DelayProxy(worker_host.listen_port, rtt_ms / 2000.0)
+                target = replace(target, port=await proxy.start())
+            pipe = None
+            try:
+                stream = await leader_host.new_stream(target, SHARD_PROTOCOL)
+                pipe = EPPipeline(cfg, leader, [
+                    local,
+                    RemoteExpertBank(stream, remote_runner.expert_ids)])
+                dt_i, lat_i = await decode_run(pipe, f"bench-ep-rtt{rtt_ms:g}")
+            finally:
+                if pipe is not None:
+                    pipe.close()
+                if proxy is not None:
+                    await proxy.close()
+            lat_i.sort()
+            point = {"rtt_ms": rtt_ms,
+                     "steps_per_sec": round(steps / dt_i, 1),
+                     "step_p50_ms": round(lat_i[len(lat_i) // 2], 2)}
+            sweep.append(point)
+            print(f"# rtt {rtt_ms:g}ms: {point['steps_per_sec']} steps/s, "
+                  f"p50 {point['step_p50_ms']}ms", file=sys.stderr)
+            if rtt_ms == 0:
+                dt, lat = dt_i, lat_i  # headline = no injected RTT
+        if not lat:  # sweep didn't include 0: headline = first point
+            dt, lat = steps / sweep[0]["steps_per_sec"], [
+                sweep[0]["step_p50_ms"]]
     finally:
-        if pipe is not None:
-            pipe.close()
         await leader_host.close()
         await worker_host.close()
 
@@ -125,6 +248,26 @@ async def run() -> dict:
     p50 = lat[len(lat) // 2]
     p50_local = lat_local[len(lat_local) // 2]
     n_moe = cfg.num_layers  # every tiny-test-moe layer is MoE
+
+    # Least-squares slope of step p50 vs injected RTT: measured ms of step
+    # latency added per ms of RTT (should approach the MoE hop count).
+    # Break-even vs local-only: the injected RTT at which dispatch overhead
+    # equals the entire local-only step cost — cross-worker EP then halves
+    # decode throughput, p50_0 + slope*rtt = 2*p50_local.
+    slope_ms_per_rtt_ms = None
+    break_even_rtt_ms = None
+    if len(sweep) >= 2:
+        xs = [p["rtt_ms"] for p in sweep]
+        ys = [p["step_p50_ms"] for p in sweep]
+        mx, my = sum(xs) / len(xs), sum(ys) / len(ys)
+        denom = sum((x - mx) ** 2 for x in xs)
+        if denom > 0:
+            slope = sum((x - mx) * (y - my) for x, y in zip(xs, ys)) / denom
+            slope_ms_per_rtt_ms = round(slope, 3)
+            if slope > 0:
+                break_even_rtt_ms = round(
+                    max(0.0, 2 * p50_local - p50) / slope, 2)
+
     return {
         "metric": "cross-worker EP decode (2 expert banks over loopback "
                   "streams), steps/sec",
@@ -138,10 +281,15 @@ async def run() -> dict:
             "moe_layers_per_step": n_moe,
             "dispatch_overhead_ms_per_layer_hop": round(
                 (p50 - p50_local) / max(1, n_moe), 3),
+            "rtt_sweep": sweep,
+            "slope_ms_per_rtt_ms": slope_ms_per_rtt_ms,
+            "break_even_rtt_ms": break_even_rtt_ms,
             "timed_steps": steps,
             "model": cfg.name,
-            "note": "loopback RTT; a real deployment adds its DCN RTT "
-                    "per MoE layer per step on top of this floor",
+            "note": "value is the RTT-0 loopback point; rtt_sweep injects "
+                    "DCN-like RTT via a delay relay, break_even_rtt_ms is "
+                    "where dispatch overhead halves throughput vs "
+                    "local-only",
         },
     }
 

@@ -35,9 +35,7 @@ import time
 
 async def run() -> dict:
     import aiohttp
-    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-        Ed25519PrivateKey,
-    )
+    from crowdllama_tpu.utils.crypto_compat import Ed25519PrivateKey
 
     from crowdllama_tpu.config import Configuration, Intervals
     from crowdllama_tpu.engine.engine import FakeEngine
@@ -166,6 +164,7 @@ async def run() -> dict:
 
                 streams0 = total_streams()
                 pool0 = gateway._stream_pool.hits
+                hp0 = gateway.hotpath_snapshot()
                 cpu0 = time.process_time()
                 t0 = time.monotonic()
                 with LagSampler() as lag:
@@ -173,6 +172,18 @@ async def run() -> dict:
                 dt = time.monotonic() - t0
                 cpu_s = time.process_time() - cpu0
                 cpu_util = cpu_s / dt
+                hp1 = gateway.hotpath_snapshot()
+                # Per-request phase attribution (ISSUE 1 tentpole d): delta
+                # of the gateway's monotonic hot-path counters over the
+                # window, divided by requests.  aead_us is process-wide
+                # (gateway + in-process workers share net/secure.py).
+                hp_req = max(1, hp1["requests"] - hp0["requests"])
+                breakdown = {
+                    k: round((hp1[k] - hp0[k]) / hp_req, 1)
+                    for k in ("route_us", "serde_us", "aead_us", "io_wait_us")
+                }
+                snapshot_rebuilds = (hp1["route_snapshot_rebuilds"]
+                                     - hp0["route_snapshot_rebuilds"])
                 pool_hits = gateway._stream_pool.hits - pool0
                 # With the gateway stream pool, only pool MISSES open an
                 # inference stream (counted on both endpoints).
@@ -191,6 +202,9 @@ async def run() -> dict:
                     # themselves, stream-pool hits, and event-loop lag.
                     "cpu_utilization": round(cpu_util, 2),
                     "cpu_us_per_request": round(cpu_s / n_requests * 1e6),
+                    # Gateway hot-path phase breakdown, µs per request.
+                    **breakdown,
+                    "route_snapshot_rebuilds": snapshot_rebuilds,
                     "stream_pool_hits": pool_hits,
                     "background_streams": max(0, bg_streams),
                     "loop_lag": lag.stats,
@@ -198,7 +212,11 @@ async def run() -> dict:
                 print(f"# size={size}: {n_requests/dt:.1f} req/s, "
                       f"discovery {discovery_s:.2f}s, "
                       f"{len(hits)} workers hit, cpu {cpu_util:.2f}, "
-                      f"{cpu_s / n_requests * 1e6:.0f}us/req, "
+                      f"{cpu_s / n_requests * 1e6:.0f}us/req "
+                      f"(route {breakdown['route_us']} serde "
+                      f"{breakdown['serde_us']} aead {breakdown['aead_us']} "
+                      f"io {breakdown['io_wait_us']}), "
+                      f"rebuilds {snapshot_rebuilds}, "
                       f"pool hits {pool_hits}, "
                       f"bg streams {max(0, bg_streams)}, "
                       f"lag max {lag.stats['max_ms']}ms", file=sys.stderr)

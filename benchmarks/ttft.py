@@ -36,9 +36,7 @@ import time
 async def run() -> dict:
     import aiohttp
     import jax
-    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-        Ed25519PrivateKey,
-    )
+    from crowdllama_tpu.utils.crypto_compat import Ed25519PrivateKey
 
     from crowdllama_tpu.config import Configuration, Intervals
     from crowdllama_tpu.engine.engine import FakeEngine, JaxEngine
@@ -172,10 +170,18 @@ async def run() -> dict:
 
             long_before = dict(engine.describe().get("prefix_cache", {}))
             long_cold = await timed_loop(s, long_cold_body)
+            mid = dict(engine.describe().get("prefix_cache", {}))
             long_warm = await timed_loop(s, long_warm_body)
             la = engine.describe().get("prefix_cache", {})
             long_prefix_stats = {k: la.get(k, 0) - long_before.get(k, 0)
                                  for k in la}
+            # Warm-phase-only cache delta: tokens_reused per hit is the
+            # prefix length the engine ACTUALLY materialized and reused —
+            # tokenizer-side counting can overstate it (context clipping,
+            # page-granular reuse).
+            warm_hits = la.get("hits", 0) - mid.get("hits", 0)
+            warm_reused = (la.get("tokens_reused", 0)
+                           - mid.get("tokens_reused", 0))
     finally:
         for stop in (gateway.stop, consumer.stop, worker.stop, engine.stop,
                      boot_host.close):
@@ -189,6 +195,14 @@ async def run() -> dict:
     p95 = ttfts[max(0, int(len(ttfts) * 0.95) - 1)]
     lc50 = statistics.median(long_cold)
     lw50 = statistics.median(long_warm)
+    # The phase only counts as a LONG-prefix result when the engine
+    # demonstrably reused >= 75% of the target prefix per warm hit; a
+    # clipped context or a cache that reuses a fraction of the prompt
+    # would otherwise report short-prefix numbers under a long-prefix
+    # label (the VERDICT r4 #7 failure shape this phase exists to avoid).
+    materialized = round(warm_reused / warm_hits) if warm_hits else 0
+    long_label = ("long_prefix" if materialized >= 0.75 * target_tokens
+                  else "short_prefix")
     return {
         "metric": f"{model} gateway TTFT p50",
         "value": round(p50, 1),
@@ -197,8 +211,10 @@ async def run() -> dict:
         "extra": {"p95_ms": round(p95, 1), "requests": n_requests,
                   "warm_prefix_p50_ms": round(statistics.median(warm), 1),
                   "prefix_cache": prefix_stats,
-                  "long_prefix": {
+                  long_label: {
                       "prefix_tokens": long_tokens,
+                      "target_prefix_tokens": target_tokens,
+                      "materialized_prefix_tokens": materialized,
                       "cold_p50_ms": round(lc50, 1),
                       "warm_p50_ms": round(lw50, 1),
                       "ttft_reduction_pct": round(100 * (1 - lw50 / lc50), 1),

@@ -43,23 +43,39 @@ async def write_length_prefixed_pb(writer: asyncio.StreamWriter, msg: pb.BaseMes
     await writer.drain()
 
 
-async def read_length_prefixed_pb(
+async def write_frame_bytes(writer: asyncio.StreamWriter, frame: bytes) -> None:
+    """Write an already-encoded frame (``encode_frame`` output).  Lets a
+    caller that may retry on a second stream serialize the protobuf ONCE
+    and reuse the bytes, instead of re-encoding per attempt."""
+    writer.write(frame)
+    await writer.drain()
+
+
+async def read_frame_payload(
     reader: asyncio.StreamReader, timeout: float | None = None
-) -> pb.BaseMessage:
-    async def _read() -> pb.BaseMessage:
+) -> bytes:
+    """Read one frame and return the RAW payload bytes (no protobuf
+    decode).  Callers that attribute CPU per phase use this to time the
+    socket wait separately from ``decode_payload``."""
+    async def _read() -> bytes:
         try:
             header = await reader.readexactly(_LEN.size)
             (length,) = _LEN.unpack(header)
             if length > MAX_MESSAGE_SIZE:
                 raise WireError(f"message size {length} exceeds maximum {MAX_MESSAGE_SIZE}")
-            payload = await reader.readexactly(length)
+            return await reader.readexactly(length)
         except asyncio.IncompleteReadError as e:
             raise WireError("stream closed mid-frame") from e
-        return decode_payload(payload)
 
     if timeout is None:
         return await _read()
     return await asyncio.wait_for(_read(), timeout)
+
+
+async def read_length_prefixed_pb(
+    reader: asyncio.StreamReader, timeout: float | None = None
+) -> pb.BaseMessage:
+    return decode_payload(await read_frame_payload(reader, timeout))
 
 
 def write_length_prefixed_pb_sync(sock: socket.socket, msg: pb.BaseMessage) -> None:

@@ -110,7 +110,24 @@ class Gateway:
         # detected by the first failed roundtrip and retried fresh.
         from crowdllama_tpu.net.host import StreamPool
 
-        self._stream_pool = StreamPool(max_per_key=4)
+        # max_per_key matches typical per-worker request concurrency (the
+        # scaling bench drives 8 clients): with only 4 slots, a 1-worker
+        # swarm under 8-way concurrency redials on half its requests and
+        # the "small swarm" points pay handshakes the 16-worker points
+        # don't — skewing any cross-size CPU comparison.
+        self._stream_pool = StreamPool(max_per_key=8)
+        # Per-phase CPU attribution for the request hot path (monotonic
+        # perf_counter_ns sums; exposed in /metrics and hotpath_snapshot):
+        #   route_ns   — worker selection (affinity probe + snapshot scan)
+        #   serde_ns   — protobuf encode/decode
+        #   io_wait_ns — awaiting socket readiness/frames (includes the
+        #                secure layer's inline seal/open, which is ALSO
+        #                broken out process-wide as aead_us — subtract to
+        #                isolate pure socket wait)
+        # requests counts routed inference/embed requests (not every HTTP
+        # hit), so per-request figures divide cleanly.
+        self._perf = {"route_ns": 0, "serde_ns": 0, "io_wait_ns": 0,
+                      "requests": 0}
         # Prefix-affinity routing: multi-turn chats replay their history
         # verbatim, so turn N shares its leading tokens with turn 1 — the
         # engine's automatic prefix cache only pays if the continuation
@@ -155,6 +172,55 @@ class Gateway:
         if contact is None:
             raise LookupError(f"worker {worker_id[:8]} not resolvable")
         return await self.peer.host.new_stream(contact, INFERENCE_PROTOCOL)
+
+    # ------------------------------------------------- hot-path attribution
+
+    def _encode_frame(self, msg) -> bytes:
+        """Serialize a request ONCE per _route attempt; the same bytes are
+        reused if the pooled stream turns out stale and the request redials
+        (previously the protobuf was re-encoded per send)."""
+        t0 = time.perf_counter_ns()
+        frame = wire.encode_frame(msg)
+        self._perf["serde_ns"] += time.perf_counter_ns() - t0
+        return frame
+
+    async def _send_frame(self, s, frame: bytes) -> None:
+        # write() is synchronous buffering (+ inline seal, counted by the
+        # secure layer's aead counters); only the drain is socket wait.
+        s.writer.write(frame)
+        t0 = time.perf_counter_ns()
+        await s.writer.drain()
+        self._perf["io_wait_ns"] += time.perf_counter_ns() - t0
+
+    async def _recv_pb(self, s, timeout: float = 600):
+        t0 = time.perf_counter_ns()
+        payload = await wire.read_frame_payload(s.reader, timeout=timeout)
+        t1 = time.perf_counter_ns()
+        reply = wire.decode_payload(payload)
+        t2 = time.perf_counter_ns()
+        self._perf["io_wait_ns"] += t1 - t0
+        self._perf["serde_ns"] += t2 - t1
+        return reply
+
+    def hotpath_snapshot(self) -> dict:
+        """Point-in-time hot-path counters; benches diff two snapshots to
+        attribute CPU per request phase (route/serde/aead/io_wait)."""
+        from crowdllama_tpu.net import secure
+
+        aead_ns, aead_ops = secure.aead_stats()
+        pm = self.peer.peer_manager
+        return {
+            "requests": self._perf["requests"],
+            "route_us": self._perf["route_ns"] / 1e3,
+            "serde_us": self._perf["serde_ns"] / 1e3,
+            "io_wait_us": self._perf["io_wait_ns"] / 1e3,
+            "aead_us": aead_ns / 1e3,  # process-wide (see net/secure.py)
+            "aead_ops": aead_ops,
+            "pool_hits": self._stream_pool.hits,
+            "pool_misses": self._stream_pool.misses,
+            "route_snapshot_rebuilds": (
+                pm.route_snapshot_rebuilds if pm is not None else 0),
+        }
 
     # ---------------------------------------------------------- middleware
 
@@ -368,6 +434,7 @@ class Gateway:
     async def _route_embed(self, model: str, inputs: list[str],
                            truncate: bool = True) -> tuple[dict, int]:
         msg = create_embed_request(model, inputs, truncate=truncate)
+        self._perf["requests"] += 1
         tried: set[str] = set()
         last_err = "no workers available for model"
         for _attempt in range(2):  # retry once on next-best worker
@@ -404,13 +471,14 @@ class Gateway:
 
         A pooled stream can be stale (worker idled it out or restarted):
         generation/embedding requests are stateless, so the failed attempt
-        retries once on a fresh dial before surfacing the error."""
+        retries once on a fresh dial — reusing the ALREADY-ENCODED frame
+        bytes — before surfacing the error."""
+        frame = self._encode_frame(msg)
         s = self._pool_get(worker_id)
         if s is not None:
             try:
-                await wire.write_length_prefixed_pb(s.writer, msg)
-                reply = await wire.read_length_prefixed_pb(s.reader,
-                                                           timeout=timeout)
+                await self._send_frame(s, frame)
+                reply = await self._recv_pb(s, timeout=timeout)
                 self._pool_put(worker_id, s)
                 return reply
             except asyncio.CancelledError:
@@ -422,9 +490,8 @@ class Gateway:
                           worker_id[:8], e)
         s = await self._dial(worker_id)
         try:
-            await wire.write_length_prefixed_pb(s.writer, msg)
-            reply = await wire.read_length_prefixed_pb(s.reader,
-                                                       timeout=timeout)
+            await self._send_frame(s, frame)
+            reply = await self._recv_pb(s, timeout=timeout)
         except BaseException:
             s.close()
             raise
@@ -565,6 +632,25 @@ class Gateway:
         lines.append("# TYPE crowdllama_gateway_affinity_hits_total counter")
         lines.append(
             f"crowdllama_gateway_affinity_hits_total {self._affinity_hits}")
+        # Request hot-path CPU attribution (ISSUE 1 tentpole d): cumulative
+        # microseconds per phase; rate(phase)/rate(requests) is the
+        # per-request cost.  aead_us is process-wide (net/secure.py).
+        hp = self.hotpath_snapshot()
+        lines.append(
+            "# TYPE crowdllama_gateway_hotpath_us_total counter")
+        for phase in ("route_us", "serde_us", "aead_us", "io_wait_us"):
+            lines.append(
+                f'crowdllama_gateway_hotpath_us_total{{phase='
+                f'"{phase[:-3]}"}} {hp[phase]:.1f}')
+        lines.append(
+            "# TYPE crowdllama_gateway_hotpath_requests_total counter")
+        lines.append(
+            f"crowdllama_gateway_hotpath_requests_total {hp['requests']}")
+        lines.append(
+            "# TYPE crowdllama_route_snapshot_rebuilds_total counter")
+        lines.append(
+            f"crowdllama_route_snapshot_rebuilds_total "
+            f"{hp['route_snapshot_rebuilds']}")
         lines.append("# TYPE crowdllama_host_streams_total counter")
         for k, v in sorted(self.peer.host.stats.items()):
             # Only the stream-kind counters belong under this metric;
@@ -594,8 +680,12 @@ class Gateway:
         pm = self.peer.peer_manager
         if pm is None:
             return None
-        return pm.find_best_worker(model, exclude=exclude,
-                                   require_embeddings=require_embeddings)
+        t0 = time.perf_counter_ns()
+        try:
+            return pm.find_best_worker(model, exclude=exclude,
+                                       require_embeddings=require_embeddings)
+        finally:
+            self._perf["route_ns"] += time.perf_counter_ns() - t0
 
     # --------------------------------------------------- OpenAI-compat v1
 
@@ -844,14 +934,19 @@ class Gateway:
                 options.get("repeat_penalty", 1.0) or 1.0)),
         )
         t0 = time.monotonic()  # TTFB measures from ADMISSION, retries included
+        self._perf["requests"] += 1
+        tr = time.perf_counter_ns()
         akey, continuation = self._affinity_key(model, messages, prompt)
+        self._perf["route_ns"] += time.perf_counter_ns() - tr
         tried: set[str] = set()
         last_err = "no workers available for model"
         for _attempt in range(2):  # retry once on next-best worker
             worker = None
             used_affinity = False
+            tr = time.perf_counter_ns()
             affine = (self._affinity_get(akey, model)
                       if continuation else None)
+            self._perf["route_ns"] += time.perf_counter_ns() - tr
             if affine is not None and affine.peer_id not in tried:
                 worker = affine
                 used_affinity = True
@@ -932,13 +1027,14 @@ class Gateway:
         # worker that dies immediately is still retryable by _route — and
         # so a STALE pooled stream is detected while a fresh redial is
         # still possible.
+        frame = self._encode_frame(msg)
         s = self._pool_get(worker_id)
         first = None
         if s is not None:
             try:
-                await wire.write_length_prefixed_pb(s.writer, msg)
+                await self._send_frame(s, frame)
                 first = extract_generate_response(
-                    await wire.read_length_prefixed_pb(s.reader, timeout=600))
+                    await self._recv_pb(s, timeout=600))
             except asyncio.CancelledError:
                 s.close()
                 raise
@@ -950,9 +1046,9 @@ class Gateway:
         if s is None:
             s = await self._dial(worker_id)
             try:
-                await wire.write_length_prefixed_pb(s.writer, msg)
+                await self._send_frame(s, frame)
                 first = extract_generate_response(
-                    await wire.read_length_prefixed_pb(s.reader, timeout=600))
+                    await self._recv_pb(s, timeout=600))
             except BaseException:
                 s.close()
                 raise
@@ -988,7 +1084,7 @@ class Gateway:
                         clean = True  # terminal frame read: stream reusable
                         break
                     resp = extract_generate_response(
-                        await wire.read_length_prefixed_pb(s.reader, timeout=600))
+                        await self._recv_pb(s, timeout=600))
                 if openai:
                     await out.write(b"data: [DONE]\n\n")
             except Exception as e:

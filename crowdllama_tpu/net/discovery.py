@@ -12,7 +12,7 @@ from __future__ import annotations
 import asyncio
 import logging
 
-from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+from crowdllama_tpu.utils.crypto_compat import Ed25519PrivateKey
 
 from crowdllama_tpu.config import Intervals
 from crowdllama_tpu.core.protocol import METADATA_PROTOCOL, namespace_key

@@ -27,15 +27,13 @@ import time
 from dataclasses import dataclass
 from typing import Awaitable, Callable
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+from crowdllama_tpu.utils.crypto_compat import (
     Ed25519PrivateKey,
     Ed25519PublicKey,
-)
-from cryptography.hazmat.primitives.asymmetric.x25519 import X25519PrivateKey
-from cryptography.hazmat.primitives.serialization import (
     Encoding,
+    InvalidSignature,
     PublicFormat,
+    X25519PrivateKey,
 )
 
 from crowdllama_tpu.core.protocol import RELAY_PROTOCOL, REVERSE_PROTOCOL
@@ -970,11 +968,7 @@ class Host:
                     pass
 
     def _pubkey_hex(self) -> str:
-        from cryptography.hazmat.primitives import serialization
-
-        return self.public_key.public_bytes(
-            serialization.Encoding.Raw, serialization.PublicFormat.Raw
-        ).hex()
+        return self.public_key.public_bytes(Encoding.Raw, PublicFormat.Raw).hex()
 
 
 def _verify_hello(hello: dict, proto: str, expected_nonce: str) -> tuple[str, bytes]:

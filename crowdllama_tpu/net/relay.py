@@ -378,15 +378,20 @@ class RelayClient:
         await asyncio.wait_for(self.registered.wait(), ACCEPT_TIMEOUT)
 
     async def stop(self) -> None:
-        for t in [self._task, *self._accepts]:
-            if t is not None:
+        tasks = [t for t in [self._task, *self._accepts] if t is not None]
+        for t in tasks:
+            # Re-cancel until the task actually ends: a cancel that races
+            # the control stream dying is swallowed inside wait_for
+            # (bpo-42130, present on 3.10) and surfaces as a stream error
+            # the reconnect loop happily retries — one cancel() is not
+            # enough to stop it.
+            while not t.done():
                 t.cancel()
-        for t in [self._task, *self._accepts]:
-            if t is not None:
-                try:
-                    await t
-                except asyncio.CancelledError:
-                    pass
+                await asyncio.wait([t], timeout=0.5)
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
         self._task = None
         self._accepts.clear()
 

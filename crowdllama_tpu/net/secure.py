@@ -23,18 +23,33 @@ tensor frames work unchanged on top.
 from __future__ import annotations
 
 import asyncio
+import time
 
-from cryptography.exceptions import InvalidTag
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
+from crowdllama_tpu.utils.crypto_compat import (
+    HKDF,
+    SHA256,
+    ChaCha20Poly1305,
+    InvalidTag,
     X25519PrivateKey,
     X25519PublicKey,
 )
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
-from cryptography.hazmat.primitives.hashes import SHA256
-from cryptography.hazmat.primitives.kdf.hkdf import HKDF
 
 MAX_FRAME = 1 * 1024 * 1024  # ciphertext cap per frame (plaintext chunks 256K)
 CHUNK = 256 * 1024
+
+# Process-wide AEAD CPU attribution (seal + open), fed by every
+# SecureWriter/SecureReader in the process.  Per-request CPU breakdowns
+# (gateway.hotpath_snapshot, benchmarks/swarm_scaling.py) read deltas of
+# these to report aead_us.  Process-wide is deliberate: the swarm benches
+# run gateway and workers in one process, and splitting the counter per
+# stream would put a dict lookup on every frame for no analytical gain.
+_aead_ns = 0
+_aead_ops = 0
+
+
+def aead_stats() -> tuple[int, int]:
+    """(total nanoseconds spent in AEAD seal/open, operation count)."""
+    return _aead_ns, _aead_ops
 
 
 class TamperError(ConnectionResetError):
@@ -75,9 +90,13 @@ class SecureWriter:
         self._ctr = 0
 
     def _frame(self, chunk: bytes) -> None:
+        global _aead_ns, _aead_ops
         nonce = self._ctr.to_bytes(12, "big")
         self._ctr += 1
+        t0 = time.perf_counter_ns()
         ct = self._aead.encrypt(nonce, chunk, None)
+        _aead_ns += time.perf_counter_ns() - t0
+        _aead_ops += 1
         self._w.write(len(ct).to_bytes(4, "big") + ct)
 
     def write(self, data: bytes) -> None:
@@ -139,12 +158,17 @@ class SecureReader:
             ct = await self._r.readexactly(length)
         except asyncio.IncompleteReadError as e:
             raise TamperError("stream cut mid-frame") from e
+        global _aead_ns, _aead_ops
         nonce = self._ctr.to_bytes(12, "big")
         self._ctr += 1
+        t0 = time.perf_counter_ns()
         try:
             pt = self._aead.decrypt(nonce, ct, None)
         except InvalidTag as e:
             raise TamperError("frame failed authentication") from e
+        finally:
+            _aead_ns += time.perf_counter_ns() - t0
+            _aead_ops += 1
         if not pt:  # authenticated close marker (SecureWriter.write_eof)
             self._eof = True
             self._authenticated_eof = True

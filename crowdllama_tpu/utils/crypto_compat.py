@@ -1,0 +1,393 @@
+"""Crypto primitives with a stdlib-only fallback.
+
+Every module that needs asymmetric identity (Ed25519), key agreement
+(X25519), AEAD framing (ChaCha20-Poly1305) or HKDF imports the names from
+here instead of ``cryptography`` directly.  When the real ``cryptography``
+package is installed those names ARE the real ones (zero overhead, zero
+behavior change).  When it is missing — CPU-only CI containers ship the
+jax_graft toolchain but not libffi/openssl wheels — the fallbacks below
+keep the whole net stack importable and functional:
+
+- X25519 and Ed25519 are REAL pure-Python implementations (RFC 7748
+  Montgomery ladder, RFC 8032 Edwards arithmetic): wire-compatible with
+  the C implementations, deterministic, just ~2-4 ms per operation
+  instead of microseconds.  Stream pooling (net/host.py StreamPool)
+  amortizes that handshake cost exactly as it does the real one.
+- The AEAD fallback is encrypt-then-MAC: SHAKE-256 XOF keystream XOR +
+  HMAC-SHA256/128 tag, same 16-byte tag length and same
+  ``InvalidTag``-on-forgery contract as ChaCha20-Poly1305, so
+  net/secure.py's frame format, empty-frame authenticated close and
+  TamperError semantics are byte-layout identical.  It is NOT
+  ChaCha20-Poly1305 on the wire: a fallback node can only talk to other
+  fallback nodes (handshakes between mixed builds fail at the first
+  frame, the same failure mode as a KDF version skew).
+
+``HAVE_CRYPTOGRAPHY`` tells callers (and tests) which build is active.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac as _hmac
+import os
+
+try:  # real implementation when available
+    from cryptography.exceptions import InvalidSignature, InvalidTag
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey,
+        X25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+    from cryptography.hazmat.primitives.hashes import SHA256
+    from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+    from cryptography.hazmat.primitives.serialization import (
+        Encoding,
+        NoEncryption,
+        PrivateFormat,
+        PublicFormat,
+    )
+
+    HAVE_CRYPTOGRAPHY = True
+
+except ImportError:  # stdlib-only fallback
+    HAVE_CRYPTOGRAPHY = False
+
+    class InvalidSignature(Exception):
+        pass
+
+    class InvalidTag(Exception):
+        pass
+
+    # --- serialization surface (only the Raw forms the repo uses) -------
+
+    class _RawEnum:
+        Raw = "Raw"
+
+    Encoding = _RawEnum
+    PublicFormat = _RawEnum
+    PrivateFormat = _RawEnum
+
+    class NoEncryption:
+        pass
+
+    class _SerializationNS:
+        Encoding = Encoding
+        PublicFormat = PublicFormat
+        PrivateFormat = PrivateFormat
+        NoEncryption = NoEncryption
+
+    serialization = _SerializationNS()
+
+    # --- X25519 (RFC 7748) ---------------------------------------------
+
+    _P = 2**255 - 19
+    _A24 = 121665
+
+    def _x25519_ladder(k: int, u: int) -> int:
+        x1, x2, z2, x3, z3 = u, 1, 0, u, 1
+        swap = 0
+        for t in reversed(range(255)):
+            kt = (k >> t) & 1
+            swap ^= kt
+            if swap:
+                x2, x3 = x3, x2
+                z2, z3 = z3, z2
+            swap = kt
+            a = (x2 + z2) % _P
+            aa = a * a % _P
+            b = (x2 - z2) % _P
+            bb = b * b % _P
+            e = (aa - bb) % _P
+            c = (x3 + z3) % _P
+            d = (x3 - z3) % _P
+            da = d * a % _P
+            cb = c * b % _P
+            x3 = (da + cb) % _P
+            x3 = x3 * x3 % _P
+            z3 = (da - cb) % _P
+            z3 = z3 * z3 % _P
+            z3 = z3 * x1 % _P
+            x2 = aa * bb % _P
+            z2 = e * (aa + _A24 * e) % _P
+        if swap:
+            x2, x3 = x3, x2
+            z2, z3 = z3, z2
+        return x2 * pow(z2, _P - 2, _P) % _P
+
+    def _x25519(scalar32: bytes, u32: bytes) -> bytes:
+        k = int.from_bytes(scalar32, "little")
+        k &= ~7
+        k &= (1 << 254) - 1
+        k |= 1 << 254
+        u = int.from_bytes(u32, "little") & ((1 << 255) - 1)
+        return _x25519_ladder(k, u).to_bytes(32, "little")
+
+    class X25519PublicKey:
+        def __init__(self, raw: bytes):
+            self._raw = bytes(raw)
+
+        @classmethod
+        def from_public_bytes(cls, raw: bytes) -> "X25519PublicKey":
+            if len(raw) != 32:
+                raise ValueError("X25519 public keys are 32 bytes")
+            return cls(raw)
+
+        def public_bytes(self, encoding=None, fmt=None) -> bytes:
+            return self._raw
+
+        def public_bytes_raw(self) -> bytes:
+            return self._raw
+
+    class X25519PrivateKey:
+        def __init__(self, raw: bytes):
+            self._raw = bytes(raw)
+
+        @classmethod
+        def generate(cls) -> "X25519PrivateKey":
+            return cls(os.urandom(32))
+
+        @classmethod
+        def from_private_bytes(cls, raw: bytes) -> "X25519PrivateKey":
+            if len(raw) != 32:
+                raise ValueError("X25519 private keys are 32 bytes")
+            return cls(raw)
+
+        def public_key(self) -> X25519PublicKey:
+            return X25519PublicKey(_x25519(self._raw, (9).to_bytes(32, "little")))
+
+        def private_bytes_raw(self) -> bytes:
+            return self._raw
+
+        def exchange(self, peer_public: X25519PublicKey) -> bytes:
+            shared = _x25519(self._raw, peer_public._raw)
+            if shared == b"\x00" * 32:
+                raise ValueError("X25519 exchange produced all-zero secret")
+            return shared
+
+    # --- Ed25519 (RFC 8032) --------------------------------------------
+
+    _L = 2**252 + 27742317777372353535851937790883648493
+    _D = -121665 * pow(121666, _P - 2, _P) % _P
+    _SQRT_M1 = pow(2, (_P - 1) // 4, _P)
+
+    def _ed_add(p, q):
+        x1, y1, z1, t1 = p
+        x2, y2, z2, t2 = q
+        a = (y1 - x1) * (y2 - x2) % _P
+        b = (y1 + x1) * (y2 + x2) % _P
+        c = 2 * t1 * t2 * _D % _P
+        d = 2 * z1 * z2 % _P
+        e, f, g, h = b - a, d - c, d + c, b + a
+        return (e * f % _P, g * h % _P, f * g % _P, e * h % _P)
+
+    def _ed_mul(s, p):
+        q = (0, 1, 1, 0)
+        while s:
+            if s & 1:
+                q = _ed_add(q, p)
+            p = _ed_add(p, p)
+            s >>= 1
+        return q
+
+    _GY = 4 * pow(5, _P - 2, _P) % _P
+
+    def _recover_x(y: int, sign: int) -> int:
+        if y >= _P:
+            raise ValueError("bad point encoding")
+        x2 = (y * y - 1) * pow(_D * y * y + 1, _P - 2, _P) % _P
+        x = pow(x2, (_P + 3) // 8, _P)
+        if (x * x - x2) % _P:
+            x = x * _SQRT_M1 % _P
+        if (x * x - x2) % _P:
+            raise ValueError("not a curve point")
+        if x == 0 and sign:
+            raise ValueError("bad point encoding")
+        if x & 1 != sign:
+            x = _P - x
+        return x
+
+    _GX = _recover_x(_GY, 0)
+    _G = (_GX, _GY, 1, _GX * _GY % _P)
+
+    def _ed_encode(p) -> bytes:
+        x, y, z, _ = p
+        zi = pow(z, _P - 2, _P)
+        x, y = x * zi % _P, y * zi % _P
+        return (y | ((x & 1) << 255)).to_bytes(32, "little")
+
+    def _ed_decode(raw: bytes):
+        if len(raw) != 32:
+            raise ValueError("Ed25519 points are 32 bytes")
+        enc = int.from_bytes(raw, "little")
+        y = enc & ((1 << 255) - 1)
+        x = _recover_x(y, enc >> 255)
+        return (x, y, 1, x * y % _P)
+
+    def _ed_eq(p, q) -> bool:
+        x1, y1, z1, _ = p
+        x2, y2, z2, _ = q
+        return (x1 * z2 - x2 * z1) % _P == 0 and (y1 * z2 - y2 * z1) % _P == 0
+
+    def _ed_secret_expand(seed: bytes):
+        h = hashlib.sha512(seed).digest()
+        a = int.from_bytes(h[:32], "little")
+        a &= (1 << 254) - 8
+        a |= 1 << 254
+        return a, h[32:]
+
+    class Ed25519PublicKey:
+        def __init__(self, raw: bytes):
+            self._raw = bytes(raw)
+
+        @classmethod
+        def from_public_bytes(cls, raw: bytes) -> "Ed25519PublicKey":
+            if len(raw) != 32:
+                raise ValueError("Ed25519 public keys are 32 bytes")
+            return cls(raw)
+
+        def public_bytes(self, encoding=None, fmt=None) -> bytes:
+            return self._raw
+
+        def public_bytes_raw(self) -> bytes:
+            return self._raw
+
+        def verify(self, signature: bytes, data: bytes) -> None:
+            if len(signature) != 64:
+                raise InvalidSignature("bad signature length")
+            try:
+                a = _ed_decode(self._raw)
+                r = _ed_decode(signature[:32])
+            except ValueError as e:
+                raise InvalidSignature(str(e)) from e
+            s = int.from_bytes(signature[32:], "little")
+            if s >= _L:
+                raise InvalidSignature("non-canonical s")
+            k = int.from_bytes(
+                hashlib.sha512(signature[:32] + self._raw + data).digest(),
+                "little") % _L
+            if not _ed_eq(_ed_mul(s, _G), _ed_add(r, _ed_mul(k, a))):
+                raise InvalidSignature("signature mismatch")
+
+    class Ed25519PrivateKey:
+        def __init__(self, seed: bytes):
+            self._seed = bytes(seed)
+            self._scalar, self._prefix = _ed_secret_expand(self._seed)
+            self._pub = _ed_encode(_ed_mul(self._scalar, _G))
+
+        @classmethod
+        def generate(cls) -> "Ed25519PrivateKey":
+            return cls(os.urandom(32))
+
+        @classmethod
+        def from_private_bytes(cls, raw: bytes) -> "Ed25519PrivateKey":
+            if len(raw) != 32:
+                raise ValueError("Ed25519 private keys are 32 bytes")
+            return cls(raw)
+
+        def public_key(self) -> Ed25519PublicKey:
+            return Ed25519PublicKey(self._pub)
+
+        def private_bytes(self, encoding=None, fmt=None, encryption=None) -> bytes:
+            return self._seed
+
+        def private_bytes_raw(self) -> bytes:
+            return self._seed
+
+        def sign(self, data: bytes) -> bytes:
+            r = int.from_bytes(
+                hashlib.sha512(self._prefix + data).digest(), "little") % _L
+            r_enc = _ed_encode(_ed_mul(r, _G))
+            k = int.from_bytes(
+                hashlib.sha512(r_enc + self._pub + data).digest(),
+                "little") % _L
+            s = (r + k * self._scalar) % _L
+            return r_enc + s.to_bytes(32, "little")
+
+    # --- AEAD: encrypt-then-MAC stand-in for ChaCha20-Poly1305 ----------
+
+    class ChaCha20Poly1305:
+        """SHAKE-256 keystream XOR + HMAC-SHA256/128 tag.  Same (nonce,
+        plaintext) -> (ciphertext || 16-byte tag) shape and same
+        raise-InvalidTag-on-any-forgery contract as the real AEAD; both
+        XOF and HMAC run in C, so throughput stays in the hundreds of
+        MB/s and the aead_us attribution counters stay meaningful."""
+
+        _TAG = 16
+
+        def __init__(self, key: bytes):
+            if len(key) != 32:
+                raise ValueError("key must be 32 bytes")
+            self._enc_key = key
+            self._mac_key = hashlib.sha256(b"compat-aead-mac" + key).digest()
+
+        def _keystream(self, nonce: bytes, n: int) -> bytes:
+            return hashlib.shake_256(
+                b"compat-aead-stream" + self._enc_key + nonce).digest(n)
+
+        def encrypt(self, nonce: bytes, data: bytes, aad: bytes | None) -> bytes:
+            ks = self._keystream(nonce, len(data))
+            ct = (int.from_bytes(data, "big") ^ int.from_bytes(ks, "big")
+                  ).to_bytes(len(data), "big") if data else b""
+            mac = _hmac.new(self._mac_key, nonce + (aad or b"") + ct,
+                            hashlib.sha256).digest()[:self._TAG]
+            return ct + mac
+
+        def decrypt(self, nonce: bytes, data: bytes, aad: bytes | None) -> bytes:
+            if len(data) < self._TAG:
+                raise InvalidTag("ciphertext shorter than tag")
+            ct, mac = data[:-self._TAG], data[-self._TAG:]
+            want = _hmac.new(self._mac_key, nonce + (aad or b"") + ct,
+                             hashlib.sha256).digest()[:self._TAG]
+            if not _hmac.compare_digest(mac, want):
+                raise InvalidTag("tag mismatch")
+            ks = self._keystream(nonce, len(ct))
+            return (int.from_bytes(ct, "big") ^ int.from_bytes(ks, "big")
+                    ).to_bytes(len(ct), "big") if ct else b""
+
+    # --- HKDF (RFC 5869, exact) ----------------------------------------
+
+    class SHA256:
+        pass
+
+    class HKDF:
+        def __init__(self, algorithm=None, length: int = 32,
+                     salt: bytes | None = None, info: bytes | None = None):
+            self._length = length
+            self._salt = salt or b"\x00" * 32
+            self._info = info or b""
+
+        def derive(self, key_material: bytes) -> bytes:
+            prk = _hmac.new(self._salt, key_material, hashlib.sha256).digest()
+            okm = b""
+            t = b""
+            counter = 1
+            while len(okm) < self._length:
+                t = _hmac.new(prk, t + self._info + bytes([counter]),
+                              hashlib.sha256).digest()
+                okm += t
+                counter += 1
+            return okm[:self._length]
+
+
+__all__ = [
+    "HAVE_CRYPTOGRAPHY",
+    "InvalidSignature",
+    "InvalidTag",
+    "Ed25519PrivateKey",
+    "Ed25519PublicKey",
+    "X25519PrivateKey",
+    "X25519PublicKey",
+    "ChaCha20Poly1305",
+    "SHA256",
+    "HKDF",
+    "serialization",
+    "Encoding",
+    "PublicFormat",
+    "PrivateFormat",
+    "NoEncryption",
+]

@@ -16,10 +16,10 @@ import os
 import threading
 from pathlib import Path
 
-from cryptography.hazmat.primitives import serialization
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+from crowdllama_tpu.utils.crypto_compat import (
     Ed25519PrivateKey,
     Ed25519PublicKey,
+    serialization,
 )
 
 DEFAULT_DIR = Path(os.environ.get("CROWDLLAMA_TPU_HOME", "~/.crowdllama-tpu")).expanduser()

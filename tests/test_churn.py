@@ -11,7 +11,7 @@ machine's on_peer_removed hook into the local DHT view.
 import asyncio
 import random
 
-from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+from crowdllama_tpu.utils.crypto_compat import Ed25519PrivateKey
 
 from crowdllama_tpu.config import Configuration, Intervals
 from crowdllama_tpu.core.protocol import namespace_key

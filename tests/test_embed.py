@@ -11,7 +11,7 @@ import aiohttp
 import jax
 import jax.numpy as jnp
 import numpy as np
-from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+from crowdllama_tpu.utils.crypto_compat import Ed25519PrivateKey
 
 from crowdllama_tpu.models import transformer as T
 from crowdllama_tpu.models.config import get_config

@@ -4,7 +4,7 @@ real sockets, compressed intervals."""
 
 import asyncio
 
-from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+from crowdllama_tpu.utils.crypto_compat import Ed25519PrivateKey
 
 from crowdllama_tpu.config import Intervals
 from crowdllama_tpu.core.protocol import namespace_key
@@ -295,9 +295,7 @@ def test_addr_classification():
 
 async def test_inbound_addr_class_stats():
     """The accepting host classifies inbound peers (ref dht.go:279-321)."""
-    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-        Ed25519PrivateKey,
-    )
+    from crowdllama_tpu.utils.crypto_compat import Ed25519PrivateKey
 
     from crowdllama_tpu.net.host import Host
 

@@ -134,6 +134,64 @@ def test_shard_group_routing():
     assert [m.peer_id for m in members] == ["g1-0", "g1-1"]
 
 
+def test_route_snapshot_epoch_invalidation():
+    pm = _pm()
+    pm.add_or_update_peer(_res("w1", tput=100))
+    pm.add_or_update_peer(_res("w2", tput=50))
+    assert pm.find_best_worker("m").peer_id == "w1"
+    built = pm.route_snapshot_rebuilds
+    for _ in range(20):
+        pm.find_best_worker("m")
+    assert pm.route_snapshot_rebuilds == built  # cached between events
+
+    # A metadata update is a routing event: the next lookup rebuilds and
+    # scores the fresh numbers.
+    pm.add_or_update_peer(_res("w2", tput=500))
+    assert pm.find_best_worker("m").peer_id == "w2"
+    assert pm.route_snapshot_rebuilds == built + 1
+
+    # So is a removal.
+    pm.remove_peer("w2")
+    assert pm.find_best_worker("m").peer_id == "w1"
+    assert pm.route_snapshot_rebuilds == built + 2
+
+
+def test_route_snapshot_stale_fallback_dead_worker():
+    pm = _pm()
+    pm.add_or_update_peer(_res("strong", tput=500))
+    pm.add_or_update_peer(_res("weak", tput=100))
+    assert pm.find_best_worker("m").peer_id == "strong"
+    epoch = pm.routing_epoch
+    # Best worker dies with NO routing event landed yet (the health loop
+    # hasn't observed the flip): the genuinely-stale snapshot must skip it
+    # via the live PeerInfo health flag instead of returning a dead pick.
+    pm.get_peer("strong").is_healthy = False
+    assert pm.routing_epoch == epoch
+    assert pm.find_best_worker("m").peer_id == "weak"
+    pm.get_peer("weak").is_healthy = False
+    assert pm.find_best_worker("m") is None
+
+
+def test_route_snapshot_no_unhealthy_rescan_at_scale():
+    pm = _pm()
+    for i in range(32):
+        pm.add_or_update_peer(_res(f"w{i}", tput=100 + i))
+    for i in range(0, 32, 2):  # half the swarm goes unhealthy
+        pm.get_peer(f"w{i}").is_healthy = False
+    pm._bump_routing_epoch()  # as health_check_peer would on the flip
+    assert pm.find_best_worker("m").peer_id == "w31"
+    built = pm.route_snapshot_rebuilds
+    snap = pm._routing_snapshot("m")
+    assert ({p.peer_id for p, _ in snap.entries}
+            == {f"w{i}" for i in range(1, 32, 2)})
+    for _ in range(200):
+        assert pm.find_best_worker("m") is not None
+    # Steady state: zero rebuilds across 200 requests — the hot path
+    # touches only the precomputed eligible entries, never the unhealthy
+    # half of the table.
+    assert pm.route_snapshot_rebuilds == built
+
+
 def test_discovery_applies_results():
     async def disc(skip):
         assert isinstance(skip, set)

@@ -8,7 +8,7 @@ Parity target: the reference's libp2p relay/hole-punch handling
 import asyncio
 
 import aiohttp
-from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+from crowdllama_tpu.utils.crypto_compat import Ed25519PrivateKey
 
 from crowdllama_tpu.config import Configuration, Intervals
 from crowdllama_tpu.core.protocol import METADATA_PROTOCOL

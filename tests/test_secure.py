@@ -9,7 +9,7 @@ noise/TLS defaults give the reference for free (discovery.go:48-84).
 import asyncio
 
 import pytest
-from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+from crowdllama_tpu.utils.crypto_compat import Ed25519PrivateKey
 
 from crowdllama_tpu.net.host import Host
 from crowdllama_tpu.net.secure import (

@@ -11,7 +11,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+from crowdllama_tpu.utils.crypto_compat import Ed25519PrivateKey
 
 from crowdllama_tpu.core.protocol import SHARD_PROTOCOL
 from crowdllama_tpu.engine.shard_service import (

@@ -15,7 +15,7 @@ import asyncio
 import json
 
 import aiohttp
-from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+from crowdllama_tpu.utils.crypto_compat import Ed25519PrivateKey
 
 from crowdllama_tpu.config import Configuration, Intervals
 from crowdllama_tpu.engine.engine import FakeEngine
