@@ -41,6 +41,7 @@ async def run() -> dict:
     from crowdllama_tpu.engine.engine import FakeEngine
     from crowdllama_tpu.gateway.gateway import Gateway
     from crowdllama_tpu.net.discovery import new_host_and_dht
+    from crowdllama_tpu.obs.metrics import quantile_from_counts
     from crowdllama_tpu.peer.peer import Peer
 
     sizes = [int(x) for x in os.environ.get(
@@ -165,6 +166,8 @@ async def run() -> dict:
                 streams0 = total_streams()
                 pool0 = gateway._stream_pool.hits
                 hp0 = gateway.hotpath_snapshot()
+                req_hist = gateway.obs.metrics.request_seconds.labels(model)
+                hist0 = req_hist.snapshot_counts()
                 cpu0 = time.process_time()
                 t0 = time.monotonic()
                 with LagSampler() as lag:
@@ -184,6 +187,15 @@ async def run() -> dict:
                 }
                 snapshot_rebuilds = (hp1["route_snapshot_rebuilds"]
                                      - hp0["route_snapshot_rebuilds"])
+                # Histogram-derived per-size latency: the window's delta of
+                # the gateway's crowdllama_request_seconds series — the
+                # number a dashboard would show for this swarm size.
+                hist_delta = [b - a for a, b in
+                              zip(hist0, req_hist.snapshot_counts())]
+                req_p50_ms = round(quantile_from_counts(
+                    req_hist.buckets, hist_delta, 0.5) * 1e3, 2)
+                req_p95_ms = round(quantile_from_counts(
+                    req_hist.buckets, hist_delta, 0.95) * 1e3, 2)
                 pool_hits = gateway._stream_pool.hits - pool0
                 # With the gateway stream pool, only pool MISSES open an
                 # inference stream (counted on both endpoints).
@@ -204,6 +216,8 @@ async def run() -> dict:
                     "cpu_us_per_request": round(cpu_s / n_requests * 1e6),
                     # Gateway hot-path phase breakdown, µs per request.
                     **breakdown,
+                    "request_hist_p50_ms": req_p50_ms,
+                    "request_hist_p95_ms": req_p95_ms,
                     "route_snapshot_rebuilds": snapshot_rebuilds,
                     "stream_pool_hits": pool_hits,
                     "background_streams": max(0, bg_streams),
@@ -227,12 +241,20 @@ async def run() -> dict:
             await w.stop()
         await boot_host.close()
 
+    # One completed span tree from the trace ring buffer: shows where a
+    # representative largest-swarm request spent its time (route/serde/
+    # aead/io_wait on the gateway side).
+    trace_sample = next(
+        (t for t in reversed(gateway.obs.trace.snapshot()["traces"])
+         if t["done"]), None)
+
     return {
         "metric": f"swarm scaling 1->{sizes[-1]} workers, gateway requests/sec",
         "value": curve[-1]["requests_per_sec"],
         "unit": "requests/sec",
         "vs_baseline": None,  # reference publishes no scaling numbers
-        "extra": {"curve": curve, "concurrency": concurrency},
+        "extra": {"curve": curve, "concurrency": concurrency,
+                  "trace_sample": trace_sample},
     }
 
 

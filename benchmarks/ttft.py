@@ -76,7 +76,9 @@ async def run() -> dict:
     consumer = Peer(Ed25519PrivateKey.generate(), cfg(bootstrap_peers=[bootstrap]),
                     engine=FakeEngine(models=[]), worker_mode=False)
     await consumer.start()
-    gateway = Gateway(consumer, port=0, host="127.0.0.1")
+    # trace_buffer sized to hold every request of the run so the span
+    # aggregation below sees all phases, not the tail of the ring.
+    gateway = Gateway(consumer, port=0, host="127.0.0.1", trace_buffer=256)
     await gateway.start()
     gw_port = gateway._runner.addresses[0][1]
 
@@ -190,6 +192,32 @@ async def run() -> dict:
             except Exception:
                 pass  # teardown must not mask the benchmark's real error
 
+    # Observability cross-check (obs/): the SAME percentile a dashboard
+    # would read from the scraped crowdllama_ttft_seconds series, plus
+    # per-phase means and one full span tree from the trace ring buffer.
+    # In-memory state survives gateway.stop(), so this reads post-teardown.
+    ttft_hist = gateway.obs.metrics.ttft_seconds
+    phase_tot: dict[str, float] = {}
+    phase_n: dict[str, int] = {}
+    trace_sample = None
+    for t in gateway.obs.trace.snapshot()["traces"]:
+        for sp in t["spans"]:
+            phase_tot[sp["name"]] = phase_tot.get(sp["name"], 0.0) \
+                + sp["dur_us"]
+            phase_n[sp["name"]] = phase_n.get(sp["name"], 0) + 1
+        if t["done"]:
+            trace_sample = t
+    obs_extra = {
+        "ttft_hist_p50_ms": round(ttft_hist.quantile(0.5) * 1000, 1),
+        "ttft_hist_p95_ms": round(ttft_hist.quantile(0.95) * 1000, 1),
+        "ttft_hist_count": ttft_hist.count,
+        "decode_step_hist_p50_ms": round(
+            gateway.obs.metrics.decode_step_seconds.quantile(0.5) * 1000, 2),
+        "phase_mean_us": {k: round(phase_tot[k] / phase_n[k], 1)
+                          for k in sorted(phase_tot)},
+        "trace_sample": trace_sample,
+    }
+
     ttfts.sort()
     p50 = statistics.median(ttfts)
     p95 = ttfts[max(0, int(len(ttfts) * 0.95) - 1)]
@@ -220,6 +248,7 @@ async def run() -> dict:
                       "ttft_reduction_pct": round(100 * (1 - lw50 / lc50), 1),
                       "prefix_cache": long_prefix_stats,
                   },
+                  "obs": obs_extra,
                   "platform": "tpu" if on_tpu else "cpu"},
     }
 

@@ -483,9 +483,16 @@ async def run_node(cfg: Configuration, worker_mode: bool) -> None:
     await peer.start()
 
     gateway = None
+    obs_server = None
     if not worker_mode:
-        gateway = Gateway(peer, port=cfg.gateway_port)
+        gateway = Gateway(peer, port=cfg.gateway_port,
+                          trace_buffer=cfg.trace_buffer)
         await gateway.start()
+    elif cfg.worker_metrics_port:
+        from crowdllama_tpu.obs.http import ObsServer
+        obs_server = ObsServer(peer, host=cfg.listen_host,
+                               port=cfg.worker_metrics_port)
+        await obs_server.start()
 
     ipc = None
     if cfg.ipc_socket:
@@ -521,6 +528,8 @@ async def run_node(cfg: Configuration, worker_mode: bool) -> None:
                         "requests", cfg.drain_timeout)
         if ipc is not None:
             await ipc.stop()
+        if obs_server is not None:
+            await obs_server.stop()
         if gateway is not None:
             await gateway.stop()
         await peer.stop()

@@ -145,6 +145,13 @@ class Configuration:
     # (SURVEY §5: "TPU build: JAX profiler traces + per-request timing").
     profile_dir: str = ""
 
+    # Observability plane (obs/): per-node span ring-buffer capacity
+    # (GET /debug/trace on gateway and worker) and the worker-side
+    # /metrics + /debug/trace listener port (0 = disabled; workers have
+    # no other HTTP surface).
+    trace_buffer: int = 64
+    worker_metrics_port: int = 0
+
     # Multi-worker sharded serving (BASELINE configs 4-5): a node with
     # shard_count > 1 serves one shard of an N-way split; shard_group names
     # the group (same string on every member; default
@@ -226,6 +233,10 @@ class Configuration:
         cfg.drain_timeout = float(env.get("CROWDLLAMA_TPU_DRAIN_TIMEOUT",
                                           cfg.drain_timeout))
         cfg.profile_dir = env.get("CROWDLLAMA_TPU_PROFILE_DIR", cfg.profile_dir)
+        cfg.trace_buffer = int(env.get("CROWDLLAMA_TPU_TRACE_BUFFER",
+                                       cfg.trace_buffer))
+        cfg.worker_metrics_port = int(env.get(
+            "CROWDLLAMA_TPU_WORKER_METRICS_PORT", cfg.worker_metrics_port))
         if env.get("CROWDLLAMA_TPU_WARMUP"):
             cfg.warmup = env["CROWDLLAMA_TPU_WARMUP"] in ("1", "true")
         for k, v in overrides.items():
@@ -250,6 +261,12 @@ class Configuration:
                              "(want 'bf16' or 'int8')")
         # int8 KV composes with both layouts (paged pools carry per-page
         # scales; ops/pallas/paged.py dequantizes in-kernel).
+        if cfg.trace_buffer < 1:
+            raise ValueError(f"trace_buffer must be >= 1, "
+                             f"got {cfg.trace_buffer}")
+        if cfg.worker_metrics_port < 0:
+            raise ValueError(f"worker_metrics_port must be >= 0, "
+                             f"got {cfg.worker_metrics_port}")
         cfg.relay_mode = (cfg.relay_mode or "auto").strip().lower()
         if cfg.relay_mode not in ("auto", "always", "off"):
             raise ValueError(f"unknown relay_mode {cfg.relay_mode!r} "
@@ -345,6 +362,13 @@ class Configuration:
                             help="draft model checkpoint dir")
         parser.add_argument("--profile-dir", dest="profile_dir",
                             help="enable jax.profiler captures into this dir")
+        parser.add_argument("--trace-buffer", dest="trace_buffer", type=int,
+                            help="span ring-buffer capacity for "
+                                 "GET /debug/trace (default 64)")
+        parser.add_argument("--worker-metrics-port",
+                            dest="worker_metrics_port", type=int,
+                            help="worker-side /metrics + /debug/trace "
+                                 "listener port (0 = disabled)")
 
     @classmethod
     def from_flags(cls, args: argparse.Namespace) -> "Configuration":
@@ -357,7 +381,7 @@ class Configuration:
                 "quantize", "kv_layout", "kv_page_size", "kv_pool_tokens",
                 "kv_dtype", "relay_mode", "spec_decode", "spec_draft",
                 "spec_draft_model", "spec_draft_path",
-                "profile_dir",
+                "profile_dir", "trace_buffer", "worker_metrics_port",
                 "dist_coordinator", "dist_num_processes", "dist_process_id",
             )
         }

@@ -36,6 +36,11 @@ class Chunk:
     done_reason: str = ""
     prompt_tokens: int = 0
     completion_tokens: int = 0
+    # Tracing (crowdllama_tpu/obs): engines that know their real queue/
+    # prefill split stamp it on the FINAL chunk (ns); zero means "unknown"
+    # and the Engine seam falls back to first-chunk timing.
+    queue_ns: int = 0
+    prefill_ns: int = 0
 
 
 class StopMatcher:
@@ -78,9 +83,22 @@ class Engine:
     """Abstract engine seam."""
 
     models: list[str] = []
+    # NodeObs of the owning worker peer (set by Peer.start); None when the
+    # engine runs without a peer (IPC-only, unit tests).
+    obs = None
 
     async def start(self) -> None: ...
     async def stop(self) -> None: ...
+
+    def obs_gauges(self) -> dict:
+        """Engine/scheduler gauges for the /metrics exposition.
+
+        Every engine exposes the same four keys so the series exist on
+        every worker (FakeEngine included, at zero) — an absent series
+        breaks absent()-style alerts across engine kinds.
+        """
+        return {"pending_depth": 0.0, "active_slots": 0.0,
+                "batch_occupancy": 0.0, "kv_cache_utilization": 0.0}
 
     async def drain(self, timeout: float = 30.0) -> bool:
         """Finish in-flight work before shutdown; True when drained."""
@@ -123,6 +141,32 @@ class Engine:
 
     # ---- the UnifiedAPIHandler seam (api.go:19) --------------------------
 
+    def _obs_generate(self, msg: pb.BaseMessage, model: str,
+                      t0: int, first_ns: int, end_ns: int,
+                      final: "Chunk | None") -> None:
+        """Record worker-side spans + histograms for one generate exchange.
+
+        The queue/prefill split comes from the engine's own stamps on the
+        final chunk when available (JaxEngine: scheduler admission times);
+        otherwise prefill defaults to the first-chunk latency — the same
+        taxonomy either way, so FakeEngine traces read like real ones.
+        """
+        if self.obs is None:
+            return
+        queue_ns = getattr(final, "queue_ns", 0) if final else 0
+        prefill_ns = getattr(final, "prefill_ns", 0) if final else 0
+        if not prefill_ns:
+            prefill_ns = max(0, (first_ns or end_ns) - t0 - queue_ns)
+        decode_ns = max(0, (end_ns - t0) - queue_ns - prefill_ns)
+        steps = getattr(final, "completion_tokens", 0) if final else 0
+        if steps > 0 and decode_ns > 0:
+            self.obs.metrics.decode_step_seconds.observe(
+                decode_ns / steps / 1e9)
+        self.obs.observe_generate(
+            getattr(msg, "trace_id", ""), getattr(msg, "parent_span", ""),
+            model, queue_ns, prefill_ns, decode_ns, steps, end_ns - t0,
+            node="worker")
+
     async def handle(self, msg: pb.BaseMessage, worker_id: str = "") -> pb.BaseMessage:
         """Blocking BaseMessage → BaseMessage (reference semantics)."""
         if msg.WhichOneof("message") == "embed_request":
@@ -130,26 +174,41 @@ class Engine:
             t0 = time.monotonic_ns()
             vectors, n_tokens = await self.embed(
                 list(ereq.input), model=ereq.model, truncate=ereq.truncate)
+            dt = time.monotonic_ns() - t0
+            if self.obs is not None:
+                self.obs.metrics.request_seconds.labels(
+                    ereq.model).observe(dt / 1e9)
+                tid = getattr(msg, "trace_id", "")
+                if tid:
+                    self.obs.trace.record(
+                        tid, "embed", dt,
+                        parent=getattr(msg, "parent_span", ""))
+                    self.obs.trace.finish(tid, dt)
             return create_embed_response(
                 model=ereq.model, embeddings=vectors, worker_id=worker_id,
-                total_duration_ns=time.monotonic_ns() - t0,
+                total_duration_ns=dt,
                 prompt_tokens=n_tokens,
             )
         req = extract_generate_request(msg)
         t0 = time.monotonic_ns()
+        first_ns = 0
         text_parts: list[str] = []
         final: Chunk | None = None
         async for chunk in self._gen_from_request(req):
+            if not first_ns:
+                first_ns = time.monotonic_ns()
             text_parts.append(chunk.text)
             final = chunk
         assert final is not None
+        end_ns = time.monotonic_ns()
+        self._obs_generate(msg, req.model, t0, first_ns, end_ns, final)
         return create_generate_response(
             model=req.model,
             response="".join(text_parts),
             worker_id=worker_id,
             done=True,
             done_reason=final.done_reason or "stop",
-            total_duration_ns=time.monotonic_ns() - t0,
+            total_duration_ns=end_ns - t0,
             prompt_tokens=final.prompt_tokens,
             completion_tokens=final.completion_tokens,
         )
@@ -162,7 +221,15 @@ class Engine:
         stream flag but never streams)."""
         req = extract_generate_request(msg)
         t0 = time.monotonic_ns()
+        first_ns = 0
+        final: Chunk | None = None
         async for chunk in self._gen_from_request(req):
+            if not first_ns:
+                first_ns = time.monotonic_ns()
+            if chunk.done:
+                final = chunk
+                self._obs_generate(msg, req.model, t0, first_ns,
+                                   time.monotonic_ns(), final)
             yield create_generate_response(
                 model=req.model,
                 response=chunk.text,
@@ -331,6 +398,11 @@ class JaxEngine(Engine):
             return mp
         return None
 
+    def obs_gauges(self) -> dict:
+        if self.scheduler is None:
+            return super().obs_gauges()
+        return self.scheduler.telemetry_gauges()
+
     def describe(self) -> dict:
         d = {"models": self.models, "throughput": 0.0, "load": 0.0}
         if self._runner is not None:
@@ -454,6 +526,17 @@ class JaxEngine(Engine):
         matcher = StopMatcher(stop)
         completion = 0
         finished = False
+
+        def _trace_split() -> tuple[int, int]:
+            # Scheduler stamps → the final chunk's queue/prefill split
+            # (obs plane): worker_queue = submit→admission, prefill =
+            # admission→first token.
+            base = req.admitted_at or req.submitted_at
+            q = max(0.0, base - req.submitted_at)
+            p = (max(0.0, req.first_token_at - base)
+                 if req.first_token_at else 0.0)
+            return int(q * 1e9), int(p * 1e9)
+
         try:
             while True:
                 token, reason = await req.out.get()
@@ -461,10 +544,12 @@ class JaxEngine(Engine):
                     finished = True
                     if reason.startswith("error"):
                         raise RuntimeError(reason)
+                    q_ns, p_ns = _trace_split()
                     yield Chunk(
                         text=matcher.flush(), done=True, done_reason=reason,
                         prompt_tokens=len(prompt_ids),
                         completion_tokens=completion,
+                        queue_ns=q_ns, prefill_ns=p_ns,
                     )
                     return
                 completion += 1
@@ -477,10 +562,12 @@ class JaxEngine(Engine):
                 if stopped:
                     finished = True
                     self.scheduler.cancel(req)
+                    q_ns, p_ns = _trace_split()
                     yield Chunk(
                         text=emit, done=True, done_reason="stop",
                         prompt_tokens=len(prompt_ids),
                         completion_tokens=completion,
+                        queue_ns=q_ns, prefill_ns=p_ns,
                     )
                     return
                 if emit:

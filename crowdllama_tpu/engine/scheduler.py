@@ -63,6 +63,10 @@ class GenRequest:
     # queue of (token_id | _DONE sentinel, finish_reason)
     out: asyncio.Queue = field(default_factory=asyncio.Queue)
     submitted_at: float = field(default_factory=time.monotonic)
+    # Tracing stamps (crowdllama_tpu/obs): admitted_at is set when the
+    # scheduler pops the request for prefill, so worker_queue =
+    # admitted_at - submitted_at and prefill = first_token_at - admitted_at.
+    admitted_at: float = 0.0
     first_token_at: float = 0.0
     cancelled: bool = False  # client went away: drop at admission / free slot
 
@@ -224,6 +228,32 @@ class Scheduler:
         busy = sum(1 for s in self.slots if s is not None)
         return busy / max(1, len(self.slots))
 
+    def telemetry_gauges(self) -> dict:
+        """Scheduler gauges for the /metrics exposition (obs plane):
+        queue depth, batch occupancy, and KV-cache utilization — the
+        Orca-style knobs continuous batching is tuned by."""
+        active = sum(1 for s in self.slots if isinstance(s, _SlotInfo))
+        total = max(1, len(self.slots))
+        g = {
+            "pending_depth": float(self.pending.qsize() + len(self._deferred)
+                                   + self._admitting),
+            "active_slots": float(active),
+            "batch_occupancy": active / total,
+        }
+        r = self.runner
+        total_pages = getattr(r, "total_pages", 0)
+        free_pages = getattr(r, "_free_pages", None)
+        if total_pages and free_pages is not None:
+            # Paged KV: exact page-pool occupancy (includes cached prefix
+            # pages awaiting reuse/eviction).
+            g["kv_cache_utilization"] = 1.0 - len(free_pages) / total_pages
+        else:
+            # Contiguous KV: tokens materialized over total capacity.
+            used = sum(s.prompt_len + s.generated for s in self.slots
+                       if isinstance(s, _SlotInfo))
+            g["kv_cache_utilization"] = used / (total * max(1, r.max_seq))
+        return g
+
     # ------------------------------------------------------------------ loop
 
     def _free_slot(self) -> int | None:
@@ -257,6 +287,7 @@ class Scheduler:
     async def _admit_one(self, req: GenRequest, slot: int) -> None:
         import functools
 
+        req.admitted_at = time.monotonic()
         sub = self._req_key(req, 0)
         loop = asyncio.get_running_loop()
         first, ks, vs, plen = await loop.run_in_executor(
@@ -524,6 +555,7 @@ class Scheduler:
                     # stays single-flight.
                     import functools
 
+                    req.admitted_at = time.monotonic()
                     job = await loop.run_in_executor(
                         self._exec, functools.partial(
                             self.runner.prefill_begin, req.prompt_ids,

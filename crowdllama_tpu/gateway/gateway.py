@@ -33,9 +33,17 @@ from crowdllama_tpu.core.messages import (
     extract_generate_response,
 )
 from crowdllama_tpu.core.protocol import INFERENCE_PROTOCOL
+from crowdllama_tpu.obs import GATEWAY_ROOT_SPAN, NodeObs, new_trace_id
+from crowdllama_tpu.obs.http import host_stat_lines
+from crowdllama_tpu.obs.metrics import LabelGuard, engine_gauge_lines
 from crowdllama_tpu.peer.peer import Peer
 
 log = logging.getLogger("crowdllama.gateway")
+
+# Gateway span phases recorded per request (docs/OBSERVABILITY.md): the
+# always-present quartet + dial/stream_flush when the request paid them.
+_GW_PHASES = ("route", "serde", "aead", "io_wait")
+_GW_OPT_PHASES = ("dial", "stream_flush")
 
 
 def _now_rfc3339() -> str:
@@ -52,7 +60,8 @@ class _StreamStarted(Exception):
 
 
 class Gateway:
-    def __init__(self, peer: Peer, port: int = 9001, host: str = "0.0.0.0"):
+    def __init__(self, peer: Peer, port: int = 9001, host: str = "0.0.0.0",
+                 trace_buffer: int = 64):
         self.peer = peer
         self.port = port
         self.host = host
@@ -78,6 +87,7 @@ class Gateway:
         self.app.router.add_post("/v1/embeddings",
                                  self.handle_openai_embeddings)
         self.app.router.add_get("/metrics", self.handle_metrics)
+        self.app.router.add_get("/debug/trace", self.handle_trace)
         for route in ("/api/delete", "/api/create", "/api/copy", "/api/push"):
             self.app.router.add_route("*", route, self.handle_unsupported)
         # Prometheus-style counters fed by the logging middleware
@@ -95,10 +105,17 @@ class Gateway:
         self._ttfb_count = 0
         # Label hygiene: only registered routes become label values —
         # scanner probes of arbitrary paths must not grow the counter maps
-        # without bound or inject quotes into the exposition format.
+        # without bound or inject quotes into the exposition format.  The
+        # guard itself lives in obs/ (LabelGuard) so worker-side metrics
+        # apply the same policy to their labels.
         self._known_paths = {r.resource.canonical
                              for r in self.app.router.routes()
                              if r.resource is not None}
+        self._path_guard = LabelGuard(allowed=self._known_paths)
+        # Tracing + histogram plane (obs/): trace ids minted per routed
+        # request, spans recorded into the ring served at /debug/trace,
+        # histograms rendered into /metrics alongside the PR 1 counters.
+        self.obs = NodeObs(trace_capacity=trace_buffer, node="gateway")
         # Inference-stream pool: a request to a worker reuses an idle
         # encrypted stream instead of paying TCP connect + signed-hello
         # handshake (Ed25519 sign/verify + X25519) per request — the
@@ -167,32 +184,50 @@ class Gateway:
         mid-response abort leaves unread frames — close those instead)."""
         self._stream_pool.put(worker_id, s)
 
-    async def _dial(self, worker_id: str):
+    async def _dial(self, worker_id: str, acc: dict | None = None):
+        t0 = time.perf_counter_ns()
         contact = await self.peer.dht.find_peer(worker_id)
         if contact is None:
             raise LookupError(f"worker {worker_id[:8]} not resolvable")
-        return await self.peer.host.new_stream(contact, INFERENCE_PROTOCOL)
+        s = await self.peer.host.new_stream(contact, INFERENCE_PROTOCOL)
+        if acc is not None:
+            acc["dial_ns"] = acc.get("dial_ns", 0) \
+                + time.perf_counter_ns() - t0
+        return s
 
     # ------------------------------------------------- hot-path attribution
+    #
+    # Each helper charges the SAME timing to the process-wide _perf counters
+    # (PR 1 exposition, hotpath_snapshot) and — when the caller passes a
+    # per-request accumulator ``acc`` — to that request's trace spans, so
+    # bench phase numbers and /debug/trace spans are one instrumentation.
 
-    def _encode_frame(self, msg) -> bytes:
+    def _encode_frame(self, msg, acc: dict | None = None) -> bytes:
         """Serialize a request ONCE per _route attempt; the same bytes are
         reused if the pooled stream turns out stale and the request redials
         (previously the protobuf was re-encoded per send)."""
         t0 = time.perf_counter_ns()
         frame = wire.encode_frame(msg)
-        self._perf["serde_ns"] += time.perf_counter_ns() - t0
+        dt = time.perf_counter_ns() - t0
+        self._perf["serde_ns"] += dt
+        if acc is not None:
+            acc["serde_ns"] = acc.get("serde_ns", 0) + dt
         return frame
 
-    async def _send_frame(self, s, frame: bytes) -> None:
+    async def _send_frame(self, s, frame: bytes,
+                          acc: dict | None = None) -> None:
         # write() is synchronous buffering (+ inline seal, counted by the
         # secure layer's aead counters); only the drain is socket wait.
         s.writer.write(frame)
         t0 = time.perf_counter_ns()
         await s.writer.drain()
-        self._perf["io_wait_ns"] += time.perf_counter_ns() - t0
+        dt = time.perf_counter_ns() - t0
+        self._perf["io_wait_ns"] += dt
+        if acc is not None:
+            acc["io_wait_ns"] = acc.get("io_wait_ns", 0) + dt
 
-    async def _recv_pb(self, s, timeout: float = 600):
+    async def _recv_pb(self, s, timeout: float = 600,
+                       acc: dict | None = None):
         t0 = time.perf_counter_ns()
         payload = await wire.read_frame_payload(s.reader, timeout=timeout)
         t1 = time.perf_counter_ns()
@@ -200,6 +235,9 @@ class Gateway:
         t2 = time.perf_counter_ns()
         self._perf["io_wait_ns"] += t1 - t0
         self._perf["serde_ns"] += t2 - t1
+        if acc is not None:
+            acc["io_wait_ns"] = acc.get("io_wait_ns", 0) + (t1 - t0)
+            acc["serde_ns"] = acc.get("serde_ns", 0) + (t2 - t1)
         return reply
 
     def hotpath_snapshot(self) -> dict:
@@ -241,8 +279,7 @@ class Gateway:
             dt = time.monotonic() - t0
             log.info("%s %s -> %.0fms", request.method, request.path,
                      dt * 1000)
-            path = (request.path if request.path in self._known_paths
-                    else "other")
+            path = self._path_guard.value(request.path)
             key = (path, status)
             self._req_count[key] = self._req_count.get(key, 0) + 1
             self._req_seconds[key] = self._req_seconds.get(key, 0.0) + dt
@@ -434,51 +471,76 @@ class Gateway:
     async def _route_embed(self, model: str, inputs: list[str],
                            truncate: bool = True) -> tuple[dict, int]:
         msg = create_embed_request(model, inputs, truncate=truncate)
-        self._perf["requests"] += 1
-        tried: set[str] = set()
-        last_err = "no workers available for model"
-        for _attempt in range(2):  # retry once on next-best worker
-            worker = self._find_worker(model, exclude=tried,
-                                       require_embeddings=True)
-            if worker is None:
-                break
-            tried.add(worker.peer_id)
-            try:
-                reply = await self._roundtrip(worker.peer_id, msg)
-                resp = extract_embed_response(reply)
-                if resp.error.startswith("invalid:"):
-                    # Deterministic client error (e.g. truncate=false input
-                    # over the context window): 400, no retry.
-                    return {"error": resp.error[len("invalid:"):].strip(),
-                            "model": model}, 400
-                if resp.error:
-                    raise RuntimeError(resp.error)
-                return {
-                    "model": model,
-                    "embeddings": [list(e.values) for e in resp.embeddings],
-                    "total_duration": resp.total_duration,
-                    "prompt_eval_count": resp.prompt_tokens,
-                    "worker_id": resp.worker_id,
-                }, 200
-            except Exception as e:
-                last_err = str(e)
-                log.warning("embed via %s failed: %s", worker.peer_id[:8], e)
-        return {"error": f"embeddings failed: {last_err}",
-                "model": model}, 503
+        from crowdllama_tpu.net import secure
 
-    async def _roundtrip(self, worker_id: str, msg, timeout: float = 600):
+        tid = new_trace_id()
+        msg.trace_id = tid
+        msg.parent_span = GATEWAY_ROOT_SPAN
+        t0 = time.monotonic()
+        self._perf["requests"] += 1
+        acc: dict = {}
+        self.obs.trace.begin(tid, node="gateway", model=model,
+                             path="/api/embed")
+        aead0 = secure.aead_stats()[0]
+        status = 503
+        served_by = ""
+        try:
+            tried: set[str] = set()
+            last_err = "no workers available for model"
+            for _attempt in range(2):  # retry once on next-best worker
+                worker = self._find_worker(model, exclude=tried,
+                                           require_embeddings=True, acc=acc)
+                if worker is None:
+                    break
+                tried.add(worker.peer_id)
+                try:
+                    reply = await self._roundtrip(worker.peer_id, msg,
+                                                  acc=acc)
+                    resp = extract_embed_response(reply)
+                    if resp.error.startswith("invalid:"):
+                        # Deterministic client error (e.g. truncate=false
+                        # input over the context window): 400, no retry.
+                        status = 400
+                        served_by = worker.peer_id
+                        return {"error":
+                                resp.error[len("invalid:"):].strip(),
+                                "model": model}, 400
+                    if resp.error:
+                        raise RuntimeError(resp.error)
+                    status = 200
+                    served_by = worker.peer_id
+                    return {
+                        "model": model,
+                        "embeddings": [list(e.values)
+                                       for e in resp.embeddings],
+                        "total_duration": resp.total_duration,
+                        "prompt_eval_count": resp.prompt_tokens,
+                        "worker_id": resp.worker_id,
+                    }, 200
+                except Exception as e:
+                    last_err = str(e)
+                    log.warning("embed via %s failed: %s",
+                                worker.peer_id[:8], e)
+            return {"error": f"embeddings failed: {last_err}",
+                    "model": model}, 503
+        finally:
+            acc["aead_ns"] = max(0, secure.aead_stats()[0] - aead0)
+            self._finish_trace(tid, acc, model, t0, status, served_by)
+
+    async def _roundtrip(self, worker_id: str, msg, timeout: float = 600,
+                         acc: dict | None = None):
         """Request/reply over a pooled (or fresh) inference stream.
 
         A pooled stream can be stale (worker idled it out or restarted):
         generation/embedding requests are stateless, so the failed attempt
         retries once on a fresh dial — reusing the ALREADY-ENCODED frame
         bytes — before surfacing the error."""
-        frame = self._encode_frame(msg)
+        frame = self._encode_frame(msg, acc=acc)
         s = self._pool_get(worker_id)
         if s is not None:
             try:
-                await self._send_frame(s, frame)
-                reply = await self._recv_pb(s, timeout=timeout)
+                await self._send_frame(s, frame, acc=acc)
+                reply = await self._recv_pb(s, timeout=timeout, acc=acc)
                 self._pool_put(worker_id, s)
                 return reply
             except asyncio.CancelledError:
@@ -488,10 +550,10 @@ class Gateway:
                 s.close()
                 log.debug("pooled stream to %s stale (%s); redialing",
                           worker_id[:8], e)
-        s = await self._dial(worker_id)
+        s = await self._dial(worker_id, acc=acc)
         try:
-            await self._send_frame(s, frame)
-            reply = await self._recv_pb(s, timeout=timeout)
+            await self._send_frame(s, frame, acc=acc)
+            reply = await self._recv_pb(s, timeout=timeout, acc=acc)
         except BaseException:
             s.close()
             raise
@@ -651,20 +713,23 @@ class Gateway:
         lines.append(
             f"crowdllama_route_snapshot_rebuilds_total "
             f"{hp['route_snapshot_rebuilds']}")
-        lines.append("# TYPE crowdllama_host_streams_total counter")
-        for k, v in sorted(self.peer.host.stats.items()):
-            # Only the stream-kind counters belong under this metric;
-            # non-stream stats (e.g. "rejected") get their own series so
-            # new Host stats keys can't silently change its meaning.
-            if k.startswith("streams_"):
-                lines.append(
-                    f'crowdllama_host_streams_total{{kind="{k}"}} {v}')
-        lines.append("# TYPE crowdllama_host_rejected_total counter")
-        lines.append(
-            f"crowdllama_host_rejected_total "
-            f"{self.peer.host.stats.get('rejected', 0)}")
+        # Swarm-uniform families (obs/): request/TTFT/decode-step
+        # histograms + engine gauges — the same series a worker's
+        # ObsServer exposes, so one dashboard reads every node.
+        lines.extend(self.obs.metrics.expose())
+        engine = getattr(self.peer, "engine", None)
+        if engine is not None:
+            try:
+                lines.extend(engine_gauge_lines(engine.obs_gauges()))
+            except Exception as e:
+                log.debug("engine gauges unavailable: %s", e)
+        lines.extend(host_stat_lines(self.peer.host))
         return web.Response(text="\n".join(lines) + "\n",
                             content_type="text/plain")
+
+    async def handle_trace(self, request: web.Request) -> web.Response:
+        """GET /debug/trace — JSON dump of the span ring buffer."""
+        return web.json_response(self.obs.trace.snapshot())
 
     async def handle_unsupported(self, request: web.Request) -> web.Response:
         """Model management (delete/create/copy/push) has no meaning at the
@@ -676,7 +741,8 @@ class Gateway:
     # -------------------------------------------------------------- routing
 
     def _find_worker(self, model: str, exclude: set[str] = frozenset(),
-                     require_embeddings: bool = False):
+                     require_embeddings: bool = False,
+                     acc: dict | None = None):
         pm = self.peer.peer_manager
         if pm is None:
             return None
@@ -685,7 +751,10 @@ class Gateway:
             return pm.find_best_worker(model, exclude=exclude,
                                        require_embeddings=require_embeddings)
         finally:
-            self._perf["route_ns"] += time.perf_counter_ns() - t0
+            dt = time.perf_counter_ns() - t0
+            self._perf["route_ns"] += dt
+            if acc is not None:
+                acc["route_ns"] = acc.get("route_ns", 0) + dt
 
     # --------------------------------------------------- OpenAI-compat v1
 
@@ -933,56 +1002,104 @@ class Gateway:
             repeat_penalty=max(0.0, float(
                 options.get("repeat_penalty", 1.0) or 1.0)),
         )
+        from crowdllama_tpu.net import secure
+
+        # Mint the trace id here — the admission point every hop downstream
+        # (stream pool, worker peer, engine, relay splice) inherits it from.
+        tid = new_trace_id()
+        msg.trace_id = tid
+        msg.parent_span = GATEWAY_ROOT_SPAN
         t0 = time.monotonic()  # TTFB measures from ADMISSION, retries included
         self._perf["requests"] += 1
-        tr = time.perf_counter_ns()
-        akey, continuation = self._affinity_key(model, messages, prompt)
-        self._perf["route_ns"] += time.perf_counter_ns() - tr
-        tried: set[str] = set()
-        last_err = "no workers available for model"
-        for _attempt in range(2):  # retry once on next-best worker
-            worker = None
-            used_affinity = False
+        acc: dict = {}
+        self.obs.trace.begin(tid, node="gateway", model=model,
+                             path=request.path, stream=stream)
+        aead0 = secure.aead_stats()[0]
+        status = 503
+        served_by = ""
+        try:
             tr = time.perf_counter_ns()
-            affine = (self._affinity_get(akey, model)
-                      if continuation else None)
+            akey, continuation = self._affinity_key(model, messages, prompt)
             self._perf["route_ns"] += time.perf_counter_ns() - tr
-            if affine is not None and affine.peer_id not in tried:
-                worker = affine
-                used_affinity = True
-            if worker is None:
-                worker = self._find_worker(model, exclude=tried)
-            if worker is None:
-                break
-            tried.add(worker.peer_id)
-            try:
-                resp = await self._forward(request, worker.peer_id, msg,
-                                           stream, shape, t0)
-                self._affinity_put(akey, worker.peer_id)
-                if used_affinity:
-                    # Counted only when the pinned route actually served:
-                    # a failed forward falls back to scoring and must not
-                    # inflate the hit counter.
-                    self._affinity_hits += 1
-                return resp
-            except _StreamStarted as e:
-                # Headers/chunks already went out: no retry, no second
-                # response — the error frame was already written downstream.
-                # The prefill still populated this worker's prefix cache,
-                # so the affinity record stays useful.
-                self._affinity_put(akey, worker.peer_id)
-                if used_affinity:
-                    self._affinity_hits += 1
-                log.warning("stream to client aborted mid-flight: %s", e.cause)
-                return e.response
-            except Exception as e:
-                last_err = str(e)
-                log.warning("worker %s failed: %s", worker.peer_id[:8], e)
-        if shape.startswith("openai"):
-            return self._openai_error(f"inference failed: {last_err}", 503,
-                                      "server_error")
-        return web.json_response(
-            {"error": f"inference failed: {last_err}", "model": model}, status=503)
+            acc["route_ns"] = acc.get("route_ns", 0) \
+                + time.perf_counter_ns() - tr
+            tried: set[str] = set()
+            last_err = "no workers available for model"
+            for _attempt in range(2):  # retry once on next-best worker
+                worker = None
+                used_affinity = False
+                tr = time.perf_counter_ns()
+                affine = (self._affinity_get(akey, model)
+                          if continuation else None)
+                dt_aff = time.perf_counter_ns() - tr
+                self._perf["route_ns"] += dt_aff
+                acc["route_ns"] = acc.get("route_ns", 0) + dt_aff
+                if affine is not None and affine.peer_id not in tried:
+                    worker = affine
+                    used_affinity = True
+                if worker is None:
+                    worker = self._find_worker(model, exclude=tried, acc=acc)
+                if worker is None:
+                    break
+                tried.add(worker.peer_id)
+                try:
+                    resp = await self._forward(request, worker.peer_id, msg,
+                                               stream, shape, t0, acc=acc)
+                    self._affinity_put(akey, worker.peer_id)
+                    if used_affinity:
+                        # Counted only when the pinned route actually
+                        # served: a failed forward falls back to scoring
+                        # and must not inflate the hit counter.
+                        self._affinity_hits += 1
+                    served_by = worker.peer_id
+                    status = resp.status
+                    return resp
+                except _StreamStarted as e:
+                    # Headers/chunks already went out: no retry, no second
+                    # response — the error frame was already written
+                    # downstream.  The prefill still populated this
+                    # worker's prefix cache, so the affinity record stays
+                    # useful.
+                    self._affinity_put(akey, worker.peer_id)
+                    if used_affinity:
+                        self._affinity_hits += 1
+                    log.warning("stream to client aborted mid-flight: %s",
+                                e.cause)
+                    served_by = worker.peer_id
+                    status = e.response.status
+                    return e.response
+                except Exception as e:
+                    last_err = str(e)
+                    log.warning("worker %s failed: %s", worker.peer_id[:8], e)
+            if shape.startswith("openai"):
+                return self._openai_error(
+                    f"inference failed: {last_err}", 503, "server_error")
+            return web.json_response(
+                {"error": f"inference failed: {last_err}", "model": model},
+                status=503)
+        finally:
+            acc["aead_ns"] = max(0, secure.aead_stats()[0] - aead0)
+            self._finish_trace(tid, acc, model, t0, status, served_by)
+
+    def _finish_trace(self, tid: str, acc: dict, model: str, t0: float,
+                      status: int, worker_id: str = "") -> None:
+        """Flush one routed request's accumulated phase timings into its
+        trace record and the request_seconds histogram.  The aead figure is
+        a process-wide delta over the request window (net/secure.py keeps
+        module counters), so concurrent requests' seal/open time can bleed
+        into each other's span — fine for attribution, not for billing."""
+        total_ns = int((time.monotonic() - t0) * 1e9)
+        tr = self.obs.trace
+        for phase in _GW_PHASES:
+            tr.record(tid, phase, acc.get(phase + "_ns", 0),
+                      parent=GATEWAY_ROOT_SPAN)
+        for phase in _GW_OPT_PHASES:
+            if acc.get(phase + "_ns"):
+                tr.record(tid, phase, acc[phase + "_ns"],
+                          parent=GATEWAY_ROOT_SPAN)
+        tr.finish(tid, total_ns, status=status,
+                  worker=worker_id[:8] if worker_id else "")
+        self.obs.metrics.request_seconds.labels(model).observe(total_ns / 1e9)
 
     def _observe_ttfb(self, dt: float) -> None:
         for i, le in enumerate(self._ttfb_le):
@@ -993,14 +1110,19 @@ class Gateway:
             self._ttfb_buckets[-1] += 1
         self._ttfb_sum += dt
         self._ttfb_count += 1
+        self.obs.metrics.ttft_seconds.observe(dt)
 
     async def _forward(self, request, worker_id: str, msg, stream: bool,
-                       shape: str, t0: float) -> web.StreamResponse:
+                       shape: str, t0: float,
+                       acc: dict | None = None) -> web.StreamResponse:
         """Open an inference stream to the worker and relay the reply
         (gateway.go:243-298).  ``shape`` picks the client dialect:
         Ollama NDJSON ("chat"/"generate") or OpenAI SSE ("openai-*").
         ``t0`` is the _route admission time: the TTFB histogram must
-        charge failed-worker retries to the request, not reset on them."""
+        charge failed-worker retries to the request, not reset on them.
+        ``acc`` is the per-request phase accumulator from _route."""
+        if acc is None:
+            acc = {}
         openai = shape.startswith("openai")
         rid = ("chatcmpl-" if shape == "openai-chat" else "cmpl-") \
             + os.urandom(12).hex()
@@ -1016,7 +1138,7 @@ class Gateway:
             return self._ollama_json(resp, shape == "chat", final=final)
 
         if not stream:
-            reply = await self._roundtrip(worker_id, msg)
+            reply = await self._roundtrip(worker_id, msg, acc=acc)
             resp = extract_generate_response(reply)
             if resp.done_reason == "error":
                 raise RuntimeError(resp.response)
@@ -1027,14 +1149,14 @@ class Gateway:
         # worker that dies immediately is still retryable by _route — and
         # so a STALE pooled stream is detected while a fresh redial is
         # still possible.
-        frame = self._encode_frame(msg)
+        frame = self._encode_frame(msg, acc=acc)
         s = self._pool_get(worker_id)
         first = None
         if s is not None:
             try:
-                await self._send_frame(s, frame)
+                await self._send_frame(s, frame, acc=acc)
                 first = extract_generate_response(
-                    await self._recv_pb(s, timeout=600))
+                    await self._recv_pb(s, timeout=600, acc=acc))
             except asyncio.CancelledError:
                 s.close()
                 raise
@@ -1044,11 +1166,11 @@ class Gateway:
                 log.debug("pooled stream to %s stale (%s); redialing",
                           worker_id[:8], e)
         if s is None:
-            s = await self._dial(worker_id)
+            s = await self._dial(worker_id, acc=acc)
             try:
-                await self._send_frame(s, frame)
+                await self._send_frame(s, frame, acc=acc)
                 first = extract_generate_response(
-                    await self._recv_pb(s, timeout=600))
+                    await self._recv_pb(s, timeout=600, acc=acc))
             except BaseException:
                 s.close()
                 raise
@@ -1069,12 +1191,18 @@ class Gateway:
 
             async def write_frame(payload: dict) -> None:
                 line = json.dumps(payload).encode()
+                tw = time.perf_counter_ns()
                 if openai:
                     await out.write(b"data: " + line + b"\n\n")
                 else:
                     await out.write(line + b"\n")
+                acc["stream_flush_ns"] = acc.get("stream_flush_ns", 0) \
+                    + time.perf_counter_ns() - tw
 
             resp = first
+            # Inter-frame receive gap ≈ worker decode step + wire, as seen
+            # from the gateway — the consumer-side decode_step histogram.
+            t_prev = time.perf_counter_ns()
             try:
                 while True:
                     if resp.done_reason == "error":
@@ -1084,7 +1212,11 @@ class Gateway:
                         clean = True  # terminal frame read: stream reusable
                         break
                     resp = extract_generate_response(
-                        await self._recv_pb(s, timeout=600))
+                        await self._recv_pb(s, timeout=600, acc=acc))
+                    t_now = time.perf_counter_ns()
+                    self.obs.metrics.decode_step_seconds.observe(
+                        (t_now - t_prev) / 1e9)
+                    t_prev = t_now
                 if openai:
                     await out.write(b"data: [DONE]\n\n")
             except Exception as e:

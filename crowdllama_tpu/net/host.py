@@ -415,6 +415,11 @@ class Host:
         # classification is per-protocol stream counts + rejections).
         self.stats: dict[str, int] = {
             "streams_in": 0, "streams_out": 0, "rejected": 0,
+            # Cumulative client-side handshake time (signed hello + ECDH),
+            # surfaced as crowdllama_host_handshake_seconds_total by
+            # obs/http.py: rate(handshake)/rate(streams_out) is the dial
+            # overhead a trace's "dial" span attributes per request.
+            "handshake_ns": 0,
         }
         self.stats_by_protocol: dict[str, int] = {}
         # DISTINCT inbound peers by address class (the TCP analog of the
@@ -568,6 +573,7 @@ class Host:
         byte pipe (a raw TCP connection, or a relay-spliced stream —
         ``contact`` maps the authenticated remote id to the Contact stored
         in the peerstore)."""
+        t_hs = time.perf_counter_ns()
         # Nonce exchange: we challenge the server, it challenges us.
         my_nonce = os.urandom(16).hex()
         await write_json_frame(writer, {"proto": protocol, "nonce": my_nonce})
@@ -615,6 +621,7 @@ class Host:
         if remote_contact is not None:
             self.peerstore[remote_id] = remote_contact
         self.stats["streams_out"] += 1
+        self.stats["handshake_ns"] += time.perf_counter_ns() - t_hs
         return Stream(
             protocol=protocol,
             remote_peer_id=remote_id,

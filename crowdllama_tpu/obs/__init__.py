@@ -1,0 +1,71 @@
+"""Swarm-wide observability plane: request tracing + histogram metrics.
+
+Every node (gateway and worker) owns one :class:`NodeObs` holding
+
+- a bounded :class:`~crowdllama_tpu.obs.trace.TraceBuffer` of per-request
+  span trees, exposed as JSON at ``GET /debug/trace``;
+- a :class:`~crowdllama_tpu.obs.metrics.NodeMetrics` bundle of the three
+  fixed-bucket histograms (``crowdllama_request_seconds``,
+  ``crowdllama_ttft_seconds``, ``crowdllama_decode_step_seconds``)
+  rendered into the Prometheus text exposition on ``GET /metrics``.
+
+Trace ids ride the ``llama.v1.BaseMessage`` envelope (``trace_id`` /
+``parent_span``, proto fields 5/6 outside the oneof) so one id follows a
+request gateway -> stream pool -> worker peer -> engine, including across
+the relay splice (the splice forwards sealed ciphertext, so the fields
+cross it untouched).  See docs/OBSERVABILITY.md for the span taxonomy and
+the ``/debug/trace`` schema.
+"""
+
+from __future__ import annotations
+
+from crowdllama_tpu.obs.metrics import (  # noqa: F401
+    DECODE_STEP_BUCKETS,
+    REQUEST_BUCKETS,
+    TTFT_BUCKETS,
+    Histogram,
+    HistogramVec,
+    LabelGuard,
+    NodeMetrics,
+)
+from crowdllama_tpu.obs.trace import Span, TraceBuffer, new_trace_id  # noqa: F401
+
+GATEWAY_ROOT_SPAN = "gateway"
+
+# Engine/scheduler gauge keys every Engine.obs_gauges() returns; the
+# exposition layer maps them to crowdllama_engine_<key> gauges on both the
+# gateway and the worker /metrics endpoints.
+ENGINE_GAUGES = (
+    "pending_depth",
+    "active_slots",
+    "batch_occupancy",
+    "kv_cache_utilization",
+)
+
+
+class NodeObs:
+    """One node's tracing + metrics state (gateway or worker)."""
+
+    def __init__(self, trace_capacity: int = 64, node: str = "") -> None:
+        self.node = node
+        self.trace = TraceBuffer(capacity=trace_capacity, node=node)
+        self.metrics = NodeMetrics()
+
+    def observe_generate(self, trace_id: str, parent: str, model: str,
+                         queue_ns: int, prefill_ns: int, decode_ns: int,
+                         steps: int, total_ns: int, **meta) -> None:
+        """Record one served generate exchange: worker-side spans + histograms.
+
+        Called at the Engine seam so FakeEngine and JaxEngine produce the
+        same span taxonomy (worker_queue / prefill / decode_step).
+        """
+        self.metrics.request_seconds.labels(model).observe(total_ns / 1e9)
+        self.metrics.ttft_seconds.observe((queue_ns + prefill_ns) / 1e9)
+        if trace_id:
+            t = self.trace
+            t.begin(trace_id, model=model, **meta)
+            t.record(trace_id, "worker_queue", queue_ns, parent=parent)
+            t.record(trace_id, "prefill", prefill_ns, parent=parent)
+            t.record(trace_id, "decode_step", decode_ns, parent=parent,
+                     steps=steps)
+            t.finish(trace_id, total_ns)

@@ -1,0 +1,82 @@
+"""Worker-side observability HTTP server: /metrics + /debug/trace.
+
+Workers have no consumer-facing HTTP surface (that is the gateway's job),
+but the tracing plane needs every node scrapeable: :class:`ObsServer` is a
+minimal aiohttp listener serving the same metric families as the gateway
+(``crowdllama_request_seconds`` / ``crowdllama_ttft_seconds`` /
+``crowdllama_decode_step_seconds`` + engine gauges + host stream counters)
+and the node's trace ring buffer as JSON.
+
+Enabled via ``--worker-metrics-port`` (0 = disabled, the default; tests
+pass ``port=0`` explicitly through ``ObsServer`` to bind an ephemeral
+port).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from aiohttp import web
+
+from crowdllama_tpu.obs.metrics import engine_gauge_lines
+
+log = logging.getLogger("crowdllama.obs")
+
+
+def host_stat_lines(host) -> list[str]:
+    """Host stream-path counters, identical series on gateway and worker."""
+    lines = ["# TYPE crowdllama_host_streams_total counter"]
+    for k, v in sorted(host.stats.items()):
+        if k.startswith("streams_"):
+            lines.append(f'crowdllama_host_streams_total{{kind="{k}"}} {v}')
+    lines.append("# TYPE crowdllama_host_rejected_total counter")
+    lines.append(
+        f"crowdllama_host_rejected_total {host.stats.get('rejected', 0)}")
+    lines.append("# TYPE crowdllama_host_handshake_seconds_total counter")
+    lines.append(
+        f"crowdllama_host_handshake_seconds_total "
+        f"{host.stats.get('handshake_ns', 0) / 1e9:.6f}")
+    return lines
+
+
+class ObsServer:
+    """Per-worker metrics/trace endpoint, mirroring the gateway's."""
+
+    def __init__(self, peer, host: str = "127.0.0.1", port: int = 0) -> None:
+        self.peer = peer
+        self.host = host
+        self.port = port
+        self._runner: web.AppRunner | None = None
+        self.app = web.Application()
+        self.app.router.add_get("/metrics", self.handle_metrics)
+        self.app.router.add_get("/debug/trace", self.handle_trace)
+
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self.app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        # Resolve the bound port (port=0 binds ephemeral).
+        self.port = self._runner.addresses[0][1]
+        log.info("worker obs endpoint on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        obs = self.peer.obs
+        lines = obs.metrics.expose()
+        engine = getattr(self.peer, "engine", None)
+        if engine is not None:
+            try:
+                lines.extend(engine_gauge_lines(engine.obs_gauges()))
+            except Exception as e:  # a sick engine must not break the scrape
+                log.debug("engine gauges unavailable: %s", e)
+        lines.extend(host_stat_lines(self.peer.host))
+        return web.Response(text="\n".join(lines) + "\n",
+                            content_type="text/plain")
+
+    async def handle_trace(self, request: web.Request) -> web.Response:
+        return web.json_response(self.peer.obs.trace.snapshot())

@@ -1,0 +1,226 @@
+"""Fixed-bucket histograms + label hygiene for the /metrics exposition.
+
+Replaces the counters-only exposition of PR 1 with latency distributions:
+
+- ``crowdllama_request_seconds``     end-to-end per request, labeled by model
+- ``crowdllama_ttft_seconds``        time to first token
+- ``crowdllama_decode_step_seconds`` per decode step
+
+Both the gateway and the worker-side ObsServer render the same families
+through :class:`NodeMetrics`, so a scraper sees one schema swarm-wide.
+
+:class:`LabelGuard` is the generalized form of the gateway's path
+allowlist: every labeled series (paths, model names, phase names) goes
+through a guard so a client cannot mint unbounded series by varying a
+request field (label-cardinality DoS on the scrape pipeline).
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from typing import Iterable
+
+# Bucket upper bounds in seconds.  Request/TTFT cover loopback FakeEngine
+# (sub-ms) through big-model TPU prefill (tens of seconds); decode steps
+# cover fused-kernel steps (sub-ms) through CPU-interpreted tiny models.
+REQUEST_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                   1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+TTFT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                1.0, 2.5, 5.0, 10.0)
+DECODE_STEP_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+                       0.05, 0.1, 0.25, 0.5, 1.0)
+
+_LABEL_VALUE_RE = re.compile(r"^[A-Za-z0-9_.:/\-]{1,64}$")
+
+
+def _fmt(v: float) -> str:
+    """Exposition number format: integers without a trailing .0."""
+    if isinstance(v, float) and v.is_integer():
+        return str(int(v))
+    return repr(float(v))
+
+
+class LabelGuard:
+    """Bound the value space of one metric label.
+
+    A value passes when it matches the explicit allowlist (if given) or,
+    with no allowlist, when it looks like a sane identifier AND the number
+    of distinct values seen so far is under ``max_values``.  Everything
+    else collapses to ``fallback`` so series cardinality stays bounded no
+    matter what strings arrive from the network.
+    """
+
+    def __init__(self, allowed: Iterable[str] | None = None,
+                 max_values: int = 64, fallback: str = "other") -> None:
+        self._allowed = frozenset(allowed) if allowed is not None else None
+        self._max = max(1, int(max_values))
+        self._fallback = fallback
+        self._seen: set[str] = set()
+        self._lock = threading.Lock()
+
+    def value(self, raw: object) -> str:
+        s = str(raw) if raw else ""
+        if self._allowed is not None:
+            return s if s in self._allowed else self._fallback
+        if not _LABEL_VALUE_RE.match(s):
+            return self._fallback
+        with self._lock:
+            if s not in self._seen:
+                if len(self._seen) >= self._max:
+                    return self._fallback
+                self._seen.add(s)
+        return s
+
+
+class Histogram:
+    """Fixed-bucket histogram, rendered cumulatively at exposition time."""
+
+    def __init__(self, buckets: Iterable[float]) -> None:
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        if not self.buckets:
+            raise ValueError("histogram needs at least one bucket")
+        self._counts = [0] * (len(self.buckets) + 1)  # +1 = overflow (+Inf)
+        self._sum = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        idx = len(self.buckets)
+        for i, b in enumerate(self.buckets):
+            if v <= b:
+                idx = i
+                break
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum += v
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return sum(self._counts)
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def snapshot_counts(self) -> list[int]:
+        """Non-cumulative per-bucket counts (last = overflow); benchmarks
+        diff two snapshots to get a per-window distribution."""
+        with self._lock:
+            return list(self._counts)
+
+    def quantile(self, q: float) -> float:
+        """Bucket-resolution quantile estimate (PromQL histogram_quantile
+        semantics).  Benchmarks read their percentiles from here so the
+        published number is the same one a dashboard would compute from
+        the scraped series."""
+        return quantile_from_counts(self.buckets, self.snapshot_counts(), q)
+
+    def lines(self, name: str, labels: str = "") -> list[str]:
+        """Series lines (no TYPE header) for one child of a family.
+
+        ``labels`` is a pre-rendered ``key="value"`` list without braces.
+        """
+        with self._lock:
+            counts = list(self._counts)
+            total_sum = self._sum
+        sep = "," if labels else ""
+        out: list[str] = []
+        cum = 0
+        for b, c in zip(self.buckets, counts):
+            cum += c
+            out.append(f'{name}_bucket{{{labels}{sep}le="{_fmt(b)}"}} {cum}')
+        cum += counts[-1]
+        out.append(f'{name}_bucket{{{labels}{sep}le="+Inf"}} {cum}')
+        out.append(f"{name}_sum{{{labels}}} {_fmt(total_sum)}"
+                   if labels else f"{name}_sum {_fmt(total_sum)}")
+        out.append(f"{name}_count{{{labels}}} {cum}"
+                   if labels else f"{name}_count {cum}")
+        return out
+
+
+def quantile_from_counts(buckets: tuple[float, ...], counts: list[int],
+                         q: float) -> float:
+    """Quantile of a (buckets, non-cumulative counts) pair: linear
+    interpolation inside the bucket, the overflow bucket clamps to the
+    highest finite bound.  Counts may be a DELTA of two snapshots."""
+    q = min(1.0, max(0.0, float(q)))
+    total = sum(counts)
+    if total == 0:
+        return 0.0
+    rank = q * total
+    cum = 0
+    lo = 0.0
+    for b, c in zip(buckets, counts):
+        cum += c
+        if cum >= rank:
+            if c == 0:
+                return b
+            return lo + (b - lo) * (1 - (cum - rank) / c)
+        lo = b
+    return buckets[-1]
+
+
+class HistogramVec:
+    """Histogram family keyed by one guarded label."""
+
+    def __init__(self, buckets: Iterable[float], label: str,
+                 guard: LabelGuard | None = None) -> None:
+        self._buckets = tuple(buckets)
+        self._label = label
+        self._guard = guard or LabelGuard(max_values=32)
+        self._children: dict[str, Histogram] = {}
+        self._lock = threading.Lock()
+
+    def labels(self, value: object) -> Histogram:
+        key = self._guard.value(value)
+        with self._lock:
+            h = self._children.get(key)
+            if h is None:
+                h = Histogram(self._buckets)
+                self._children[key] = h
+            return h
+
+    def expose(self, name: str) -> list[str]:
+        out = [f"# TYPE {name} histogram"]
+        with self._lock:
+            children = sorted(self._children.items())
+        for key, h in children:
+            out.extend(h.lines(name, f'{self._label}="{key}"'))
+        return out
+
+
+class NodeMetrics:
+    """The three per-node histogram families, one instance per node."""
+
+    def __init__(self) -> None:
+        self.model_guard = LabelGuard(max_values=32)
+        self.request_seconds = HistogramVec(
+            REQUEST_BUCKETS, "model", self.model_guard)
+        self.ttft_seconds = Histogram(TTFT_BUCKETS)
+        self.decode_step_seconds = Histogram(DECODE_STEP_BUCKETS)
+
+    def expose(self) -> list[str]:
+        out = self.request_seconds.expose("crowdllama_request_seconds")
+        out.append("# TYPE crowdllama_ttft_seconds histogram")
+        out.extend(self.ttft_seconds.lines("crowdllama_ttft_seconds"))
+        out.append("# TYPE crowdllama_decode_step_seconds histogram")
+        out.extend(self.decode_step_seconds.lines(
+            "crowdllama_decode_step_seconds"))
+        return out
+
+
+def engine_gauge_lines(gauges: dict) -> list[str]:
+    """Render Engine.obs_gauges() as crowdllama_engine_* gauges."""
+    out: list[str] = []
+    for key in sorted(gauges):
+        try:
+            val = float(gauges[key])
+        except (TypeError, ValueError):
+            continue
+        name = f"crowdllama_engine_{key}"
+        out.append(f"# TYPE {name} gauge")
+        out.append(f"{name} {_fmt(val)}")
+    return out

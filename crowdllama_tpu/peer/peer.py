@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 
 from crowdllama_tpu.utils.crypto_compat import Ed25519PrivateKey
 
@@ -31,6 +32,7 @@ from crowdllama_tpu.core.resource import Resource
 from crowdllama_tpu.engine.engine import Engine
 from crowdllama_tpu.net.discovery import discover_peers, new_host_and_dht, request_peer_metadata
 from crowdllama_tpu.net.host import Stream
+from crowdllama_tpu.obs import NodeObs
 from crowdllama_tpu.peermanager.manager import PeerHealthConfig, PeerManager
 from crowdllama_tpu.utils.aio import run_every
 from crowdllama_tpu.version import VERSION
@@ -128,6 +130,11 @@ class Peer:
         self._tasks: list[asyncio.Task] = []
         self.relay_client = None  # net/relay.py RelayClient when relaying
         self.relay_service = None  # RelayService when hosting one (public)
+        # Per-node observability plane (trace ring + histograms): served by
+        # obs/http.ObsServer on workers, read directly by tests/benches.
+        self.obs = NodeObs(
+            trace_capacity=getattr(config, "trace_buffer", 64) or 64,
+            node="worker" if worker_mode else "consumer")
 
     # ----------------------------------------------------------- lifecycle
 
@@ -165,6 +172,11 @@ class Peer:
         if shard_service is not None:
             # Sharded-model member: serve our pipeline stage to group leaders.
             self.host.set_stream_handler(SHARD_PROTOCOL, shard_service.handle)
+        # The engine records worker_queue/prefill/decode_step spans and the
+        # per-request histograms into this node's obs plane (engine.py
+        # _obs_generate); attach BEFORE attach_peer so engine overrides see
+        # a fully wired peer.
+        self.engine.obs = self.obs
         self.engine.attach_peer(self)
 
         self.peer_manager = PeerManager(
@@ -551,21 +563,39 @@ class Peer:
         except (wire.WireError, asyncio.TimeoutError, OSError) as e:
             log.debug("inference stream read ended: %s", e)
             return False
+        # Trace propagation: the gateway's id arrives on the envelope and is
+        # echoed on every response frame, so a multi-hop consumer (relay
+        # splice included) can correlate replies without holding state.
+        tid = msg.trace_id
         try:
             which = msg.WhichOneof("message")
             if which == "embed_request":
                 reply = await self.engine.handle(msg, worker_id=self.peer_id)
+                reply.trace_id = tid
                 await wire.write_length_prefixed_pb(stream.writer, reply)
                 return True
             req = msg.generate_request
             if which != "generate_request":
                 raise ValueError("expected GenerateRequest")
             if req.stream:
+                flush_ns = 0
                 async for frame in self.engine.handle_streaming(msg, worker_id=self.peer_id):
+                    frame.trace_id = tid
+                    t0 = time.perf_counter_ns()
                     await wire.write_length_prefixed_pb(stream.writer, frame)
+                    flush_ns += time.perf_counter_ns() - t0
+                if tid:
+                    self.obs.trace.record(tid, "stream_flush", flush_ns,
+                                          parent=msg.parent_span)
             else:
                 reply = await self.engine.handle(msg, worker_id=self.peer_id)
+                reply.trace_id = tid
+                t0 = time.perf_counter_ns()
                 await wire.write_length_prefixed_pb(stream.writer, reply)
+                if tid:
+                    self.obs.trace.record(
+                        tid, "stream_flush", time.perf_counter_ns() - t0,
+                        parent=msg.parent_span)
             return True
         except Exception as e:
             # Synthesize an error response (peer.go:233-243).
@@ -598,6 +628,7 @@ class Peer:
                     done=True,
                     done_reason="error",
                 )
+            err.trace_id = tid
             try:
                 await wire.write_length_prefixed_pb(stream.writer, err)
             except Exception:

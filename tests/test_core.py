@@ -151,6 +151,31 @@ class TestWireCodec:
         with pytest.raises(ValueError):
             extract_generate_request(create_generate_response("m", "r"))
 
+    def test_frame_without_trace_id_roundtrips_untouched(self):
+        # Back-compat with pre-tracing peers: a frame that never set
+        # trace_id/parent_span must decode with empty trace fields and
+        # re-serialize byte-identically (proto3 absent-string semantics —
+        # no spurious field tags on the wire).
+        msg = create_generate_request("llama-3-8b", "hello", max_tokens=4)
+        assert msg.trace_id == "" and msg.parent_span == ""
+        raw = msg.SerializeToString()
+        got = pb.BaseMessage()
+        got.ParseFromString(raw)
+        assert got.trace_id == "" and got.parent_span == ""
+        assert got.SerializeToString() == raw
+        assert extract_generate_request(got).model == "llama-3-8b"
+
+    def test_trace_id_roundtrips_over_wire(self):
+        a, b = socket.socketpair()
+        msg = create_generate_request("m", "p")
+        msg.trace_id = "deadbeefcafef00d"
+        msg.parent_span = "gateway"
+        wire.write_length_prefixed_pb_sync(a, msg)
+        got = wire.read_length_prefixed_pb_sync(b)
+        assert got.trace_id == "deadbeefcafef00d"
+        assert got.parent_span == "gateway"
+        a.close(); b.close()
+
 
 def test_flatten_chat():
     out = flatten_chat([{"role": "system", "content": "be brief"},

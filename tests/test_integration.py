@@ -585,3 +585,102 @@ async def test_prefix_affinity_routes_conversation_to_same_worker():
             except Exception:
                 pass
         await boot_host.close()
+
+
+async def test_trace_propagates_across_two_worker_swarm():
+    """Tentpole acceptance: a routed request through a 2-worker swarm
+    shows up with the SAME trace id in the gateway's and the serving
+    worker's /debug/trace, worker spans are children of the gateway root
+    span, and the gateway's phase spans account for the request wall
+    clock to within 20%."""
+    from crowdllama_tpu.obs.http import ObsServer
+
+    boot_host, _ = await new_host_and_dht(
+        Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    bootstrap = f"127.0.0.1:{boot_host.listen_port}"
+
+    # delay makes engine compute dominate HTTP/loopback overhead, so the
+    # io_wait span (which envelopes the worker's work) carries the wall
+    # clock and the 20% bound is insensitive to scheduler jitter.
+    workers, obs_servers = [], []
+    for _ in range(2):
+        w = Peer(Ed25519PrivateKey.generate(), _cfg(bootstrap),
+                 engine=FakeEngine(models=["tiny-test"], delay=0.25),
+                 worker_mode=True)
+        await w.start()
+        workers.append(w)
+        srv = ObsServer(w, port=0)
+        await srv.start()
+        obs_servers.append(srv)
+
+    consumer = Peer(Ed25519PrivateKey.generate(), _cfg(bootstrap),
+                    engine=FakeEngine(models=[]), worker_mode=False)
+    await consumer.start()
+    gateway = Gateway(consumer, port=0, host="127.0.0.1", trace_buffer=16)
+    await gateway.start()
+    gw_port = gateway._runner.addresses[0][1]
+
+    try:
+        await _wait_for(
+            lambda: len(consumer.peer_manager.get_workers()) == 2,
+            what="consumer discovering both workers")
+
+        async with aiohttp.ClientSession() as s:
+            body = {"model": "tiny-test", "stream": False,
+                    "messages": [{"role": "user", "content": "trace me"}]}
+            async with s.post(f"http://127.0.0.1:{gw_port}/api/chat",
+                              json=body) as resp:
+                assert resp.status == 200, await resp.text()
+                served_by = (await resp.json())["worker_id"]
+
+            async with s.get(
+                    f"http://127.0.0.1:{gw_port}/debug/trace") as resp:
+                assert resp.status == 200
+                gw_dump = await resp.json()
+        assert gw_dump["node"] == "gateway"
+        assert gw_dump["capacity"] == 16
+        gw_trace = gw_dump["traces"][-1]
+        tid = gw_trace["trace_id"]
+        assert len(tid) == 16 and gw_trace["done"]
+
+        # The serving worker holds the same trace; the idle one does not.
+        idx = next(i for i, w in enumerate(workers)
+                   if w.peer_id == served_by)
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"http://127.0.0.1:{obs_servers[idx].port}"
+                             f"/debug/trace") as resp:
+                assert resp.status == 200
+                wk_dump = await resp.json()
+        wk_trace = next((t for t in wk_dump["traces"]
+                         if t["trace_id"] == tid), None)
+        assert wk_trace is not None, (
+            f"trace {tid} missing from serving worker's ring buffer")
+        other = obs_servers[1 - idx].peer.obs.trace
+        assert other.get(tid) is None, "idle worker recorded the trace"
+
+        # Span taxonomy + parentage.
+        gw_spans = {sp["name"]: sp for sp in gw_trace["spans"]}
+        assert {"route", "serde", "aead", "io_wait"} <= set(gw_spans)
+        wk_spans = {sp["name"]: sp for sp in wk_trace["spans"]}
+        assert {"worker_queue", "prefill", "decode_step",
+                "stream_flush"} <= set(wk_spans)
+        assert all(sp.get("parent") == "gateway"
+                   for sp in wk_spans.values())
+
+        # Phase accounting: gateway spans sum to the request wall clock
+        # (trace total) within 20%; the worker's compute fits inside it.
+        wall_us = gw_trace["total_us"]
+        gw_sum = sum(sp["dur_us"] for sp in gw_trace["spans"])
+        assert 0.8 * wall_us <= gw_sum <= 1.2 * wall_us, (
+            f"gateway spans {gw_sum:.0f}us vs wall {wall_us:.0f}us")
+        wk_sum = sum(sp["dur_us"] for sp in wk_trace["spans"])
+        assert wk_sum <= 1.2 * wall_us, (
+            f"worker spans {wk_sum:.0f}us exceed wall {wall_us:.0f}us")
+    finally:
+        await gateway.stop()
+        await consumer.stop()
+        for srv in obs_servers:
+            await srv.stop()
+        for w in workers:
+            await w.stop()
+        await boot_host.close()

@@ -1,0 +1,194 @@
+"""Prometheus exposition lint for BOTH scrape surfaces (gateway /metrics
+and the worker ObsServer's /metrics): every series belongs to a declared
+# TYPE family (declared once), no duplicate series, label values stay in
+the sane charset the obs/ LabelGuard enforces, and histogram families are
+internally consistent (monotone cumulative buckets, +Inf == _count).
+
+This is the guard that keeps the two endpoints mirror images: a metric
+added to one side with a malformed name/labels — or a family exposed
+twice — fails here before a real Prometheus server ever chokes on it.
+"""
+
+import re
+
+import aiohttp
+from crowdllama_tpu.utils.crypto_compat import Ed25519PrivateKey
+
+from crowdllama_tpu.config import Configuration, Intervals
+from crowdllama_tpu.engine.engine import FakeEngine
+from crowdllama_tpu.gateway.gateway import Gateway
+from crowdllama_tpu.net.discovery import new_host_and_dht
+from crowdllama_tpu.obs.http import ObsServer
+from crowdllama_tpu.peer.peer import Peer
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"')
+_VALUE_RE = re.compile(r"^[A-Za-z0-9_.:+/\- ]{0,128}$")
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{([^}]*)\})? (\S+)$")
+
+
+def _parse(text):
+    """exposition text -> (types, samples); asserts structural validity."""
+    types: dict[str, str] = {}
+    samples: list[tuple[str, str, float]] = []
+    seen: set[tuple[str, str]] = set()
+    for ln, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            assert parts[:2] == ["#", "TYPE"], f"line {ln}: bad comment"
+            assert len(parts) == 4, f"line {ln}: malformed TYPE"
+            _, _, fam, kind = parts
+            assert _NAME_RE.match(fam), f"line {ln}: bad family {fam!r}"
+            assert kind in ("counter", "gauge", "histogram"), (
+                f"line {ln}: unknown type {kind!r}")
+            assert fam not in types, f"line {ln}: duplicate TYPE for {fam}"
+            types[fam] = kind
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"line {ln}: unparseable sample {line!r}"
+        name, _, labels, value = m.groups()
+        labels = labels or ""
+        key = (name, labels)
+        assert key not in seen, f"line {ln}: duplicate series {key}"
+        seen.add(key)
+        for lname, lval in _LABEL_RE.findall(labels):
+            assert _VALUE_RE.match(lval), (
+                f"line {ln}: label {lname} has unsane value {lval!r}")
+        v = float(value)
+        assert v >= 0, f"line {ln}: negative sample {line!r}"
+        samples.append((name, labels, v))
+    return types, samples
+
+
+def _family_of(name: str, types: dict[str, str]) -> str:
+    if name in types:
+        return name
+    for suffix in ("_bucket", "_sum", "_count"):
+        base = name[: -len(suffix)] if name.endswith(suffix) else ""
+        if base in types and types[base] == "histogram":
+            return base
+    raise AssertionError(f"series {name} has no # TYPE declaration")
+
+
+def _lint(text: str) -> dict[str, str]:
+    types, samples = _parse(text)
+    for name, _, _ in samples:
+        _family_of(name, types)
+    # Histogram consistency per child (labels minus the le pair).
+    hists: dict[tuple[str, str], dict] = {}
+    for name, labels, v in samples:
+        fam = _family_of(name, types)
+        if types[fam] != "histogram":
+            continue
+        mle = re.search(r'le="([^"]*)",?', labels)
+        child = re.sub(r'le="[^"]*",?', "", labels).rstrip(",")
+        h = hists.setdefault((fam, child),
+                             {"buckets": [], "count": None, "sum": None})
+        if name.endswith("_bucket"):
+            assert mle, f"{name}{{{labels}}} missing le"
+            h["buckets"].append((mle.group(1), v))
+        elif name.endswith("_count"):
+            h["count"] = v
+        elif name.endswith("_sum"):
+            h["sum"] = v
+    for (fam, child), h in hists.items():
+        where = f"{fam}{{{child}}}"
+        assert h["count"] is not None and h["sum"] is not None, (
+            f"{where}: missing _count/_sum")
+        assert h["buckets"], f"{where}: histogram with no buckets"
+        assert h["buckets"][-1][0] == "+Inf", f"{where}: last le != +Inf"
+        counts = [n for _, n in h["buckets"]]
+        assert counts == sorted(counts), f"{where}: non-monotone buckets"
+        assert counts[-1] == h["count"], (
+            f"{where}: +Inf bucket {counts[-1]} != count {h['count']}")
+    return types
+
+
+def _cfg(bootstrap):
+    return Configuration(listen_host="127.0.0.1",
+                         bootstrap_peers=[bootstrap],
+                         intervals=Intervals.default())
+
+
+async def _wait_for(cond, timeout=20.0, what="condition"):
+    import asyncio
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        if cond():
+            return
+        await asyncio.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+async def test_gateway_and_worker_metrics_lint():
+    boot_host, _ = await new_host_and_dht(
+        Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    bootstrap = f"127.0.0.1:{boot_host.listen_port}"
+
+    worker = Peer(Ed25519PrivateKey.generate(), _cfg(bootstrap),
+                  engine=FakeEngine(models=["tiny-test"]), worker_mode=True)
+    await worker.start()
+    obs_srv = ObsServer(worker, port=0)
+    await obs_srv.start()
+
+    consumer = Peer(Ed25519PrivateKey.generate(), _cfg(bootstrap),
+                    engine=FakeEngine(models=[]), worker_mode=False)
+    await consumer.start()
+    gateway = Gateway(consumer, port=0, host="127.0.0.1")
+    await gateway.start()
+    gw_port = gateway._runner.addresses[0][1]
+
+    try:
+        await _wait_for(
+            lambda: consumer.peer_manager.find_best_worker("tiny-test")
+            is not None, what="worker discovery")
+        async with aiohttp.ClientSession() as s:
+            # Streamed + non-streamed traffic so the labeled request
+            # histograms, TTFT and decode-step series carry samples.
+            body = {"model": "tiny-test", "stream": False,
+                    "messages": [{"role": "user", "content": "lint me"}]}
+            async with s.post(f"http://127.0.0.1:{gw_port}/api/chat",
+                              json=body) as resp:
+                assert resp.status == 200
+            body["stream"] = True
+            async with s.post(f"http://127.0.0.1:{gw_port}/api/chat",
+                              json=body) as resp:
+                assert resp.status == 200
+                async for _ in resp.content:
+                    pass
+            async with s.get(
+                    f"http://127.0.0.1:{gw_port}/metrics") as resp:
+                assert resp.status == 200
+                gw_text = await resp.text()
+            async with s.get(f"http://127.0.0.1:{obs_srv.port}"
+                             f"/metrics") as resp:
+                assert resp.status == 200
+                wk_text = await resp.text()
+
+        gw_types = _lint(gw_text)
+        wk_types = _lint(wk_text)
+        # The swarm-uniform families exist on BOTH scrape surfaces, with
+        # the engine/scheduler gauges next to them.
+        for types in (gw_types, wk_types):
+            for fam in ("crowdllama_request_seconds",
+                        "crowdllama_ttft_seconds",
+                        "crowdllama_decode_step_seconds"):
+                assert types.get(fam) == "histogram", f"{fam} missing"
+            for g in ("pending_depth", "active_slots", "batch_occupancy",
+                      "kv_cache_utilization"):
+                assert types.get(f"crowdllama_engine_{g}") == "gauge"
+        # Traffic landed in BOTH sides' request histograms.
+        for text in (gw_text, wk_text):
+            assert re.search(r'crowdllama_request_seconds_count\{'
+                             r'model="tiny-test"\} [1-9]', text), (
+                "no tiny-test request samples recorded")
+    finally:
+        await gateway.stop()
+        await consumer.stop()
+        await obs_srv.stop()
+        await worker.stop()
+        await boot_host.close()

@@ -143,6 +143,22 @@ async def test_relayed_worker_serves_through_gateway(monkeypatch):
                 assert "via relay" in d["message"]["content"]
                 assert d["worker_id"] == worker.peer_id
         assert worker.host.stats.get("streams_reversed_in", 0) == 0
+
+        # Trace propagation across the relay splice: the relay forwards
+        # sealed ciphertext, so the envelope's trace_id crosses untouched
+        # and the worker's ring buffer holds the gateway-minted trace.
+        gw_traces = gateway.obs.trace.snapshot()["traces"]
+        assert gw_traces, "gateway recorded no trace"
+        tid = gw_traces[-1]["trace_id"]
+        wk = worker.obs.trace.get(tid)
+        assert wk is not None, (
+            f"trace {tid} did not reach the relayed worker")
+        wk_spans = {s["name"]: s for s in wk["spans"]}
+        assert {"worker_queue", "prefill", "decode_step"} <= set(wk_spans)
+        # Worker spans are children of the gateway root span.
+        assert all(s.get("parent") == "gateway" for s in wk_spans.values())
+        gw_spans = {s["name"] for s in gw_traces[-1]["spans"]}
+        assert {"route", "serde", "aead", "io_wait"} <= gw_spans
     finally:
         await gateway.stop()
         await consumer.stop()
