@@ -1,0 +1,22 @@
+# Developer entry points.  Every test target pins JAX to CPU (tests
+# virtualize 8 devices via XLA flags in tests/conftest.py).
+
+PY ?= python
+PYTEST = env JAX_PLATFORMS=cpu $(PY) -m pytest -p no:cacheprovider
+
+.PHONY: test tier1 chaos
+
+# Full suite (slow soaks included).
+test:
+	$(PYTEST) tests/ -q
+
+# The tier-1 gate: what CI (and ROADMAP.md) holds the repo to.
+tier1:
+	$(PYTEST) tests/ -q -m 'not slow' --continue-on-collection-errors
+
+# Deterministic fault-injection matrix (docs/ROBUSTNESS.md): seeded
+# FaultPlans from crowdllama_tpu/testing/faults.py kill streams, fail
+# handshakes, and exhaust budgets; assertions check the request plane
+# heals (mid-stream failover, 504 budgets, 503 shedding).
+chaos:
+	$(PYTEST) tests/ -q -m chaos

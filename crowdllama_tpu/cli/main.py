@@ -486,7 +486,10 @@ async def run_node(cfg: Configuration, worker_mode: bool) -> None:
     obs_server = None
     if not worker_mode:
         gateway = Gateway(peer, port=cfg.gateway_port,
-                          trace_buffer=cfg.trace_buffer)
+                          trace_buffer=cfg.trace_buffer,
+                          request_timeout=cfg.request_timeout,
+                          admission_max_inflight=cfg.admission_max_inflight,
+                          retry_after_s=cfg.retry_after_s)
         await gateway.start()
     elif cfg.worker_metrics_port:
         from crowdllama_tpu.obs.http import ObsServer

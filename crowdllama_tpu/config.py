@@ -141,6 +141,20 @@ class Configuration:
     spec_draft_model: str = ""  # draft model registry name (spec "draft")
     spec_draft_path: str = ""   # draft checkpoint dir (random-init if empty)
     drain_timeout: float = 30.0  # graceful-shutdown grace for in-flight reqs
+    # Robustness plane (docs/ROBUSTNESS.md): per-request wall-clock budget
+    # in seconds, charged across retries and mid-stream failovers; clients
+    # may request LESS via the X-Request-Timeout header (this value is the
+    # ceiling).  600 matches the pre-budget hard-coded frame timeouts.
+    request_timeout: float = 600.0
+    # Gateway load shedding: max concurrently routed inference requests
+    # before new ones get an immediate 503 + Retry-After (0 = off).
+    admission_max_inflight: int = 0
+    # Worker-side shedding: scheduler pending depth at which submit()
+    # rejects with "overloaded" (0 = off; the gateway translates the
+    # rejection into 503 + Retry-After after failing over).
+    admission_pending_max: int = 0
+    # Retry-After hint (seconds) stamped on shed 503 responses.
+    retry_after_s: float = 1.0
     # Directory for jax.profiler traces; empty disables the profile surface
     # (SURVEY §5: "TPU build: JAX profiler traces + per-request timing").
     profile_dir: str = ""
@@ -232,6 +246,16 @@ class Configuration:
                                       cfg.spec_draft_path)
         cfg.drain_timeout = float(env.get("CROWDLLAMA_TPU_DRAIN_TIMEOUT",
                                           cfg.drain_timeout))
+        cfg.request_timeout = float(env.get(
+            "CROWDLLAMA_TPU_REQUEST_TIMEOUT", cfg.request_timeout))
+        cfg.admission_max_inflight = int(env.get(
+            "CROWDLLAMA_TPU_ADMISSION_MAX_INFLIGHT",
+            cfg.admission_max_inflight))
+        cfg.admission_pending_max = int(env.get(
+            "CROWDLLAMA_TPU_ADMISSION_PENDING_MAX",
+            cfg.admission_pending_max))
+        cfg.retry_after_s = float(env.get(
+            "CROWDLLAMA_TPU_RETRY_AFTER", cfg.retry_after_s))
         cfg.profile_dir = env.get("CROWDLLAMA_TPU_PROFILE_DIR", cfg.profile_dir)
         cfg.trace_buffer = int(env.get("CROWDLLAMA_TPU_TRACE_BUFFER",
                                        cfg.trace_buffer))
@@ -264,6 +288,18 @@ class Configuration:
         if cfg.trace_buffer < 1:
             raise ValueError(f"trace_buffer must be >= 1, "
                              f"got {cfg.trace_buffer}")
+        if cfg.request_timeout <= 0:
+            raise ValueError(f"request_timeout must be positive, "
+                             f"got {cfg.request_timeout}")
+        if cfg.admission_max_inflight < 0:
+            raise ValueError(f"admission_max_inflight must be >= 0, "
+                             f"got {cfg.admission_max_inflight}")
+        if cfg.admission_pending_max < 0:
+            raise ValueError(f"admission_pending_max must be >= 0, "
+                             f"got {cfg.admission_pending_max}")
+        if cfg.retry_after_s < 0:
+            raise ValueError(f"retry_after_s must be >= 0, "
+                             f"got {cfg.retry_after_s}")
         if cfg.worker_metrics_port < 0:
             raise ValueError(f"worker_metrics_port must be >= 0, "
                              f"got {cfg.worker_metrics_port}")
@@ -369,6 +405,22 @@ class Configuration:
                             dest="worker_metrics_port", type=int,
                             help="worker-side /metrics + /debug/trace "
                                  "listener port (0 = disabled)")
+        parser.add_argument("--request-timeout", dest="request_timeout",
+                            type=float,
+                            help="per-request wall-clock budget in seconds, "
+                                 "charged across retries/failovers "
+                                 "(X-Request-Timeout may lower it)")
+        parser.add_argument("--admission-max-inflight",
+                            dest="admission_max_inflight", type=int,
+                            help="gateway: max concurrent routed requests "
+                                 "before shedding 503s (0 = off)")
+        parser.add_argument("--admission-pending-max",
+                            dest="admission_pending_max", type=int,
+                            help="worker: scheduler pending depth that "
+                                 "rejects new work as overloaded (0 = off)")
+        parser.add_argument("--retry-after", dest="retry_after_s",
+                            type=float,
+                            help="Retry-After seconds hinted on shed 503s")
 
     @classmethod
     def from_flags(cls, args: argparse.Namespace) -> "Configuration":
@@ -382,6 +434,8 @@ class Configuration:
                 "kv_dtype", "relay_mode", "spec_decode", "spec_draft",
                 "spec_draft_model", "spec_draft_path",
                 "profile_dir", "trace_buffer", "worker_metrics_port",
+                "request_timeout", "admission_max_inflight",
+                "admission_pending_max", "retry_after_s",
                 "dist_coordinator", "dist_num_processes", "dist_process_id",
             )
         }

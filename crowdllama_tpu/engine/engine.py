@@ -25,6 +25,7 @@ from crowdllama_tpu.core.messages import (
     extract_generate_request,
     flatten_chat,
 )
+from crowdllama_tpu.testing import faults
 
 log = logging.getLogger("crowdllama.engine")
 
@@ -190,6 +191,8 @@ class Engine:
                 prompt_tokens=n_tokens,
             )
         req = extract_generate_request(msg)
+        await faults.inject("engine.request", worker=worker_id,
+                            model=req.model)
         t0 = time.monotonic_ns()
         first_ns = 0
         text_parts: list[str] = []
@@ -222,10 +225,14 @@ class Engine:
         req = extract_generate_request(msg)
         t0 = time.monotonic_ns()
         first_ns = 0
+        n_chunk = 0
         final: Chunk | None = None
         async for chunk in self._gen_from_request(req):
             if not first_ns:
                 first_ns = time.monotonic_ns()
+            await faults.inject("engine.stream_chunk", worker=worker_id,
+                                model=req.model, index=n_chunk)
+            n_chunk += 1
             if chunk.done:
                 final = chunk
                 self._obs_generate(msg, req.model, t0, first_ns,
@@ -325,8 +332,10 @@ class JaxEngine(Engine):
         self._runner = await loop.run_in_executor(None, _build)
         if self.config.warmup:
             await loop.run_in_executor(None, self._warmup)
-        self.scheduler = Scheduler(self._runner,
-                                   decode_chunk=self.config.decode_chunk)
+        self.scheduler = Scheduler(
+            self._runner,
+            decode_chunk=self.config.decode_chunk,
+            admission_pending_max=self.config.admission_pending_max)
         self.scheduler.start()
         log.info(
             "engine up: model=%s mesh=%s slots=%d max_seq=%d",

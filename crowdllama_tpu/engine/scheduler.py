@@ -46,6 +46,16 @@ _DONE = object()
 _RESERVED = object()
 
 
+class OverloadedError(RuntimeError):
+    """Admission rejected: pending depth crossed the configured threshold.
+
+    The message starts with "overloaded" on purpose — the gateway matches
+    that word in worker error strings to translate the failure into an
+    HTTP 503 with a Retry-After hint (load shedding, docs/ROBUSTNESS.md)
+    instead of a generic inference error.
+    """
+
+
 @dataclass(eq=False)  # identity semantics (slot/queue tracking, WeakSet)
 class GenRequest:
     prompt_ids: list[int]
@@ -89,9 +99,15 @@ class _InFlightChunk:
 
 class Scheduler:
     def __init__(self, runner: ModelRunner, max_queue: int = 256,
-                 decode_chunk: int = 8):
+                 decode_chunk: int = 8, admission_pending_max: int = 0):
         self.runner = runner
         self.decode_chunk = max(1, decode_chunk)
+        # Load shedding (docs/ROBUSTNESS.md): reject at submit() once the
+        # pending depth reaches this, instead of queueing work whose
+        # deadline will expire before admission.  0 = no threshold (the
+        # bounded pending queue still applies backpressure by blocking).
+        self.admission_pending_max = max(0, admission_pending_max)
+        self.shed_requests = 0
         self.state = runner.init_state()
         self.slots: list[_SlotInfo | None] = [None] * runner.max_slots
         self.pending: asyncio.Queue[GenRequest] = asyncio.Queue(max_queue)
@@ -169,6 +185,14 @@ class Scheduler:
                 f"prompt of {len(req.prompt_ids)} tokens exceeds max context "
                 f"{self.runner.max_seq}"
             )
+        if self.admission_pending_max:
+            depth = (self.pending.qsize() + len(self._deferred)
+                     + self._admitting)
+            if depth >= self.admission_pending_max:
+                self.shed_requests += 1
+                raise OverloadedError(
+                    f"overloaded: {depth} requests pending (admission "
+                    f"threshold {self.admission_pending_max})")
         await self.pending.put(req)
         self._track(req)
         self._wake.set()

@@ -51,7 +51,14 @@ def _now_rfc3339() -> str:
 
 
 class _StreamStarted(Exception):
-    """A streamed response failed after headers were sent (not retryable)."""
+    """The CLIENT side of a streamed response failed (disconnect, write
+    error) or the terminal frame already went out: not retryable, not
+    failover-able — the response object is final as-is.
+
+    Worker-side mid-stream failures deliberately do NOT raise this any
+    more: they propagate as ordinary exceptions so _route can fail the
+    stream over to the next-best worker and resume it (docs/ROBUSTNESS.md).
+    """
 
     def __init__(self, response: "web.StreamResponse", cause: Exception):
         super().__init__(str(cause))
@@ -59,12 +66,45 @@ class _StreamStarted(Exception):
         self.cause = cause
 
 
+class _BudgetExhausted(Exception):
+    """The request's wall-clock budget expired (pre- or mid-stream)."""
+
+
+class _StreamCtx:
+    """Client-side state of ONE streamed response, surviving failover.
+
+    Created per routed request; ``out``/``sent_text`` carry the prepared
+    response and every char already delivered across worker attempts, and
+    the OpenAI envelope state (rid/created/chunk ordinal) stays stable so
+    a failover does not re-send the role delta or change the stream id."""
+
+    __slots__ = ("out", "sent_text", "rid", "created", "nth")
+
+    def __init__(self, shape: str):
+        self.out: web.StreamResponse | None = None
+        self.sent_text = ""
+        self.rid = ("chatcmpl-" if shape == "openai-chat" else "cmpl-") \
+            + os.urandom(12).hex()
+        self.created = int(time.time())
+        self.nth = 0
+
+
 class Gateway:
     def __init__(self, peer: Peer, port: int = 9001, host: str = "0.0.0.0",
-                 trace_buffer: int = 64):
+                 trace_buffer: int = 64, request_timeout: float = 600.0,
+                 admission_max_inflight: int = 0,
+                 retry_after_s: float = 1.0):
         self.peer = peer
         self.port = port
         self.host = host
+        # Robustness plane (docs/ROBUSTNESS.md): total wall-clock budget
+        # per request, charged across retries and failovers (a client may
+        # lower it per request via X-Request-Timeout); gateway-side
+        # admission cap (0 = off); Retry-After hint on shed 503s.
+        self.request_timeout = max(0.1, float(request_timeout))
+        self.admission_max_inflight = max(0, int(admission_max_inflight))
+        self.retry_after_s = max(0.0, float(retry_after_s))
+        self._inflight = 0  # routed inference requests currently in flight
         self._runner: web.AppRunner | None = None
         self.app = web.Application(middlewares=[self._log_middleware])
         self.app.router.add_post("/api/chat", self.handle_chat)
@@ -145,6 +185,12 @@ class Gateway:
         # hit), so per-request figures divide cleanly.
         self._perf = {"route_ns": 0, "serde_ns": 0, "io_wait_ns": 0,
                       "requests": 0}
+        # Robustness counters (exposed in /metrics): mid-stream failovers,
+        # replayed-and-trimmed chunks during them, shed requests (gateway
+        # admission cap + worker "overloaded" rejections), and wall-clock
+        # budget exhaustions.
+        self._robust = {"failovers": 0, "replayed_chunks": 0, "shed": 0,
+                        "budget_exhausted": 0}
         # Prefix-affinity routing: multi-turn chats replay their history
         # verbatim, so turn N shares its leading tokens with turn 1 — the
         # engine's automatic prefix cache only pays if the continuation
@@ -184,16 +230,53 @@ class Gateway:
         mid-response abort leaves unread frames — close those instead)."""
         self._stream_pool.put(worker_id, s)
 
-    async def _dial(self, worker_id: str, acc: dict | None = None):
+    async def _dial(self, worker_id: str, acc: dict | None = None,
+                    timeout: float | None = None):
+        """``timeout`` caps the dial + handshake at the request's remaining
+        budget (never above the protocol's own handshake timeout)."""
+        from crowdllama_tpu.net.host import HANDSHAKE_TIMEOUT
+
         t0 = time.perf_counter_ns()
         contact = await self.peer.dht.find_peer(worker_id)
         if contact is None:
             raise LookupError(f"worker {worker_id[:8]} not resolvable")
-        s = await self.peer.host.new_stream(contact, INFERENCE_PROTOCOL)
+        hs = (HANDSHAKE_TIMEOUT if timeout is None
+              else max(0.05, min(HANDSHAKE_TIMEOUT, timeout)))
+        s = await self.peer.host.new_stream(contact, INFERENCE_PROTOCOL,
+                                            timeout=hs)
         if acc is not None:
             acc["dial_ns"] = acc.get("dial_ns", 0) \
                 + time.perf_counter_ns() - t0
         return s
+
+    # ------------------------------------------------- budgets and shedding
+
+    def _budget(self, request: web.Request) -> float:
+        """Per-request wall-clock budget in seconds: the configured ceiling,
+        lowered by a valid ``X-Request-Timeout`` header."""
+        hdr = request.headers.get("X-Request-Timeout", "")
+        if hdr:
+            try:
+                v = float(hdr)
+            except ValueError:
+                v = 0.0
+            if v > 0:
+                return min(v, self.request_timeout)
+        return self.request_timeout
+
+    def _shed_headers(self) -> dict:
+        return {"Retry-After": str(max(1, round(self.retry_after_s)))}
+
+    def _shed_response(self, shape: str, model: str,
+                       message: str) -> web.Response:
+        """503 + Retry-After: the uniform load-shedding response."""
+        self._robust["shed"] += 1
+        headers = self._shed_headers()
+        if shape.startswith("openai"):
+            return self._openai_error(message, 503, "server_error",
+                                      headers=headers)
+        return web.json_response({"error": message, "model": model},
+                                 status=503, headers=headers)
 
     # ------------------------------------------------- hot-path attribution
     #
@@ -694,6 +777,29 @@ class Gateway:
         lines.append("# TYPE crowdllama_gateway_affinity_hits_total counter")
         lines.append(
             f"crowdllama_gateway_affinity_hits_total {self._affinity_hits}")
+        # Robustness plane (docs/ROBUSTNESS.md): failover/replay/shed/budget
+        # counters plus dead-transport pool evictions.
+        lines.append("# TYPE crowdllama_gateway_failovers_total counter")
+        lines.append(
+            f"crowdllama_gateway_failovers_total {self._robust['failovers']}")
+        lines.append(
+            "# TYPE crowdllama_gateway_replayed_chunks_total counter")
+        lines.append(
+            f"crowdllama_gateway_replayed_chunks_total "
+            f"{self._robust['replayed_chunks']}")
+        lines.append("# TYPE crowdllama_gateway_shed_total counter")
+        lines.append(
+            f"crowdllama_gateway_shed_total {self._robust['shed']}")
+        lines.append(
+            "# TYPE crowdllama_gateway_budget_exhausted_total counter")
+        lines.append(
+            f"crowdllama_gateway_budget_exhausted_total "
+            f"{self._robust['budget_exhausted']}")
+        lines.append(
+            "# TYPE crowdllama_gateway_pool_evicted_dead_total counter")
+        lines.append(
+            f"crowdllama_gateway_pool_evicted_dead_total "
+            f"{self._stream_pool.evicted_dead}")
         # Request hot-path CPU attribution (ISSUE 1 tentpole d): cumulative
         # microseconds per phase; rate(phase)/rate(requests) is the
         # per-request cost.  aead_us is process-wide (net/secure.py).
@@ -760,10 +866,12 @@ class Gateway:
 
     @staticmethod
     def _openai_error(message: str, status: int,
-                      err_type: str = "invalid_request_error"):
+                      err_type: str = "invalid_request_error",
+                      headers: dict | None = None):
         return web.json_response(
             {"error": {"message": message, "type": err_type,
-                       "param": None, "code": None}}, status=status)
+                       "param": None, "code": None}}, status=status,
+            headers=headers)
 
     @staticmethod
     def _openai_options(body: dict) -> dict:
@@ -978,6 +1086,28 @@ class Gateway:
     async def _route(self, request, model, stream, options,
                      messages=None, prompt="",
                      shape="chat") -> web.StreamResponse:
+        """Admission gate + inflight accounting around _route_admitted.
+
+        Shedding happens BEFORE a trace id is minted or a worker touched:
+        an overloaded gateway must answer 503 + Retry-After from pure
+        in-memory state (docs/ROBUSTNESS.md)."""
+        if self.admission_max_inflight \
+                and self._inflight >= self.admission_max_inflight:
+            return self._shed_response(
+                shape, model,
+                f"overloaded: {self._inflight} requests in flight "
+                f"(admission cap {self.admission_max_inflight})")
+        self._inflight += 1
+        try:
+            return await self._route_admitted(
+                request, model, stream, options, messages=messages,
+                prompt=prompt, shape=shape)
+        finally:
+            self._inflight -= 1
+
+    async def _route_admitted(self, request, model, stream, options,
+                              messages=None, prompt="",
+                              shape="chat") -> web.StreamResponse:
         msg = create_generate_request(
             model=model,
             prompt=prompt,
@@ -1010,6 +1140,11 @@ class Gateway:
         msg.trace_id = tid
         msg.parent_span = GATEWAY_ROOT_SPAN
         t0 = time.monotonic()  # TTFB measures from ADMISSION, retries included
+        # Total wall-clock budget, charged across every retry/failover this
+        # request pays (docs/ROBUSTNESS.md): routing, dials, handshakes and
+        # decode all race the same deadline.
+        budget = self._budget(request)
+        deadline = t0 + budget
         self._perf["requests"] += 1
         acc: dict = {}
         self.obs.trace.begin(tid, node="gateway", model=model,
@@ -1017,6 +1152,10 @@ class Gateway:
         aead0 = secure.aead_stats()[0]
         status = 503
         served_by = ""
+        sctx = _StreamCtx(shape)
+        budget_out = False
+        prev_worker = ""
+        died_at = 0.0
         try:
             tr = time.perf_counter_ns()
             akey, continuation = self._affinity_key(model, messages, prompt)
@@ -1026,6 +1165,10 @@ class Gateway:
             tried: set[str] = set()
             last_err = "no workers available for model"
             for _attempt in range(2):  # retry once on next-best worker
+                now = time.monotonic()
+                if now >= deadline:
+                    budget_out = True
+                    break
                 worker = None
                 used_affinity = False
                 tr = time.perf_counter_ns()
@@ -1042,9 +1185,27 @@ class Gateway:
                 if worker is None:
                     break
                 tried.add(worker.peer_id)
+                if sctx.out is not None:
+                    # MID-STREAM FAILOVER: headers (and sent_text chars)
+                    # already reached the client from a worker that then
+                    # died — replay on the next-best worker and resume the
+                    # same response (docs/ROBUSTNESS.md).  The trace id is
+                    # reused on purpose: one client request, one trace.
+                    self._robust["failovers"] += 1
+                    self.obs.trace.record(
+                        tid, "failover",
+                        int(max(0.0, now - died_at) * 1e9),
+                        parent=GATEWAY_ROOT_SPAN,
+                        from_worker=prev_worker[:8],
+                        to_worker=worker.peer_id[:8])
+                    log.warning(
+                        "failing stream over %s -> %s (replaying %d "
+                        "delivered chars)", prev_worker[:8],
+                        worker.peer_id[:8], len(sctx.sent_text))
                 try:
                     resp = await self._forward(request, worker.peer_id, msg,
-                                               stream, shape, t0, acc=acc)
+                                               stream, shape, t0, acc=acc,
+                                               ctx=sctx, deadline=deadline)
                     self._affinity_put(akey, worker.peer_id)
                     if used_affinity:
                         # Counted only when the pinned route actually
@@ -1055,11 +1216,11 @@ class Gateway:
                     status = resp.status
                     return resp
                 except _StreamStarted as e:
-                    # Headers/chunks already went out: no retry, no second
-                    # response — the error frame was already written
-                    # downstream.  The prefill still populated this
-                    # worker's prefix cache, so the affinity record stays
-                    # useful.
+                    # The CLIENT side of the stream failed (disconnect,
+                    # write error): no retry, no failover, no second
+                    # response — nobody is listening.  The prefill still
+                    # populated this worker's prefix cache, so the
+                    # affinity record stays useful.
                     self._affinity_put(akey, worker.peer_id)
                     if used_affinity:
                         self._affinity_hits += 1
@@ -1068,9 +1229,44 @@ class Gateway:
                     served_by = worker.peer_id
                     status = e.response.status
                     return e.response
+                except _BudgetExhausted as e:
+                    last_err = str(e) or "request budget exhausted"
+                    budget_out = True
+                    break
                 except Exception as e:
+                    # Worker-side failure (pre- OR mid-stream): eligible
+                    # for retry/failover on the next-best worker.
                     last_err = str(e)
+                    prev_worker = worker.peer_id
+                    died_at = time.monotonic()
                     log.warning("worker %s failed: %s", worker.peer_id[:8], e)
+            if budget_out:
+                self._robust["budget_exhausted"] += 1
+            if sctx.out is not None:
+                # Headers already out and every attempt exhausted: finish
+                # the started stream with a terminal error frame instead
+                # of dropping the connection mid-body.
+                status = sctx.out.status
+                detail = (f"request budget exhausted after {budget:.1f}s"
+                          if budget_out else f"inference failed: {last_err}")
+                served_by = prev_worker
+                return await self._terminal_error_frame(
+                    sctx, shape, model, detail)
+            if budget_out:
+                status = 504
+                detail = (f"deadline exceeded: request budget "
+                          f"{budget:.1f}s exhausted ({last_err})")
+                if shape.startswith("openai"):
+                    return self._openai_error(detail, 504, "server_error")
+                return web.json_response(
+                    {"error": detail, "model": model}, status=504)
+            if "overloaded" in last_err:
+                # Worker-side admission rejection (scheduler pending depth
+                # over threshold): shed with the same 503 + Retry-After
+                # contract as the gateway's own cap.
+                status = 503
+                return self._shed_response(
+                    shape, model, f"inference failed: {last_err}")
             if shape.startswith("openai"):
                 return self._openai_error(
                     f"inference failed: {last_err}", 503, "server_error")
@@ -1112,33 +1308,77 @@ class Gateway:
         self._ttfb_count += 1
         self.obs.metrics.ttft_seconds.observe(dt)
 
+    async def _terminal_error_frame(self, ctx: _StreamCtx, shape: str,
+                                    model: str,
+                                    message: str) -> web.StreamResponse:
+        """Every attempt exhausted AFTER headers went out: end the started
+        stream with a well-formed terminal error frame (Ollama NDJSON error
+        line / OpenAI SSE error event + [DONE]) instead of dropping the
+        connection mid-body.  Client write failures here are moot — nobody
+        is listening — hence the blanket suppress."""
+        out = ctx.out
+        try:
+            if shape.startswith("openai"):
+                line = json.dumps({"error": {
+                    "message": message, "type": "server_error"}}).encode()
+                await out.write(b"data: " + line + b"\n\n")
+                await out.write(b"data: [DONE]\n\n")
+            else:
+                line = json.dumps({
+                    "model": model,
+                    "created_at": _now_rfc3339(),
+                    "done": True, "done_reason": "error",
+                    "error": message,
+                }).encode()
+                await out.write(line + b"\n")
+            await out.write_eof()
+        except Exception:
+            pass
+        return out
+
     async def _forward(self, request, worker_id: str, msg, stream: bool,
                        shape: str, t0: float,
-                       acc: dict | None = None) -> web.StreamResponse:
+                       acc: dict | None = None,
+                       ctx: _StreamCtx | None = None,
+                       deadline: float | None = None) -> web.StreamResponse:
         """Open an inference stream to the worker and relay the reply
         (gateway.go:243-298).  ``shape`` picks the client dialect:
         Ollama NDJSON ("chat"/"generate") or OpenAI SSE ("openai-*").
         ``t0`` is the _route admission time: the TTFB histogram must
         charge failed-worker retries to the request, not reset on them.
-        ``acc`` is the per-request phase accumulator from _route."""
+        ``acc`` is the per-request phase accumulator from _route.
+
+        ``ctx`` carries the client-side stream state across worker
+        attempts: on a FAILOVER call (ctx.out already prepared) the reply
+        is replayed and trimmed against ctx.sent_text so the client never
+        sees a duplicated or missing character.  ``deadline`` is the
+        absolute monotonic cutoff from the request's wall-clock budget —
+        every dial/handshake/recv below is clamped to what remains of it,
+        and expiry surfaces as _BudgetExhausted."""
         if acc is None:
             acc = {}
+        if ctx is None:
+            ctx = _StreamCtx(shape)
         openai = shape.startswith("openai")
-        rid = ("chatcmpl-" if shape == "openai-chat" else "cmpl-") \
-            + os.urandom(12).hex()
-        created = int(time.time())
-        nth = {"n": 0}
+
+        def remaining() -> float:
+            return (deadline - time.monotonic()) if deadline is not None \
+                else 600.0
+
+        def _recv_timeout() -> float:
+            return max(0.05, min(600.0, remaining()))
 
         def render(resp, final: bool) -> dict:
             if openai:
-                d = self._openai_json(resp, shape, final, stream, rid,
-                                      created, first=nth["n"] == 0)
-                nth["n"] += 1
+                d = self._openai_json(resp, shape, final, stream, ctx.rid,
+                                      ctx.created, first=ctx.nth == 0)
+                ctx.nth += 1
                 return d
             return self._ollama_json(resp, shape == "chat", final=final)
 
         if not stream:
-            reply = await self._roundtrip(worker_id, msg, acc=acc)
+            reply = await self._roundtrip(worker_id, msg,
+                                          timeout=_recv_timeout(), acc=acc)
             resp = extract_generate_response(reply)
             if resp.done_reason == "error":
                 raise RuntimeError(resp.response)
@@ -1149,6 +1389,8 @@ class Gateway:
         # worker that dies immediately is still retryable by _route — and
         # so a STALE pooled stream is detected while a fresh redial is
         # still possible.
+        if remaining() <= 0:
+            raise _BudgetExhausted("budget exhausted before dial")
         frame = self._encode_frame(msg, acc=acc)
         s = self._pool_get(worker_id)
         first = None
@@ -1156,23 +1398,32 @@ class Gateway:
             try:
                 await self._send_frame(s, frame, acc=acc)
                 first = extract_generate_response(
-                    await self._recv_pb(s, timeout=600, acc=acc))
+                    await self._recv_pb(s, timeout=_recv_timeout(), acc=acc))
             except asyncio.CancelledError:
                 s.close()
                 raise
             except Exception as e:
                 s.close()
                 s = None
+                if remaining() <= 0:
+                    raise _BudgetExhausted(
+                        "budget exhausted on pooled attempt") from e
                 log.debug("pooled stream to %s stale (%s); redialing",
                           worker_id[:8], e)
         if s is None:
-            s = await self._dial(worker_id, acc=acc)
+            s = await self._dial(worker_id, acc=acc,
+                                 timeout=(remaining()
+                                          if deadline is not None else None))
             try:
                 await self._send_frame(s, frame, acc=acc)
                 first = extract_generate_response(
-                    await self._recv_pb(s, timeout=600, acc=acc))
-            except BaseException:
+                    await self._recv_pb(s, timeout=_recv_timeout(), acc=acc))
+            except BaseException as e:
                 s.close()
+                if (isinstance(e, (asyncio.TimeoutError, OSError))
+                        and remaining() <= 0):
+                    raise _BudgetExhausted(
+                        "budget exhausted during dial/first frame") from e
                 raise
         # Pool the stream back only after the worker's terminal frame was
         # READ (a mid-response abort leaves frames in flight — closing is
@@ -1181,63 +1432,96 @@ class Gateway:
         try:
             if first.done_reason == "error":
                 raise RuntimeError(first.response)
-            self._observe_ttfb(time.monotonic() - t0)
-            out = web.StreamResponse(
-                status=200,
-                headers={"Content-Type": ("text/event-stream" if openai
-                                          else "application/x-ndjson")},
-            )
-            await out.prepare(request)
+            if ctx.out is None:
+                self._observe_ttfb(time.monotonic() - t0)
+                out = web.StreamResponse(
+                    status=200,
+                    headers={"Content-Type": ("text/event-stream" if openai
+                                              else "application/x-ndjson")},
+                )
+                await out.prepare(request)
+                ctx.out = out
+            out = ctx.out
 
             async def write_frame(payload: dict) -> None:
+                # A client-side write failure is final (_StreamStarted):
+                # there is no one left to fail over for.
                 line = json.dumps(payload).encode()
                 tw = time.perf_counter_ns()
-                if openai:
-                    await out.write(b"data: " + line + b"\n\n")
-                else:
-                    await out.write(line + b"\n")
+                try:
+                    if openai:
+                        await out.write(b"data: " + line + b"\n\n")
+                    else:
+                        await out.write(line + b"\n")
+                except Exception as e:
+                    raise _StreamStarted(out, e) from e
                 acc["stream_flush_ns"] = acc.get("stream_flush_ns", 0) \
                     + time.perf_counter_ns() - tw
+
+            # Replay trim (failover only): the re-sent request regenerates
+            # from the prompt, so the first len(ctx.sent_text) chars of the
+            # new reply were ALREADY delivered — skip them by count, and
+            # log once if the replay text diverges from what the client
+            # holds (non-greedy sampling without a seed can differ).
+            skip = len(ctx.sent_text)
+            replay_pos = 0
+            diverged = False
 
             resp = first
             # Inter-frame receive gap ≈ worker decode step + wire, as seen
             # from the gateway — the consumer-side decode_step histogram.
             t_prev = time.perf_counter_ns()
-            try:
-                while True:
-                    if resp.done_reason == "error":
-                        raise RuntimeError(resp.response)
+            while True:
+                if resp.done_reason == "error":
+                    raise RuntimeError(resp.response)
+                text = resp.response
+                trimmed_empty = False
+                if skip > 0 and text:
+                    take = min(skip, len(text))
+                    if (not diverged
+                            and ctx.sent_text[replay_pos:replay_pos + take]
+                            != text[:take]):
+                        diverged = True
+                        log.warning(
+                            "failover replay diverged from delivered text "
+                            "at char %d (request %s); resuming by count",
+                            replay_pos, ctx.rid)
+                    replay_pos += take
+                    skip -= take
+                    text = text[take:]
+                    resp.response = text
+                    self._robust["replayed_chunks"] += 1
+                    trimmed_empty = not text
+                if resp.done or not trimmed_empty:
+                    ctx.sent_text += text
                     await write_frame(render(resp, final=resp.done))
-                    if resp.done:
-                        clean = True  # terminal frame read: stream reusable
-                        break
-                    resp = extract_generate_response(
-                        await self._recv_pb(s, timeout=600, acc=acc))
-                    t_now = time.perf_counter_ns()
-                    self.obs.metrics.decode_step_seconds.observe(
-                        (t_now - t_prev) / 1e9)
-                    t_prev = t_now
-                if openai:
-                    await out.write(b"data: [DONE]\n\n")
-            except Exception as e:
-                # Mid-stream failure: emit a terminal error frame; wrap so
-                # _route doesn't retry or double-respond.
+                if resp.done:
+                    clean = True  # terminal frame read: stream reusable
+                    break
+                if remaining() <= 0:
+                    raise _BudgetExhausted("budget exhausted mid-stream")
                 try:
-                    if openai:
-                        await write_frame({"error": {
-                            "message": str(e), "type": "server_error"}})
-                        await out.write(b"data: [DONE]\n\n")
-                    else:
-                        await write_frame({
-                            "model": resp.model,
-                            "created_at": _now_rfc3339(),
-                            "done": True, "done_reason": "error",
-                            "error": str(e),
-                        })
-                except Exception:
-                    pass
+                    resp = extract_generate_response(
+                        await self._recv_pb(s, timeout=_recv_timeout(),
+                                            acc=acc))
+                except asyncio.TimeoutError as e:
+                    if remaining() <= 0:
+                        raise _BudgetExhausted(
+                            "budget exhausted mid-stream") from e
+                    raise
+                t_now = time.perf_counter_ns()
+                self.obs.metrics.decode_step_seconds.observe(
+                    (t_now - t_prev) / 1e9)
+                t_prev = t_now
+            if openai:
+                try:
+                    await out.write(b"data: [DONE]\n\n")
+                except Exception as e:
+                    raise _StreamStarted(out, e) from e
+            try:
+                await out.write_eof()
+            except Exception as e:
                 raise _StreamStarted(out, e) from e
-            await out.write_eof()
             return out
         finally:
             if clean:

@@ -37,6 +37,7 @@ from crowdllama_tpu.utils.crypto_compat import (
 )
 
 from crowdllama_tpu.core.protocol import RELAY_PROTOCOL, REVERSE_PROTOCOL
+from crowdllama_tpu.testing import faults
 from crowdllama_tpu.net.secure import (
     SecureReader,
     SecureWriter,
@@ -337,6 +338,21 @@ class StreamPool:
         self._closed = False
         self.hits = 0
         self.misses = 0
+        self.evicted_dead = 0  # handed-back streams whose transport died
+
+    @staticmethod
+    def _transport_dead(s: Stream) -> bool:
+        """True when the remote already closed this pooled stream (EOF fed
+        to the reader while it idled).  Checking here — not on the borrowing
+        caller's first roundtrip — saves that caller a guaranteed-failed
+        attempt (docs/ROBUSTNESS.md)."""
+        at_eof = getattr(s.reader, "at_eof", None)
+        if at_eof is None:
+            return False
+        try:
+            return bool(at_eof())
+        except Exception:
+            return True
 
     def get(self, key: str) -> Stream | None:
         pool = self._pools.get(key, [])
@@ -344,6 +360,10 @@ class StreamPool:
             s, ts = pool.pop()
             if (time.monotonic() - ts < self.idle_s
                     and not s.writer.is_closing()):
+                if self._transport_dead(s):
+                    self.evicted_dead += 1
+                    s.close()
+                    continue
                 self.hits += 1
                 return s
             s.close()
@@ -505,6 +525,9 @@ class Host:
         ``local_port`` pins that socket's local bind (the punch requester
         dials the relay FROM the port its pre-bound listener owns).
         """
+        await faults.inject(
+            "host.new_stream", protocol=protocol,
+            peer=target.peer_id if isinstance(target, Contact) else "")
         if isinstance(target, Contact) and target.relay:
             return await self._new_stream_via_relay(target, protocol, timeout)
         if isinstance(target, Contact):

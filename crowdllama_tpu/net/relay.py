@@ -52,6 +52,7 @@ import logging
 import os
 
 from crowdllama_tpu.core.protocol import RELAY_PROTOCOL
+from crowdllama_tpu.testing import faults
 from crowdllama_tpu.net.host import (
     Contact,
     Host,
@@ -114,6 +115,7 @@ class RelayService:
             return
         op = str(req.get("op", ""))
         try:
+            await faults.inject("relay.op", op=op)
             if self._closed:
                 await write_json_frame(stream.writer,
                                        {"ok": False, "error": "relay closed"})
@@ -311,6 +313,14 @@ class RelayService:
 
 async def _splice(a: Stream, b: Stream) -> None:
     """Bidirectional byte copy until either side closes."""
+    try:
+        await faults.inject("relay.splice")
+    except faults.FaultError:
+        # Injected relay death: both legs drop, exactly like the relay
+        # process dying mid-splice.
+        a.close()
+        b.close()
+        return
 
     async def one(src: Stream, dst: Stream) -> None:
         try:

@@ -207,4 +207,10 @@ class SecureReader:
         return out
 
     def at_eof(self) -> bool:
-        return self._eof and not self._buf
+        # Consult the UNDERLYING reader too: asyncio marks it at_eof as
+        # soon as the transport feeds a FIN, without any read having run —
+        # so a pooled idle stream whose remote died is detectable here
+        # before a borrower burns a roundtrip on it (StreamPool.get).
+        # _buf must be empty either way: buffered plaintext is still
+        # readable data, EOF or not.
+        return not self._buf and (self._eof or self._r.at_eof())

@@ -598,6 +598,16 @@ class Peer:
                         parent=msg.parent_span)
             return True
         except Exception as e:
+            from crowdllama_tpu.testing.faults import KillStream
+
+            if isinstance(e, KillStream):
+                # Injected worker death (testing/faults.py): drop the
+                # transport with NO error frame — from the gateway this is
+                # indistinguishable from the worker process crashing
+                # mid-stream, which is what chaos tests simulate.
+                log.warning("fault injection killed inference stream: %s", e)
+                stream.close()
+                return False
             # Synthesize an error response (peer.go:233-243).
             log.warning("inference failed: %s", e)
             from crowdllama_tpu.core.messages import (

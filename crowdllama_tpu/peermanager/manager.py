@@ -158,10 +158,24 @@ class PeerManager:
         # every accepted update is a routing event.
         self._bump_routing_epoch()
 
+    # Quarantine-map hard cap: a long-lived gateway under heavy churn must
+    # not grow recently_removed without bound (entries only veto re-adds;
+    # beyond the cap the OLDEST vetoes are the least useful, so those are
+    # dropped first).  perform_cleanup() sweeps expired entries on its
+    # normal cadence; this cap is the backstop between sweeps.
+    _QUARANTINE_MAX = 4096
+
     def remove_peer(self, peer_id: str, quarantine: bool = True) -> None:
         if self.peers.pop(peer_id, None) is not None:
             if quarantine:
                 self.recently_removed[peer_id] = time.monotonic()
+                if len(self.recently_removed) > self._QUARANTINE_MAX:
+                    excess = (len(self.recently_removed)
+                              - self._QUARANTINE_MAX)
+                    for pid in sorted(self.recently_removed,
+                                      key=self.recently_removed.get
+                                      )[:excess]:
+                        del self.recently_removed[pid]
             self._bump_routing_epoch()
             # A shrinking table should search for replacements promptly.
             self._discovery_idle_rounds = 0

@@ -1,0 +1,5 @@
+"""Test-support machinery importable from production code paths.
+
+Only ``faults`` lives here: deterministic fault injection hooks that are
+inert (one module-global ``None`` check) unless a test installs a plan.
+"""

@@ -1,0 +1,161 @@
+"""Deterministic fault injection for chaos tests (docs/ROBUSTNESS.md).
+
+Production code calls ``await faults.inject("<site>", **attrs)`` at a few
+named choke points; with no plan installed the call is a no-op costing one
+module-global read.  A test installs a :class:`FaultPlan` — a seeded list
+of :class:`FaultRule`s — and every rule fires at a DETERMINISTIC pass
+index, so a failure like "kill the serving worker at token 3" replays
+identically run after run (the seed drives only delay jitter).
+
+Sites wired in this repo:
+
+====================  =====================================================
+site                  attrs / where
+====================  =====================================================
+``engine.request``    non-streamed inference entry (engine/engine.py
+                      ``Engine.handle``): ``worker``, ``model``
+``engine.stream_chunk``  before the worker yields chunk N of a streamed
+                      response (``Engine.handle_streaming``): ``worker``,
+                      ``model``, ``index``
+``host.new_stream``   before a dial + handshake (net/host.py): ``peer``
+                      (empty for bare addresses), ``protocol``
+``relay.op``          relay service op dispatch (net/relay.py): ``op``
+``relay.splice``      before a relay starts its bidirectional copy loop
+====================  =====================================================
+
+Actions:
+
+- ``"error"`` — raise :class:`FaultError` (a generic failure the caller's
+  normal error handling sees: failed dial, failed request, ...).
+- ``"kill_stream"`` — raise :class:`KillStream`.  The worker's serve loop
+  treats it specially: it closes the transport WITHOUT writing an error
+  frame, which is exactly what a crashed worker process looks like from
+  the gateway (mid-stream EOF) — the trigger for mid-stream failover.
+- ``"delay"`` — ``asyncio.sleep(delay_s + seeded jitter)`` then continue.
+
+Usage::
+
+    plan = FaultPlan(seed=42, rules=[
+        FaultRule(site="engine.stream_chunk", action="kill_stream",
+                  after=3, times=1),
+    ])
+    with faults.installed(plan):
+        ... drive a request ...
+    assert plan.log  # fired events, in order
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+
+class FaultError(RuntimeError):
+    """An injected failure (generic: dial failed, request failed, ...)."""
+
+
+class KillStream(FaultError):
+    """Injected hard death: the serving side must drop the transport with
+    no error frame, so the peer observes an unexplained EOF."""
+
+
+@dataclass
+class FaultRule:
+    """One deterministic trigger: fires at pass index >= ``after`` through
+    its ``site`` (counting only passes whose attrs satisfy ``match``), at
+    most ``times`` times (0 = unlimited)."""
+
+    site: str
+    action: str = "error"  # "error" | "kill_stream" | "delay"
+    match: dict = field(default_factory=dict)
+    after: int = 0
+    times: int = 1
+    delay_s: float = 0.0
+    jitter_s: float = 0.0  # extra seeded-uniform delay on "delay"
+    message: str = "injected fault"
+    # Runtime state (owned by the plan; reset by FaultPlan.reset()).
+    passes: int = 0
+    fired: int = 0
+
+
+class FaultPlan:
+    """A seeded, replayable set of fault rules.
+
+    ``log`` records every fired event as ``(site, attrs, action)`` in
+    firing order — tests assert on it to prove the plan did what the
+    scenario claims."""
+
+    def __init__(self, seed: int = 0, rules: list[FaultRule] | None = None):
+        self.seed = seed
+        self.rules: list[FaultRule] = list(rules or [])
+        self._rng = random.Random(seed)
+        self.log: list[tuple[str, dict, str]] = []
+
+    def reset(self) -> None:
+        """Rewind pass/fire counters and the jitter RNG to t=0."""
+        self._rng = random.Random(self.seed)
+        self.log.clear()
+        for rule in self.rules:
+            rule.passes = 0
+            rule.fired = 0
+
+    async def inject(self, site: str, **attrs) -> None:
+        for rule in self.rules:
+            if rule.site != site:
+                continue
+            if any(attrs.get(k) != v for k, v in rule.match.items()):
+                continue
+            idx = rule.passes
+            rule.passes += 1
+            if idx < rule.after:
+                continue
+            if rule.times and rule.fired >= rule.times:
+                continue
+            rule.fired += 1
+            self.log.append((site, dict(attrs), rule.action))
+            if rule.action == "delay":
+                jitter = (self._rng.uniform(0, rule.jitter_s)
+                          if rule.jitter_s else 0.0)
+                await asyncio.sleep(rule.delay_s + jitter)
+            elif rule.action == "kill_stream":
+                raise KillStream(f"{rule.message} @ {site}")
+            else:
+                raise FaultError(f"{rule.message} @ {site}")
+
+
+_active: FaultPlan | None = None
+
+
+def install(plan: FaultPlan) -> FaultPlan:
+    global _active
+    _active = plan
+    return plan
+
+
+def clear() -> None:
+    global _active
+    _active = None
+
+
+def active() -> FaultPlan | None:
+    return _active
+
+
+@contextmanager
+def installed(plan: FaultPlan):
+    """``with faults.installed(plan): ...`` — install for the block, always
+    clear after (a leaked plan would fail unrelated tests)."""
+    install(plan)
+    try:
+        yield plan
+    finally:
+        clear()
+
+
+async def inject(site: str, **attrs) -> None:
+    """The production-side hook: no-op unless a plan is installed."""
+    plan = _active
+    if plan is not None:
+        await plan.inject(site, **attrs)

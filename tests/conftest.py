@@ -28,6 +28,22 @@ import inspect  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # Markers used across the suite:
+    #   slow  — excluded from the tier-1 gate (pytest -m 'not slow');
+    #           long-soak/benchmark tests.
+    #   chaos — deterministic fault-injection tests (testing/faults.py):
+    #           seeded FaultPlans kill streams/handshakes mid-request and
+    #           assert the request plane heals (docs/ROBUSTNESS.md).  They
+    #           run in tier 1 AND standalone via `make chaos`.
+    config.addinivalue_line(
+        "markers", "slow: long-running soak/benchmark tests "
+                   "(excluded from the tier-1 gate)")
+    config.addinivalue_line(
+        "markers", "chaos: deterministic fault-injection tests driven by "
+                   "crowdllama_tpu.testing.faults (see docs/ROBUSTNESS.md)")
+
+
 # Minimal asyncio runner so tests don't depend on pytest-asyncio being
 # installed: any `async def test_*` is run to completion on a fresh loop.
 @pytest.hookimpl(tryfirst=True)

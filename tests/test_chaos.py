@@ -1,0 +1,351 @@
+"""Chaos tests for the request plane (docs/ROBUSTNESS.md): seeded fault
+plans (crowdllama_tpu/testing/faults.py) kill the serving worker
+mid-stream, fail handshakes, and exhaust wall-clock budgets against a
+REAL loopback swarm — assertions check the client-visible contract
+survives: byte-identical streams across failover, well-formed 504s
+inside the budget, 503 + Retry-After under overload."""
+
+import asyncio
+import json
+import time
+
+import aiohttp
+import pytest
+from crowdllama_tpu.utils.crypto_compat import Ed25519PrivateKey
+
+from crowdllama_tpu.config import Configuration, Intervals
+from crowdllama_tpu.core.protocol import INFERENCE_PROTOCOL
+from crowdllama_tpu.engine.engine import FakeEngine
+from crowdllama_tpu.engine.scheduler import (
+    GenRequest,
+    OverloadedError,
+    Scheduler,
+)
+from crowdllama_tpu.gateway.gateway import Gateway
+from crowdllama_tpu.net.discovery import new_host_and_dht
+from crowdllama_tpu.peer.peer import Peer
+from crowdllama_tpu.testing import faults
+from crowdllama_tpu.testing.faults import FaultPlan, FaultRule
+
+pytestmark = pytest.mark.chaos
+
+
+def _cfg(bootstrap, **kw):
+    cfg = Configuration(
+        listen_host="127.0.0.1",
+        bootstrap_peers=[bootstrap],
+        intervals=Intervals.default(),  # test mode: compressed
+    )
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+async def _wait_for(cond, timeout=30.0, interval=0.1, what="condition"):
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        if cond():
+            return
+        await asyncio.sleep(interval)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+async def _topology(n_workers=2, engine_factory=None, **gw_kwargs):
+    """Bootstrap + N workers + consumer gateway, all real loopback
+    sockets (the reference's integration style, integration_test.go)."""
+    if engine_factory is None:
+        engine_factory = lambda: FakeEngine(models=["tiny-test"])  # noqa: E731
+    boot_host, _ = await new_host_and_dht(
+        Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    bootstrap = f"127.0.0.1:{boot_host.listen_port}"
+
+    workers = [Peer(Ed25519PrivateKey.generate(), _cfg(bootstrap),
+                    engine=engine_factory(), worker_mode=True)
+               for _ in range(n_workers)]
+    for w in workers:
+        await w.start()
+    consumer = Peer(Ed25519PrivateKey.generate(), _cfg(bootstrap),
+                    engine=FakeEngine(models=[]), worker_mode=False)
+    await consumer.start()
+    gateway = Gateway(consumer, port=0, host="127.0.0.1", **gw_kwargs)
+    await gateway.start()
+    gw_port = gateway._runner.addresses[0][1]
+
+    await _wait_for(
+        lambda: len({p.peer_id for p in
+                     consumer.peer_manager.get_healthy_peers()
+                     if p.is_worker}) == n_workers,
+        what=f"all {n_workers} workers discovered")
+
+    async def teardown():
+        faults.clear()  # never leak a plan into the next test
+        await gateway.stop()
+        await consumer.stop()
+        for w in workers:
+            try:
+                await w.stop()
+            except Exception:
+                pass
+        await boot_host.close()
+
+    return workers, consumer, gateway, gw_port, teardown
+
+
+def _chat_body(stream=True):
+    return {"model": "tiny-test", "stream": stream,
+            "messages": [{"role": "user",
+                          "content": "tell me a long story about the "
+                                     "swarm and its peers"}]}
+
+
+def _ndjson_lines(raw: str) -> list[dict]:
+    return [json.loads(l) for l in raw.splitlines() if l.strip()]
+
+
+def _content(lines: list[dict]) -> str:
+    return "".join(l.get("message", {}).get("content", "") for l in lines)
+
+
+async def test_midstream_worker_kill_failover_byte_identical():
+    """Acceptance (ISSUE 3): a seeded plan kills the serving worker after
+    3 streamed chunks in a 2-worker swarm; the client still receives the
+    COMPLETE stream, byte-identical to a fault-free run, the failover
+    span is recorded under the gateway root, and the counter moves."""
+    workers, consumer, gateway, gw_port, teardown = await _topology(2)
+    try:
+        url = f"http://127.0.0.1:{gw_port}/api/chat"
+        async with aiohttp.ClientSession() as s:
+            # Fault-free baseline: the byte-identity reference.
+            async with s.post(url, json=_chat_body()) as resp:
+                assert resp.status == 200
+                baseline = _ndjson_lines(await resp.text())
+            assert baseline[-1]["done"] is True
+            base_text = _content(baseline)
+            assert len(baseline) > 6, "prompt too short to kill mid-stream"
+
+            plan = FaultPlan(seed=42, rules=[
+                FaultRule(site="engine.stream_chunk", action="kill_stream",
+                          after=3, times=1)])
+            with faults.installed(plan):
+                async with s.post(url, json=_chat_body()) as resp:
+                    assert resp.status == 200
+                    lines = _ndjson_lines(await resp.text())
+
+            # The injected death happened...
+            assert plan.log and plan.log[0][2] == "kill_stream"
+            # ...and the client could not tell: complete, clean stream.
+            assert lines[-1]["done"] is True
+            assert lines[-1].get("done_reason") == "stop"
+            assert "error" not in lines[-1]
+            assert _content(lines) == base_text
+
+        assert gateway._robust["failovers"] == 1
+        assert gateway._robust["replayed_chunks"] >= 1
+
+        # Failover span, parented under the gateway root span.
+        traces = gateway.obs.trace.snapshot()["traces"]
+        spans = [sp for t in traces for sp in t["spans"]
+                 if sp["name"] == "failover"]
+        assert len(spans) == 1
+        assert spans[0]["parent"] == "gateway"
+        assert spans[0]["meta"]["from_worker"] != spans[0]["meta"]["to_worker"]
+
+        # And the counters are on the exposition surface.
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"http://127.0.0.1:{gw_port}/metrics") as resp:
+                text = await resp.text()
+        assert "crowdllama_gateway_failovers_total 1" in text
+        assert "crowdllama_gateway_budget_exhausted_total 0" in text
+    finally:
+        await teardown()
+
+
+async def test_midstream_kill_replays_deterministically():
+    """The same seeded plan, reset and re-run, kills at the same chunk and
+    heals the same way — chaos scenarios are replayable, not flaky."""
+    workers, consumer, gateway, gw_port, teardown = await _topology(2)
+    try:
+        url = f"http://127.0.0.1:{gw_port}/api/chat"
+        plan = FaultPlan(seed=7, rules=[
+            FaultRule(site="engine.stream_chunk", action="kill_stream",
+                      after=2, times=1)])
+        texts, logs = [], []
+        async with aiohttp.ClientSession() as s:
+            for _ in range(2):
+                plan.reset()
+                with faults.installed(plan):
+                    async with s.post(url, json=_chat_body()) as resp:
+                        assert resp.status == 200
+                        texts.append(_content(
+                            _ndjson_lines(await resp.text())))
+                logs.append([(site, a.get("index"), action)
+                             for site, a, action in plan.log])
+        assert texts[0] == texts[1]
+        assert logs[0] == logs[1] == [("engine.stream_chunk", 2,
+                                       "kill_stream")]
+        assert gateway._robust["failovers"] == 2
+    finally:
+        await teardown()
+
+
+async def test_handshake_fault_fails_over_before_stream():
+    """An injected dial/handshake failure on the inference protocol is
+    absorbed by the ordinary pre-stream retry: the request lands on the
+    next-best worker with no client-visible error."""
+    workers, consumer, gateway, gw_port, teardown = await _topology(2)
+    try:
+        plan = FaultPlan(rules=[
+            FaultRule(site="host.new_stream",
+                      match={"protocol": INFERENCE_PROTOCOL}, times=1)])
+        async with aiohttp.ClientSession() as s:
+            with faults.installed(plan):
+                async with s.post(f"http://127.0.0.1:{gw_port}/api/chat",
+                                  json=_chat_body(stream=False)) as resp:
+                    assert resp.status == 200
+                    d = await resp.json()
+        assert d["done"] is True
+        assert "swarm" in d["message"]["content"]
+        assert len(plan.log) == 1
+        assert gateway._robust["failovers"] == 0  # pre-stream: plain retry
+    finally:
+        await teardown()
+
+
+async def test_deadline_budget_returns_504_within_budget():
+    """Acceptance (ISSUE 3): a request whose X-Request-Timeout budget
+    expires gets a WELL-FORMED terminal error within budget + 1s, not a
+    hang until the transport dies."""
+    workers, consumer, gateway, gw_port, teardown = await _topology(
+        1, engine_factory=lambda: FakeEngine(models=["tiny-test"], delay=8.0))
+    try:
+        t0 = time.monotonic()
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"http://127.0.0.1:{gw_port}/api/chat",
+                              json=_chat_body(),
+                              headers={"X-Request-Timeout": "1"}) as resp:
+                assert resp.status == 504
+                d = await resp.json()
+        elapsed = time.monotonic() - t0
+        assert elapsed < 2.0, f"504 took {elapsed:.1f}s against a 1s budget"
+        assert "deadline exceeded" in d["error"]
+        assert gateway._robust["budget_exhausted"] == 1
+    finally:
+        await teardown()
+
+
+async def test_gateway_admission_cap_sheds_503_with_retry_after():
+    """Acceptance (ISSUE 3): with the inflight cap at 1, a concurrent
+    second request is shed with 503 + Retry-After while the first
+    completes normally."""
+    workers, consumer, gateway, gw_port, teardown = await _topology(
+        1, engine_factory=lambda: FakeEngine(models=["tiny-test"], delay=1.0),
+        admission_max_inflight=1, retry_after_s=2.0)
+    try:
+        url = f"http://127.0.0.1:{gw_port}/api/chat"
+
+        async def one(s):
+            async with s.post(url, json=_chat_body(stream=False)) as resp:
+                return resp.status, resp.headers.get("Retry-After"), \
+                    await resp.json()
+
+        async with aiohttp.ClientSession() as s:
+            a, b = await asyncio.gather(one(s), one(s))
+        shed = a if a[0] == 503 else b
+        served = b if shed is a else a
+        assert served[0] == 200
+        assert shed[0] == 503
+        assert shed[1] == "2"  # Retry-After from retry_after_s
+        assert "overloaded" in shed[2]["error"]
+        assert gateway._robust["shed"] == 1
+
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"http://127.0.0.1:{gw_port}/metrics") as resp:
+                text = await resp.text()
+        assert "crowdllama_gateway_shed_total 1" in text
+    finally:
+        await teardown()
+
+
+async def test_worker_overload_error_maps_to_shed_contract():
+    """A worker rejecting with the scheduler's "overloaded:" error string
+    surfaces at the gateway as the SAME 503 + Retry-After contract as the
+    gateway's own admission cap."""
+
+    class _OverloadedEngine(FakeEngine):
+        async def generate(self, prompt, **kw):  # type: ignore[override]
+            raise OverloadedError(
+                "overloaded: 9 requests pending (admission threshold 8)")
+            yield  # pragma: no cover — async-generator marker
+
+    workers, consumer, gateway, gw_port, teardown = await _topology(
+        1, engine_factory=lambda: _OverloadedEngine(models=["tiny-test"]),
+        retry_after_s=3.0)
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"http://127.0.0.1:{gw_port}/api/chat",
+                              json=_chat_body(stream=False)) as resp:
+                assert resp.status == 503
+                assert resp.headers.get("Retry-After") == "3"
+                d = await resp.json()
+        assert "overloaded" in d["error"]
+        assert gateway._robust["shed"] == 1
+    finally:
+        await teardown()
+
+
+async def test_single_worker_kill_ends_stream_with_terminal_error_frame():
+    """No failover target: the already-started stream must END with a
+    well-formed terminal error frame (done=true, done_reason=error), not
+    a dropped connection mid-body."""
+    workers, consumer, gateway, gw_port, teardown = await _topology(1)
+    try:
+        plan = FaultPlan(rules=[
+            FaultRule(site="engine.stream_chunk", action="kill_stream",
+                      after=2, times=0)])  # every attempt dies
+        async with aiohttp.ClientSession() as s:
+            with faults.installed(plan):
+                async with s.post(f"http://127.0.0.1:{gw_port}/api/chat",
+                                  json=_chat_body()) as resp:
+                    assert resp.status == 200  # headers were already out
+                    lines = _ndjson_lines(await resp.text())
+        assert lines, "some chunks must have been delivered before the kill"
+        last = lines[-1]
+        assert last["done"] is True
+        assert last["done_reason"] == "error"
+        assert "error" in last
+        assert gateway._robust["failovers"] == 0
+    finally:
+        await teardown()
+
+
+async def test_scheduler_admission_threshold_sheds_at_submit():
+    """Unit: the scheduler's pending-depth threshold rejects at submit()
+    with OverloadedError (whose message carries the "overloaded" token
+    the gateway's shed mapping matches on)."""
+
+    class _StubRunner:
+        max_slots = 1
+        max_seq = 128
+
+        def init_state(self):
+            return None
+
+    sched = Scheduler(_StubRunner(), admission_pending_max=1)
+    try:
+        await sched.submit(GenRequest(prompt_ids=[1, 2, 3]))
+        with pytest.raises(OverloadedError) as ei:
+            await sched.submit(GenRequest(prompt_ids=[4, 5]))
+        assert "overloaded" in str(ei.value)
+        assert sched.shed_requests == 1
+        assert sched.telemetry_gauges()["pending_depth"] == 1.0
+    finally:
+        await sched.stop()
+    # Threshold off (0): the bounded queue alone applies backpressure.
+    sched2 = Scheduler(_StubRunner(), admission_pending_max=0)
+    try:
+        await sched2.submit(GenRequest(prompt_ids=[1]))
+        await sched2.submit(GenRequest(prompt_ids=[2]))
+        assert sched2.shed_requests == 0
+    finally:
+        await sched2.stop()

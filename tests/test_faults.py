@@ -1,0 +1,89 @@
+"""Unit tests for the deterministic fault-injection harness
+(crowdllama_tpu/testing/faults.py): rules fire at exact pass indices,
+match filters select sites/attrs, times bounds firing, and the module
+hook is inert unless a plan is installed."""
+
+import pytest
+
+from crowdllama_tpu.testing import faults
+from crowdllama_tpu.testing.faults import FaultError, FaultPlan, FaultRule, KillStream
+
+
+async def test_rule_fires_at_exact_pass_index():
+    plan = FaultPlan(rules=[FaultRule(site="s", after=2, times=1)])
+    for i in range(5):
+        if i == 2:
+            with pytest.raises(FaultError):
+                await plan.inject("s")
+        else:
+            await plan.inject("s")  # passes 0,1 (before) and 3,4 (spent)
+    assert [a for (_, _, a) in plan.log] == ["error"]
+    assert plan.rules[0].passes == 5 and plan.rules[0].fired == 1
+
+
+async def test_match_filter_selects_attrs_and_counts_only_matches():
+    plan = FaultPlan(rules=[
+        FaultRule(site="s", match={"worker": "w1"}, after=1, times=1)])
+    # Non-matching passes must not advance the rule's pass counter.
+    await plan.inject("s", worker="w2")
+    await plan.inject("s", worker="w2")
+    await plan.inject("s", worker="w1")  # matching pass 0: before `after`
+    with pytest.raises(FaultError):
+        await plan.inject("s", worker="w1")  # matching pass 1: fires
+    assert plan.log == [("s", {"worker": "w1"}, "error")]
+
+
+async def test_times_zero_is_unlimited():
+    plan = FaultPlan(rules=[FaultRule(site="s", times=0)])
+    for _ in range(4):
+        with pytest.raises(FaultError):
+            await plan.inject("s")
+    assert plan.rules[0].fired == 4
+
+
+async def test_kill_stream_is_a_fault_error():
+    plan = FaultPlan(rules=[FaultRule(site="s", action="kill_stream")])
+    with pytest.raises(KillStream):
+        await plan.inject("s")
+    assert issubclass(KillStream, FaultError)
+    assert issubclass(FaultError, RuntimeError)
+
+
+async def test_reset_replays_identically():
+    plan = FaultPlan(seed=7, rules=[FaultRule(site="s", after=1, times=2)])
+
+    async def run():
+        fired = []
+        for i in range(5):
+            try:
+                await plan.inject("s", i=i)
+            except FaultError:
+                fired.append(i)
+        return fired, list(plan.log)
+
+    first = await run()
+    plan.reset()
+    second = await run()
+    assert first == second == ([1, 2], [("s", {"i": 1}, "error"),
+                                        ("s", {"i": 2}, "error")])
+
+
+async def test_module_hook_inert_without_plan_and_installed_clears():
+    faults.clear()
+    await faults.inject("anything", x=1)  # no plan: must be a no-op
+    plan = FaultPlan(rules=[FaultRule(site="anything", times=0)])
+    with faults.installed(plan):
+        assert faults.active() is plan
+        with pytest.raises(FaultError):
+            await faults.inject("anything")
+    assert faults.active() is None
+    await faults.inject("anything")  # cleared again
+
+
+async def test_delay_action_sleeps_and_logs():
+    plan = FaultPlan(seed=3, rules=[
+        FaultRule(site="s", action="delay", delay_s=0.0, jitter_s=0.01,
+                  times=2)])
+    await plan.inject("s")
+    await plan.inject("s")
+    assert [a for (_, _, a) in plan.log] == ["delay", "delay"]

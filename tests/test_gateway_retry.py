@@ -1,0 +1,266 @@
+"""Retry/exactly-once semantics on the gateway request plane: a failed
+worker burns a retry on the next-best one, the client always receives
+EXACTLY ONE response, deterministic client errors (400) are never
+retried, and the robustness satellites (dead-transport pool eviction,
+bounded quarantine map) hold their invariants."""
+
+import asyncio
+import time
+
+import aiohttp
+import pytest
+from crowdllama_tpu.utils.crypto_compat import Ed25519PrivateKey
+
+from crowdllama_tpu.config import Configuration, Intervals
+from crowdllama_tpu.core.resource import Resource
+from crowdllama_tpu.engine.engine import FakeEngine
+from crowdllama_tpu.gateway.gateway import Gateway
+from crowdllama_tpu.net.discovery import new_host_and_dht
+from crowdllama_tpu.net.host import StreamPool
+from crowdllama_tpu.peer.peer import Peer
+from crowdllama_tpu.peermanager.manager import PeerHealthConfig, PeerManager
+from crowdllama_tpu.testing import faults
+from crowdllama_tpu.testing.faults import FaultPlan, FaultRule
+
+
+def _cfg(bootstrap, **kw):
+    cfg = Configuration(
+        listen_host="127.0.0.1",
+        bootstrap_peers=[bootstrap],
+        intervals=Intervals.default(),
+    )
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+async def _wait_for(cond, timeout=30.0, interval=0.1, what="condition"):
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        if cond():
+            return
+        await asyncio.sleep(interval)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+async def _topology(n_workers=2, engine_factory=None):
+    if engine_factory is None:
+        engine_factory = lambda: FakeEngine(models=["tiny-test"])  # noqa: E731
+    boot_host, _ = await new_host_and_dht(
+        Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    bootstrap = f"127.0.0.1:{boot_host.listen_port}"
+    workers = [Peer(Ed25519PrivateKey.generate(), _cfg(bootstrap),
+                    engine=engine_factory(), worker_mode=True)
+               for _ in range(n_workers)]
+    for w in workers:
+        await w.start()
+    consumer = Peer(Ed25519PrivateKey.generate(), _cfg(bootstrap),
+                    engine=FakeEngine(models=[]), worker_mode=False)
+    await consumer.start()
+    gateway = Gateway(consumer, port=0, host="127.0.0.1")
+    await gateway.start()
+    gw_port = gateway._runner.addresses[0][1]
+    await _wait_for(
+        lambda: len({p.peer_id for p in
+                     consumer.peer_manager.get_healthy_peers()
+                     if p.is_worker}) == n_workers,
+        what=f"all {n_workers} workers discovered")
+
+    async def teardown():
+        faults.clear()
+        await gateway.stop()
+        await consumer.stop()
+        for w in workers:
+            try:
+                await w.stop()
+            except Exception:
+                pass
+        await boot_host.close()
+
+    return workers, consumer, gateway, gw_port, teardown
+
+
+@pytest.mark.chaos
+async def test_faulty_worker_retried_on_next_best():
+    """A worker whose engine rejects every request (matched by peer id)
+    is transparently retried on the other worker — the client sees 200."""
+    workers, consumer, gateway, gw_port, teardown = await _topology(2)
+    try:
+        bad = workers[0]
+        plan = FaultPlan(rules=[
+            FaultRule(site="engine.request", times=0,
+                      match={"worker": bad.peer_id})])
+        body = {"model": "tiny-test", "stream": False,
+                "messages": [{"role": "user", "content": "retry me"}]}
+        async with aiohttp.ClientSession() as s:
+            with faults.installed(plan):
+                for _ in range(3):
+                    async with s.post(
+                            f"http://127.0.0.1:{gw_port}/api/chat",
+                            json=body) as resp:
+                        assert resp.status == 200, await resp.text()
+                        d = await resp.json()
+                    assert d["worker_id"] == workers[1].peer_id
+                    assert "retry me" in d["message"]["content"]
+        # The faulty worker's engine never generated anything: the fault
+        # fires before generate(), and the good worker served every one.
+        assert bad.engine.calls == 0
+        assert workers[1].engine.calls == 3
+    finally:
+        await teardown()
+
+
+@pytest.mark.chaos
+async def test_all_workers_faulty_returns_single_503():
+    """Exactly-once response semantics when every attempt fails: one 503
+    JSON body naming the injected error, nothing generated."""
+    workers, consumer, gateway, gw_port, teardown = await _topology(1)
+    try:
+        plan = FaultPlan(rules=[FaultRule(site="engine.request", times=0)])
+        async with aiohttp.ClientSession() as s:
+            with faults.installed(plan):
+                async with s.post(
+                        f"http://127.0.0.1:{gw_port}/api/chat",
+                        json={"model": "tiny-test", "stream": False,
+                              "messages": [{"role": "user",
+                                            "content": "x"}]}) as resp:
+                    assert resp.status == 503
+                    d = await resp.json()
+        assert "injected fault" in d["error"]
+        assert workers[0].engine.calls == 0
+        assert gateway._robust["shed"] == 0  # plain failure, not shedding
+    finally:
+        await teardown()
+
+
+async def test_embed_client_error_400_not_retried():
+    """A deterministic client error (ValueError → "invalid:" prefix) must
+    return 400 from the FIRST worker — burning a retry on another worker
+    that would fail identically wastes capacity and doubles the error."""
+
+    class _BadInputEngine(FakeEngine):
+        async def embed(self, texts, model="", truncate=True):
+            self.calls += 1
+            raise ValueError("input exceeds the context window")
+
+    workers, consumer, gateway, gw_port, teardown = await _topology(
+        2, engine_factory=lambda: _BadInputEngine(models=["tiny-test"]))
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"http://127.0.0.1:{gw_port}/api/embed",
+                              json={"model": "tiny-test",
+                                    "input": "way too long"}) as resp:
+                assert resp.status == 400
+                d = await resp.json()
+        assert "context window" in d["error"]
+        assert not d["error"].startswith("invalid:")  # prefix stripped
+        assert sum(w.engine.calls for w in workers) == 1, (
+            "a 400-class error must not be retried on another worker")
+    finally:
+        await teardown()
+
+
+async def test_transient_embed_error_is_retried():
+    """Contrast case: a transient (non-ValueError) embed failure on one
+    worker IS retried and succeeds on the other."""
+    workers, consumer, gateway, gw_port, teardown = await _topology(2)
+    try:
+        bad = workers[0]
+        orig = bad.engine.embed
+
+        async def flaky_embed(texts, model="", truncate=True):
+            raise ConnectionError("transient backend hiccup")
+
+        bad.engine.embed = flaky_embed
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.post(f"http://127.0.0.1:{gw_port}/api/embed",
+                                  json={"model": "tiny-test",
+                                        "input": "hello"}) as resp:
+                    body = await resp.json()
+                    # Whether the flaky worker was scored first (retried
+                    # onto the good one) or not, the client must see 200.
+                    assert resp.status == 200, body
+            assert len(body["embeddings"]) == 1
+        finally:
+            bad.engine.embed = orig
+    finally:
+        await teardown()
+
+
+def test_stream_pool_evicts_dead_transports():
+    """Satellite: a pooled stream whose remote closed while it idled is
+    evicted at get() time (counted), not handed to a borrower who would
+    pay a guaranteed-failed roundtrip."""
+
+    class _Reader:
+        def __init__(self):
+            self.eof = False
+
+        def at_eof(self):
+            return self.eof
+
+    class _Writer:
+        def is_closing(self):
+            return False
+
+    class _Stream:
+        def __init__(self):
+            self.reader = _Reader()
+            self.writer = _Writer()
+            self.closed = False
+
+        def close(self):
+            self.closed = True
+
+    pool = StreamPool(max_per_key=4)
+    dead, live = _Stream(), _Stream()
+    pool.put("w", dead)
+    pool.put("w", live)
+    dead.reader.eof = True
+    # LIFO pop order: live first (healthy → returned), then on the next
+    # get the dead one is evicted and the miss is recorded.
+    assert pool.get("w") is live
+    got = pool.get("w")
+    assert got is None
+    assert pool.evicted_dead == 1
+    assert dead.closed
+    # An at_eof() that raises counts as dead too (defensive).
+
+    class _BrokenReader:
+        def at_eof(self):
+            raise RuntimeError("transport gone")
+
+    broken = _Stream()
+    broken.reader = _BrokenReader()
+    pool.put("w", broken)
+    assert pool.get("w") is None
+    assert pool.evicted_dead == 2
+
+
+def test_quarantine_map_bounded():
+    """Satellite: recently_removed must not grow without bound under
+    churn — the oldest vetoes are dropped past the cap."""
+    pm = PeerManager(self_peer_id="self",
+                     config=PeerHealthConfig(Intervals()))
+    cap = PeerManager._QUARANTINE_MAX
+    # Pre-age the map right at the cap (oldest first).
+    now = time.monotonic()
+    pm.recently_removed = {
+        f"old-{i}": now - 1000 + i for i in range(cap)}
+
+    def _res(pid):
+        r = Resource(peer_id=pid, supported_models=["m"],
+                     tokens_throughput=10.0, worker_mode=True)
+        r.touch()
+        return r
+
+    for i in range(5):
+        pm.add_or_update_peer(_res(f"fresh-{i}"))
+        pm.remove_peer(f"fresh-{i}")
+    assert len(pm.recently_removed) == cap
+    # The newest vetoes survived; the oldest were dropped.
+    for i in range(5):
+        assert f"fresh-{i}" in pm.recently_removed
+        assert f"old-{i}" not in pm.recently_removed
