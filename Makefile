@@ -4,7 +4,7 @@
 PY ?= python
 PYTEST = env JAX_PLATFORMS=cpu $(PY) -m pytest -p no:cacheprovider
 
-.PHONY: test tier1 chaos
+.PHONY: test tier1 chaos distill-smoke
 
 # Full suite (slow soaks included).
 test:
@@ -20,3 +20,10 @@ tier1:
 # heals (mid-stream failover, 504 budgets, 503 shedding).
 chaos:
 	$(PYTEST) tests/ -q -m chaos
+
+# Draft-distillation training tests (docs/SPECULATIVE.md): 30-step CPU
+# distillation smoke + native-checkpoint round-trip + the trained-draft
+# greedy-exactness regression.  Runs in tier 1 too; this target is the
+# standalone loop for iterating on train/distill.py.
+distill-smoke:
+	$(PYTEST) tests/ -q -m train

@@ -20,6 +20,11 @@ Phases (CROWDLLAMA_BENCH_PHASES to select, comma-separated):
                workload (headline) + repetitive best case, with the
                prompt-echo/generative acceptance split, plus draft-MODEL
                bounds (self-draft ceiling, untrained-draft floor)
+  decode_spec_draft  DISTILLED-draft speculation: benchmarks/spec_decode.py's
+               {ngram, random-draft, distilled-draft} x k sweep on a
+               held-out generative workload vs the 1.12/4.79 bracket
+               (checkpoint via CROWDLLAMA_TPU_SPEC_DRAFT_PATH, sha256
+               recorded; distills a tiny draft in-phase when unset)
   kernel    Pallas flash prefill+decode numeric parity vs the jnp reference
             ops, on the attached device (interpret-mode on CPU fallback)
   ttft      gateway p50 TTFT through the full loopback stack
@@ -102,8 +107,8 @@ PARTIAL_PATH = Path(__file__).resolve().parent / "BENCH_partial.jsonl"
 # ~3 min of on-chip param init alone).
 _ALL_PHASES = ("kernel", "decode", "decode_paged", "decode8b",
                "decode8b_paged", "decode8b_ctx4k", "ttft", "swarm",
-               "ep_dispatch", "capacity", "decode_spec", "decode_kv8",
-               "decode8b_int4")
+               "ep_dispatch", "capacity", "decode_spec",
+               "decode_spec_draft", "decode_kv8", "decode8b_int4")
 
 # Phases meaningless on the CPU fallback (real-size or quantized decode).
 _TPU_ONLY_PHASES = frozenset(
@@ -622,6 +627,12 @@ def _spec_phase() -> dict:
         }
 
     results = {name: run_workload(p) for name, p in workloads.items()}
+    # Echo-vs-generative labels (ISSUE 4): which acceptance source each
+    # workload can even exercise — natural prose has no prompt to replay,
+    # so its acceptance is all generative; the repetitive prompt's wins
+    # are mostly echo.
+    results["natural"]["workload_kind"] = "generative"
+    results["repetitive_best_case"]["workload_kind"] = "echo"
 
     # Draft-MODEL speculation (VERDICT r4 weak #4: no throughput number
     # anywhere): two labeled cells bound the feature.  CEILING = a draft
@@ -680,6 +691,23 @@ def _spec_phase() -> dict:
                              "paged decode); echo acceptance exists only "
                              "on traffic that replays its prompt"},
     }
+
+
+def _spec_draft_phase() -> dict:
+    """Distilled-draft speculation (ISSUE 4): benchmarks/spec_decode.py's
+    {ngram, random-draft, distilled-draft} x k sweep on a held-out
+    generative workload, positioned against the r5 bracket (1.12 random
+    floor / 4.79 self-draft ceiling).  Consumes
+    CROWDLLAMA_TPU_SPEC_DRAFT_PATH (a `crowdllama-tpu distill-draft`
+    checkpoint) and records its sha256; without one it distills a
+    tiny-scale draft in-phase from repo prose (CPU: ~1 min)."""
+    bench_dir = str(Path(__file__).resolve().parent / "benchmarks")
+    if bench_dir not in sys.path:
+        sys.path.insert(0, bench_dir)
+    import spec_decode
+
+    return spec_decode.run_sweep(
+        draft_path=os.environ.get("CROWDLLAMA_TPU_SPEC_DRAFT_PATH", ""))
 
 
 # ----------------------------------------------------------------- kernel
@@ -944,6 +972,7 @@ def main() -> None:
         "decode8b_ctx4k": lambda: _decode_phase(
             "llama-3-8b", slots=8, ctx_override=4096),
         "decode_spec": _spec_phase,
+        "decode_spec_draft": _spec_draft_phase,
         "kernel": _kernel_parity_phase,
         "ttft": _ttft_phase,
         "swarm": _swarm_phase,

@@ -85,6 +85,41 @@ def build_parser() -> argparse.ArgumentParser:
     rm = sub.add_parser("rm", help="delete a local pulled checkpoint")
     rm.add_argument("model")
     rm.add_argument("--models-dir", default="")
+    distill = sub.add_parser(
+        "distill-draft",
+        help="distill a small draft model from a main model's logits for "
+             "--spec-decode draft (train/distill.py, docs/SPECULATIVE.md)")
+    distill.add_argument("--teacher", default="tiny-test",
+                         help="main-model registry name")
+    distill.add_argument("--teacher-path", default="",
+                         help="teacher checkpoint dir (empty = random init, "
+                              "matching a checkpoint-less serving node)")
+    distill.add_argument("--out", required=True,
+                         help="checkpoint dir to write (becomes "
+                              "--spec-draft-path)")
+    distill.add_argument("--draft-layers", type=int, default=2)
+    distill.add_argument("--steps", type=int, default=1200)
+    distill.add_argument("--batch", type=int, default=16)
+    distill.add_argument("--seq-len", type=int, default=64)
+    distill.add_argument("--corpus-seqs", type=int, default=256,
+                         help="teacher-rollout sequences to synthesize")
+    distill.add_argument("--corpus", default="",
+                         help="optional text file: seeds rollout prefixes "
+                              "(the prompt distribution) and joins the "
+                              "corpus as raw chunks")
+    distill.add_argument("--max-prefix", type=int, default=32,
+                         help="longest rollout prefix length")
+    distill.add_argument("--sample-temperature", type=float, default=0.0,
+                         help="rollout sampling temperature (0 = greedy, "
+                              "the verify-time trajectory distribution)")
+    distill.add_argument("--no-tie-embeddings", action="store_true",
+                         help="random-init embed/lm_head instead of "
+                              "copying the teacher's")
+    distill.add_argument("--lr", type=float, default=3e-3)
+    distill.add_argument("--kl-weight", type=float, default=0.5)
+    distill.add_argument("--kl-temperature", type=float, default=2.0)
+    distill.add_argument("--seed", type=int, default=0)
+    distill.add_argument("--verbose", action="store_true")
     return p
 
 
@@ -112,6 +147,8 @@ def main(argv: list[str] | None = None) -> int:
         return _show(args)
     if args.command == "rm":
         return _rm(args)
+    if args.command == "distill-draft":
+        return _distill_draft(args)
     if args.command == "start":
         cfg = Configuration.from_flags(args)
         new_app_logger("crowdllama", cfg.verbose)
@@ -154,6 +191,33 @@ def main(argv: list[str] | None = None) -> int:
             return 0
     build_parser().print_help()
     return 1
+
+
+def _distill_draft(args) -> int:
+    """Train + save a speculative draft checkpoint (train/distill.py);
+    prints the flags that load it back into a serving node."""
+    logging.basicConfig(
+        stream=sys.stderr,
+        level=logging.DEBUG if args.verbose else logging.INFO)
+    from crowdllama_tpu.train.distill import DistillConfig, distill_draft
+
+    dc = DistillConfig(
+        teacher=args.teacher, teacher_path=args.teacher_path,
+        draft_layers=args.draft_layers, steps=args.steps, batch=args.batch,
+        seq_len=args.seq_len, corpus_seqs=args.corpus_seqs,
+        corpus_path=args.corpus, sample_temperature=args.sample_temperature,
+        max_prefix=args.max_prefix,
+        tie_embeddings=not args.no_tie_embeddings,
+        lr=args.lr, kl_weight=args.kl_weight,
+        kl_temperature=args.kl_temperature, seed=args.seed, out=args.out)
+    result = distill_draft(dc)
+    print(f"checkpoint: {result['checkpoint']}")
+    print(f"final loss: {result['losses'][-1]:.4f}  "
+          f"greedy agreement: {result['agreement']:.3f}")
+    print("serve with: crowdllama-tpu start --worker-mode "
+          f"--model {args.teacher} --spec-decode draft "
+          f"--spec-draft-path {result['checkpoint']}")
+    return 0
 
 
 async def _pull(args) -> int:

@@ -140,6 +140,11 @@ class Configuration:
     spec_draft: int = 4  # draft tokens per verify step
     spec_draft_model: str = ""  # draft model registry name (spec "draft")
     spec_draft_path: str = ""   # draft checkpoint dir (random-init if empty)
+    # > 0 enables the acceptance-adaptive draft-length controller
+    # (engine/scheduler.py): draft_len retunes between dispatches within
+    # [0, spec_draft_max], pausing speculation entirely (k=0, plain-decode
+    # cost) when drafts mostly miss.  0 = fixed spec_draft (seed behavior).
+    spec_draft_max: int = 0
     drain_timeout: float = 30.0  # graceful-shutdown grace for in-flight reqs
     # Robustness plane (docs/ROBUSTNESS.md): per-request wall-clock budget
     # in seconds, charged across retries and mid-stream failovers; clients
@@ -244,6 +249,8 @@ class Configuration:
                                        cfg.spec_draft_model)
         cfg.spec_draft_path = env.get("CROWDLLAMA_TPU_SPEC_DRAFT_PATH",
                                       cfg.spec_draft_path)
+        cfg.spec_draft_max = int(env.get("CROWDLLAMA_TPU_SPEC_DRAFT_MAX",
+                                         cfg.spec_draft_max))
         cfg.drain_timeout = float(env.get("CROWDLLAMA_TPU_DRAIN_TIMEOUT",
                                           cfg.drain_timeout))
         cfg.request_timeout = float(env.get(
@@ -323,11 +330,19 @@ class Configuration:
                     "(paged spec verifies against int8 pools)")
             if cfg.spec_draft < 1:
                 raise ValueError("spec_draft must be >= 1")
-        if cfg.spec_decode == "draft":
-            if not cfg.spec_draft_model:
+            if cfg.spec_draft_max < 0:
+                raise ValueError("spec_draft_max must be >= 0")
+            if cfg.spec_draft_max and cfg.spec_draft_max < cfg.spec_draft:
                 raise ValueError(
-                    "spec_decode=draft needs --spec-draft-model (the small "
-                    "model that proposes tokens)")
+                    f"spec_draft_max ({cfg.spec_draft_max}) must be >= "
+                    f"spec_draft ({cfg.spec_draft}) — it is the adaptive "
+                    "controller's growth ceiling")
+        if cfg.spec_decode == "draft":
+            if not cfg.spec_draft_model and not cfg.spec_draft_path:
+                raise ValueError(
+                    "spec_decode=draft needs --spec-draft-model (registry "
+                    "name) or --spec-draft-path (a distill-draft checkpoint "
+                    "dir, which carries its own config)")
             if cfg.kv_layout != "paged":
                 raise ValueError(
                     "draft-model speculation runs on the paged layout only "
@@ -396,6 +411,11 @@ class Configuration:
                             help="draft model name (spec_decode=draft)")
         parser.add_argument("--spec-draft-path", dest="spec_draft_path",
                             help="draft model checkpoint dir")
+        parser.add_argument("--spec-draft-max", dest="spec_draft_max",
+                            type=int,
+                            help="enable acceptance-adaptive draft length: "
+                                 "retune k in [0, max] between dispatches "
+                                 "(0 = fixed --spec-draft)")
         parser.add_argument("--profile-dir", dest="profile_dir",
                             help="enable jax.profiler captures into this dir")
         parser.add_argument("--trace-buffer", dest="trace_buffer", type=int,
@@ -432,7 +452,7 @@ class Configuration:
                 "shard_group", "shard_index", "shard_count", "shard_strategy",
                 "quantize", "kv_layout", "kv_page_size", "kv_pool_tokens",
                 "kv_dtype", "relay_mode", "spec_decode", "spec_draft",
-                "spec_draft_model", "spec_draft_path",
+                "spec_draft_model", "spec_draft_path", "spec_draft_max",
                 "profile_dir", "trace_buffer", "worker_metrics_port",
                 "request_timeout", "admission_max_inflight",
                 "admission_pending_max", "retry_after_s",

@@ -335,7 +335,8 @@ class JaxEngine(Engine):
         self.scheduler = Scheduler(
             self._runner,
             decode_chunk=self.config.decode_chunk,
-            admission_pending_max=self.config.admission_pending_max)
+            admission_pending_max=self.config.admission_pending_max,
+            spec_draft_max=self.config.spec_draft_max)
         self.scheduler.start()
         log.info(
             "engine up: model=%s mesh=%s slots=%d max_seq=%d",
@@ -454,7 +455,17 @@ class JaxEngine(Engine):
                 "acceptance_rate_generative": round(gen / offered, 3),
             }
             if self.config.spec_decode == "draft":
-                d["spec_decode"]["draft_model"] = self.config.spec_draft_model
+                d["spec_decode"]["draft_model"] = (
+                    self.config.spec_draft_model
+                    or self.config.spec_draft_path)
+            if self.scheduler._spec_adaptive:
+                d["spec_decode"]["adaptive"] = {
+                    "draft_len": getattr(self.scheduler.runner,
+                                         "draft_len", 0),
+                    "draft_len_max": self.scheduler.spec_draft_max,
+                    "retunes": self.scheduler.spec_retunes,
+                    "probes": self.scheduler.spec_probes,
+                }
         return d
 
     async def capture_profile(self, seconds: float = 3.0) -> str:

@@ -29,13 +29,28 @@ def build_runner(config, plan, cfg, params):
             prefix_cache=config.kv_prefix_cache,
             kv_dtype=plan.kv_dtype)
         if plan.runner == "DraftSpecPagedModelRunner":
+            from dataclasses import replace as _replace
+
             from crowdllama_tpu.engine.spec import DraftSpecPagedModelRunner
-            from crowdllama_tpu.engine.weights import load_or_init_params
+            from crowdllama_tpu.engine.weights import (
+                is_native_checkpoint,
+                load_or_init_params,
+                native_config_from_dir,
+            )
             from crowdllama_tpu.models.config import get_config
 
-            draft_cfg = get_config(
-                config.spec_draft_model,
-                max_context_length=cfg.max_context_length)
+            if (config.spec_draft_path
+                    and is_native_checkpoint(config.spec_draft_path)):
+                # A distill-draft checkpoint carries its own architecture
+                # (2-layer distilled drafts have no registry entry) —
+                # --spec-draft-model is optional and ignored for shapes.
+                draft_cfg = _replace(
+                    native_config_from_dir(config.spec_draft_path),
+                    max_context_length=cfg.max_context_length)
+            else:
+                draft_cfg = get_config(
+                    config.spec_draft_model,
+                    max_context_length=cfg.max_context_length)
             draft_params = None
             if config.spec_draft_path:
                 draft_params = load_or_init_params(

@@ -102,6 +102,22 @@ class MultiEngine(Engine):
             self._peer.update_metadata()  # advertise without waiting a tick
         log.info("hot-registered model %s from %s", name, path or "<default>")
 
+    # Point-in-time gauges (spec_draft_len is the controller's CURRENT k,
+    # the ratios a per-child fullness): max across children.  Everything
+    # else (depths, counts, spec acceptance totals) sums.
+    _GAUGE_MAX = frozenset(
+        {"batch_occupancy", "kv_cache_utilization", "spec_draft_len"})
+
+    def obs_gauges(self) -> dict:
+        out: dict = {}
+        for eng in self._engines.values():
+            for k, v in eng.obs_gauges().items():
+                if k in self._GAUGE_MAX:
+                    out[k] = max(out.get(k, 0.0), v)
+                else:
+                    out[k] = out.get(k, 0.0) + v
+        return out or super().obs_gauges()
+
     def describe(self) -> dict:
         per = {name: e.describe() for name, e in self._engines.items()}
         return {

@@ -99,7 +99,8 @@ class _InFlightChunk:
 
 class Scheduler:
     def __init__(self, runner: ModelRunner, max_queue: int = 256,
-                 decode_chunk: int = 8, admission_pending_max: int = 0):
+                 decode_chunk: int = 8, admission_pending_max: int = 0,
+                 spec_draft_max: int = 0):
         self.runner = runner
         self.decode_chunk = max(1, decode_chunk)
         # Load shedding (docs/ROBUSTNESS.md): reject at submit() once the
@@ -151,6 +152,31 @@ class Scheduler:
         # exist only on templated/retrieval traffic (VERDICT r4 weak #4).
         self.spec_accept_echo = 0
         self.spec_accept_gen = 0
+        # Acceptance-adaptive draft length (ISSUE 4 tentpole #2): retune
+        # the runner's draft_len BETWEEN dispatches from a windowed
+        # acceptance rate.  k shrinks toward 0 when drafts mostly miss
+        # (k = 0 pauses speculation entirely — the runner dispatches its
+        # parent's PLAIN decode program, so a bad draft costs plain-decode
+        # throughput plus only rare probes), grows toward spec_draft_max
+        # when windows fully accept.  Greedy exactness is untouched:
+        # drafts decide how MANY tokens emit per dispatch, never which.
+        # Feature-gated on the runner (ReplicatedRunner pins
+        # supports_adaptive_draft False: a leader-side retune would
+        # diverge follower replay programs).
+        self.spec_draft_max = max(0, spec_draft_max)
+        self._spec_adaptive = (
+            self.spec_draft_max > 0
+            and getattr(runner, "supports_adaptive_draft", False)
+            and getattr(runner, "draft_len", 0) > 0)
+        self.spec_retunes = 0    # draft_len changes applied
+        self.spec_probes = 0     # paused→k=1 probe dispatches
+        self.spec_shrink_rate = 0.25   # window rate at/below → shrink
+        self.spec_grow_rate = 0.8      # window rate at/above → grow
+        self.spec_probe_interval = 64  # plain steps between paused probes
+        self._accept_acc = 0     # window: draft tokens accepted
+        self._accept_off = 0     # window: draft tokens offered
+        self._plain_since_probe = 0
+        self._spec_probing = False
 
     # ---------------------------------------------------------------- public
 
@@ -276,6 +302,17 @@ class Scheduler:
             used = sum(s.prompt_len + s.generated for s in self.slots
                        if isinstance(s, _SlotInfo))
             g["kv_cache_utilization"] = used / (total * max(1, r.max_seq))
+        if hasattr(r, "draft_len"):
+            # Speculation acceptance on BOTH /metrics surfaces (gateway
+            # aggregates worker gauges): emitted/steps is the live
+            # tokens-per-verify-dispatch dividend; the echo/gen split
+            # keeps the echo dividend from being read as general; the
+            # live draft_len shows what the adaptive controller chose.
+            g["spec_steps"] = float(self.spec_steps)
+            g["spec_emitted"] = float(self.spec_emitted)
+            g["spec_accept_echo"] = float(self.spec_accept_echo)
+            g["spec_accept_gen"] = float(self.spec_accept_gen)
+            g["spec_draft_len"] = float(r.draft_len)
         return g
 
     # ------------------------------------------------------------------ loop
@@ -385,12 +422,46 @@ class Scheduler:
         free slot exists: at saturation there is nothing to admit into, and
         per-token dispatch would starve decode amortization for as long as
         the queue stays non-empty (VERDICT r4 weak #3).  EOS / budget
-        overshoot within a chunk is discarded by _loop's snapshot."""
+        overshoot within a chunk is discarded by _loop's snapshot.
+        Adaptive-spec PROBES also dispatch size 1: the probe exists to
+        sample acceptance, and a full chunk of speculative steps against a
+        draft that just proved useless would burn a chunk's worth of
+        slowdown per sample."""
+        if self._spec_probing:
+            return 1
         if self._free_slot() is None:
             return self.decode_chunk
         if not self.pending.empty() or self._deferred:
             return 1
         return self.decode_chunk
+
+    def _spec_retune(self, accepted: int, offered: int) -> None:
+        """Fold one retired chunk's acceptance into the window; retune
+        draft_len when the window holds enough evidence (≥ 2k offered
+        draft tokens — about one decode chunk at steady state).  Shrink is
+        geometric (a useless draft reaches the k=0 pause in O(log k)
+        chunks), growth is linear (one step toward spec_draft_max per
+        fully-accepting window)."""
+        self._accept_acc += accepted
+        self._accept_off += offered
+        k = getattr(self.runner, "draft_len", 0)
+        if self._accept_off < 2 * max(1, k):
+            return
+        rate = self._accept_acc / max(1, self._accept_off)
+        new_k = k
+        if rate <= self.spec_shrink_rate:
+            new_k = k // 2
+        elif rate >= self.spec_grow_rate and k < self.spec_draft_max:
+            new_k = k + 1
+        self._accept_acc = self._accept_off = 0
+        self._spec_probing = False
+        if new_k != k:
+            self.runner.set_draft_len(new_k)
+            self.spec_retunes += 1
+            if new_k == 0:
+                self._plain_since_probe = 0
+            log.info("spec retune: draft_len %d -> %d (window rate %.2f)",
+                     k, new_k, rate)
 
     async def _loop(self) -> None:
         while True:
@@ -635,6 +706,11 @@ class Scheduler:
         dt = max(now - max(self._last_retire_at, fl.dispatched_at), 1e-6)
         self._last_retire_at = now
         emitted = 0
+        chunk_acc = 0  # draft tokens accepted in this chunk (live slots)
+        chunk_off = 0  # draft tokens offered in this chunk (live slots)
+        # k at DISPATCH time, recovered from the packed layout [K, 3+k, B]
+        # — the live draft_len may already have been retuned since.
+        k_dispatch = tokens.shape[1] - 3 if tokens.ndim == 3 else 0
         for step in range(tokens.shape[0]):
             for i, info in enumerate(fl.snapshot):
                 # Identity check: emit only to slots still owned by the
@@ -663,6 +739,11 @@ class Scheduler:
                             self.spec_accept_echo += step_emitted - 1
                         else:
                             self.spec_accept_gen += step_emitted - 1
+                    if step_emitted >= 1:
+                        # Window sample: this live step offered k_dispatch
+                        # draft tokens and accepted step_emitted-1 of them.
+                        chunk_acc += step_emitted - 1
+                        chunk_off += k_dispatch
                 else:
                     self._emit(info.req, int(tokens[step, i]), info)
                     emitted += 1
@@ -675,6 +756,23 @@ class Scheduler:
             self.spec_steps += tokens.shape[0] * max(
                 1, sum(1 for s in fl.snapshot if isinstance(s, _SlotInfo)))
             self.spec_emitted += emitted
+            if self._spec_adaptive and chunk_off:
+                self._spec_retune(chunk_acc, chunk_off)
+        elif (self._spec_adaptive
+              and getattr(self.runner, "draft_len", -1) == 0):
+            # Speculation paused (plain 2-D chunks).  Workloads shift —
+            # after spec_probe_interval plain steps, dispatch ONE k=1
+            # verify step (chunk size 1 via _chunk_size) to re-sample
+            # acceptance; _spec_retune then resumes or re-pauses.  Probe
+            # overhead is a few small-model steps per interval: a paused
+            # engine stays within a few % of a plain engine by design.
+            self._plain_since_probe += tokens.shape[0]
+            if (not self._spec_probing
+                    and self._plain_since_probe >= self.spec_probe_interval):
+                self._plain_since_probe = 0
+                self._spec_probing = True
+                self.spec_probes += 1
+                self.runner.set_draft_len(1)
         await self._flush_releases(loop)
         if emitted == 0:
             # Pure-overshoot chunk (dispatched before its slots' EOS was

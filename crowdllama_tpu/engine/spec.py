@@ -132,7 +132,32 @@ def _verify_accept_emit(st, logits, drafts, j: int, s_max: int):
     return counts, emit, pending, hist, carry
 
 
-class SpecModelRunner(ModelRunner):
+class _AdaptiveDraftLen:
+    """Adaptive-k hook shared by every spec runner: the scheduler retunes
+    ``draft_len`` BETWEEN dispatches (never mid-program — the verify
+    program takes k as a static jit argument, so each distinct k compiles
+    once and is cached).  k = 0 pauses speculation entirely: the runner
+    dispatches its parent's plain decode program, so a paused spec engine
+    costs exactly what a non-spec engine does.
+
+    Exactness is untouched by retunes: drafts only ever decide how MANY
+    greedy tokens emit per dispatch, never which, so any k schedule emits
+    the same greedy stream (the regression test switches k mid-stream).
+
+    NOT supported under multi-host leader-replicated serving: followers
+    replay decode frames with their construction-time draft_len, so a
+    leader-side retune would diverge the traced programs.  The scheduler
+    feature-gates on ``supports_adaptive_draft`` (ReplicatedRunner pins
+    it False).
+    """
+
+    supports_adaptive_draft = True
+
+    def set_draft_len(self, k: int) -> None:
+        self.draft_len = max(0, int(k))
+
+
+class SpecModelRunner(_AdaptiveDraftLen, ModelRunner):
     """ModelRunner with n-gram speculative decode (contiguous KV only).
 
     ``decode_steps_device`` returns a PACKED int32 block [K, 2+J, B]:
@@ -154,7 +179,8 @@ class SpecModelRunner(ModelRunner):
         # proposer attribute matches to prompt-echo vs generative history.
         self._spec_plens = np.zeros((self.max_slots,), np.int32)
         self._spec_decode = jax.jit(self._spec_decode_impl,
-                                    donate_argnums=(1,), static_argnums=(3,))
+                                    donate_argnums=(1,),
+                                    static_argnums=(3, 4))
         self._set_hist = jax.jit(self._set_hist_impl, donate_argnums=(0,))
 
     # ------------------------------------------------------------------ state
@@ -185,24 +211,30 @@ class SpecModelRunner(ModelRunner):
 
     # ---------------------------------------------------------------- drafts
 
-    def _propose(self, hist, seq_lens, prompt_lens):
-        return propose_ngram_drafts(hist, seq_lens, self.draft_len,
+    def _propose(self, hist, seq_lens, prompt_lens, draft_len: int):
+        return propose_ngram_drafts(hist, seq_lens, draft_len,
                                     self.max_seq, prompt_lens)
 
     # ---------------------------------------------------------------- decode
 
     def _spec_decode_impl(self, params, state: DecodeState, prompt_lens,
-                          num_steps: int):
-        """``num_steps`` verify steps; returns (packed [K, 2+J, B], state)."""
+                          num_steps: int, draft_len: int):
+        """``num_steps`` verify steps; returns (packed [K, 2+J, B], state).
+
+        ``draft_len`` is a STATIC jit argument: the adaptive controller
+        mutates ``self.draft_len`` between dispatches, and reading it at
+        trace time would silently pin the first-traced k (input shapes
+        don't change with k, so jit would never retrace)."""
         cfg = self.cfg
         b = self.max_slots
-        j = 1 + self.draft_len
+        j = 1 + draft_len
         s_max = self.max_seq
         bidx = jnp.arange(b)
 
         def step(st: DecodeState, _):
             drafts, from_prompt = self._propose(st.hist, st.seq_lens,
-                                                prompt_lens)    # [B, k]
+                                                prompt_lens,
+                                                draft_len)      # [B, k]
             seq_tok = jnp.concatenate([st.tokens[:, None], drafts], 1)  # [B,J]
             positions = jnp.minimum(st.seq_lens[:, None] + jnp.arange(j),
                                     s_max - 1)                  # [B, J]
@@ -245,11 +277,18 @@ class SpecModelRunner(ModelRunner):
         return np.asarray(tokens), new_state
 
     def decode_steps_device(self, state: DecodeState, num_steps: int = 1):
+        if self.draft_len == 0:
+            # Speculation paused: dispatch the parent's plain greedy/sampled
+            # program (2-D [K, B] — the scheduler branches on ndim).  hist
+            # rides through the plain scan untouched; it goes stale, which
+            # only costs proposal quality after a resume, never correctness.
+            return ModelRunner.decode_steps_device(self, state, num_steps)
         return self._spec_decode(self.params, state,
-                                 jnp.asarray(self._spec_plens), num_steps)
+                                 jnp.asarray(self._spec_plens), num_steps,
+                                 self.draft_len)
 
 
-class SpecPagedModelRunner(PagedModelRunner):
+class SpecPagedModelRunner(_AdaptiveDraftLen, PagedModelRunner):
     """PagedModelRunner with n-gram speculative decode (VERDICT r3 #4:
     spec must compose with the serving-default paged layout, int8 pools
     included).
@@ -275,7 +314,8 @@ class SpecPagedModelRunner(PagedModelRunner):
         self.draft_len = max(1, draft_len)
         self._spec_plens = np.zeros((self.max_slots,), np.int32)
         self._spec_decode = jax.jit(self._spec_decode_impl,
-                                    donate_argnums=(1,), static_argnums=(4,))
+                                    donate_argnums=(1,),
+                                    static_argnums=(4, 5))
         self._set_hist = jax.jit(self._set_hist_impl, donate_argnums=(0,))
 
     # ------------------------------------------------------------------ state
@@ -310,11 +350,12 @@ class SpecPagedModelRunner(PagedModelRunner):
     # ---------------------------------------------------------------- decode
 
     def _spec_decode_impl(self, params, state, page_table, prompt_lens,
-                          num_steps: int):
-        """``num_steps`` verify steps; returns (packed [K, 2+J, B], state)."""
+                          num_steps: int, draft_len: int):
+        """``num_steps`` verify steps; returns (packed [K, 2+J, B], state).
+        ``draft_len`` is static (see the contiguous runner's docstring)."""
         cfg = self.cfg
         b = self.max_slots
-        j = 1 + self.draft_len
+        j = 1 + draft_len
         s_max = self.max_seq
         pg = self.page_size
         l = cfg.num_layers
@@ -325,7 +366,7 @@ class SpecPagedModelRunner(PagedModelRunner):
 
         def step(st, _):
             drafts, from_prompt, draft_k, draft_v = self._propose_in_step(
-                st, prompt_lens)
+                st, prompt_lens, draft_len)
             seq_tok = jnp.concatenate([st.tokens[:, None], drafts], 1)
             positions = jnp.minimum(st.seq_lens[:, None] + jnp.arange(j),
                                     s_max - 1)                  # [B, J]
@@ -398,12 +439,12 @@ class SpecPagedModelRunner(PagedModelRunner):
         new_state, packed = jax.lax.scan(step, state, length=num_steps)
         return packed, new_state  # packed [K, 2+J, B]
 
-    def _propose_in_step(self, st, prompt_lens):
+    def _propose_in_step(self, st, prompt_lens, draft_len: int):
         """Traced draft proposal for one verify step: returns
         ([B, draft_len] drafts, from_prompt [B], draft_k, draft_v) — the
         base runner drafts by n-gram lookup and carries no draft cache."""
         drafts, from_prompt = propose_ngram_drafts(
-            st.hist, st.seq_lens, self.draft_len, self.max_seq,
+            st.hist, st.seq_lens, draft_len, self.max_seq,
             prompt_lens)
         return drafts, from_prompt, st.draft_k, st.draft_v
 
@@ -414,11 +455,19 @@ class SpecPagedModelRunner(PagedModelRunner):
         return super().pre_decode_check(steps * (1 + self.draft_len))
 
     def decode_steps_device(self, state, num_steps: int = 1):
+        if self.draft_len == 0:
+            # Paused: the parent's plain paged decode program.  hist and
+            # the draft cache (if any) ride through its scan unchanged;
+            # stale proposal context after a resume only lowers acceptance
+            # until overwritten — never correctness (misses emit exactly
+            # the plain greedy stream).
+            return PagedModelRunner.decode_steps_device(self, state,
+                                                        num_steps)
         j = 1 + self.draft_len
         self._ensure_capacity(num_steps * j)
         packed, new_state = self._spec_decode(
             self.params, state, jnp.asarray(self.page_table),
-            jnp.asarray(self._spec_plens), num_steps)
+            jnp.asarray(self._spec_plens), num_steps, self.draft_len)
         for slot in self._slot_pages:
             self._host_seq[slot] = min(self._host_seq[slot] + num_steps * j,
                                        self.max_seq)
@@ -519,12 +568,12 @@ class DraftSpecPagedModelRunner(SpecPagedModelRunner):
 
     # ---------------------------------------------------------------- drafts
 
-    def _propose_in_step(self, st, prompt_lens):
+    def _propose_in_step(self, st, prompt_lens, draft_len: int):
         """Autoregressive greedy draft rollout: ``draft_len`` small-model
         decode steps from the pending token, extending the draft cache.
         Draft-model proposals are GENERATIVE by definition (no prompt-echo
         attribution), so ``from_prompt`` is always False."""
-        k = self.draft_len
+        k = draft_len
         s_max = self.max_seq
 
         def dstep(carry, _):

@@ -28,11 +28,113 @@ def load_or_init_params(cfg: ModelConfig, model_path: str = "",
                         dtype=jnp.bfloat16, seed: int = 0) -> dict:
     if model_path:
         path = Path(model_path).expanduser()
+        if is_native_checkpoint(path):
+            log.info("loading native checkpoint from %s", path)
+            return load_native_params(cfg, path, dtype=dtype)
         if path.is_dir() and list(path.glob("*.safetensors")):
             log.info("loading weights from %s", path)
             return load_safetensors_params(cfg, path, dtype=dtype)
         log.warning("model_path %s has no safetensors; using random init", path)
     return T.init_params(cfg, jax.random.PRNGKey(seed), dtype=dtype)
+
+
+# ---- native checkpoints ----------------------------------------------------
+#
+# train/distill.py writes checkpoints in the engine's OWN pytree layout
+# (stacked-layer arrays, native key paths joined by "/"), not HF names —
+# a distilled draft has no HF identity to round-trip through.  The marker
+# key in config.json keeps load_or_init_params from misreading the dir as
+# an HF checkpoint (both contain config.json + *.safetensors).
+
+_NATIVE_MARKER = "crowdllama_tpu_native"
+
+
+def is_native_checkpoint(path: str | Path) -> bool:
+    cfg_file = Path(path).expanduser() / "config.json"
+    if not cfg_file.exists():
+        return False
+    try:
+        return bool(json.loads(cfg_file.read_text()).get(_NATIVE_MARKER))
+    except (OSError, ValueError):
+        return False
+
+
+def _flatten_params(params: dict, prefix: str = "") -> dict[str, np.ndarray]:
+    out: dict[str, np.ndarray] = {}
+    for k, v in params.items():
+        key = f"{prefix}/{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            out.update(_flatten_params(v, key))
+        else:
+            # float32 on disk: bf16 is not a numpy dtype, and a tiny draft
+            # checkpoint doesn't need the 2x size saving.
+            out[key] = np.asarray(jnp.asarray(v), np.float32)
+    return out
+
+
+def save_params(cfg: ModelConfig, params: dict, out_dir: str | Path,
+                meta: dict | None = None) -> Path:
+    """Write a native checkpoint: config.json (marker + full ModelConfig +
+    caller metadata) and model.safetensors (flattened native pytree,
+    float32).  Loadable via ``load_or_init_params`` / ``--spec-draft-path``
+    — ``native_config_from_dir`` reconstructs the architecture, so the
+    checkpoint needs no registry entry."""
+    from dataclasses import asdict
+
+    from safetensors.numpy import save_file
+
+    out = Path(out_dir).expanduser()
+    out.mkdir(parents=True, exist_ok=True)
+    doc = {_NATIVE_MARKER: True, "model_config": asdict(cfg)}
+    if meta:
+        doc["meta"] = meta
+    (out / "config.json").write_text(json.dumps(doc, indent=2))
+    save_file(_flatten_params(params), str(out / "model.safetensors"))
+    return out
+
+
+def native_config_from_dir(path: str | Path) -> ModelConfig:
+    """Reconstruct the ModelConfig a native checkpoint was saved with."""
+    from crowdllama_tpu.models.config import RopeScaling
+
+    d = json.loads((Path(path).expanduser() / "config.json").read_text())
+    if not d.get(_NATIVE_MARKER):
+        raise ValueError(f"{path} is not a native checkpoint "
+                         f"(missing {_NATIVE_MARKER} marker)")
+    mc = dict(d["model_config"])
+    if mc.get("rope_scaling") is not None:
+        mc["rope_scaling"] = RopeScaling(**mc["rope_scaling"])
+    return ModelConfig(**mc)
+
+
+def load_native_params(cfg: ModelConfig, path: str | Path,
+                       dtype=jnp.bfloat16) -> dict:
+    """Load a native checkpoint into the engine pytree, casting to the
+    serving dtype.  ``cfg`` must match the saved architecture — init a
+    reference pytree and fill it so shape/key mismatches fail loudly."""
+    from safetensors.numpy import load_file
+
+    flat = load_file(str(Path(path).expanduser() / "model.safetensors"))
+
+    def rebuild(ref, prefix=""):
+        out = {}
+        for k, v in ref.items():
+            key = f"{prefix}/{k}" if prefix else str(k)
+            if isinstance(v, dict):
+                out[k] = rebuild(v, key)
+            else:
+                if key not in flat:
+                    raise KeyError(f"native checkpoint {path} missing {key}")
+                arr = flat[key]
+                if tuple(arr.shape) != tuple(v.shape):
+                    raise ValueError(
+                        f"native checkpoint {path}: {key} has shape "
+                        f"{tuple(arr.shape)}, config wants {tuple(v.shape)}")
+                out[k] = jnp.asarray(arr, dtype)
+        return out
+
+    ref = T.init_params(cfg, jax.random.PRNGKey(0), dtype=dtype)
+    return rebuild(ref)
 
 
 def load_safetensors_params(cfg: ModelConfig, path: Path, dtype=jnp.bfloat16) -> dict:

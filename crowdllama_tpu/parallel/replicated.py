@@ -123,6 +123,13 @@ class ReplicatedRunner:
     """
 
     defer_release = True  # releases broadcast; scheduler defers them
+    # Adaptive draft-length retuning is leader-local state; followers
+    # replay decode frames traced with their construction-time draft_len,
+    # so a leader-side set_draft_len would silently diverge the replicated
+    # programs.  Explicit class attribute (not __getattr__ passthrough)
+    # so the scheduler's feature gate sees False even when the inner
+    # runner supports it.
+    supports_adaptive_draft = False
 
     def __init__(self, inner):
         self.inner = inner

@@ -1,0 +1,7 @@
+"""Training utilities (draft-model distillation for speculative decoding).
+
+The serving engine is inference-only everywhere else; this package holds
+the one training loop the project needs — distilling a small draft model
+against a served main model's logits (train/distill.py) so draft-MODEL
+speculation has something better than random init to propose with.
+"""

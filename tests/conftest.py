@@ -42,6 +42,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "chaos: deterministic fault-injection tests driven by "
                    "crowdllama_tpu.testing.faults (see docs/ROBUSTNESS.md)")
+    config.addinivalue_line(
+        "markers", "train: draft-distillation training tests "
+                   "(train/distill.py; run in tier 1 AND standalone via "
+                   "`make distill-smoke`)")
 
 
 # Minimal asyncio runner so tests don't depend on pytest-asyncio being

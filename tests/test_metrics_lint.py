@@ -192,3 +192,62 @@ async def test_gateway_and_worker_metrics_lint():
         await obs_srv.stop()
         await worker.stop()
         await boot_host.close()
+
+
+def test_spec_gauges_lint():
+    """The adaptive-speculation gauges (scheduler.telemetry_gauges) render
+    as lint-clean crowdllama_engine_* families — the exact lines both
+    /metrics surfaces emit for a spec-decode worker."""
+    import jax
+    import jax.numpy as jnp
+
+    from crowdllama_tpu.engine.scheduler import Scheduler
+    from crowdllama_tpu.engine.spec import SpecModelRunner
+    from crowdllama_tpu.models import transformer as T
+    from crowdllama_tpu.models.config import get_config
+    from crowdllama_tpu.obs.metrics import engine_gauge_lines
+
+    cfg = get_config("tiny-test", max_context_length=128)
+    params = T.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    spec = SpecModelRunner(cfg, params=params, max_slots=2, max_seq=128,
+                           dtype=jnp.float32, draft_len=4)
+    sched = Scheduler(spec, spec_draft_max=8)
+    types = _lint("\n".join(engine_gauge_lines(sched.telemetry_gauges())))
+    for g in ("spec_steps", "spec_emitted", "spec_accept_echo",
+              "spec_accept_gen", "spec_draft_len"):
+        assert types.get(f"crowdllama_engine_{g}") == "gauge", g
+
+
+def test_multi_engine_forwards_spec_gauges():
+    """MultiEngine (the CLI's engine container, even for one model) must
+    FORWARD child scheduler gauges to the worker /metrics surface —
+    counters summed, point-in-time gauges (occupancy/utilization/
+    spec_draft_len) maxed — or every worker scrapes zeros and the spec
+    telemetry never leaves the process."""
+    from crowdllama_tpu.engine.multi import MultiEngine
+    from crowdllama_tpu.obs.metrics import engine_gauge_lines
+
+    class _Child:
+        def __init__(self, g):
+            self._g = g
+
+        def obs_gauges(self):
+            return dict(self._g)
+
+    me = MultiEngine.__new__(MultiEngine)
+    me._engines = {
+        "a": _Child({"pending_depth": 1.0, "batch_occupancy": 0.5,
+                     "kv_cache_utilization": 0.125, "spec_draft_len": 2.0,
+                     "spec_steps": 10.0, "spec_accept_gen": 7.0}),
+        "b": _Child({"pending_depth": 2.0, "batch_occupancy": 0.25,
+                     "kv_cache_utilization": 0.5, "spec_draft_len": 3.0,
+                     "spec_steps": 4.0, "spec_accept_gen": 1.0}),
+    }
+    g = me.obs_gauges()
+    assert g["pending_depth"] == 3.0          # counters sum
+    assert g["spec_steps"] == 14.0
+    assert g["spec_accept_gen"] == 8.0
+    assert g["batch_occupancy"] == 0.5        # point-in-time gauges max
+    assert g["kv_cache_utilization"] == 0.5
+    assert g["spec_draft_len"] == 3.0
+    _lint("\n".join(engine_gauge_lines(g)))

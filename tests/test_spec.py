@@ -7,6 +7,7 @@ import asyncio
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from crowdllama_tpu.engine.runner import ModelRunner
 from crowdllama_tpu.engine.spec import SpecModelRunner
@@ -86,7 +87,7 @@ def test_spec_history_proposals():
                         + [0] * 118], jnp.int32)
     # cur=6: pending bigram (7, 8) matches positions 0-1 → draft 21, 22, 23.
     drafts, from_prompt = spec._propose(hist, jnp.asarray([6]),
-                                        jnp.asarray([7]))
+                                        jnp.asarray([7]), spec.draft_len)
     assert drafts.tolist() == [[21, 22, 23]]
     assert bool(from_prompt[0]) is True  # matched inside the prompt region
 
@@ -355,3 +356,197 @@ def test_packed_source_row_marks_echo_acceptance():
     # Wherever a draft was accepted, the source must be attributed (1 or
     # 2, never 0); steps with no acceptance must carry 0.
     assert ((counts > 1) == (srcs > 0)).all(), (counts, srcs)
+
+# ------- distilled draft + acceptance-adaptive draft length (ISSUE 4) -----
+
+
+def _unpack_into(packed, toks):
+    """Append a decode chunk's tokens: packed spec layout [K, 2+J, B] or
+    plain [K, B] (speculation paused) — the same branch the scheduler's
+    _retire_inflight takes."""
+    if packed.ndim == 3:
+        for step in range(packed.shape[0]):
+            n = int(packed[step, 0, 0])
+            toks.extend(int(t) for t in packed[step, 1:1 + n, 0])
+    else:
+        toks.extend(int(t) for t in packed[:, 0])
+
+
+@pytest.mark.train
+def test_trained_draft_exactness_across_k_changes():
+    """A DISTILLED draft through the paged draft runner emits byte-identical
+    greedy tokens vs the plain paged runner — including across mid-stream
+    ``set_draft_len`` retunes (3 -> 1 -> 0 pause -> 4 resume), exactly the
+    transitions the scheduler's adaptive controller applies."""
+    from crowdllama_tpu.engine.paged import PagedModelRunner
+    from crowdllama_tpu.train.distill import DistillConfig, distill_draft
+
+    cfg = get_config("tiny-test", max_context_length=128)
+    params = T.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    res = distill_draft(
+        DistillConfig(steps=30, batch=8, seq_len=32, corpus_seqs=16,
+                      log_every=0),
+        teacher_cfg=cfg, teacher_params=params)
+    prompt = [5, 9, 5, 9, 5, 9, 5]
+
+    base = PagedModelRunner(cfg, params=params, max_slots=2, max_seq=128,
+                            page_size=32, mesh_spec="1")
+    state = base.init_state()
+    first, ks, vs, plen = base.prefill(prompt, 0.0, 1.0,
+                                       jax.random.PRNGKey(7))
+    state = base.insert(state, 0, ks, vs, plen, first, 0.0, 1.0)
+    out, state = base.decode_steps(state, 40)
+    ref = [first] + [int(t) for t in out[:, 0]]
+
+    spec = _draft_runner(params, cfg, res["draft_config"],
+                         res["draft_params"], draft_len=3)
+    sstate = spec.init_state()
+    sfirst, ks, vs, plen = spec.prefill(prompt, 0.0, 1.0,
+                                        jax.random.PRNGKey(7))
+    sstate = spec.insert(sstate, 0, ks, vs, plen, sfirst, 0.0, 1.0,
+                         prompt_tokens=prompt)
+    toks = [sfirst]
+    for steps, k in ((8, 3), (6, 1), (6, 0), (6, 4)):
+        spec.set_draft_len(k)
+        packed, sstate = spec.decode_steps(sstate, steps)
+        _unpack_into(packed, toks)
+    n = min(len(ref), len(toks))
+    assert n > 20
+    assert toks[:n] == ref[:n], (toks[:n], ref[:n])
+
+
+def test_adaptive_retune_shrinks_geometrically_to_pause():
+    """Zero acceptance shrinks draft_len geometrically (4 -> 2 -> 1 -> 0)
+    once each window holds >= 2k offered draft tokens; at 0 the runner
+    dispatches the plain program (speculation paused)."""
+    from crowdllama_tpu.engine.scheduler import Scheduler
+
+    _, spec = _runners(draft_len=4)
+    sched = Scheduler(spec, spec_draft_max=8)
+    assert sched._spec_adaptive
+    for expect in (2, 1, 0):
+        sched._spec_retune(0, 2 * max(1, spec.draft_len))
+        assert spec.draft_len == expect, expect
+    assert sched.spec_retunes == 3
+    # Below-threshold evidence must NOT move k.
+    spec.set_draft_len(4)
+    sched._spec_retune(0, 3)  # < 2*4 offered
+    assert spec.draft_len == 4
+
+
+def test_adaptive_retune_grows_toward_max():
+    """Full acceptance grows draft_len linearly, capped at spec_draft_max."""
+    from crowdllama_tpu.engine.scheduler import Scheduler
+
+    _, spec = _runners(draft_len=2)
+    sched = Scheduler(spec, spec_draft_max=4)
+    for expect in (3, 4, 4):  # capped at max
+        off = 2 * max(1, spec.draft_len)
+        sched._spec_retune(off, off)
+        assert spec.draft_len == expect, expect
+    assert sched.spec_retunes == 2
+
+
+async def test_adaptive_pause_probe_arming():
+    """Paused speculation re-samples acceptance: after spec_probe_interval
+    plain decode steps the controller arms a k=1 probe and shrinks the
+    next dispatch to a single step."""
+    import time as _time
+
+    from crowdllama_tpu.engine.scheduler import Scheduler, _InFlightChunk
+
+    _, spec = _runners(draft_len=4)
+    sched = Scheduler(spec, spec_draft_max=8)
+    spec.set_draft_len(0)  # as if the controller paused it
+    loop = asyncio.get_running_loop()
+    plain = np.zeros((sched.spec_probe_interval, 2), np.int32)  # [K, B]
+    sched._inflight = _InFlightChunk(plain, [None, None], _time.monotonic())
+    await sched._retire_inflight(loop)
+    assert sched._spec_probing
+    assert sched.spec_probes == 1
+    assert spec.draft_len == 1
+    assert sched._chunk_size() == 1
+    # The probe's retune decision clears the probing state either way.
+    sched._spec_retune(2, 2)
+    assert not sched._spec_probing
+    assert spec.draft_len == 2  # probe accepted -> resume and grow
+
+
+async def test_adaptive_k_grows_end_to_end():
+    """Scheduler end to end on a fully-predictable (zeroed) model: the
+    controller grows draft_len from 1 toward spec_draft_max as windows
+    fully accept."""
+    from crowdllama_tpu.engine.scheduler import DONE, GenRequest, Scheduler
+
+    cfg = get_config("tiny-test", max_context_length=128)
+    params = jax.tree_util.tree_map(
+        lambda a: a * 0, T.init_params(cfg, jax.random.PRNGKey(0),
+                                       dtype=jnp.float32))
+    spec = SpecModelRunner(cfg, params=params, max_slots=2, max_seq=128,
+                           dtype=jnp.float32, draft_len=1)
+    sched = Scheduler(spec, decode_chunk=4, spec_draft_max=3)
+    sched.start()
+    try:
+        req = GenRequest(prompt_ids=[3, 1, 4, 1, 5], max_tokens=48,
+                         eos_id=-1)
+        await sched.submit(req)
+        while True:
+            tok, _ = await asyncio.wait_for(req.out.get(), 60)
+            if tok is DONE:
+                break
+        assert spec.draft_len > 1
+        assert spec.draft_len <= 3
+        assert sched.spec_retunes >= 1
+        g = sched.telemetry_gauges()
+        assert g["spec_draft_len"] == float(spec.draft_len)
+        assert g["spec_accept_echo"] + g["spec_accept_gen"] > 0
+    finally:
+        await sched.stop()
+
+
+def test_paused_spec_throughput_matches_plain_paged():
+    """The ISSUE 4 cost guard: with a USELESS (random) draft the adaptive
+    controller pauses speculation, and the paused runner's decode must
+    stay within 10% of the plain paged runner's tok/s — it dispatches the
+    parent's own program, so any gap is pure host overhead."""
+    import time as _time
+
+    from crowdllama_tpu.engine.paged import PagedModelRunner
+
+    cfg = get_config("tiny-test", max_context_length=128)
+    params = T.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    draft_cfg = get_config("tiny-test", max_context_length=128)
+    draft_params = T.init_params(draft_cfg, jax.random.PRNGKey(99),
+                                 dtype=jnp.float32)
+    prompt = [5, 9, 5, 9, 5, 9, 5]
+
+    def _setup(runner):
+        state = runner.init_state()
+        first, ks, vs, plen = runner.prefill(prompt, 0.0, 1.0,
+                                             jax.random.PRNGKey(7))
+        kw = {"prompt_tokens": prompt} if hasattr(runner, "set_draft_len") \
+            else {}
+        return runner.insert(state, 0, ks, vs, plen, first, 0.0, 1.0, **kw)
+
+    def _best_time(runner, state, steps=16, reps=2):
+        best = float("inf")
+        for _ in range(reps):
+            t0 = _time.monotonic()
+            out, state = runner.decode_steps(state, steps)
+            best = min(best, _time.monotonic() - t0)
+        return best, state
+
+    plain = PagedModelRunner(cfg, params=params, max_slots=2, max_seq=128,
+                             page_size=32, mesh_spec="1")
+    pstate = _setup(plain)
+    _, pstate = _best_time(plain, pstate, steps=4, reps=1)  # compile warmup
+    t_plain, _ = _best_time(plain, pstate)
+
+    spec = _draft_runner(params, cfg, draft_cfg, draft_params, draft_len=3)
+    spec.set_draft_len(0)  # what the controller converges to here
+    sstate = _setup(spec)
+    _, sstate = _best_time(spec, sstate, steps=4, reps=1)
+    t_spec, _ = _best_time(spec, sstate)
+
+    # best-of-2 on identical step counts; 10% + a 2ms floor for timer noise.
+    assert t_spec <= t_plain * 1.10 + 0.002, (t_spec, t_plain)
