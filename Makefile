@@ -4,7 +4,7 @@
 PY ?= python
 PYTEST = env JAX_PLATFORMS=cpu $(PY) -m pytest -p no:cacheprovider
 
-.PHONY: test tier1 chaos distill-smoke
+.PHONY: test tier1 chaos distill-smoke bench-kv
 
 # Full suite (slow soaks included).
 test:
@@ -27,3 +27,9 @@ chaos:
 # standalone loop for iterating on train/distill.py.
 distill-smoke:
 	$(PYTEST) tests/ -q -m train
+
+# KV-shipping benchmark (docs/KV_TRANSFER.md): fetch-vs-recompute TTFT
+# over real p2p streams with an injected-RTT sweep; writes the artifact
+# under benchmarks/results/.
+bench-kv:
+	env JAX_PLATFORMS=cpu CROWDLLAMA_BENCH_PHASES=kv_transfer $(PY) bench.py

@@ -34,6 +34,12 @@ Phases (CROWDLLAMA_BENCH_PHASES to select, comma-separated):
   ep_dispatch  cross-worker expert-parallel decode through a 2-bank MoE
             group on real loopback streams — the per-MoE-layer dispatch
             hop price (BASELINE config 4; subprocess, CPU)
+  kv_transfer  swarm KV shipping: prefix-page fetch vs prefill recompute
+            TTFT across injected RTT, with the break-even prefix length
+            (benchmarks/kv_transfer.py as a subprocess, CPU)
+  mini_swarm  REAL tiny engines behind the gateway on CPU — end-to-end
+            tok/s + TTFT under concurrent load, with a FakeEngine
+            control curve (VERDICT #5; subprocess, CPU)
   capacity  static params+KV HBM accounting per registry model against
             the attached chip (largest-servable report; subprocess)
 
@@ -107,8 +113,9 @@ PARTIAL_PATH = Path(__file__).resolve().parent / "BENCH_partial.jsonl"
 # ~3 min of on-chip param init alone).
 _ALL_PHASES = ("kernel", "decode", "decode_paged", "decode8b",
                "decode8b_paged", "decode8b_ctx4k", "ttft", "swarm",
-               "ep_dispatch", "capacity", "decode_spec",
-               "decode_spec_draft", "decode_kv8", "decode8b_int4")
+               "ep_dispatch", "kv_transfer", "mini_swarm", "capacity",
+               "decode_spec", "decode_spec_draft", "decode_kv8",
+               "decode8b_int4")
 
 # Phases meaningless on the CPU fallback (real-size or quantized decode).
 _TPU_ONLY_PHASES = frozenset(
@@ -870,6 +877,18 @@ def _ep_dispatch_phase() -> dict:
     return _subprocess_phase("ep_dispatch.py", {"JAX_PLATFORMS": "cpu"})
 
 
+def _kv_transfer_phase() -> dict:
+    # Control-plane-vs-compute crossover (fetch TTFT against recompute):
+    # CPU by design, like swarm/ep_dispatch.
+    return _subprocess_phase("kv_transfer.py", {"JAX_PLATFORMS": "cpu"})
+
+
+def _mini_swarm_phase() -> dict:
+    # Real tiny engines behind the gateway (VERDICT #5): CPU by design —
+    # the point is e2e serving behaviour, not chip throughput.
+    return _subprocess_phase("mini_swarm.py", {"JAX_PLATFORMS": "cpu"})
+
+
 def _capacity_phase() -> dict:
     # Static HBM accounting per registry model (BASELINE config 2/3
     # feasibility); reads the attached chip's HBM, assumes one v5e on
@@ -977,6 +996,8 @@ def main() -> None:
         "ttft": _ttft_phase,
         "swarm": _swarm_phase,
         "ep_dispatch": _ep_dispatch_phase,
+        "kv_transfer": _kv_transfer_phase,
+        "mini_swarm": _mini_swarm_phase,
         "capacity": _capacity_phase,
     }
 

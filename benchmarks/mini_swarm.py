@@ -1,0 +1,222 @@
+"""Real-engine mini-swarm benchmark (ROADMAP VERDICT #5, config-5 shape).
+
+swarm_scaling.py measures the control plane with FakeEngine workers;
+this phase puts 2-4 REAL tiny-model JaxEngines behind the gateway on
+CPU and measures what a client actually experiences end to end under
+concurrent load: sustained generated tokens/sec across the swarm and
+per-request TTFT (first streamed NDJSON frame), crossing HTTP ->
+routing -> p2p stream -> scheduler/prefill -> decode -> stream protocol.
+
+The SAME topology and load is then re-run with FakeEngine workers — the
+control-plane control curve: the gap between the two isolates engine
+time (prefill + decode) from routing/transport, per swarm size.
+
+Prints ONE JSON line; value is end-to-end tokens/sec at the largest
+real-engine swarm, extra holds both curves.
+
+Env overrides:
+  CROWDLLAMA_BENCH_MINI_SIZES    swarm sizes      (default "2,4")
+  CROWDLLAMA_BENCH_MINI_REQUESTS requests per size (default 24)
+  CROWDLLAMA_BENCH_MINI_CONCURRENCY in-flight cap  (default 4)
+  CROWDLLAMA_BENCH_MINI_TOKENS   tokens per request (default 16)
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+import _common  # noqa: F401,E402 - repo path + JAX platform bootstrap
+
+import asyncio
+import json
+import os
+import statistics
+import time
+
+MODEL = "tiny-test"
+
+
+async def _measure(kind: str, sizes: list[int], n_requests: int,
+                   concurrency: int, num_predict: int) -> list[dict]:
+    import aiohttp
+    from crowdllama_tpu.utils.crypto_compat import Ed25519PrivateKey
+
+    from crowdllama_tpu.config import Configuration, Intervals
+    from crowdllama_tpu.engine.engine import FakeEngine, JaxEngine
+    from crowdllama_tpu.gateway.gateway import Gateway
+    from crowdllama_tpu.net.discovery import new_host_and_dht
+    from crowdllama_tpu.peer.peer import Peer
+
+    def cfg(**kw):
+        c = Configuration(listen_host="127.0.0.1", model=MODEL,
+                          intervals=Intervals.default())
+        for k, v in kw.items():
+            setattr(c, k, v)
+        return c
+
+    boot_host, _ = await new_host_and_dht(
+        Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    bootstrap = f"127.0.0.1:{boot_host.listen_port}"
+
+    consumer = Peer(Ed25519PrivateKey.generate(),
+                    cfg(bootstrap_peers=[bootstrap]),
+                    engine=FakeEngine(models=[]), worker_mode=False)
+    await consumer.start()
+    gateway = Gateway(consumer, port=0, host="127.0.0.1")
+    await gateway.start()
+    gw_port = gateway._runner.addresses[0][1]
+    url = f"http://127.0.0.1:{gw_port}/api/chat"
+
+    workers: list[Peer] = []
+    engines: list = []
+    curve: list[dict] = []
+
+    async def add_worker() -> None:
+        if kind == "real":
+            eng = JaxEngine(cfg(), max_context_length=256)
+            await eng.start()
+            engines.append(eng)
+        else:
+            eng = FakeEngine(models=[MODEL])
+        w = Peer(Ed25519PrivateKey.generate(),
+                 cfg(bootstrap_peers=[bootstrap]), engine=eng,
+                 worker_mode=True)
+        workers.append(w)  # before start: finally stops partial starts
+        await w.start()
+
+    try:
+        async with aiohttp.ClientSession() as session:
+            for size in sizes:
+                t_grow = time.monotonic()
+                # Sequential: real engines compile on the same device;
+                # parallel starts interleave compilations for no win.
+                while len(workers) < size:
+                    await add_worker()
+                deadline = time.monotonic() + 120
+                while time.monotonic() < deadline:
+                    healthy = {p.peer_id for p in
+                               consumer.peer_manager.get_healthy_peers()
+                               if p.is_worker}
+                    if len(healthy) >= size:
+                        break
+                    await asyncio.sleep(0.1)
+                else:
+                    raise RuntimeError(f"discovery stalled at size {size}")
+                discovery_s = time.monotonic() - t_grow
+
+                sem = asyncio.Semaphore(concurrency)
+                ttfts: list[float] = []
+                tokens = [0]
+                hits: dict[str, int] = {}
+
+                async def one(i: int) -> None:
+                    # Unique leading tag: with the paged engines' prefix
+                    # cache on, a repeated prompt would measure cache hits.
+                    body = {"model": MODEL, "stream": True,
+                            "options": {"num_predict": num_predict},
+                            "messages": [{"role": "user",
+                                          "content": f"{i:04d} mini swarm "
+                                                     "load test prompt"}]}
+                    async with sem:
+                        t0 = time.monotonic()
+                        first = True
+                        async with session.post(url, json=body) as resp:
+                            assert resp.status == 200, await resp.text()
+                            async for line in resp.content:
+                                if not line.strip():
+                                    continue
+                                if first:
+                                    ttfts.append(
+                                        (time.monotonic() - t0) * 1000)
+                                    first = False
+                                d = json.loads(line)
+                                if d.get("done"):
+                                    tokens[0] += d.get(
+                                        "eval_count",
+                                        num_predict)
+                                    wid = d.get("worker_id", "")
+                                    hits[wid] = hits.get(wid, 0) + 1
+
+                # Prime every worker once (compile paths, warm streams)
+                # before the timed window.
+                await asyncio.gather(*(one(-1 - k) for k in range(size)))
+                ttfts.clear(); tokens[0] = 0; hits.clear()
+
+                t0 = time.monotonic()
+                await asyncio.gather(*(one(i) for i in range(n_requests)))
+                dt = time.monotonic() - t0
+                ttfts.sort()
+                point = {
+                    "workers": size,
+                    "tokens_per_sec": round(tokens[0] / dt, 1),
+                    "requests_per_sec": round(n_requests / dt, 1),
+                    "ttft_p50_ms": round(statistics.median(ttfts), 1),
+                    "ttft_p95_ms": round(
+                        ttfts[max(0, int(len(ttfts) * 0.95) - 1)], 1),
+                    "tokens_generated": tokens[0],
+                    "distinct_workers_hit": len(hits),
+                    "discovery_s": round(discovery_s, 2),
+                }
+                curve.append(point)
+                print(f"# {kind} size={size}: {point['tokens_per_sec']} "
+                      f"tok/s, ttft p50 {point['ttft_p50_ms']}ms, "
+                      f"{len(hits)} workers hit", file=sys.stderr)
+    finally:
+        await gateway.stop()
+        await consumer.stop()
+        for w in workers:
+            await w.stop()
+        for e in engines:
+            await e.stop()
+        await boot_host.close()
+    return curve
+
+
+async def run() -> dict:
+    sizes = [int(x) for x in os.environ.get(
+        "CROWDLLAMA_BENCH_MINI_SIZES", "2,4").split(",") if x.strip()]
+    n_requests = int(os.environ.get("CROWDLLAMA_BENCH_MINI_REQUESTS", "24"))
+    concurrency = int(
+        os.environ.get("CROWDLLAMA_BENCH_MINI_CONCURRENCY", "4"))
+    num_predict = int(os.environ.get("CROWDLLAMA_BENCH_MINI_TOKENS", "16"))
+
+    real = await _measure("real", sizes, n_requests, concurrency,
+                          num_predict)
+    control = await _measure("fake", sizes, n_requests, concurrency,
+                             num_predict)
+
+    head = real[-1]
+    ctrl = control[-1]
+    return {
+        "metric": (f"mini-swarm e2e {MODEL} tokens/sec, "
+                   f"{sizes[-1]} real engines behind the gateway"),
+        "value": head["tokens_per_sec"],
+        "unit": "tokens/sec",
+        "vs_baseline": None,  # reference publishes no e2e numbers
+        "extra": {
+            "real_curve": real,
+            "control_curve_fake_engine": control,
+            # Engine share of TTFT: real minus control at the largest
+            # size — what prefill+decode add on top of the control plane.
+            "engine_ttft_ms": round(
+                head["ttft_p50_ms"] - ctrl["ttft_p50_ms"], 1),
+            "requests_per_size": n_requests,
+            "concurrency": concurrency,
+            "num_predict": num_predict,
+            "note": "control curve = identical topology and load with "
+                    "FakeEngine workers (control-plane only)",
+        },
+    }
+
+
+def main() -> None:
+    os.environ.setdefault("CROWDLLAMA_TPU_TEST_MODE", "1")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    result = asyncio.run(run())
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

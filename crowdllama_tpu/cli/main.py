@@ -553,7 +553,8 @@ async def run_node(cfg: Configuration, worker_mode: bool) -> None:
                           trace_buffer=cfg.trace_buffer,
                           request_timeout=cfg.request_timeout,
                           admission_max_inflight=cfg.admission_max_inflight,
-                          retry_after_s=cfg.retry_after_s)
+                          retry_after_s=cfg.retry_after_s,
+                          kv_ship=cfg.kv_ship)
         await gateway.start()
     elif cfg.worker_metrics_port:
         from crowdllama_tpu.obs.http import ObsServer

@@ -160,6 +160,19 @@ class Configuration:
     admission_pending_max: int = 0
     # Retry-After hint (seconds) stamped on shed 503 responses.
     retry_after_s: float = 1.0
+    # KV shipping (docs/KV_TRANSFER.md): on a prefix-affinity miss the
+    # gateway hints the last worker that held the prefix, and the chosen
+    # worker fetches its paged-KV pages peer-to-peer instead of
+    # recomputing the prefill.  Strictly additive: any fetch failure falls
+    # back to plain prefill.
+    kv_ship: bool = False
+    # Don't bother fetching when fewer than this many prefix tokens are
+    # missing locally — below break-even the round trip costs more than
+    # the recompute it saves (benchmarks/kv_transfer.py measures it).
+    kv_ship_min_tokens: int = 512
+    # Wall-clock cap on one fetch (dial + frames); charged against the
+    # request's deadline budget like any other phase.
+    kv_ship_timeout: float = 5.0
     # Directory for jax.profiler traces; empty disables the profile surface
     # (SURVEY §5: "TPU build: JAX profiler traces + per-request timing").
     profile_dir: str = ""
@@ -263,6 +276,12 @@ class Configuration:
             cfg.admission_pending_max))
         cfg.retry_after_s = float(env.get(
             "CROWDLLAMA_TPU_RETRY_AFTER", cfg.retry_after_s))
+        if env.get("CROWDLLAMA_TPU_KV_SHIP"):
+            cfg.kv_ship = env["CROWDLLAMA_TPU_KV_SHIP"] in ("1", "true")
+        cfg.kv_ship_min_tokens = int(env.get(
+            "CROWDLLAMA_TPU_KV_SHIP_MIN_TOKENS", cfg.kv_ship_min_tokens))
+        cfg.kv_ship_timeout = float(env.get(
+            "CROWDLLAMA_TPU_KV_SHIP_TIMEOUT", cfg.kv_ship_timeout))
         cfg.profile_dir = env.get("CROWDLLAMA_TPU_PROFILE_DIR", cfg.profile_dir)
         cfg.trace_buffer = int(env.get("CROWDLLAMA_TPU_TRACE_BUFFER",
                                        cfg.trace_buffer))
@@ -307,6 +326,12 @@ class Configuration:
         if cfg.retry_after_s < 0:
             raise ValueError(f"retry_after_s must be >= 0, "
                              f"got {cfg.retry_after_s}")
+        if cfg.kv_ship_min_tokens < 0:
+            raise ValueError(f"kv_ship_min_tokens must be >= 0, "
+                             f"got {cfg.kv_ship_min_tokens}")
+        if cfg.kv_ship_timeout <= 0:
+            raise ValueError(f"kv_ship_timeout must be positive, "
+                             f"got {cfg.kv_ship_timeout}")
         if cfg.worker_metrics_port < 0:
             raise ValueError(f"worker_metrics_port must be >= 0, "
                              f"got {cfg.worker_metrics_port}")
@@ -441,6 +466,19 @@ class Configuration:
         parser.add_argument("--retry-after", dest="retry_after_s",
                             type=float,
                             help="Retry-After seconds hinted on shed 503s")
+        parser.add_argument("--kv-ship", dest="kv_ship",
+                            action="store_const", const=True, default=None,
+                            help="fetch paged-KV pages from the peer that "
+                                 "last held a shared prefix instead of "
+                                 "recomputing the prefill (paged cache only)")
+        parser.add_argument("--kv-ship-min-tokens", dest="kv_ship_min_tokens",
+                            type=int,
+                            help="skip the fetch when fewer prefix tokens "
+                                 "than this are missing locally")
+        parser.add_argument("--kv-ship-timeout", dest="kv_ship_timeout",
+                            type=float,
+                            help="seconds before a KV fetch gives up and "
+                                 "falls back to plain prefill")
 
     @classmethod
     def from_flags(cls, args: argparse.Namespace) -> "Configuration":
@@ -456,6 +494,7 @@ class Configuration:
                 "profile_dir", "trace_buffer", "worker_metrics_port",
                 "request_timeout", "admission_max_inflight",
                 "admission_pending_max", "retry_after_s",
+                "kv_ship", "kv_ship_min_tokens", "kv_ship_timeout",
                 "dist_coordinator", "dist_num_processes", "dist_process_id",
             )
         }

@@ -121,6 +121,29 @@ def extract_embed_response(msg: pb.BaseMessage) -> pb.EmbedResponse:
     return msg.embed_response
 
 
+def create_kv_fetch_request(model: str, chain_hashes: Iterable[bytes],
+                            page_size: int) -> pb.BaseMessage:
+    req = pb.KvFetchRequest(model=model, page_size=int(page_size))
+    req.chain_hashes.extend(bytes(h) for h in chain_hashes)
+    return pb.BaseMessage(kv_fetch_request=req)
+
+
+def extract_kv_fetch_request(msg: pb.BaseMessage) -> pb.KvFetchRequest:
+    if msg.WhichOneof("message") != "kv_fetch_request":
+        raise ValueError("message does not contain a KvFetchRequest")
+    return msg.kv_fetch_request
+
+
+def kv_pages_msg(pages: pb.KvPages) -> pb.BaseMessage:
+    return pb.BaseMessage(kv_pages=pages)
+
+
+def extract_kv_pages(msg: pb.BaseMessage) -> pb.KvPages:
+    if msg.WhichOneof("message") != "kv_pages":
+        raise ValueError("message does not contain a KvPages")
+    return msg.kv_pages
+
+
 def flatten_chat(messages: Iterable[Mapping[str, str]]) -> str:
     """Flatten Ollama-style chat messages into a single prompt string.
 

@@ -42,6 +42,10 @@ class Chunk:
     # and the Engine seam falls back to first-chunk timing.
     queue_ns: int = 0
     prefill_ns: int = 0
+    # KV shipping (docs/KV_TRANSFER.md): wall time the engine spent fetching
+    # donor pages before prefill — becomes a kv_fetch span on the worker's
+    # trace surface.  Zero = no fetch attempted.
+    kv_fetch_ns: int = 0
 
 
 class StopMatcher:
@@ -87,6 +91,11 @@ class Engine:
     # NodeObs of the owning worker peer (set by Peer.start); None when the
     # engine runs without a peer (IPC-only, unit tests).
     obs = None
+    # Engines that can act on a GenerateRequest.kv_donor hint (fetch cached
+    # KV pages from a peer before prefill, docs/KV_TRANSFER.md) opt in; the
+    # hint is dropped silently everywhere else so the wire field is always
+    # safe to set.
+    supports_kv_donor = False
 
     async def start(self) -> None: ...
     async def stop(self) -> None: ...
@@ -116,6 +125,14 @@ class Engine:
     def model_dir(self, model: str) -> str | None:
         """Local checkpoint directory for ``model`` if this engine can
         SHARE it over the swarm (net/model_share.py); None otherwise."""
+        return None
+
+    async def export_kv_pages(self, model: str, chain_hashes: list[bytes],
+                              page_size: int) -> dict | None:
+        """Serve a peer's KvFetchRequest (docs/KV_TRANSFER.md): the KV
+        pages of the longest locally indexed prefix of ``chain_hashes``,
+        or None when this engine has nothing to offer (no paged prefix
+        cache, unknown model, geometry mismatch)."""
         return None
 
     def generate(
@@ -156,9 +173,17 @@ class Engine:
             return
         queue_ns = getattr(final, "queue_ns", 0) if final else 0
         prefill_ns = getattr(final, "prefill_ns", 0) if final else 0
+        kv_ns = getattr(final, "kv_fetch_ns", 0) if final else 0
+        if kv_ns:
+            # The donor fetch ran before submit, so it is in neither the
+            # queue nor the prefill stamp — give it its own span and keep
+            # it out of the decode residual below.
+            self.obs.trace.record(
+                getattr(msg, "trace_id", ""), "kv_fetch", kv_ns,
+                parent=getattr(msg, "parent_span", ""))
         if not prefill_ns:
-            prefill_ns = max(0, (first_ns or end_ns) - t0 - queue_ns)
-        decode_ns = max(0, (end_ns - t0) - queue_ns - prefill_ns)
+            prefill_ns = max(0, (first_ns or end_ns) - t0 - queue_ns - kv_ns)
+        decode_ns = max(0, (end_ns - t0) - queue_ns - prefill_ns - kv_ns)
         steps = getattr(final, "completion_tokens", 0) if final else 0
         if steps > 0 and decode_ns > 0:
             self.obs.metrics.decode_step_seconds.observe(
@@ -197,7 +222,7 @@ class Engine:
         first_ns = 0
         text_parts: list[str] = []
         final: Chunk | None = None
-        async for chunk in self._gen_from_request(req):
+        async for chunk in self._gen_from_request(req, trace_id=msg.trace_id):
             if not first_ns:
                 first_ns = time.monotonic_ns()
             text_parts.append(chunk.text)
@@ -227,7 +252,7 @@ class Engine:
         first_ns = 0
         n_chunk = 0
         final: Chunk | None = None
-        async for chunk in self._gen_from_request(req):
+        async for chunk in self._gen_from_request(req, trace_id=msg.trace_id):
             if not first_ns:
                 first_ns = time.monotonic_ns()
             await faults.inject("engine.stream_chunk", worker=worker_id,
@@ -254,13 +279,24 @@ class Engine:
         (the reference concatenates contents, gateway.go:189-207)."""
         return flatten_chat(messages)
 
-    def _gen_from_request(self, req: pb.GenerateRequest) -> AsyncIterator[Chunk]:
+    def _gen_from_request(self, req: pb.GenerateRequest,
+                          trace_id: str = "") -> AsyncIterator[Chunk]:
         prompt = req.prompt
         if not prompt and req.messages:
             prompt = self._format_chat(
                 [{"role": m.role, "content": m.content} for m in req.messages],
                 model=req.model,
             )
+        kwargs = {}
+        donor = getattr(req, "kv_donor", "")
+        if donor and self.supports_kv_donor:
+            # Only engines that opted in receive the kwargs — third-party
+            # Engine subclasses with the pre-KV-ship generate() signature
+            # keep working with the hint silently dropped.  The trace id
+            # rides along so the donor's kv_export span lands in the SAME
+            # cross-node trace as the fetcher's kv_fetch.
+            kwargs["kv_donor"] = donor
+            kwargs["kv_trace"] = trace_id
         return self.generate(
             prompt,
             model=req.model,
@@ -271,11 +307,14 @@ class Engine:
             stop=list(req.stop),
             top_k=int(req.top_k or 0),
             repeat_penalty=float(req.repeat_penalty or 1.0),
+            **kwargs,
         )
 
 
 class JaxEngine(Engine):
     """The real engine: ModelRunner + continuous-batching Scheduler."""
+
+    supports_kv_donor = True
 
     def __init__(self, config: Configuration | None = None, **overrides):
         self.config = config or Configuration.from_environment()
@@ -285,6 +324,11 @@ class JaxEngine(Engine):
         self.scheduler = None
         self.tokenizer = None
         self._runner = None
+        self._peer = None  # set by attach_peer (KV fetch dials through it)
+        self._kv_streams = None  # pooled donor streams (lazy StreamPool)
+
+    def attach_peer(self, peer) -> None:
+        self._peer = peer
 
     async def start(self) -> None:
         """Build tokenizer/params/runner (compiles on first use)."""
@@ -386,6 +430,8 @@ class JaxEngine(Engine):
         return await self.scheduler.drain(timeout)
 
     async def stop(self) -> None:
+        if self._kv_streams is not None:
+            self._kv_streams.close()
         exec_ = getattr(self.scheduler, "_exec", None)
         if self.scheduler is not None:
             await self.scheduler.stop()
@@ -413,6 +459,155 @@ class JaxEngine(Engine):
             return super().obs_gauges()
         return self.scheduler.telemetry_gauges()
 
+    # ---------------------------- KV shipping (docs/KV_TRANSFER.md) -------
+
+    def _kv_ship_ready(self) -> bool:
+        r = self._runner
+        return (bool(self.config.kv_ship) and self.scheduler is not None
+                and r is not None and getattr(r, "prefix_cache", False)
+                and hasattr(r, "import_pages"))
+
+    async def export_kv_pages(self, model: str, chain_hashes: list[bytes],
+                              page_size: int) -> dict | None:
+        """Donor side: serve a peer's fetch from the prefix index.
+
+        Runs through the scheduler's exclusive point so the device→host
+        gather reads a live (undonated) pool between dispatches; the
+        runner ref-pins the matched pages for the gather's duration."""
+        r = self._runner
+        if (self.scheduler is None or r is None
+                or not getattr(r, "prefix_cache", False)
+                or not hasattr(r, "export_pages")):
+            return None
+        if model and model not in self.models:
+            return None
+        hashes = [bytes(h) for h in chain_hashes]
+
+        def _export(state):
+            return r.export_pages(state, hashes, page_size=int(page_size))
+
+        return await self.scheduler.run_exclusive(_export)
+
+    async def _fetch_kv_payload(self, donor: str, model: str,
+                                prompt_ids: list[int], trace_id: str = ""
+                                ) -> tuple[dict | None, int]:
+        """Receiver side: dial the donor and pull the prefix's pages.
+
+        Returns (payload-for-GenRequest.kv_import | None, fetch wall ns;
+        0 ns = no fetch was even attempted).  Every failure mode — donor
+        gone, stream killed, timeout, dtype mismatch discovered at import —
+        degrades to plain prefill; this path can make a request faster,
+        never break it."""
+        r = self._runner
+        peer = self._peer
+        if (not self._kv_ship_ready() or peer is None or not donor
+                or donor == getattr(peer, "peer_id", "")):
+            return None, 0
+        keys = r.chain_keys_for_prompt(prompt_ids)
+        covered = r.local_prefix_coverage(keys)
+        uncovered = (len(keys) - covered) * r.page_size
+        if uncovered < max(1, int(self.config.kv_ship_min_tokens)):
+            return None, 0  # short tail: the round trip costs more than it saves
+        mx = self.obs.metrics if self.obs is not None else None
+        timeout = max(0.5, float(self.config.kv_ship_timeout))
+        t0 = time.monotonic_ns()
+        try:
+            payload = await asyncio.wait_for(
+                self._kv_fetch_once(peer, donor, model, keys, trace_id),
+                timeout)
+        except Exception as e:
+            dt = time.monotonic_ns() - t0
+            if mx is not None:
+                mx.kv_ship_inc("fetches")
+                mx.kv_ship_inc("fallbacks")
+                mx.kv_fetch_seconds.observe(dt / 1e9)
+            log.warning("kv fetch from %s failed (%s); plain prefill",
+                        donor, e)
+            return None, dt
+        dt = time.monotonic_ns() - t0
+        if mx is not None:
+            mx.kv_ship_inc("fetches")
+            mx.kv_fetch_seconds.observe(dt / 1e9)
+        if payload is None:
+            if mx is not None:
+                mx.kv_ship_inc("fallbacks")
+            return None, dt
+        if mx is not None:
+            mx.kv_ship_inc("bytes", payload.get("bytes", 0))
+        return payload, dt
+
+    async def _kv_fetch_once(self, peer, donor: str, model: str,
+                             keys: list[bytes],
+                             trace_id: str = "") -> dict | None:
+        from crowdllama_tpu.core import wire
+        from crowdllama_tpu.core.messages import (
+            create_kv_fetch_request,
+            extract_kv_pages,
+        )
+        from crowdllama_tpu.core.protocol import INFERENCE_PROTOCOL
+
+        await faults.inject("kv.fetch", worker=getattr(peer, "peer_id", ""),
+                            donor=donor)
+        # Pool donor streams: the TCP + signed-hello handshake costs ~20 ms
+        # on loopback — more than the page transfer itself — and the donor's
+        # inference serve loop already handles many exchanges per stream.
+        if self._kv_streams is None:
+            from crowdllama_tpu.net.host import StreamPool
+
+            self._kv_streams = StreamPool(max_per_key=2)
+        stream = self._kv_streams.get(donor)
+        if stream is None:
+            contact = await peer.dht.find_peer(donor)
+            if contact is None:
+                raise LookupError(f"kv donor {donor} not found in DHT")
+            stream = await peer.host.new_stream(contact, INFERENCE_PROTOCOL)
+        done = False
+        try:
+            fetch = create_kv_fetch_request(model, keys,
+                                            self._runner.page_size)
+            fetch.trace_id = trace_id  # donor's kv_export joins this trace
+            await wire.write_length_prefixed_pb(stream.writer, fetch)
+            k_pages: list[bytes] = []
+            v_pages: list[bytes] = []
+            k_scales: list[bytes] = []
+            v_scales: list[bytes] = []
+            matched, dtype = 0, ""
+            while True:
+                frame = await wire.read_length_prefixed_pb(
+                    stream.reader,
+                    timeout=max(0.5, float(self.config.kv_ship_timeout)))
+                kvp = extract_kv_pages(frame)
+                if kvp.error:
+                    raise RuntimeError(f"kv donor error: {kvp.error}")
+                matched = int(kvp.matched) or matched
+                dtype = kvp.kv_dtype or dtype
+                k_pages.extend(kvp.k_pages)
+                v_pages.extend(kvp.v_pages)
+                k_scales.extend(kvp.k_scales)
+                v_scales.extend(kvp.v_scales)
+                if kvp.done:
+                    done = True
+                    break
+        finally:
+            # A completed exchange leaves the stream at a frame boundary —
+            # reusable.  Anything else (error frame, timeout mid-stream)
+            # may have frames in flight: close, never pool.
+            if done:
+                self._kv_streams.put(donor, stream)
+            else:
+                stream.close()
+        n = min(len(k_pages), len(v_pages))
+        if n == 0:
+            return None  # donor matched nothing (or evicted everything)
+        total = sum(len(b) for b in (*k_pages, *v_pages,
+                                     *k_scales, *v_scales))
+        return {
+            "keys": keys[:n],
+            "k_pages": k_pages[:n], "v_pages": v_pages[:n],
+            "k_scales": k_scales[:n], "v_scales": v_scales[:n],
+            "kv_dtype": dtype, "bytes": total,
+        }
+
     def describe(self) -> dict:
         d = {"models": self.models, "throughput": 0.0, "load": 0.0}
         if self._runner is not None:
@@ -429,6 +624,13 @@ class JaxEngine(Engine):
                 "hits": self._runner.prefix_hits,
                 "misses": self._runner.prefix_misses,
                 "tokens_reused": self._runner.prefix_tokens_reused,
+            }
+        if (self._runner is not None
+                and hasattr(self._runner, "kv_pages_exported")):
+            d["kv_ship"] = {
+                "enabled": bool(self.config.kv_ship),
+                "pages_exported": self._runner.kv_pages_exported,
+                "pages_imported": self._runner.kv_pages_imported,
             }
         if self.scheduler is not None and self.scheduler.spec_steps:
             steps = self.scheduler.spec_steps
@@ -522,6 +724,8 @@ class JaxEngine(Engine):
         stop: list[str] | None = None,
         top_k: int = 0,
         repeat_penalty: float = 1.0,
+        kv_donor: str = "",
+        kv_trace: str = "",
     ) -> AsyncIterator[Chunk]:
         from crowdllama_tpu.engine.scheduler import DONE, GenRequest
 
@@ -531,6 +735,10 @@ class JaxEngine(Engine):
             raise ValueError(f"model {model!r} not served (have {self.models})")
 
         prompt_ids = self.tokenizer.encode(prompt)
+        kv_import, kv_ns = None, 0
+        if kv_donor:
+            kv_import, kv_ns = await self._fetch_kv_payload(
+                kv_donor, model, prompt_ids, trace_id=kv_trace)
         req = GenRequest(
             prompt_ids=prompt_ids,
             max_tokens=max_tokens,
@@ -540,6 +748,7 @@ class JaxEngine(Engine):
             repeat_penalty=float(repeat_penalty or 1.0),
             eos_id=self.tokenizer.eos_id,
             seed=seed,
+            kv_import=kv_import,
         )
         await self.scheduler.submit(req)
         decoder = self.tokenizer.stream_decoder()
@@ -570,6 +779,7 @@ class JaxEngine(Engine):
                         prompt_tokens=len(prompt_ids),
                         completion_tokens=completion,
                         queue_ns=q_ns, prefill_ns=p_ns,
+                        kv_fetch_ns=kv_ns,
                     )
                     return
                 completion += 1
@@ -588,6 +798,7 @@ class JaxEngine(Engine):
                         prompt_tokens=len(prompt_ids),
                         completion_tokens=completion,
                         queue_ns=q_ns, prefill_ns=p_ns,
+                        kv_fetch_ns=kv_ns,
                     )
                     return
                 if emit:

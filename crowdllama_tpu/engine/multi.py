@@ -22,6 +22,8 @@ log = logging.getLogger("crowdllama.engine.multi")
 
 
 class MultiEngine(Engine):
+    supports_kv_donor = True
+
     def __init__(self, config):
         self.config = config
         names = [m.strip() for m in config.model.split(",") if m.strip()]
@@ -135,11 +137,20 @@ class MultiEngine(Engine):
     def generate(self, prompt: str, model: str = "", max_tokens: int = 128,
                  temperature: float = 0.0, top_p: float = 1.0, seed: int = 0,
                  stop: list[str] | None = None, top_k: int = 0,
-                 repeat_penalty: float = 1.0) -> AsyncIterator[Chunk]:
+                 repeat_penalty: float = 1.0, kv_donor: str = "",
+                 kv_trace: str = "") -> AsyncIterator[Chunk]:
         return self._child(model).generate(
             prompt, model=model, max_tokens=max_tokens,
             temperature=temperature, top_p=top_p, seed=seed, stop=stop,
-            top_k=top_k, repeat_penalty=repeat_penalty)
+            top_k=top_k, repeat_penalty=repeat_penalty, kv_donor=kv_donor,
+            kv_trace=kv_trace)
+
+    async def export_kv_pages(self, model: str, chain_hashes: list[bytes],
+                              page_size: int) -> dict | None:
+        eng = self._engines.get(model)
+        if eng is None:
+            return None
+        return await eng.export_kv_pages(model, chain_hashes, page_size)
 
     async def embed(self, texts: list[str], model: str = "",
                     truncate: bool = True) -> tuple[list[list[float]], int]:

@@ -172,6 +172,10 @@ class PagedModelRunner(ModelRunner):
         self.prefix_hits = 0
         self.prefix_misses = 0
         self.prefix_tokens_reused = 0
+        # KV shipping (docs/KV_TRANSFER.md): pages served to / seeded from
+        # peers via export_pages/import_pages.
+        self.kv_pages_exported = 0
+        self.kv_pages_imported = 0
 
         self._insert_paged = jax.jit(self._insert_paged_impl,
                                      donate_argnums=(0,))
@@ -818,6 +822,187 @@ class PagedModelRunner(ModelRunner):
             self._host_seq[slot] = min(self._host_seq[slot] + num_steps,
                                        self.max_seq)
         return tokens, new_state
+
+    # -------------------------------------- KV shipping (docs/KV_TRANSFER.md)
+
+    def kv_wire_dtype(self) -> str:
+        """Pool dtype as it appears in KvPages.kv_dtype ("int8" pools ship
+        raw int8 pages + bf16 scales; bf16/f32 pools ship raw pool bytes)."""
+        return ("int8" if self.kv_dtype == "int8"
+                else jnp.dtype(self.dtype).name)
+
+    def chain_keys_for_prompt(self, prompt_ids: list[int]) -> list[bytes]:
+        """Chain hashes a fetch for ``prompt_ids`` asks a donor about — the
+        same one-page-early cap prefill matching uses (>= 1 suffix token
+        must remain to produce logits)."""
+        return self._chain_keys(prompt_ids,
+                                max(0, (len(prompt_ids) - 1) // self.page_size))
+
+    def local_prefix_coverage(self, keys: list[bytes]) -> int:
+        """How many leading chain keys the local index already holds (a
+        fetch only pays for the uncovered tail)."""
+        m = 0
+        for k in keys:
+            if k not in self._prefix_index:
+                break
+            m += 1
+        return m
+
+    def export_pages(self, state: PagedDecodeState, chain_hashes: list[bytes],
+                     page_size: int = 0) -> dict | None:
+        """Serve a peer's KvFetchRequest: host-gather the K/V pages of the
+        longest indexed prefix of ``chain_hashes``.
+
+        Ref-pinning protocol: matched pages are pinned (+1 ref) for the
+        duration of the device→host gather so a concurrent admission's
+        ``_alloc`` cannot evict-and-reuse them mid-copy; the pin drops in
+        the ``finally``.  Runs at the scheduler's exclusive point (no
+        in-flight dispatch donates the pool while we read it).  int8 pools
+        ship pages + scales verbatim — no requantization on either side.
+
+        Returns None when nothing matched, the prefix cache is off, or the
+        requester's page geometry differs (pages would not be
+        interchangeable)."""
+        if not self.prefix_cache or (page_size and page_size != self.page_size):
+            return None
+        pages: list[int] = []
+        for k in chain_hashes:
+            page = self._prefix_index.get(bytes(k))
+            if page is None:
+                break
+            pages.append(page)
+            self._lru_tick += 1
+            self._index_lru[bytes(k)] = self._lru_tick
+        if not pages:
+            return None
+        for p in pages:
+            self._page_refs[p] = self._page_refs.get(p, 0) + 1
+        try:
+            idx = jnp.asarray(np.asarray(pages, np.int32))
+            k_host = np.asarray(state.pool_k[:, idx])  # [L, n, Hkv, pg, Dh]
+            v_host = np.asarray(state.pool_v[:, idx])
+            k_scales: list[bytes] = []
+            v_scales: list[bytes] = []
+            if self.kv_dtype == "int8":
+                ks_host = np.asarray(state.k_scale[:, idx])  # [L, n, Hkv, pg]
+                vs_host = np.asarray(state.v_scale[:, idx])
+                k_scales = [ks_host[:, i].tobytes()
+                            for i in range(len(pages))]
+                v_scales = [vs_host[:, i].tobytes()
+                            for i in range(len(pages))]
+        finally:
+            for p in pages:
+                self._page_refs[p] = self._page_refs.get(p, 1) - 1
+        self.kv_pages_exported += len(pages)
+        return {
+            "matched": len(pages),
+            "kv_dtype": self.kv_wire_dtype(),
+            "k_pages": [k_host[:, i].tobytes() for i in range(len(pages))],
+            "v_pages": [v_host[:, i].tobytes() for i in range(len(pages))],
+            "k_scales": k_scales,
+            "v_scales": v_scales,
+        }
+
+    @partial(jax.jit, static_argnums=0, donate_argnums=(1,))
+    def _import_paged(self, state: PagedDecodeState, page_idx, kp, vp,
+                      ksp, vsp):
+        """Scatter fetched pages ([L, n, Hkv, pg, Dh], already pool dtype)
+        into freshly allocated pool pages (dump-page padded — one compile
+        per import-size bucket, like the other paged scatters)."""
+        pool_k = state.pool_k.at[:, page_idx].set(kp)
+        pool_v = state.pool_v.at[:, page_idx].set(vp)
+        k_scale, v_scale = state.k_scale, state.v_scale
+        if self.kv_dtype == "int8":
+            k_scale = k_scale.at[:, page_idx].set(ksp)
+            v_scale = v_scale.at[:, page_idx].set(vsp)
+        return PagedDecodeState(
+            pool_k=pool_k, pool_v=pool_v,
+            k_scale=k_scale, v_scale=v_scale,
+            seq_lens=state.seq_lens, tokens=state.tokens,
+            active=state.active, temperature=state.temperature,
+            top_p=state.top_p, top_k=state.top_k,
+            repeat_penalty=state.repeat_penalty, recent=state.recent,
+            keys=state.keys, hist=state.hist,
+            draft_k=state.draft_k, draft_v=state.draft_v,
+        )
+
+    def import_pages(self, state: PagedDecodeState,
+                     payload: dict) -> tuple[PagedDecodeState, int]:
+        """Seed the prefix index from a donor's exported pages.
+
+        ``payload``: ``keys`` (chain hashes aligned with the page lists),
+        ``k_pages``/``v_pages`` (+ ``k_scales``/``v_scales`` for int8) and
+        ``kv_dtype``.  Locally covered leading keys are skipped (coverage
+        is always a prefix); the rest are allocated, scattered, and indexed
+        at refcount 0 — exactly the state a locally inserted-then-released
+        prefix leaves behind, so the ordinary suffix-only prefill consumes
+        them with no new code path.  Raises on dtype/shape mismatch or
+        ``PagesExhausted``; the caller falls back to plain prefill."""
+        keys = [bytes(k) for k in payload["keys"]]
+        k_pages, v_pages = payload["k_pages"], payload["v_pages"]
+        n = min(len(keys), len(k_pages), len(v_pages))
+        if not self.prefix_cache or n == 0:
+            return state, 0
+        want = self.kv_wire_dtype()
+        got = payload.get("kv_dtype", "")
+        if got != want:
+            raise ValueError(f"kv dtype mismatch: donor ships {got!r}, "
+                             f"local pool is {want!r}")
+        skip = self.local_prefix_coverage(keys[:n])
+        if skip >= n:
+            return state, 0
+        cfg = self.cfg
+        l, hkv, dh = (cfg.num_layers, cfg.num_kv_heads,
+                      cfg.resolved_head_dim())
+        pg = self.page_size
+        quant = self.kv_dtype == "int8"
+        pool_np = np.dtype(jnp.int8 if quant else self.dtype)
+        page_nbytes = l * hkv * pg * dh * pool_np.itemsize
+        scale_nbytes = l * hkv * pg * np.dtype(jnp.bfloat16).itemsize
+        for buf in (*k_pages[skip:n], *v_pages[skip:n]):
+            if len(buf) != page_nbytes:
+                raise ValueError(f"kv page payload is {len(buf)} bytes, "
+                                 f"expected {page_nbytes}")
+        if quant:
+            for buf in (*payload["k_scales"][skip:n],
+                        *payload["v_scales"][skip:n]):
+                if len(buf) != scale_nbytes:
+                    raise ValueError(
+                        f"kv scale payload is {len(buf)} bytes, "
+                        f"expected {scale_nbytes}")
+        n_imp = n - skip
+        fresh = self._alloc(n_imp)  # PagesExhausted -> caller falls back
+        # Dump-page padding buckets the scatter's compile like _prefill_ctx:
+        # one program per power-of-two import size, not one per count.
+        width = 1 << (n_imp - 1).bit_length() if n_imp > 1 else 1
+        page_idx = np.full((width,), self.total_pages, np.int32)
+        page_idx[:n_imp] = fresh
+
+        def stack(bufs, dt, shape):
+            rows = [np.frombuffer(b, dt).reshape(shape) for b in bufs]
+            rows += [np.zeros(shape, dt)] * (width - len(rows))
+            return jnp.asarray(np.stack(rows, axis=1))
+
+        kp = stack(k_pages[skip:n], pool_np, (l, hkv, pg, dh))
+        vp = stack(v_pages[skip:n], pool_np, (l, hkv, pg, dh))
+        ksp = vsp = None
+        if quant:
+            sc_np = np.dtype(jnp.bfloat16)
+            ksp = stack(payload["k_scales"][skip:n], sc_np, (l, hkv, pg))
+            vsp = stack(payload["v_scales"][skip:n], sc_np, (l, hkv, pg))
+        state = self._import_paged(state, jnp.asarray(page_idx), kp, vp,
+                                   ksp, vsp)
+        for i, page in enumerate(fresh):
+            key = keys[skip + i]
+            self._prefix_index[key] = page
+            self._page_key[page] = key
+            self._lru_tick += 1
+            self._index_lru[key] = self._lru_tick
+            if skip + i > 0:  # chain edge for cascade eviction
+                self._key_children.setdefault(
+                    keys[skip + i - 1], set()).add(key)
+        self.kv_pages_imported += n_imp
+        return state, n_imp
 
     # -------------------------------------------------------------- buckets
 

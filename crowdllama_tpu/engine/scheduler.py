@@ -79,6 +79,12 @@ class GenRequest:
     admitted_at: float = 0.0
     first_token_at: float = 0.0
     cancelled: bool = False  # client went away: drop at admission / free slot
+    # KV shipping (docs/KV_TRANSFER.md): pages fetched from a donor peer,
+    # applied via runner.import_pages right before this request's prefill
+    # (the suffix-only path then consumes them like locally cached pages).
+    # Any import failure falls back to plain prefill — never fails the
+    # request.
+    kv_import: dict | None = None
 
 
 @dataclass
@@ -131,6 +137,10 @@ class Scheduler:
         # Long prompts popped while another chunked admission is running
         # (kept FIFO ahead of pending).
         self._deferred: collections.deque[GenRequest] = collections.deque()
+        # Exclusive runner access (KV export, docs/KV_TRANSFER.md): queued
+        # (fn, future) pairs the loop runs on the dispatch executor between
+        # device dispatches — see run_exclusive.
+        self._exclusive: list[tuple] = []
         self._to_release: list[int] = []
         self._draining = False
         self._embeds = 0  # embedding forwards in flight on the executor
@@ -273,6 +283,26 @@ class Scheduler:
                 return False
             await asyncio.sleep(0.1)
 
+    async def run_exclusive(self, fn):
+        """Run ``fn(state) -> result`` on the dispatch executor at the
+        decode loop's next safe point (between device dispatches).
+
+        Reading ``self.state`` from outside the loop coroutine is unsafe:
+        an in-flight dispatch may already have DONATED those buffers, and
+        the loop reassigns ``self.state`` only when its executor await
+        resolves (observed as "Array has been deleted").  ``fn`` must treat
+        the state as read-only — KV export qualifies (host gathers plus
+        allocator bookkeeping, no donation)."""
+        if self._task is None:
+            # Loop not running (unit tests drive the runner directly):
+            # nothing can be in flight, execute immediately.
+            loop = asyncio.get_running_loop()
+            return await loop.run_in_executor(self._exec, fn, self.state)
+        fut = asyncio.get_running_loop().create_future()
+        self._exclusive.append((fn, fut))
+        self._wake.set()
+        return await fut
+
     @property
     def load(self) -> float:
         busy = sum(1 for s in self.slots if s is not None)
@@ -344,6 +374,26 @@ class Scheduler:
             return jax.random.fold_in(key, lane)
         self._rng, sub = jax.random.split(self._rng)
         return sub
+
+    async def _apply_kv_import(self, req: GenRequest, loop) -> None:
+        """Seed fetched donor pages into the runner's prefix index right
+        before this request's prefill (docs/KV_TRANSFER.md).  Failure is a
+        perf event, not a correctness one — the request continues with a
+        plain prefill of the same tokens."""
+        import functools
+
+        payload, req.kv_import = req.kv_import, None
+        imp = getattr(self.runner, "import_pages", None)
+        if payload is None or imp is None:
+            return
+        try:
+            self.state, n = await loop.run_in_executor(
+                self._exec, functools.partial(imp, self.state, payload))
+            if n:
+                log.info("kv import: seeded %d fetched pages", n)
+        except Exception as e:
+            log.warning("kv import failed (%s); falling back to plain "
+                        "prefill", e)
 
     async def _admit_one(self, req: GenRequest, slot: int) -> None:
         import functools
@@ -501,7 +551,7 @@ class Scheduler:
         # in-progress chunked admission is work).
         if (all(s is None for s in self.slots) and self.pending.empty()
                 and self._inflight is None and self._chunking is None
-                and not self._deferred):
+                and not self._deferred and not self._exclusive):
             self._wake.clear()
             await self._wake.wait()
 
@@ -515,6 +565,22 @@ class Scheduler:
                 self.state = await loop_.run_in_executor(
                     self._exec, self.runner.release, self.state, i)
                 self.requests_served += 1
+
+        # Exclusive runner access (run_exclusive): no dispatch is queued on
+        # the executor right now, so fn reads a live, undonated state.  A
+        # failing fn fails only its caller, never the loop.
+        while self._exclusive:
+            fn, fut = self._exclusive.pop(0)
+            try:
+                res = await loop_.run_in_executor(self._exec, fn, self.state)
+            except BaseException as e:
+                if not fut.cancelled():
+                    fut.set_exception(e)
+                if not isinstance(e, Exception):
+                    raise
+            else:
+                if not fut.cancelled():
+                    fut.set_result(res)
 
         # Admit pending requests into free slots — but at most one prefill
         # per iteration once any slot is decoding, so a burst of long prompts
@@ -627,6 +693,11 @@ class Scheduler:
                 break
             if req.cancelled:
                 continue
+            if req.kv_import is not None:
+                # Before the monolithic-vs-chunked decision: imported pages
+                # flip prefill_prefers_monolithic toward the suffix-only
+                # path, exactly like a local cache hit would.
+                await self._apply_kv_import(req, loop)
             chunk = getattr(self.runner, "prefill_chunk", 0)
             # Paged runners keep the suffix-only (prefix-cache) path for
             # prompts the cache mostly covers — chunked admission would

@@ -22,6 +22,7 @@ import json
 import logging
 import os
 import time
+from collections import OrderedDict
 
 from aiohttp import web
 
@@ -93,10 +94,14 @@ class Gateway:
     def __init__(self, peer: Peer, port: int = 9001, host: str = "0.0.0.0",
                  trace_buffer: int = 64, request_timeout: float = 600.0,
                  admission_max_inflight: int = 0,
-                 retry_after_s: float = 1.0):
+                 retry_after_s: float = 1.0, kv_ship: bool = False):
         self.peer = peer
         self.port = port
         self.host = host
+        # KV shipping (docs/KV_TRANSFER.md): on an affinity MISS, hint the
+        # remembered worker as a page donor so the chosen worker fetches
+        # the shared prefix instead of recomputing it.
+        self.kv_ship = bool(kv_ship)
         # Robustness plane (docs/ROBUSTNESS.md): total wall-clock budget
         # per request, charged across retries and failovers (a client may
         # lower it per request via X-Request-Timeout); gateway-side
@@ -199,8 +204,13 @@ class Gateway:
         # worker is healthy and not near-saturated, otherwise scoring
         # wins (affinity is a tiebreak on top of manager.go:338-387's
         # throughput/(1+load), never a replacement for health).
-        self._affinity: dict[str, tuple[str, float]] = {}
+        # Bounded LRU (same policy PeerManager.recently_removed got):
+        # get/put move the key to the MRU end, inserts at capacity evict
+        # the LRU entry — O(1), no sort-half stalls under churn.
+        self._affinity: OrderedDict[str, tuple[str, float]] = OrderedDict()
         self._affinity_hits = 0
+        self._affinity_evicted = 0
+        self._kv_hints = 0
 
     # ----------------------------------------------------------- lifecycle
 
@@ -777,6 +787,14 @@ class Gateway:
         lines.append("# TYPE crowdllama_gateway_affinity_hits_total counter")
         lines.append(
             f"crowdllama_gateway_affinity_hits_total {self._affinity_hits}")
+        lines.append(
+            "# TYPE crowdllama_gateway_affinity_evicted_total counter")
+        lines.append(
+            f"crowdllama_gateway_affinity_evicted_total "
+            f"{self._affinity_evicted}")
+        lines.append("# TYPE crowdllama_gateway_kv_hints_total counter")
+        lines.append(
+            f"crowdllama_gateway_kv_hints_total {self._kv_hints}")
         # Robustness plane (docs/ROBUSTNESS.md): failover/replay/shed/budget
         # counters plus dead-transport pool evictions.
         lines.append("# TYPE crowdllama_gateway_failovers_total counter")
@@ -1065,6 +1083,7 @@ class Gateway:
         if entry is None or time.monotonic() - entry[1] > self._AFFINITY_TTL_S:
             self._affinity.pop(akey, None)
             return None
+        self._affinity.move_to_end(akey)  # LRU touch: live conversation
         pm = self.peer.peer_manager
         cand = pm.is_routable(entry[0], model) if pm is not None else None
         if (cand is not None
@@ -1076,12 +1095,31 @@ class Gateway:
     def _affinity_put(self, akey: str | None, worker_id: str) -> None:
         if akey is None:
             return
-        if len(self._affinity) >= self._AFFINITY_MAX:
-            # Drop the older half (insertion-ordered enough: entries are
-            # re-put on every successful request).
-            items = sorted(self._affinity.items(), key=lambda kv: kv[1][1])
-            self._affinity = dict(items[self._AFFINITY_MAX // 2:])
+        if akey not in self._affinity and \
+                len(self._affinity) >= self._AFFINITY_MAX:
+            self._affinity.popitem(last=False)
+            self._affinity_evicted += 1
         self._affinity[akey] = (worker_id, time.monotonic())
+        self._affinity.move_to_end(akey)
+
+    def _kv_donor_for(self, akey: str | None, model: str,
+                      chosen_worker: str) -> str:
+        """Donor hint for a continuation that is NOT landing on its
+        remembered worker: that worker's paged cache still holds the
+        conversation's prefix, so the chosen worker can fetch the pages
+        instead of recomputing them (docs/KV_TRANSFER.md).  Only a
+        still-routable peer qualifies — hinting a dead donor would burn
+        the fetch timeout on every request it's attached to."""
+        if not self.kv_ship or akey is None:
+            return ""
+        entry = self._affinity.get(akey)
+        if entry is None or entry[0] == chosen_worker \
+                or time.monotonic() - entry[1] > self._AFFINITY_TTL_S:
+            return ""
+        pm = self.peer.peer_manager
+        if pm is None or pm.is_routable(entry[0], model) is None:
+            return ""
+        return entry[0]
 
     async def _route(self, request, model, stream, options,
                      messages=None, prompt="",
@@ -1185,6 +1223,19 @@ class Gateway:
                 if worker is None:
                     break
                 tried.add(worker.peer_id)
+                # Affinity miss on a continuation: attach the remembered
+                # worker as a KV donor so the chosen one fetches the shared
+                # prefix's pages instead of recomputing them.  Reset per
+                # attempt — a failover target may BE the donor.
+                msg.generate_request.kv_donor = ""
+                if continuation and not used_affinity:
+                    donor = self._kv_donor_for(akey, model, worker.peer_id)
+                    if donor:
+                        msg.generate_request.kv_donor = donor
+                        self._kv_hints += 1
+                        self.obs.trace.record(
+                            tid, "kv_hint", 0, parent=GATEWAY_ROOT_SPAN,
+                            donor=donor[:8], worker=worker.peer_id[:8])
                 if sctx.out is not None:
                     # MID-STREAM FAILOVER: headers (and sent_text chars)
                     # already reached the client from a worker that then

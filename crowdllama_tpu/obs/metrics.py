@@ -201,6 +201,16 @@ class NodeMetrics:
             REQUEST_BUCKETS, "model", self.model_guard)
         self.ttft_seconds = Histogram(TTFT_BUCKETS)
         self.decode_step_seconds = Histogram(DECODE_STEP_BUCKETS)
+        # KV shipping (docs/KV_TRANSFER.md): fetch latency observed by the
+        # fetching worker; bytes/fetches/fallbacks count page traffic on
+        # whichever side moved it (a donor's exports land in the same
+        # families).  Part of NodeMetrics so every node — gateway included —
+        # exposes the series at zero rather than absent.
+        self.kv_fetch_seconds = Histogram(TTFT_BUCKETS)
+        self.kv_ship = {"bytes": 0, "fetches": 0, "fallbacks": 0}
+
+    def kv_ship_inc(self, key: str, n: int = 1) -> None:
+        self.kv_ship[key] = self.kv_ship.get(key, 0) + int(n)
 
     def expose(self) -> list[str]:
         out = self.request_seconds.expose("crowdllama_request_seconds")
@@ -209,6 +219,12 @@ class NodeMetrics:
         out.append("# TYPE crowdllama_decode_step_seconds histogram")
         out.extend(self.decode_step_seconds.lines(
             "crowdllama_decode_step_seconds"))
+        for key in ("bytes", "fetches", "fallbacks"):
+            name = f"crowdllama_kv_ship_{key}_total"
+            out.append(f"# TYPE {name} counter")
+            out.append(f"{name} {self.kv_ship.get(key, 0)}")
+        out.append("# TYPE crowdllama_kv_fetch_seconds histogram")
+        out.extend(self.kv_fetch_seconds.lines("crowdllama_kv_fetch_seconds"))
         return out
 
 

@@ -574,6 +574,9 @@ class Peer:
                 reply.trace_id = tid
                 await wire.write_length_prefixed_pb(stream.writer, reply)
                 return True
+            if which == "kv_fetch_request":
+                await self._serve_kv_fetch(stream, msg)
+                return True
             req = msg.generate_request
             if which != "generate_request":
                 raise ValueError("expected GenerateRequest")
@@ -644,6 +647,74 @@ class Peer:
             except Exception:
                 return False  # writer dead: end the stream's serve loop
             return True  # error frame delivered; the exchange is complete
+
+    _KV_FRAME_BYTES = 4 * 1024 * 1024  # page payload per KvPages frame
+
+    async def _serve_kv_fetch(self, stream: Stream, msg) -> None:
+        """Serve a peer's paged-KV fetch (docs/KV_TRANSFER.md, donor side).
+
+        Pages stream out in bounded frames well under wire.MAX_MESSAGE_SIZE;
+        the exporter pins page refs only for the device→host gather, so a
+        slow receiver never holds donor pool pages hostage.  All failures
+        are reported in-band (KvPages.error) — the fetcher falls back to
+        plain prefill, it never retries against us."""
+        from crowdllama_tpu.core import pb
+        from crowdllama_tpu.core.messages import kv_pages_msg
+        from crowdllama_tpu.testing.faults import KillStream
+
+        req = msg.kv_fetch_request
+        tid = msg.trace_id
+        t0 = time.perf_counter_ns()
+        try:
+            payload = await asyncio.wait_for(
+                self.engine.export_kv_pages(
+                    req.model, list(req.chain_hashes), int(req.page_size)),
+                timeout=max(1.0, self.config.kv_ship_timeout))
+        except KillStream:
+            raise
+        except Exception as e:
+            payload, err = None, f"kv export failed: {e}"
+        else:
+            err = "" if payload is not None else "kv export unavailable"
+        if payload is None or payload["matched"] == 0:
+            out = kv_pages_msg(pb.KvPages(
+                model=req.model, matched=0, done=True,
+                error=err or ""))
+            out.trace_id = tid
+            await wire.write_length_prefixed_pb(stream.writer, out)
+            return
+        k_pages, v_pages = payload["k_pages"], payload["v_pages"]
+        k_scales, v_scales = payload["k_scales"], payload["v_scales"]
+        matched = payload["matched"]
+        sent_bytes = 0
+        start = 0
+        while start < matched:
+            end, size = start, 0
+            while end < matched and (size < self._KV_FRAME_BYTES
+                                     or end == start):
+                size += len(k_pages[end]) + len(v_pages[end])
+                if k_scales:
+                    size += len(k_scales[end]) + len(v_scales[end])
+                end += 1
+            frame = pb.KvPages(
+                model=req.model, matched=matched, start=start,
+                kv_dtype=payload["kv_dtype"], done=(end >= matched))
+            frame.k_pages.extend(k_pages[start:end])
+            frame.v_pages.extend(v_pages[start:end])
+            if k_scales:
+                frame.k_scales.extend(k_scales[start:end])
+                frame.v_scales.extend(v_scales[start:end])
+            out = kv_pages_msg(frame)
+            out.trace_id = tid
+            await wire.write_length_prefixed_pb(stream.writer, out)
+            sent_bytes += size
+            start = end
+        self.obs.metrics.kv_ship_inc("bytes", sent_bytes)
+        self.obs.metrics.kv_ship_inc("fetches")
+        if tid:
+            self.obs.trace.record(tid, "kv_export",
+                                  time.perf_counter_ns() - t0,
+                                  pages=matched, bytes=sent_bytes)
 
     # ----------------------------------------------------------- discovery
 

@@ -21,6 +21,9 @@ site                  attrs / where
                       (empty for bare addresses), ``protocol``
 ``relay.op``          relay service op dispatch (net/relay.py): ``op``
 ``relay.splice``      before a relay starts its bidirectional copy loop
+``kv.fetch``          before a worker dials a KV-page donor
+                      (engine/engine.py ``_kv_fetch_once``): ``worker``,
+                      ``donor``
 ====================  =====================================================
 
 Actions:

@@ -1,0 +1,372 @@
+"""Swarm KV shipping (docs/KV_TRANSFER.md): prefix-affinity misses become
+paged-KV page fetches instead of prefill recompute.
+
+Runner level: export_pages/import_pages move pages between pools and the
+ordinary suffix-only prefill consumes imported pages exactly like locally
+cached ones — greedy decode must be byte-identical to a cold serve, for
+bf16 and int8 pools, including partial matches after donor-side eviction.
+
+End to end: a worker given a kv_donor hint dials the donor over the real
+p2p inference stream, imports the pages, and produces the same bytes as a
+plain prefill; an injected stream kill on the fetch path falls back to
+plain prefill and still matches.
+"""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from crowdllama_tpu.utils.crypto_compat import Ed25519PrivateKey
+
+from crowdllama_tpu.config import Configuration, Intervals
+from crowdllama_tpu.engine.paged import PagedModelRunner
+from crowdllama_tpu.models import transformer as T
+from crowdllama_tpu.models.config import get_config
+from crowdllama_tpu.testing import faults
+
+PG = 32
+
+
+def _runner(**kw):
+    cfg = get_config("tiny-test", max_context_length=256)
+    params = T.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    return PagedModelRunner(cfg, params=params, max_slots=4, max_seq=256,
+                            dtype=jnp.float32, page_size=PG, **kw)
+
+
+def _serve(runner, state, slot, prompt, steps=6):
+    first, ks, vs, plen = runner.prefill(prompt, 0.0, 1.0,
+                                         jax.random.PRNGKey(1), state=state)
+    state = runner.insert(state, slot, ks, vs, plen, first, 0.0, 1.0)
+    out, state = runner.decode_steps(state, steps)
+    return [first] + [int(t) for t in out[:, slot]], state
+
+
+def _ship(donor, dstate, recv, rstate, prompt):
+    """export donor's pages for ``prompt`` and import them into recv."""
+    keys = donor.chain_keys_for_prompt(prompt)
+    payload = donor.export_pages(dstate, keys)
+    assert payload is not None
+    payload["keys"] = keys[: payload["matched"]]
+    rstate, n = recv.import_pages(rstate, payload)
+    return rstate, n, payload
+
+
+def test_imported_pages_decode_byte_identical():
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(1, 500, 3 * PG + 9).tolist()
+
+    donor = _runner()
+    dstate = donor.init_state()
+    toks_donor, dstate = _serve(donor, dstate, 0, prompt)
+
+    recv = _runner()
+    rstate = recv.init_state()
+    rstate, n, _ = _ship(donor, dstate, recv, rstate, prompt)
+    assert n == 3
+    assert donor.kv_pages_exported == 3 and recv.kv_pages_imported == 3
+
+    toks_recv, rstate = _serve(recv, rstate, 0, prompt)
+    # Suffix-only prefill consumed the imported pages like local ones...
+    assert recv.prefix_hits == 1
+    assert recv.prefix_tokens_reused == 3 * PG
+    # ...and greedy decode matches the donor's cold serve exactly.
+    assert toks_recv == toks_donor, (toks_recv, toks_donor)
+
+
+def test_imported_pages_int8_pool_byte_identical():
+    rng = np.random.default_rng(1)
+    prompt = rng.integers(1, 500, 2 * PG + 5).tolist()
+
+    donor = _runner(kv_dtype="int8")
+    dstate = donor.init_state()
+    toks_donor, dstate = _serve(donor, dstate, 0, prompt)
+
+    recv = _runner(kv_dtype="int8")
+    rstate = recv.init_state()
+    rstate, n, payload = _ship(donor, dstate, recv, rstate, prompt)
+    assert n == 2
+    # int8 pools ship pages + bf16 scales verbatim, no requantization.
+    assert payload["kv_dtype"] == "int8"
+    assert len(payload["k_scales"]) == 2
+
+    toks_recv, rstate = _serve(recv, rstate, 0, prompt)
+    assert recv.prefix_hits == 1
+    assert toks_recv == toks_donor, (toks_recv, toks_donor)
+
+
+def test_partial_match_after_donor_eviction():
+    """Donor pressure evicted the chain's tail before the fetch: the donor
+    serves the surviving leading pages, the receiver imports the subset and
+    recomputes only the rest — still byte-identical."""
+    rng = np.random.default_rng(2)
+    prompt = rng.integers(1, 500, 3 * PG + 7).tolist()
+
+    donor = _runner()
+    dstate = donor.init_state()
+    toks_donor, dstate = _serve(donor, dstate, 0, prompt)
+    dstate = donor.release(dstate, 0)
+    # Simulate eviction of the chain's LAST page (match stops there).
+    keys = donor.chain_keys_for_prompt(prompt)
+    page = donor._prefix_index.pop(keys[-1])
+    donor._page_key.pop(page, None)
+    donor._index_lru.pop(keys[-1], None)
+    donor._free_pages.append(page)
+
+    recv = _runner()
+    rstate = recv.init_state()
+    rstate, n, payload = _ship(donor, dstate, recv, rstate, prompt)
+    assert payload["matched"] == 2 and n == 2
+
+    toks_recv, rstate = _serve(recv, rstate, 0, prompt)
+    assert recv.prefix_hits == 1
+    assert recv.prefix_tokens_reused == 2 * PG  # subset, rest recomputed
+    assert toks_recv == toks_donor, (toks_recv, toks_donor)
+
+
+def test_import_rejects_dtype_and_shape_mismatch():
+    import pytest
+
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(1, 500, PG + 3).tolist()
+    donor = _runner()
+    dstate = donor.init_state()
+    _, dstate = _serve(donor, dstate, 0, prompt)
+    keys = donor.chain_keys_for_prompt(prompt)
+    payload = donor.export_pages(dstate, keys)
+    payload["keys"] = keys[: payload["matched"]]
+
+    recv = _runner(kv_dtype="int8")
+    with pytest.raises(ValueError, match="dtype mismatch"):
+        recv.import_pages(recv.init_state(), dict(payload))
+
+    recv2 = _runner()
+    bad = dict(payload)
+    bad["k_pages"] = [b"\x00" * 8] * len(bad["k_pages"])
+    with pytest.raises(ValueError, match="bytes"):
+        recv2.import_pages(recv2.init_state(), bad)
+
+
+def test_export_respects_page_geometry_and_gate():
+    rng = np.random.default_rng(4)
+    prompt = rng.integers(1, 500, PG + 2).tolist()
+    donor = _runner()
+    dstate = donor.init_state()
+    _, dstate = _serve(donor, dstate, 0, prompt)
+    keys = donor.chain_keys_for_prompt(prompt)
+    # Mismatched page geometry: pages are not interchangeable.
+    assert donor.export_pages(dstate, keys, page_size=PG * 2) is None
+    # Unknown hashes: nothing to serve.
+    assert donor.export_pages(dstate, [b"\x00" * 32]) is None
+    # Cache off: no index to serve from.
+    off = _runner(prefix_cache=False)
+    assert off.export_pages(off.init_state(), keys) is None
+
+
+def test_gateway_affinity_lru_and_donor_hint():
+    """The affinity map is a bounded LRU (eviction counted for /metrics),
+    and _kv_donor_for only hints a fresh, routable, different worker."""
+    from types import SimpleNamespace
+
+    from crowdllama_tpu.gateway.gateway import Gateway
+
+    class _PM:
+        def __init__(self):
+            self.routable = {}
+
+        def is_routable(self, pid, model):
+            return self.routable.get(pid)
+
+    pm = _PM()
+    gw = Gateway(SimpleNamespace(peer_manager=pm), port=0, kv_ship=True)
+    gw._AFFINITY_MAX = 4
+    for i in range(6):
+        gw._affinity_put(f"k{i}", f"w{i}")
+    assert len(gw._affinity) == 4
+    assert gw._affinity_evicted == 2
+    assert "k0" not in gw._affinity and "k5" in gw._affinity
+    # A get is an LRU touch: k2 survives the next insert, k3 does not.
+    pm.routable["w2"] = SimpleNamespace(
+        peer_id="w2", resource=SimpleNamespace(load=0.0))
+    assert gw._affinity_get("k2", "m") is not None
+    gw._affinity_put("k9", "w9")
+    assert "k2" in gw._affinity and "k3" not in gw._affinity
+
+    # Donor hint: fresh + routable + not the chosen worker.
+    assert gw._kv_donor_for("k2", "m", chosen_worker="wX") == "w2"
+    assert gw._kv_donor_for("k2", "m", chosen_worker="w2") == ""
+    assert gw._kv_donor_for("k9", "m", "wX") == ""   # w9 not routable
+    assert gw._kv_donor_for(None, "m", "wX") == ""
+    assert gw._kv_donor_for("missing", "m", "wX") == ""
+    gw.kv_ship = False                               # gate respected
+    assert gw._kv_donor_for("k2", "m", "wX") == ""
+
+
+# --------------------------------------------------------------- end to end
+
+MODEL = "tiny-test"
+PROMPT = ("Swarm KV shipping turns prefix-affinity misses into paged "
+          "page fetches instead of recomputing the prefill from scratch. "
+          "This long shared prefix spans several pages so the fetch "
+          "actually pays for its round trip.")
+
+
+def _cfg(bootstrap, **kw):
+    cfg = Configuration(
+        listen_host="127.0.0.1",
+        bootstrap_peers=[bootstrap],
+        intervals=Intervals.default(),
+        model=MODEL,
+        kv_layout="paged",
+        kv_page_size=16,
+        kv_ship=True,
+        kv_ship_min_tokens=16,
+    )
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+async def _generate_text(engine, kv_donor=""):
+    from crowdllama_tpu.core.messages import (
+        create_generate_request,
+        extract_generate_response,
+    )
+
+    msg = create_generate_request(MODEL, PROMPT, max_tokens=8)
+    msg.trace_id = "kvshiptrace0000"
+    if kv_donor:
+        msg.generate_request.kv_donor = kv_donor
+    reply = await engine.handle(msg, worker_id="t")
+    resp = extract_generate_response(reply)
+    assert resp.done_reason != "error", resp.response
+    return resp.response
+
+
+async def test_kv_fetch_end_to_end_and_chaos_fallback():
+    from crowdllama_tpu.engine.engine import JaxEngine
+    from crowdllama_tpu.net.discovery import new_host_and_dht
+    from crowdllama_tpu.peer.peer import Peer
+
+    boot_host, _ = await new_host_and_dht(
+        Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    bootstrap = f"127.0.0.1:{boot_host.listen_port}"
+
+    engines, peers = [], []
+    for _ in range(3):  # A = donor, B = fetcher, C = chaos fetcher
+        eng = JaxEngine(_cfg(bootstrap), max_context_length=256,
+                        warmup=False)
+        await eng.start()
+        peer = Peer(Ed25519PrivateKey.generate(), _cfg(bootstrap),
+                    engine=eng, worker_mode=True)
+        await peer.start()
+        engines.append(eng)
+        peers.append(peer)
+    eng_a, eng_b, eng_c = engines
+    peer_a, peer_b, peer_c = peers
+
+    try:
+        # Wait until B and C can resolve the donor in the DHT.
+        for p in (peer_b, peer_c):
+            deadline = asyncio.get_running_loop().time() + 20
+            while asyncio.get_running_loop().time() < deadline:
+                if await p.dht.find_peer(peer_a.peer_id) is not None:
+                    break
+                await asyncio.sleep(0.1)
+            else:
+                raise AssertionError("donor never became resolvable")
+
+        # Donor serves the prompt cold: pages land in its prefix index.
+        text_a = await _generate_text(eng_a)
+
+        # B fetches the prefix from A instead of recomputing it.
+        text_b = await _generate_text(eng_b, kv_donor=peer_a.peer_id)
+        assert text_b == text_a, (text_b, text_a)
+        assert eng_b._runner.kv_pages_imported > 0
+        assert eng_a._runner.kv_pages_exported > 0
+        assert eng_b.obs.metrics.kv_ship["fetches"] == 1
+        assert eng_b.obs.metrics.kv_ship["fallbacks"] == 0
+        assert eng_b.obs.metrics.kv_ship["bytes"] > 0
+        assert eng_b.obs.metrics.kv_fetch_seconds.count == 1
+        # Donor-side accounting + spans on both trace surfaces.
+        assert eng_a.obs.metrics.kv_ship["bytes"] > 0
+        tr_b = eng_b.obs.trace.get("kvshiptrace0000")
+        assert any(s["name"] == "kv_fetch" for s in tr_b["spans"]), tr_b
+        tr_a = peer_a.obs.trace.get("kvshiptrace0000")
+        assert any(s["name"] == "kv_export" for s in tr_a["spans"]), tr_a
+
+        # C's fetch dies mid-dial (injected): plain prefill fallback must
+        # complete byte-identically and count as a fallback.
+        plan = faults.FaultPlan(seed=7, rules=[
+            faults.FaultRule(site="kv.fetch", action="kill_stream"),
+        ])
+        with faults.installed(plan):
+            text_c = await _generate_text(eng_c, kv_donor=peer_a.peer_id)
+        assert plan.log, "kv.fetch fault never fired"
+        assert text_c == text_a, (text_c, text_a)
+        assert eng_c._runner.kv_pages_imported == 0
+        assert eng_c.obs.metrics.kv_ship["fallbacks"] == 1
+    finally:
+        for p in peers:
+            await p.stop()
+        for e in engines:
+            await e.stop()
+        await boot_host.close()
+
+
+async def test_kv_donor_hint_survives_routed_request():
+    """The donor hint rides _route_admitted's actual wire message: a
+    continuation routed with kv_ship on must reach the worker and answer
+    200 with the hint counted.  Regression for a field-path bug where the
+    gateway set kv_donor on BaseMessage instead of GenerateRequest and
+    500'd every /api/chat request (the unit test above never drives the
+    routed path)."""
+    import aiohttp
+
+    from crowdllama_tpu.engine.engine import FakeEngine
+    from crowdllama_tpu.gateway.gateway import Gateway
+    from crowdllama_tpu.net.discovery import new_host_and_dht
+    from crowdllama_tpu.peer.peer import Peer
+
+    boot_host, _ = await new_host_and_dht(
+        Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    bootstrap = f"127.0.0.1:{boot_host.listen_port}"
+    worker = Peer(Ed25519PrivateKey.generate(), _cfg(bootstrap),
+                  engine=FakeEngine(models=[MODEL]), worker_mode=True)
+    await worker.start()
+    consumer = Peer(Ed25519PrivateKey.generate(), _cfg(bootstrap),
+                    engine=FakeEngine(models=[]), worker_mode=False)
+    await consumer.start()
+    gateway = Gateway(consumer, port=0, host="127.0.0.1", kv_ship=True)
+    await gateway.start()
+    try:
+        deadline = asyncio.get_running_loop().time() + 30
+        while asyncio.get_running_loop().time() < deadline:
+            if any(p.is_worker for p in
+                   consumer.peer_manager.get_healthy_peers()):
+                break
+            await asyncio.sleep(0.1)
+        else:
+            raise AssertionError("worker never discovered")
+
+        # Force the hint branch: a donor regardless of scoring's pick
+        # (with one worker a real miss cannot name a different donor).
+        gateway._kv_donor_for = lambda akey, model, chosen: worker.peer_id
+        body = {"model": MODEL, "stream": False,
+                "messages": [{"role": "user", "content": "ship pages"},
+                             {"role": "assistant", "content": "ok"},
+                             {"role": "user", "content": "again"}]}
+        gw_port = gateway._runner.addresses[0][1]
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"http://127.0.0.1:{gw_port}/api/chat",
+                              json=body) as resp:
+                assert resp.status == 200, await resp.text()
+                d = await resp.json()
+        assert d["message"]["content"]
+        assert gateway._kv_hints == 1
+    finally:
+        await gateway.stop()
+        await consumer.stop()
+        await worker.stop()
+        await boot_host.close()

@@ -176,11 +176,19 @@ async def test_gateway_and_worker_metrics_lint():
         for types in (gw_types, wk_types):
             for fam in ("crowdllama_request_seconds",
                         "crowdllama_ttft_seconds",
-                        "crowdllama_decode_step_seconds"):
+                        "crowdllama_decode_step_seconds",
+                        "crowdllama_kv_fetch_seconds"):
                 assert types.get(fam) == "histogram", f"{fam} missing"
+            for c in ("bytes", "fetches", "fallbacks"):
+                fam = f"crowdllama_kv_ship_{c}_total"
+                assert types.get(fam) == "counter", f"{fam} missing"
             for g in ("pending_depth", "active_slots", "batch_occupancy",
                       "kv_cache_utilization"):
                 assert types.get(f"crowdllama_engine_{g}") == "gauge"
+        # Gateway-side routing counters for the KV-ship plane.
+        for fam in ("crowdllama_gateway_affinity_evicted_total",
+                    "crowdllama_gateway_kv_hints_total"):
+            assert gw_types.get(fam) == "counter", f"{fam} missing"
         # Traffic landed in BOTH sides' request histograms.
         for text in (gw_text, wk_text):
             assert re.search(r'crowdllama_request_seconds_count\{'
