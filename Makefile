@@ -6,9 +6,11 @@ PYTEST = env JAX_PLATFORMS=cpu $(PY) -m pytest -p no:cacheprovider
 
 .PHONY: test tier1 chaos distill-smoke bench-kv
 
-# Full suite (slow soaks included).
-test:
-	$(PYTEST) tests/ -q
+# Full suite (slow soaks included).  Runs the chaos matrix FIRST: the
+# fault-injection scenarios are the cheapest way to catch a request-
+# plane regression, so they gate the long tail instead of trailing it.
+test: chaos
+	$(PYTEST) tests/ -q -m 'not chaos'
 
 # The tier-1 gate: what CI (and ROADMAP.md) holds the repo to.
 tier1:
@@ -16,8 +18,9 @@ tier1:
 
 # Deterministic fault-injection matrix (docs/ROBUSTNESS.md): seeded
 # FaultPlans from crowdllama_tpu/testing/faults.py kill streams, fail
-# handshakes, and exhaust budgets; assertions check the request plane
-# heals (mid-stream failover, 504 budgets, 503 shedding).
+# handshakes, exhaust budgets, and drain workers mid-stream; assertions
+# check the request plane heals (mid-stream failover, live migration
+# with KV handoff, 504 budgets, 503 shedding).
 chaos:
 	$(PYTEST) tests/ -q -m chaos
 

@@ -174,6 +174,156 @@ async def _measure(kind: str, sizes: list[int], n_requests: int,
     return curve
 
 
+async def _drain_phase(n_requests: int, concurrency: int,
+                       num_predict: int) -> dict:
+    """Live-migration phase (docs/ROBUSTNESS.md): 4 real engines under
+    streaming load, one of them drained mid-burst.  Every in-flight
+    stream must complete (migrated to a survivor with KV handoff), and
+    NEW requests keep landing on the survivors — zero failed streams is
+    the acceptance bar."""
+    import aiohttp
+    from crowdllama_tpu.utils.crypto_compat import Ed25519PrivateKey
+
+    from crowdllama_tpu.config import Configuration, Intervals
+    from crowdllama_tpu.engine.engine import FakeEngine, JaxEngine
+    from crowdllama_tpu.gateway.gateway import Gateway
+    from crowdllama_tpu.net.discovery import new_host_and_dht
+    from crowdllama_tpu.peer.peer import Peer
+
+    size = 4
+
+    def cfg(**kw):
+        c = Configuration(listen_host="127.0.0.1", model=MODEL,
+                          intervals=Intervals.default(),
+                          kv_layout="paged", kv_page_size=16,
+                          kv_ship=True, kv_ship_min_tokens=16)
+        for k, v in kw.items():
+            setattr(c, k, v)
+        return c
+
+    boot_host, _ = await new_host_and_dht(
+        Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    bootstrap = f"127.0.0.1:{boot_host.listen_port}"
+    consumer = Peer(Ed25519PrivateKey.generate(),
+                    cfg(bootstrap_peers=[bootstrap]),
+                    engine=FakeEngine(models=[]), worker_mode=False)
+    await consumer.start()
+    gateway = Gateway(consumer, port=0, host="127.0.0.1", kv_ship=True)
+    await gateway.start()
+    gw_port = gateway._runner.addresses[0][1]
+    url = f"http://127.0.0.1:{gw_port}/api/chat"
+
+    workers: list[Peer] = []
+    engines: list = []
+    try:
+        for _ in range(size):
+            eng = JaxEngine(cfg(), max_context_length=256)
+            await eng.start()
+            engines.append(eng)
+            w = Peer(Ed25519PrivateKey.generate(),
+                     cfg(bootstrap_peers=[bootstrap]), engine=eng,
+                     worker_mode=True)
+            workers.append(w)
+            await w.start()
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            healthy = {p.peer_id for p in
+                       consumer.peer_manager.get_healthy_peers()
+                       if p.is_worker}
+            if len(healthy) >= size:
+                break
+            await asyncio.sleep(0.1)
+        else:
+            raise RuntimeError("discovery stalled in drain phase")
+
+        sem = asyncio.Semaphore(concurrency)
+        completed = [0]
+        failed = [0]
+
+        async with aiohttp.ClientSession() as session:
+            async def one(i: int) -> None:
+                # Multi-page prompt (page_size 16): the drained worker's
+                # prefill pages are worth fetching on migration.
+                body = {"model": MODEL, "stream": True,
+                        "options": {"num_predict": num_predict},
+                        "messages": [{"role": "user",
+                                      "content": f"{i:04d} drain phase "
+                                      "stream that must survive a mid-"
+                                      "burst worker drain with its KV "
+                                      "handed to a surviving engine"}]}
+                async with sem:
+                    try:
+                        async with session.post(url, json=body) as resp:
+                            assert resp.status == 200, await resp.text()
+                            last = None
+                            async for line in resp.content:
+                                if line.strip():
+                                    last = json.loads(line)
+                            ok = (last is not None and last.get("done")
+                                  and last.get("done_reason") != "error"
+                                  and "error" not in last)
+                            completed[0] += ok
+                            failed[0] += not ok
+                    except Exception:
+                        failed[0] += 1
+
+            # Prime compile paths outside the measured burst.
+            await asyncio.gather(*(one(-1 - k) for k in range(size)))
+            completed[0] = 0
+            failed[0] = 0
+
+            t0 = time.monotonic()
+            burst = [asyncio.create_task(one(i)) for i in range(n_requests)]
+
+            async def drain_one() -> tuple[str, float, int]:
+                await asyncio.sleep(0.3)   # let streams get in flight
+                # Drain the worker actually serving the burst — routing
+                # may concentrate load, and draining an idle worker
+                # would never exercise the mid-stream MigrateFrame path.
+                def load(k: int) -> tuple:
+                    g = engines[k].obs_gauges()
+                    return (g.get("active_slots", 0.0),
+                            g.get("pending_depth", 0.0))
+                idx = max(range(size), key=load)
+                td = time.monotonic()
+                migrated = await workers[idx].drain()
+                return (workers[idx].peer_id, time.monotonic() - td,
+                        migrated)
+
+            (drained_id, drain_s, migrated), *_ = await asyncio.gather(
+                drain_one(), *burst)
+            dt = time.monotonic() - t0
+
+        gw_m = gateway.obs.metrics
+        replayed = sum(e.obs.metrics.replayed_prefill_tokens
+                       for e in engines)
+        point = {
+            "workers": size,
+            "streams_total": n_requests,
+            "streams_completed": completed[0],
+            "streams_failed": failed[0],
+            "drained_worker": drained_id[:8],
+            "drain_call_s": round(drain_s, 3),
+            "inflight_migrated": migrated,
+            "gateway_migrated_streams": gw_m.migrated_streams,
+            "replayed_prefill_tokens": replayed,
+            "wall_s": round(dt, 2),
+        }
+        print(f"# drain phase: {completed[0]}/{n_requests} streams ok, "
+              f"{migrated} migrated off {drained_id[:8]} in "
+              f"{drain_s * 1000:.0f}ms, replayed_prefill={replayed}",
+              file=sys.stderr)
+        return point
+    finally:
+        await gateway.stop()
+        await consumer.stop()
+        for w in workers:
+            await w.stop()
+        for e in engines:
+            await e.stop()
+        await boot_host.close()
+
+
 async def run() -> dict:
     sizes = [int(x) for x in os.environ.get(
         "CROWDLLAMA_BENCH_MINI_SIZES", "2,4").split(",") if x.strip()]
@@ -186,6 +336,7 @@ async def run() -> dict:
                           num_predict)
     control = await _measure("fake", sizes, n_requests, concurrency,
                              num_predict)
+    drain = await _drain_phase(n_requests, concurrency, num_predict)
 
     head = real[-1]
     ctrl = control[-1]
@@ -202,6 +353,7 @@ async def run() -> dict:
             # size — what prefill+decode add on top of the control plane.
             "engine_ttft_ms": round(
                 head["ttft_p50_ms"] - ctrl["ttft_p50_ms"], 1),
+            "drain_phase": drain,
             "requests_per_size": n_requests,
             "concurrency": concurrency,
             "num_predict": num_predict,

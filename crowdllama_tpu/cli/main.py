@@ -568,9 +568,15 @@ async def run_node(cfg: Configuration, worker_mode: bool) -> None:
         await ipc.start()
 
     stop = asyncio.Event()
+    got_sig: list[int] = []
     loop = asyncio.get_running_loop()
+
+    def _on_signal(signum: int) -> None:
+        got_sig.append(signum)
+        stop.set()
+
     for sig in (signal.SIGINT, signal.SIGTERM):
-        loop.add_signal_handler(sig, stop.set)
+        loop.add_signal_handler(sig, _on_signal, sig)
 
     async def stats_loop() -> None:
         while True:
@@ -587,13 +593,31 @@ async def run_node(cfg: Configuration, worker_mode: bool) -> None:
     finally:
         log.info("shutting down")
         stats.cancel()
-        # Graceful drain: stop advertising first (the swarm fails over to
-        # other workers), finish in-flight requests, then tear down.
-        await peer.stop_advertising()
-        drained = await engine.drain(cfg.drain_timeout)
-        if not drained:
-            log.warning("drain timed out after %.0fs; dropping in-flight "
-                        "requests", cfg.drain_timeout)
+        if signal.SIGTERM in got_sig and worker_mode:
+            # SIGTERM on a worker = live-migration drain
+            # (docs/ROBUSTNESS.md): advertise draining, migrate in-flight
+            # streams to the swarm, then stay up as a KV donor for their
+            # successors through the drain window.  A second signal (or an
+            # earlier POST /drain having already moved everything) cuts
+            # the window short.
+            migrated = await peer.drain()
+            if migrated:
+                log.info("migrated %d in-flight streams; serving KV "
+                         "fetches for %.0fs (signal again to exit now)",
+                         migrated, cfg.drain_timeout)
+                stop.clear()
+                try:
+                    await asyncio.wait_for(stop.wait(), cfg.drain_timeout)
+                except asyncio.TimeoutError:
+                    pass
+        else:
+            # SIGINT (operator foreground stop) / consumer: finish
+            # in-flight requests in place, then tear down.
+            await peer.stop_advertising()
+            drained = await engine.drain(cfg.drain_timeout)
+            if not drained:
+                log.warning("drain timed out after %.0fs; dropping "
+                            "in-flight requests", cfg.drain_timeout)
         if ipc is not None:
             await ipc.stop()
         if obs_server is not None:

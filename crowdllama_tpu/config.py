@@ -332,6 +332,9 @@ class Configuration:
         if cfg.kv_ship_timeout <= 0:
             raise ValueError(f"kv_ship_timeout must be positive, "
                              f"got {cfg.kv_ship_timeout}")
+        if cfg.drain_timeout <= 0:
+            raise ValueError(f"drain_timeout must be positive, "
+                             f"got {cfg.drain_timeout}")
         if cfg.worker_metrics_port < 0:
             raise ValueError(f"worker_metrics_port must be >= 0, "
                              f"got {cfg.worker_metrics_port}")
@@ -479,6 +482,11 @@ class Configuration:
                             type=float,
                             help="seconds before a KV fetch gives up and "
                                  "falls back to plain prefill")
+        parser.add_argument("--drain-timeout", dest="drain_timeout",
+                            type=float,
+                            help="graceful-drain window in seconds: how "
+                                 "long a SIGTERM'd/drained worker stays up "
+                                 "as a KV donor for its migrated streams")
 
     @classmethod
     def from_flags(cls, args: argparse.Namespace) -> "Configuration":
@@ -495,6 +503,7 @@ class Configuration:
                 "request_timeout", "admission_max_inflight",
                 "admission_pending_max", "retry_after_s",
                 "kv_ship", "kv_ship_min_tokens", "kv_ship_timeout",
+                "drain_timeout",
                 "dist_coordinator", "dist_num_processes", "dist_process_id",
             )
         }

@@ -144,6 +144,31 @@ def extract_kv_pages(msg: pb.BaseMessage) -> pb.KvPages:
     return msg.kv_pages
 
 
+def migrate_frame_msg(
+    model: str,
+    worker_id: str,
+    delivered_tokens: int = 0,
+    prompt_tokens: int = 0,
+    chain_hashes: Iterable[bytes] = (),
+    page_size: int = 0,
+    reason: str = "drain",
+) -> pb.BaseMessage:
+    mf = pb.MigrateFrame(
+        model=model, worker_id=worker_id,
+        delivered_tokens=int(delivered_tokens),
+        prompt_tokens=int(prompt_tokens),
+        page_size=int(page_size), reason=reason,
+    )
+    mf.chain_hashes.extend(bytes(h) for h in chain_hashes)
+    return pb.BaseMessage(migrate_frame=mf)
+
+
+def extract_migrate_frame(msg: pb.BaseMessage) -> pb.MigrateFrame:
+    if msg.WhichOneof("message") != "migrate_frame":
+        raise ValueError("message does not contain a MigrateFrame")
+    return msg.migrate_frame
+
+
 def flatten_chat(messages: Iterable[Mapping[str, str]]) -> str:
     """Flatten Ollama-style chat messages into a single prompt string.
 

@@ -77,6 +77,13 @@ class Resource:
     # circuit semantics, dht.go:386-395; relay failover candidates come
     # from these advertisements).
     relay_capable: bool = False
+    # Graceful drain (docs/ROBUSTNESS.md): the worker stops accepting new
+    # generate requests and is quarantined from routing snapshots, but
+    # stays alive serving KvFetchRequests as a donor for its migrated
+    # streams until drain_timeout.  Wire back-compat both ways: old
+    # parsers drop the field as unknown JSON, old advertisements default
+    # to False here.
+    draining: bool = False
     shard_group: ShardGroup | None = None
 
     def touch(self) -> None:

@@ -24,6 +24,7 @@ from crowdllama_tpu.core.messages import (
     extract_embed_request,
     extract_generate_request,
     flatten_chat,
+    migrate_frame_msg,
 )
 from crowdllama_tpu.testing import faults
 
@@ -113,6 +114,15 @@ class Engine:
     async def drain(self, timeout: float = 30.0) -> bool:
         """Finish in-flight work before shutdown; True when drained."""
         return True
+
+    async def migrate(self) -> int:
+        """Hand off every in-flight request for live migration (graceful
+        drain, docs/ROBUSTNESS.md): each active stream retires with a
+        ``"migrate"`` terminal reason, which ``handle_streaming`` turns
+        into a MigrateFrame so the gateway re-routes it.  Returns how many
+        requests were moved; engines without a scheduler have nothing to
+        move."""
+        return 0
 
     def attach_peer(self, peer) -> None:
         """Called by Peer.start() so engines that talk to the swarm (e.g.
@@ -255,9 +265,41 @@ class Engine:
         async for chunk in self._gen_from_request(req, trace_id=msg.trace_id):
             if not first_ns:
                 first_ns = time.monotonic_ns()
-            await faults.inject("engine.stream_chunk", worker=worker_id,
-                                model=req.model, index=n_chunk)
+            try:
+                await faults.inject("engine.stream_chunk", worker=worker_id,
+                                    model=req.model, index=n_chunk)
+            except faults.DrainRequested:
+                # Chaos trigger for live migration (docs/ROBUSTNESS.md):
+                # as if SIGTERM / POST /drain landed mid-stream.  Start the
+                # drain concurrently and keep streaming — the scheduler
+                # retires this request with "migrate" at its next safe
+                # point, and the done branch below emits the MigrateFrame.
+                peer = getattr(self, "_peer", None)
+                if peer is not None and hasattr(peer, "drain"):
+                    asyncio.get_running_loop().create_task(peer.drain())
+                else:
+                    asyncio.get_running_loop().create_task(self.migrate())
             n_chunk += 1
+            if chunk.done and chunk.done_reason == "migrate":
+                # Live migration: the terminal frame is a MigrateFrame, not
+                # a GenerateResponse — generation state for the gateway to
+                # re-route the stream with this worker as KV donor.  Any
+                # held-back text (stop-matcher tail) is dropped: the
+                # successor replays the whole generation and the gateway's
+                # sent_text trim dedups what was already delivered.
+                self._obs_generate(msg, req.model, t0, first_ns,
+                                   time.monotonic_ns(), chunk)
+                hashes, page_size = self._migrate_export_meta(req)
+                yield migrate_frame_msg(
+                    model=req.model,
+                    worker_id=worker_id,
+                    delivered_tokens=chunk.completion_tokens,
+                    prompt_tokens=chunk.prompt_tokens,
+                    chain_hashes=hashes,
+                    page_size=page_size,
+                    reason="drain",
+                )
+                return
             if chunk.done:
                 final = chunk
                 self._obs_generate(msg, req.model, t0, first_ns,
@@ -279,14 +321,26 @@ class Engine:
         (the reference concatenates contents, gateway.go:189-207)."""
         return flatten_chat(messages)
 
-    def _gen_from_request(self, req: pb.GenerateRequest,
-                          trace_id: str = "") -> AsyncIterator[Chunk]:
+    def _prompt_of(self, req: pb.GenerateRequest) -> str:
         prompt = req.prompt
         if not prompt and req.messages:
             prompt = self._format_chat(
                 [{"role": m.role, "content": m.content} for m in req.messages],
                 model=req.model,
             )
+        return prompt
+
+    def _migrate_export_meta(self, req: pb.GenerateRequest
+                             ) -> tuple[list[bytes], int]:
+        """(chain hashes, page size) for a MigrateFrame — what this worker
+        can serve the successor as a KV donor.  Informational: the
+        successor recomputes the chain from the replayed prompt; engines
+        without a paged prefix index advertise nothing."""
+        return [], 0
+
+    def _gen_from_request(self, req: pb.GenerateRequest,
+                          trace_id: str = "") -> AsyncIterator[Chunk]:
+        prompt = self._prompt_of(req)
         kwargs = {}
         donor = getattr(req, "kv_donor", "")
         if donor and self.supports_kv_donor:
@@ -297,6 +351,11 @@ class Engine:
             # cross-node trace as the fetcher's kv_fetch.
             kwargs["kv_donor"] = donor
             kwargs["kv_trace"] = trace_id
+            if getattr(req, "migrate", False):
+                # Migrated stream (docs/ROBUSTNESS.md): the fetch is the
+                # point of the re-route — bypass the kv_ship opt-in and
+                # break-even gates so the successor always tries the donor.
+                kwargs["migrate"] = True
         return self.generate(
             prompt,
             model=req.model,
@@ -429,6 +488,27 @@ class JaxEngine(Engine):
             return True
         return await self.scheduler.drain(timeout)
 
+    async def migrate(self) -> int:
+        """Retire every in-flight request with "migrate" at the decode
+        loop's next safe point (graceful drain); prefix pages stay cached
+        so this worker keeps serving them as a KV donor."""
+        if self.scheduler is None:
+            return 0
+        moved = await self.scheduler.migrate()
+        if moved and self.obs is not None:
+            self.obs.metrics.drain_inc("migrated_slots", moved)
+        return moved
+
+    def _migrate_export_meta(self, req: pb.GenerateRequest
+                             ) -> tuple[list[bytes], int]:
+        r = self._runner
+        if (r is None or self.tokenizer is None
+                or not getattr(r, "prefix_cache", False)
+                or not hasattr(r, "chain_keys_for_prompt")):
+            return [], 0
+        ids = self.tokenizer.encode(self._prompt_of(req))
+        return r.chain_keys_for_prompt(ids), int(r.page_size)
+
     async def stop(self) -> None:
         if self._kv_streams is not None:
             self._kv_streams.close()
@@ -489,7 +569,8 @@ class JaxEngine(Engine):
         return await self.scheduler.run_exclusive(_export)
 
     async def _fetch_kv_payload(self, donor: str, model: str,
-                                prompt_ids: list[int], trace_id: str = ""
+                                prompt_ids: list[int], trace_id: str = "",
+                                migrate: bool = False
                                 ) -> tuple[dict | None, int]:
         """Receiver side: dial the donor and pull the prefix's pages.
 
@@ -497,43 +578,93 @@ class JaxEngine(Engine):
         0 ns = no fetch was even attempted).  Every failure mode — donor
         gone, stream killed, timeout, dtype mismatch discovered at import —
         degrades to plain prefill; this path can make a request faster,
-        never break it."""
+        never break it.  One transient failure earns one retry inside the
+        same kv_ship_timeout budget (decorrelated jitter), so a donor
+        hiccup doesn't forfeit a large prefix over nothing.
+
+        ``migrate`` marks a migrated stream (docs/ROBUSTNESS.md): the
+        kv_ship opt-in and break-even gates are bypassed — the fetch IS
+        the point of the re-route — and prompt pages the donor could have
+        served but this worker recomputed are counted in
+        ``crowdllama_replayed_prefill_tokens_total`` (0 == complete
+        handoff)."""
+        import random
+
         r = self._runner
         peer = self._peer
-        if (not self._kv_ship_ready() or peer is None or not donor
+        ready = (self.scheduler is not None and r is not None
+                 and getattr(r, "prefix_cache", False)
+                 and hasattr(r, "import_pages")
+                 and (bool(self.config.kv_ship) or migrate))
+        if (not ready or peer is None or not donor
                 or donor == getattr(peer, "peer_id", "")):
             return None, 0
         keys = r.chain_keys_for_prompt(prompt_ids)
         covered = r.local_prefix_coverage(keys)
         uncovered = (len(keys) - covered) * r.page_size
-        if uncovered < max(1, int(self.config.kv_ship_min_tokens)):
-            return None, 0  # short tail: the round trip costs more than it saves
         mx = self.obs.metrics if self.obs is not None else None
+
+        def _account_replay(covered_pages: int) -> None:
+            if migrate and mx is not None:
+                mx.replayed_prefill_tokens += (
+                    max(0, len(keys) - covered_pages) * r.page_size)
+
+        if uncovered <= 0:
+            return None, 0  # local pages already cover the prompt
+        if (not migrate
+                and uncovered < max(1, int(self.config.kv_ship_min_tokens))):
+            return None, 0  # short tail: the round trip costs more than it saves
         timeout = max(0.5, float(self.config.kv_ship_timeout))
+        deadline = time.monotonic() + timeout
         t0 = time.monotonic_ns()
-        try:
-            payload = await asyncio.wait_for(
-                self._kv_fetch_once(peer, donor, model, keys, trace_id),
-                timeout)
-        except Exception as e:
-            dt = time.monotonic_ns() - t0
+        payload, err = None, None
+        for attempt in range(2):
+            budget = deadline - time.monotonic()
+            if budget <= 0:
+                break
+            try:
+                payload = await asyncio.wait_for(
+                    self._kv_fetch_once(peer, donor, model, keys, trace_id),
+                    budget)
+                err = None
+                break
+            except Exception as e:
+                err = e
+                if attempt:
+                    break
+                # Decorrelated jitter; skip the retry when the backoff
+                # would eat what's left of the budget.
+                backoff = random.uniform(0.05, 0.15)
+                if deadline - time.monotonic() <= backoff:
+                    break
+                if mx is not None:
+                    mx.kv_ship_inc("retries")
+                log.warning("kv fetch from %s failed (%s); retrying in "
+                            "%.0f ms", donor, e, backoff * 1e3)
+                await asyncio.sleep(backoff)
+        dt = time.monotonic_ns() - t0
+        if err is not None:
             if mx is not None:
                 mx.kv_ship_inc("fetches")
                 mx.kv_ship_inc("fallbacks")
                 mx.kv_fetch_seconds.observe(dt / 1e9)
             log.warning("kv fetch from %s failed (%s); plain prefill",
-                        donor, e)
+                        donor, err)
+            _account_replay(covered)
             return None, dt
-        dt = time.monotonic_ns() - t0
         if mx is not None:
             mx.kv_ship_inc("fetches")
             mx.kv_fetch_seconds.observe(dt / 1e9)
         if payload is None:
             if mx is not None:
                 mx.kv_ship_inc("fallbacks")
+            _account_replay(covered)
             return None, dt
         if mx is not None:
             mx.kv_ship_inc("bytes", payload.get("bytes", 0))
+        # The donor's pages cover keys[:n] from the start of the chain —
+        # a superset or subset of the local coverage, never disjoint.
+        _account_replay(max(covered, len(payload.get("keys", ()))))
         return payload, dt
 
     async def _kv_fetch_once(self, peer, donor: str, model: str,
@@ -726,6 +857,7 @@ class JaxEngine(Engine):
         repeat_penalty: float = 1.0,
         kv_donor: str = "",
         kv_trace: str = "",
+        migrate: bool = False,
     ) -> AsyncIterator[Chunk]:
         from crowdllama_tpu.engine.scheduler import DONE, GenRequest
 
@@ -738,7 +870,8 @@ class JaxEngine(Engine):
         kv_import, kv_ns = None, 0
         if kv_donor:
             kv_import, kv_ns = await self._fetch_kv_payload(
-                kv_donor, model, prompt_ids, trace_id=kv_trace)
+                kv_donor, model, prompt_ids, trace_id=kv_trace,
+                migrate=migrate)
         req = GenRequest(
             prompt_ids=prompt_ids,
             max_tokens=max_tokens,
@@ -864,12 +997,22 @@ class FakeEngine(Engine):
         self.models = models or ["tiny-test"]
         self.delay = delay
         self.calls = 0
+        # Live-migration test double: migrate() flips the flag and every
+        # active generator retires with "migrate" at its next yield point
+        # — the cheap path for exercising the gateway's migration handling
+        # without a real scheduler.
+        self._migrating = False
+        self._active = 0
 
     async def start(self) -> None:
         return
 
     async def stop(self) -> None:
         return
+
+    async def migrate(self) -> int:
+        self._migrating = True
+        return self._active
 
     def describe(self) -> dict:
         return {"models": self.models, "throughput": 100.0, "load": 0.1}
@@ -881,24 +1024,33 @@ class FakeEngine(Engine):
         repeat_penalty: float = 1.0,
     ) -> AsyncIterator[Chunk]:
         self.calls += 1
-        if self.delay:
-            await asyncio.sleep(self.delay)
-        matcher = StopMatcher(stop)
-        words = f"echo: {prompt}".split(" ")
-        emitted = 0
-        stopped = False
-        for i, w in enumerate(words):
-            emit, stopped = matcher.feed(w + ("" if i == len(words) - 1
-                                              else " "))
-            if emit:
-                yield Chunk(text=emit)
-                emitted += 1
-            if stopped:
-                break
-        yield Chunk(text="" if stopped else matcher.flush(), done=True,
-                    done_reason="stop",
-                    prompt_tokens=len(prompt.split()),
-                    completion_tokens=max(emitted, 1))
+        self._active += 1
+        try:
+            if self.delay:
+                await asyncio.sleep(self.delay)
+            matcher = StopMatcher(stop)
+            words = f"echo: {prompt}".split(" ")
+            emitted = 0
+            stopped = False
+            for i, w in enumerate(words):
+                if self._migrating:
+                    yield Chunk(text="", done=True, done_reason="migrate",
+                                prompt_tokens=len(prompt.split()),
+                                completion_tokens=max(emitted, 1))
+                    return
+                emit, stopped = matcher.feed(w + ("" if i == len(words) - 1
+                                                  else " "))
+                if emit:
+                    yield Chunk(text=emit)
+                    emitted += 1
+                if stopped:
+                    break
+            yield Chunk(text="" if stopped else matcher.flush(), done=True,
+                        done_reason="stop",
+                        prompt_tokens=len(prompt.split()),
+                        completion_tokens=max(emitted, 1))
+        finally:
+            self._active -= 1
 
     async def embed(self, texts: list[str], model: str = "",
                     truncate: bool = True) -> tuple[list[list[float]], int]:

@@ -40,6 +40,21 @@ class MultiEngine(Engine):
             self._engines[name] = JaxEngine(child_cfg)
         self.models = names
         self._peer = None
+        self._obs = None
+
+    # The peer hands its NodeObs to `engine.obs`; the children do the
+    # actual serving, so the handle must fan out or every child-side
+    # counter (kv_ship, replayed_prefill, migrated_slots, fetch
+    # latency) silently stays zero on multi-model CLI workers.
+    @property
+    def obs(self):
+        return self._obs
+
+    @obs.setter
+    def obs(self, value) -> None:
+        self._obs = value
+        for eng in self._engines.values():
+            eng.obs = value
 
     def _child(self, model: str) -> JaxEngine:
         if not model:
@@ -80,6 +95,11 @@ class MultiEngine(Engine):
             *(e.drain(timeout) for e in self._engines.values()))
         return all(results)
 
+    async def migrate(self) -> int:
+        moved = await asyncio.gather(
+            *(e.migrate() for e in self._engines.values()))
+        return sum(moved)
+
     def attach_peer(self, peer) -> None:
         self._peer = peer
         for eng in self._engines.values():
@@ -97,6 +117,7 @@ class MultiEngine(Engine):
         child_cfg = _dc_replace(self.config, model=name,
                                 model_path=path or self.config.model_path)
         eng = JaxEngine(child_cfg)
+        eng.obs = self._obs
         await eng.start()
         self._engines[name] = eng
         self.models = list(self._engines)
@@ -134,16 +155,21 @@ class MultiEngine(Engine):
     def _format_chat(self, messages: list[dict], model: str = "") -> str:
         return self._child(model)._format_chat(messages, model=model)
 
+    def _migrate_export_meta(self, req) -> tuple[list[bytes], int]:
+        eng = self._engines.get(req.model)
+        return eng._migrate_export_meta(req) if eng is not None else ([], 0)
+
     def generate(self, prompt: str, model: str = "", max_tokens: int = 128,
                  temperature: float = 0.0, top_p: float = 1.0, seed: int = 0,
                  stop: list[str] | None = None, top_k: int = 0,
                  repeat_penalty: float = 1.0, kv_donor: str = "",
-                 kv_trace: str = "") -> AsyncIterator[Chunk]:
+                 kv_trace: str = "", migrate: bool = False
+                 ) -> AsyncIterator[Chunk]:
         return self._child(model).generate(
             prompt, model=model, max_tokens=max_tokens,
             temperature=temperature, top_p=top_p, seed=seed, stop=stop,
             top_k=top_k, repeat_penalty=repeat_penalty, kv_donor=kv_donor,
-            kv_trace=kv_trace)
+            kv_trace=kv_trace, migrate=migrate)
 
     async def export_kv_pages(self, model: str, chain_hashes: list[bytes],
                               page_size: int) -> dict | None:

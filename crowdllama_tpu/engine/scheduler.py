@@ -143,6 +143,10 @@ class Scheduler:
         self._exclusive: list[tuple] = []
         self._to_release: list[int] = []
         self._draining = False
+        # Live migration (docs/ROBUSTNESS.md): a pending migrate() call —
+        # the loop resolves the future at its next safe point after
+        # retiring every admitted/queued request with reason "migrate".
+        self._migrating: "asyncio.Future | None" = None
         self._embeds = 0  # embedding forwards in flight on the executor
         # Requests whose output queues drain must also see consumed (the
         # consumer may still be flushing final frames to the client after
@@ -282,6 +286,58 @@ class Scheduler:
             if time.monotonic() >= deadline:
                 return False
             await asyncio.sleep(0.1)
+
+    async def migrate(self) -> int:
+        """Hand off every admitted and queued request for live migration
+        (graceful drain, docs/ROBUSTNESS.md); returns how many were moved.
+
+        Enters draining (new submits are rejected), then retires every
+        request — active slots, the in-progress chunked admission,
+        deferred long prompts, and the pending queue — with a
+        ``"migrate"`` terminal reason at the decode loop's next safe
+        point (between device dispatches, so no program is reading the
+        slots being cleared).  Released slots return their pages through
+        the runner's prefix cache, so this worker keeps serving them to
+        the successor as a KV donor until the drain deadline.
+        """
+        self._draining = True
+        if self._task is None:
+            # Loop not running (unit tests drive the runner directly):
+            # nothing can be in flight, process immediately.
+            return self._migrate_now()
+        fut = asyncio.get_running_loop().create_future()
+        self._migrating = fut
+        self._wake.set()
+        return await fut
+
+    def _migrate_now(self) -> int:
+        """Synchronous migration body; only safe between dispatches (the
+        loop's safe point, or with no loop running)."""
+        moved = 0
+        if self._chunking is not None:
+            req, slot, job = self._chunking
+            self._chunking = None
+            self._admitting -= 1
+            self.slots[slot] = None  # release the _RESERVED slot
+            abort = getattr(self.runner, "prefill_abort", None)
+            if abort is not None:
+                abort(job)
+            req.out.put_nowait((_DONE, "migrate"))
+            moved += 1
+        for i, info in enumerate(self.slots):
+            if isinstance(info, _SlotInfo):
+                self.slots[i] = None
+                self.state = self.runner.release(self.state, i)
+                self.requests_served += 1
+                info.req.out.put_nowait((_DONE, "migrate"))
+                moved += 1
+        while self._deferred:
+            self._deferred.popleft().out.put_nowait((_DONE, "migrate"))
+            moved += 1
+        while not self.pending.empty():
+            self.pending.get_nowait().out.put_nowait((_DONE, "migrate"))
+            moved += 1
+        return moved
 
     async def run_exclusive(self, fn):
         """Run ``fn(state) -> result`` on the dispatch executor at the
@@ -542,6 +598,12 @@ class Scheduler:
                 while not self.pending.empty():
                     self.pending.get_nowait().out.put_nowait(
                         (_DONE, "error: engine failure"))
+                if self._migrating is not None:
+                    # A pending migrate() must not hang on engine failure;
+                    # everything above was failed, nothing left to move.
+                    fut, self._migrating = self._migrating, None
+                    if not fut.cancelled():
+                        fut.set_result(0)
                 self._to_release.clear()  # init_state replaces it all
                 self.state = await asyncio.get_running_loop(
                 ).run_in_executor(self._exec, self.runner.init_state)
@@ -551,7 +613,8 @@ class Scheduler:
         # in-progress chunked admission is work).
         if (all(s is None for s in self.slots) and self.pending.empty()
                 and self._inflight is None and self._chunking is None
-                and not self._deferred and not self._exclusive):
+                and not self._deferred and not self._exclusive
+                and self._migrating is None):
             self._wake.clear()
             await self._wake.wait()
 
@@ -565,6 +628,42 @@ class Scheduler:
                 self.state = await loop_.run_in_executor(
                     self._exec, self.runner.release, self.state, i)
                 self.requests_served += 1
+
+        # Live migration (migrate()): retire everything with "migrate" at
+        # this safe point.  Slots clear BEFORE the in-flight chunk is read
+        # back, so _retire_inflight's identity check drops its undelivered
+        # tokens — the successor replays decode from the prompt anyway.
+        # Release goes through the executor like every device call; freed
+        # pages land in the runner's prefix cache for KV export.
+        if self._migrating is not None:
+            fut, self._migrating = self._migrating, None
+            moved = 0
+            if self._chunking is not None:
+                req, slot, job = self._chunking
+                self._chunking = None
+                self._admitting -= 1
+                self.slots[slot] = None  # release the _RESERVED slot
+                abort = getattr(self.runner, "prefill_abort", None)
+                if abort is not None:
+                    await loop_.run_in_executor(self._exec, abort, job)
+                req.out.put_nowait((_DONE, "migrate"))
+                moved += 1
+            for i, info in enumerate(self.slots):
+                if isinstance(info, _SlotInfo):
+                    self.slots[i] = None
+                    self.state = await loop_.run_in_executor(
+                        self._exec, self.runner.release, self.state, i)
+                    self.requests_served += 1
+                    info.req.out.put_nowait((_DONE, "migrate"))
+                    moved += 1
+            while self._deferred:
+                self._deferred.popleft().out.put_nowait((_DONE, "migrate"))
+                moved += 1
+            while not self.pending.empty():
+                self.pending.get_nowait().out.put_nowait((_DONE, "migrate"))
+                moved += 1
+            if not fut.cancelled():
+                fut.set_result(moved)
 
         # Exclusive runner access (run_exclusive): no dispatch is queued on
         # the executor right now, so fn reads a live, undonated state.  A

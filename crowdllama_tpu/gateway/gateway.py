@@ -71,6 +71,25 @@ class _BudgetExhausted(Exception):
     """The request's wall-clock budget expired (pre- or mid-stream)."""
 
 
+class _WorkerDraining(Exception):
+    """The worker announced it is draining: either it rejected the request
+    up front (typed ``draining`` terminal frame) or it handed off an
+    in-flight stream with a MigrateFrame.  _route treats this as a
+    MIGRATION, not a failure: the drained worker is quarantined from the
+    routing snapshot but attached to the retry as a KV donor with
+    ``migrate=True``, so the successor imports the prompt's pages instead
+    of re-running prefill (docs/ROBUSTNESS.md)."""
+
+    def __init__(self, worker_id: str, migrated: bool = False,
+                 delivered_tokens: int = 0):
+        super().__init__(
+            f"worker {worker_id[:8]} draining"
+            + (" (mid-stream handoff)" if migrated else ""))
+        self.worker_id = worker_id
+        self.migrated = migrated  # True: MigrateFrame, stream was in flight
+        self.delivered_tokens = delivered_tokens
+
+
 class _StreamCtx:
     """Client-side state of ONE streamed response, surviving failover.
 
@@ -210,11 +229,25 @@ class Gateway:
         self._affinity: OrderedDict[str, tuple[str, float]] = OrderedDict()
         self._affinity_hits = 0
         self._affinity_evicted = 0
+        self._affinity_repointed = 0
         self._kv_hints = 0
 
     # ----------------------------------------------------------- lifecycle
 
     async def start(self) -> None:
+        pm = self.peer.peer_manager
+        if pm is not None:
+            # Affinity hygiene rides the manager's eviction hook — CHAINED,
+            # not replaced: the DHT's provider-store eviction (net/dht.py)
+            # may have registered first and must keep firing.
+            prev = pm.on_peer_removed
+
+            def _on_removed(peer_id: str) -> None:
+                if prev is not None:
+                    prev(peer_id)
+                self._affinity_drop_worker(peer_id)
+
+            pm.on_peer_removed = _on_removed
         self._runner = web.AppRunner(self.app, access_log=None)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
@@ -792,6 +825,11 @@ class Gateway:
         lines.append(
             f"crowdllama_gateway_affinity_evicted_total "
             f"{self._affinity_evicted}")
+        lines.append(
+            "# TYPE crowdllama_gateway_affinity_repointed_total counter")
+        lines.append(
+            f"crowdllama_gateway_affinity_repointed_total "
+            f"{self._affinity_repointed}")
         lines.append("# TYPE crowdllama_gateway_kv_hints_total counter")
         lines.append(
             f"crowdllama_gateway_kv_hints_total {self._kv_hints}")
@@ -1102,6 +1140,23 @@ class Gateway:
         self._affinity[akey] = (worker_id, time.monotonic())
         self._affinity.move_to_end(akey)
 
+    def _affinity_drop_worker(self, worker_id: str,
+                              successor: str = "") -> None:
+        """Affinity hygiene on drain/removal: entries pinned to a worker
+        that is leaving either re-point to its migration successor (whose
+        cache holds the imported pages) or evict outright — a stale pin
+        would burn a routing attempt per continuation until its TTL."""
+        if not worker_id:
+            return
+        now = time.monotonic()
+        for akey in [k for k, v in self._affinity.items()
+                     if v[0] == worker_id]:
+            if successor:
+                self._affinity[akey] = (successor, now)
+                self._affinity_repointed += 1
+            else:
+                del self._affinity[akey]
+
     def _kv_donor_for(self, akey: str | None, model: str,
                       chosen_worker: str) -> str:
         """Donor hint for a continuation that is NOT landing on its
@@ -1202,7 +1257,16 @@ class Gateway:
                 + time.perf_counter_ns() - tr
             tried: set[str] = set()
             last_err = "no workers available for model"
-            for _attempt in range(2):  # retry once on next-best worker
+            attempt = 0
+            max_attempts = 2  # retry once on next-best worker
+            # Live migration (docs/ROBUSTNESS.md): a worker that announced
+            # drain becomes the successor's KV donor, and the handoff is
+            # granted ONE extra attempt beyond the ordinary retry budget.
+            forced_donor = ""
+            drained_worker = ""
+            drain_extra_granted = False
+            while attempt < max_attempts:
+                attempt += 1
                 now = time.monotonic()
                 if now >= deadline:
                     budget_out = True
@@ -1228,7 +1292,15 @@ class Gateway:
                 # prefix's pages instead of recomputing them.  Reset per
                 # attempt — a failover target may BE the donor.
                 msg.generate_request.kv_donor = ""
-                if continuation and not used_affinity:
+                msg.generate_request.migrate = False
+                if forced_donor and forced_donor != worker.peer_id:
+                    # MIGRATION: the drained worker stays alive as a KV
+                    # donor through its drain window, so the successor
+                    # fetches the prompt's pages instead of re-running
+                    # prefill (fetch-instead-of-recompute).
+                    msg.generate_request.kv_donor = forced_donor
+                    msg.generate_request.migrate = True
+                elif continuation and not used_affinity:
                     donor = self._kv_donor_for(akey, model, worker.peer_id)
                     if donor:
                         msg.generate_request.kv_donor = donor
@@ -1258,6 +1330,12 @@ class Gateway:
                                                stream, shape, t0, acc=acc,
                                                ctx=sctx, deadline=deadline)
                     self._affinity_put(akey, worker.peer_id)
+                    if drained_worker and drained_worker != worker.peer_id:
+                        # Every conversation pinned to the drained worker
+                        # re-points to the successor that absorbed the
+                        # handoff (satellite: affinity hygiene).
+                        self._affinity_drop_worker(drained_worker,
+                                                   successor=worker.peer_id)
                     if used_affinity:
                         # Counted only when the pinned route actually
                         # served: a failed forward falls back to scoring
@@ -1284,6 +1362,35 @@ class Gateway:
                     last_err = str(e) or "request budget exhausted"
                     budget_out = True
                     break
+                except _WorkerDraining as e:
+                    # A drain is a deliberate handoff, not a failure:
+                    # quarantine the worker from routing immediately (epoch
+                    # bump derails other in-flight routing at the snapshot),
+                    # grant the handoff one extra attempt, and carry the
+                    # drained worker forward as the successor's KV donor.
+                    last_err = str(e)
+                    pm = self.peer.peer_manager
+                    mark = getattr(pm, "mark_draining", None)
+                    if mark is not None:
+                        mark(e.worker_id)
+                    forced_donor = e.worker_id
+                    drained_worker = e.worker_id
+                    if not drain_extra_granted:
+                        drain_extra_granted = True
+                        max_attempts += 1
+                    if e.migrated:
+                        self.obs.metrics.migrated_streams += 1
+                    self.obs.trace.record(
+                        tid, "migrate", 0, parent=GATEWAY_ROOT_SPAN,
+                        from_worker=e.worker_id[:8],
+                        mid_stream=e.migrated,
+                        delivered_tokens=e.delivered_tokens)
+                    prev_worker = e.worker_id
+                    died_at = time.monotonic()
+                    log.info(
+                        "worker %s draining; re-routing with KV handoff "
+                        "(mid_stream=%s, delivered_tokens=%d)",
+                        e.worker_id[:8], e.migrated, e.delivered_tokens)
                 except Exception as e:
                     # Worker-side failure (pre- OR mid-stream): eligible
                     # for retry/failover on the next-best worker.
@@ -1427,10 +1534,23 @@ class Gateway:
                 return d
             return self._ollama_json(resp, shape == "chat", final=final)
 
+        def classify(raw):
+            """Decode one inference-stream frame, surfacing drain/handoff
+            frames as _WorkerDraining so _route re-routes with the drained
+            worker attached as KV donor (checked BEFORE the generate
+            extraction: a MigrateFrame is a different oneof arm)."""
+            if raw.WhichOneof("message") == "migrate_frame":
+                mf = raw.migrate_frame
+                raise _WorkerDraining(worker_id, migrated=True,
+                                      delivered_tokens=mf.delivered_tokens)
+            resp = extract_generate_response(raw)
+            if resp.done and resp.done_reason == "draining":
+                raise _WorkerDraining(worker_id)
+            return resp
+
         if not stream:
-            reply = await self._roundtrip(worker_id, msg,
-                                          timeout=_recv_timeout(), acc=acc)
-            resp = extract_generate_response(reply)
+            resp = classify(await self._roundtrip(
+                worker_id, msg, timeout=_recv_timeout(), acc=acc))
             if resp.done_reason == "error":
                 raise RuntimeError(resp.response)
             return web.json_response(render(resp, final=True))
@@ -1448,9 +1568,11 @@ class Gateway:
         if s is not None:
             try:
                 await self._send_frame(s, frame, acc=acc)
-                first = extract_generate_response(
+                first = classify(
                     await self._recv_pb(s, timeout=_recv_timeout(), acc=acc))
-            except asyncio.CancelledError:
+            except (asyncio.CancelledError, _WorkerDraining):
+                # A draining reject is a DELIBERATE answer, not a stale
+                # pooled stream: no redial (it would get the same reject).
                 s.close()
                 raise
             except Exception as e:
@@ -1467,7 +1589,7 @@ class Gateway:
                                           if deadline is not None else None))
             try:
                 await self._send_frame(s, frame, acc=acc)
-                first = extract_generate_response(
+                first = classify(
                     await self._recv_pb(s, timeout=_recv_timeout(), acc=acc))
             except BaseException as e:
                 s.close()
@@ -1552,7 +1674,7 @@ class Gateway:
                 if remaining() <= 0:
                     raise _BudgetExhausted("budget exhausted mid-stream")
                 try:
-                    resp = extract_generate_response(
+                    resp = classify(
                         await self._recv_pb(s, timeout=_recv_timeout(),
                                             acc=acc))
                 except asyncio.TimeoutError as e:

@@ -50,6 +50,10 @@ class ObsServer:
         self.app = web.Application()
         self.app.router.add_get("/metrics", self.handle_metrics)
         self.app.router.add_get("/debug/trace", self.handle_trace)
+        # Operator drain hook (docs/ROBUSTNESS.md): same graceful path as
+        # SIGTERM, for orchestrators that reach workers over HTTP (e.g.
+        # a preStop hook) instead of signaling the process.
+        self.app.router.add_post("/drain", self.handle_drain)
 
     async def start(self) -> None:
         self._runner = web.AppRunner(self.app, access_log=None)
@@ -80,3 +84,16 @@ class ObsServer:
 
     async def handle_trace(self, request: web.Request) -> web.Response:
         return web.json_response(self.peer.obs.trace.snapshot())
+
+    async def handle_drain(self, request: web.Request) -> web.Response:
+        drain = getattr(self.peer, "drain", None)
+        if drain is None:
+            return web.json_response(
+                {"error": "peer does not support drain"}, status=501)
+        already = bool(getattr(self.peer, "_draining", False))
+        migrated = await drain()
+        return web.json_response({
+            "draining": True,
+            "already_draining": already,
+            "migrated_streams": migrated,
+        })

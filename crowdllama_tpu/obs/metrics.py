@@ -207,10 +207,26 @@ class NodeMetrics:
         # families).  Part of NodeMetrics so every node — gateway included —
         # exposes the series at zero rather than absent.
         self.kv_fetch_seconds = Histogram(TTFT_BUCKETS)
-        self.kv_ship = {"bytes": 0, "fetches": 0, "fallbacks": 0}
+        self.kv_ship = {"bytes": 0, "fetches": 0, "fallbacks": 0,
+                        "retries": 0}
+        # Graceful drain + live migration (docs/ROBUSTNESS.md): drain_*
+        # count control-plane events on the node that drained; the two
+        # flat families count the request plane's view of migration —
+        # migrated_streams on whichever side moved a stream (the gateway
+        # re-routing it, the worker handing it off),
+        # replayed_prefill_tokens on the successor worker: prompt tokens a
+        # migrate-flagged request recomputed even though the donor could
+        # have served them (0 == the KV handoff was complete).
+        self.drain = {"initiated": 0, "migrated_slots": 0,
+                      "rejected_requests": 0}
+        self.migrated_streams = 0
+        self.replayed_prefill_tokens = 0
 
     def kv_ship_inc(self, key: str, n: int = 1) -> None:
         self.kv_ship[key] = self.kv_ship.get(key, 0) + int(n)
+
+    def drain_inc(self, key: str, n: int = 1) -> None:
+        self.drain[key] = self.drain.get(key, 0) + int(n)
 
     def expose(self) -> list[str]:
         out = self.request_seconds.expose("crowdllama_request_seconds")
@@ -219,12 +235,22 @@ class NodeMetrics:
         out.append("# TYPE crowdllama_decode_step_seconds histogram")
         out.extend(self.decode_step_seconds.lines(
             "crowdllama_decode_step_seconds"))
-        for key in ("bytes", "fetches", "fallbacks"):
+        for key in ("bytes", "fetches", "fallbacks", "retries"):
             name = f"crowdllama_kv_ship_{key}_total"
             out.append(f"# TYPE {name} counter")
             out.append(f"{name} {self.kv_ship.get(key, 0)}")
         out.append("# TYPE crowdllama_kv_fetch_seconds histogram")
         out.extend(self.kv_fetch_seconds.lines("crowdllama_kv_fetch_seconds"))
+        for key in ("initiated", "migrated_slots", "rejected_requests"):
+            name = f"crowdllama_drain_{key}_total"
+            out.append(f"# TYPE {name} counter")
+            out.append(f"{name} {self.drain.get(key, 0)}")
+        out.append("# TYPE crowdllama_migrated_streams_total counter")
+        out.append(f"crowdllama_migrated_streams_total "
+                   f"{self.migrated_streams}")
+        out.append("# TYPE crowdllama_replayed_prefill_tokens_total counter")
+        out.append(f"crowdllama_replayed_prefill_tokens_total "
+                   f"{self.replayed_prefill_tokens}")
         return out
 
 

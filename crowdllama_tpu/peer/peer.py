@@ -130,6 +130,7 @@ class Peer:
         self._tasks: list[asyncio.Task] = []
         self.relay_client = None  # net/relay.py RelayClient when relaying
         self.relay_service = None  # RelayService when hosting one (public)
+        self._draining = False  # graceful drain entered (docs/ROBUSTNESS.md)
         # Per-node observability plane (trace ring + histograms): served by
         # obs/http.ObsServer on workers, read directly by tests/benches.
         self.obs = NodeObs(
@@ -449,8 +450,70 @@ class Peer:
         await add(model, str(dest))
         return str(dest)
 
+    async def drain(self) -> int:
+        """Graceful drain (docs/ROBUSTNESS.md): flip this peer to the
+        ``draining`` state and hand off in-flight generation.
+
+        Idempotent (SIGTERM and POST /drain may race).  Order matters:
+
+        1. advertised metadata flips to ``draining: true`` — gateways that
+           re-probe quarantine us from routing snapshots;
+        2. the publish/advertise loops stop and ONE forced metadata
+           provide goes out, so the swarm learns about the drain now
+           rather than at the next reprovide tick;
+        3. the engine migrates every in-flight request — each stream gets
+           a MigrateFrame and the gateway re-routes it with this worker
+           attached as KV donor.
+
+        New GenerateRequests are rejected with a ``draining`` terminal
+        frame from here on, but the serve loops STAY UP: this node keeps
+        answering KvFetchRequests (the donor role) until the process
+        exits at drain_timeout.  Returns how many requests were migrated.
+        """
+        if self._draining:
+            return 0
+        self._draining = True
+        self.resource.draining = True
+        self.resource.touch()
+        if self.obs is not None:
+            self.obs.metrics.drain_inc("initiated")
+        t0 = time.perf_counter_ns()
+        await self.stop_advertising()
+        if self.dht is not None and self.host is not None:
+            try:
+                await self.dht.reconnect_if_needed()
+                # min_interval=0 forces the network provide NOW — the
+                # stale record from the serving era must not outlive the
+                # streams it would route here.
+                await asyncio.wait_for(
+                    self.dht.provide(metadata_key(self.host.peer_id.encode()),
+                                     min_interval=0), timeout=5.0)
+            except Exception as e:
+                log.warning("drain metadata publish failed: %s", e)
+        migrated = await self.engine.migrate()
+        if self.obs is not None:
+            self.obs.trace.record(
+                f"drain-{self.peer_id[:8]}", "drain",
+                time.perf_counter_ns() - t0, migrated=migrated)
+        log.info("peer %s draining: %d in-flight requests migrated",
+                 self.peer_id[:8], migrated)
+        return migrated
+
     async def stop(self) -> None:
         await self.stop_advertising()
+        # Departure publish BEFORE tearing down relay + inference streams:
+        # peers that re-probe metadata during the teardown window see
+        # draining=true and deroute instead of racing dead streams
+        # (regression-tested in tests/test_churn.py).
+        if self.dht is not None and self.host is not None:
+            self.resource.draining = True
+            self.resource.touch()
+            try:
+                await asyncio.wait_for(
+                    self.dht.provide(metadata_key(self.host.peer_id.encode()),
+                                     min_interval=0), timeout=2.0)
+            except Exception as e:
+                log.debug("departure publish failed: %s", e)
         if self.relay_client is not None:
             await self.relay_client.stop()
             self.relay_client = None
@@ -580,6 +643,24 @@ class Peer:
             req = msg.generate_request
             if which != "generate_request":
                 raise ValueError("expected GenerateRequest")
+            if self._draining:
+                # Typed reject (docs/ROBUSTNESS.md): a draining worker
+                # takes no NEW generation — the gateway fails over without
+                # burning its failover budget on us — but the stream stays
+                # open: we keep serving KvFetchRequests as a migration
+                # donor until drain_timeout.
+                from crowdllama_tpu.core.messages import (
+                    create_generate_response,
+                )
+
+                if self.obs is not None:
+                    self.obs.metrics.drain_inc("rejected_requests")
+                reject = create_generate_response(
+                    model=req.model, response="", worker_id=self.peer_id,
+                    done=True, done_reason="draining")
+                reject.trace_id = tid
+                await wire.write_length_prefixed_pb(stream.writer, reject)
+                return True
             if req.stream:
                 flush_ns = 0
                 async for frame in self.engine.handle_streaming(msg, worker_id=self.peer_id):
@@ -664,6 +745,11 @@ class Peer:
 
         req = msg.kv_fetch_request
         tid = msg.trace_id
+        # Chaos choke point (testing/faults.py): a donor hiccup here is
+        # what the fetcher's retry/deadline handling defends against.
+        from crowdllama_tpu.testing import faults
+
+        await faults.inject("kv.serve", worker=self.peer_id, model=req.model)
         t0 = time.perf_counter_ns()
         try:
             payload = await asyncio.wait_for(

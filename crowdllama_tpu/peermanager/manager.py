@@ -190,6 +190,22 @@ class PeerManager:
         if info is not None:
             info.last_seen = time.monotonic()
 
+    def mark_draining(self, peer_id: str) -> bool:
+        """Quarantine ``peer_id`` from routing IMMEDIATELY (epoch bump).
+
+        Called by the gateway the moment it sees a MigrateFrame or a
+        ``draining`` reject — metadata propagation (the drained worker's
+        final publish + our next health probe) confirms it within an
+        interval, but new requests must stop landing on the worker NOW,
+        not a probe later.  The peer stays in the table (healthy, still a
+        KV donor); only the routing snapshot excludes it."""
+        info = self.peers.get(peer_id)
+        if info is None or getattr(info.resource, "draining", False):
+            return False
+        info.resource.draining = True
+        self._bump_routing_epoch()
+        return True
+
     # -------------------------------------------------------------- queries
 
     def get_peer(self, peer_id: str) -> PeerInfo | None:
@@ -236,6 +252,11 @@ class PeerManager:
             if not p.is_healthy or not p.is_worker:
                 continue
             r = p.resource
+            # Draining workers are quarantined from NEW work but stay in
+            # the table: they keep serving KV fetches for the streams that
+            # migrated off them (docs/ROBUSTNESS.md).
+            if getattr(r, "draining", False):
+                continue
             if model and model not in r.supported_models:
                 continue
             sg = r.shard_group

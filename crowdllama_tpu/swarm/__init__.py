@@ -1,0 +1,21 @@
+"""Swarm-level control loops (elastic drain/scale, docs/ROBUSTNESS.md)."""
+
+from crowdllama_tpu.swarm.autoscale import (
+    AutoscaleConfig,
+    AutoscaleController,
+    Decision,
+    Sample,
+    parse_gauges,
+    pick_drain_candidate,
+    simulate,
+)
+
+__all__ = [
+    "AutoscaleConfig",
+    "AutoscaleController",
+    "Decision",
+    "Sample",
+    "parse_gauges",
+    "pick_drain_candidate",
+    "simulate",
+]

@@ -24,6 +24,9 @@ site                  attrs / where
 ``kv.fetch``          before a worker dials a KV-page donor
                       (engine/engine.py ``_kv_fetch_once``): ``worker``,
                       ``donor``
+``kv.serve``          donor side, before a KvFetchRequest is served
+                      (peer.py ``_serve_kv_fetch``): ``worker`` (the
+                      donor), ``model``
 ====================  =====================================================
 
 Actions:
@@ -35,6 +38,11 @@ Actions:
   frame, which is exactly what a crashed worker process looks like from
   the gateway (mid-stream EOF) — the trigger for mid-stream failover.
 - ``"delay"`` — ``asyncio.sleep(delay_s + seeded jitter)`` then continue.
+- ``"drain"`` — raise :class:`DrainRequested`.  Only meaningful at
+  ``engine.stream_chunk``: the worker reacts by starting its own graceful
+  drain (as if SIGTERM / POST /drain arrived mid-stream) and the stream
+  continues until the scheduler hands it off with a MigrateFrame — the
+  chaos trigger for live request migration (docs/ROBUSTNESS.md).
 
 Usage::
 
@@ -64,6 +72,12 @@ class KillStream(FaultError):
     no error frame, so the peer observes an unexplained EOF."""
 
 
+class DrainRequested(FaultError):
+    """Injected graceful drain: the worker catching it starts its own
+    drain (equivalent to SIGTERM / POST /drain landing mid-stream) and
+    keeps streaming until the scheduler migrates the request."""
+
+
 @dataclass
 class FaultRule:
     """One deterministic trigger: fires at pass index >= ``after`` through
@@ -71,7 +85,7 @@ class FaultRule:
     most ``times`` times (0 = unlimited)."""
 
     site: str
-    action: str = "error"  # "error" | "kill_stream" | "delay"
+    action: str = "error"  # "error" | "kill_stream" | "delay" | "drain"
     match: dict = field(default_factory=dict)
     after: int = 0
     times: int = 1
@@ -124,6 +138,8 @@ class FaultPlan:
                 await asyncio.sleep(rule.delay_s + jitter)
             elif rule.action == "kill_stream":
                 raise KillStream(f"{rule.message} @ {site}")
+            elif rule.action == "drain":
+                raise DrainRequested(f"{rule.message} @ {site}")
             else:
                 raise FaultError(f"{rule.message} @ {site}")
 

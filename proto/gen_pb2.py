@@ -69,12 +69,19 @@ def extract_serialized(src: str) -> bytes:
 
 
 def apply_schema_edits(fdp: descriptor_pb2.FileDescriptorProto) -> None:
-    """PR 5: peer-to-peer paged-KV shipping messages."""
+    """PR 5: peer-to-peer paged-KV shipping messages.
+    PR 6: live request migration (graceful drain)."""
     # GenerateRequest.kv_donor: peer id of a worker believed to hold this
     # conversation's prefix KV hot (gateway affinity memory).  Proto3
     # back-compat: absent == "" == no hint.
     (gen_req,) = [m for m in fdp.message_type if m.name == "GenerateRequest"]
     _ensure_field(gen_req, _field("kv_donor", 12, STR))
+    # GenerateRequest.migrate: this request is the gateway's re-route of a
+    # stream a draining worker handed back (docs/ROBUSTNESS.md drain
+    # machine).  The serving worker treats the kv_donor fetch as mandatory
+    # recovery (bypasses the kv-ship opt-in + min-token gates) and accounts
+    # recomputed prefill under replayed_prefill_tokens.  Absent == false.
+    _ensure_field(gen_req, _field("migrate", 13, BOOL))
 
     kv_fetch = descriptor_pb2.DescriptorProto(name="KvFetchRequest")
     _ensure_field(kv_fetch, _field("model", 1, STR))
@@ -95,12 +102,30 @@ def apply_schema_edits(fdp: descriptor_pb2.FileDescriptorProto) -> None:
     _ensure_field(kv_pages, _field("error", 10, STR))
     _ensure_message(fdp, kv_pages)
 
+    # MigrateFrame: a draining worker's mid-stream handoff.  Emitted in
+    # place of the terminal GenerateResponse on every in-flight stream when
+    # the worker drains; carries the generation state the gateway needs to
+    # re-route with fetch-instead-of-recompute (the worker itself stays
+    # alive as a KV donor until drain_timeout).
+    mig = descriptor_pb2.DescriptorProto(name="MigrateFrame")
+    _ensure_field(mig, _field("model", 1, STR))
+    _ensure_field(mig, _field("worker_id", 2, STR))
+    _ensure_field(mig, _field("delivered_tokens", 3, I32))
+    _ensure_field(mig, _field("prompt_tokens", 4, I32))
+    _ensure_field(mig, _field("chain_hashes", 5, BYTES, REP))
+    _ensure_field(mig, _field("page_size", 6, I32))
+    _ensure_field(mig, _field("reason", 7, STR))
+    _ensure_message(fdp, mig)
+
     (base,) = [m for m in fdp.message_type if m.name == "BaseMessage"]
     _ensure_field(base, _field("kv_fetch_request", 7, MSG,
                                type_name=".llama.v1.KvFetchRequest",
                                oneof_index=0))
     _ensure_field(base, _field("kv_pages", 8, MSG,
                                type_name=".llama.v1.KvPages",
+                               oneof_index=0))
+    _ensure_field(base, _field("migrate_frame", 9, MSG,
+                               type_name=".llama.v1.MigrateFrame",
                                oneof_index=0))
 
 

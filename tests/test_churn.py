@@ -110,3 +110,37 @@ async def test_churn_converges_and_dead_providers_evicted():
             await w.stop()
         await boot_dht.stop_maintenance()
         await boot_host.close()
+
+
+async def test_stop_publishes_departure_before_stream_teardown():
+    """Ordered shutdown (docs/ROBUSTNESS.md): Peer.stop() must publish the
+    draining departure record BEFORE tearing down relay/host streams, so a
+    peer that re-probes metadata during the teardown window sees
+    draining=true and deroutes instead of racing dead streams."""
+    boot_host, _ = await new_host_and_dht(
+        Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    bootstrap = f"127.0.0.1:{boot_host.listen_port}"
+    try:
+        w = await _worker(bootstrap)
+        order = []
+        real_provide = w.dht.provide
+        real_close = w.host.close
+
+        async def provide(*a, **kw):
+            order.append("provide")
+            return await real_provide(*a, **kw)
+
+        async def close(*a, **kw):
+            order.append("host_close")
+            return await real_close(*a, **kw)
+
+        w.dht.provide = provide
+        w.host.close = close
+        await w.stop()
+        assert "provide" in order, "no departure publish during stop()"
+        assert "host_close" in order
+        assert order.index("provide") < order.index("host_close")
+        # And the record it published said draining.
+        assert w.resource.draining is True
+    finally:
+        await boot_host.close()

@@ -87,3 +87,23 @@ async def test_delay_action_sleeps_and_logs():
     await plan.inject("s")
     await plan.inject("s")
     assert [a for (_, _, a) in plan.log] == ["delay", "delay"]
+
+
+async def test_drain_action_raises_drain_requested():
+    """The "drain" action (live-migration chaos trigger) raises the typed
+    DrainRequested — a control signal the engine's stream loop catches BY
+    TYPE (before the generic FaultError handling) to start a graceful
+    drain — and logs like any other action."""
+    plan = FaultPlan(rules=[
+        FaultRule(site="engine.stream_chunk", action="drain", after=1,
+                  times=1)])
+    await plan.inject("engine.stream_chunk", worker="w1", index=0)
+    with pytest.raises(faults.DrainRequested):
+        await plan.inject("engine.stream_chunk", worker="w1", index=1)
+    # times=1: spent; later chunks stream on undisturbed.
+    await plan.inject("engine.stream_chunk", worker="w1", index=2)
+    assert [(s, a) for (s, _, a) in plan.log] == [
+        ("engine.stream_chunk", "drain")]
+    # Part of the fault family (generic chaos tooling still counts it)
+    # but always catchable on its own ahead of FaultError.
+    assert issubclass(faults.DrainRequested, FaultError)

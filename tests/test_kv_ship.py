@@ -210,6 +210,9 @@ PROMPT = ("Swarm KV shipping turns prefix-affinity misses into paged "
           "page fetches instead of recomputing the prefill from scratch. "
           "This long shared prefix spans several pages so the fetch "
           "actually pays for its round trip.")
+PROMPT2 = ("A transient fetch error must be healed by one decorrelated "
+           "backoff retry inside the shipping budget, so this second "
+           "multi-page prompt imports its pages on the second attempt.")
 
 
 def _cfg(bootstrap, **kw):
@@ -228,13 +231,13 @@ def _cfg(bootstrap, **kw):
     return cfg
 
 
-async def _generate_text(engine, kv_donor=""):
+async def _generate_text(engine, kv_donor="", prompt=PROMPT):
     from crowdllama_tpu.core.messages import (
         create_generate_request,
         extract_generate_response,
     )
 
-    msg = create_generate_request(MODEL, PROMPT, max_tokens=8)
+    msg = create_generate_request(MODEL, prompt, max_tokens=8)
     msg.trace_id = "kvshiptrace0000"
     if kv_donor:
         msg.generate_request.kv_donor = kv_donor
@@ -296,10 +299,13 @@ async def test_kv_fetch_end_to_end_and_chaos_fallback():
         tr_a = peer_a.obs.trace.get("kvshiptrace0000")
         assert any(s["name"] == "kv_export" for s in tr_a["spans"]), tr_a
 
-        # C's fetch dies mid-dial (injected): plain prefill fallback must
-        # complete byte-identically and count as a fallback.
+        # C's fetch dies mid-dial on EVERY attempt (times=0 — a single
+        # kill would be absorbed by the in-budget retry): plain prefill
+        # fallback must complete byte-identically, count as a fallback,
+        # and count the burned retry.
         plan = faults.FaultPlan(seed=7, rules=[
-            faults.FaultRule(site="kv.fetch", action="kill_stream"),
+            faults.FaultRule(site="kv.fetch", action="kill_stream",
+                             times=0),
         ])
         with faults.installed(plan):
             text_c = await _generate_text(eng_c, kv_donor=peer_a.peer_id)
@@ -307,6 +313,25 @@ async def test_kv_fetch_end_to_end_and_chaos_fallback():
         assert text_c == text_a, (text_c, text_a)
         assert eng_c._runner.kv_pages_imported == 0
         assert eng_c.obs.metrics.kv_ship["fallbacks"] == 1
+        assert eng_c.obs.metrics.kv_ship["retries"] == 1
+
+        # A TRANSIENT fetch error (times=1) is healed by the backoff
+        # retry inside the kv_ship_timeout budget: pages import, decode
+        # matches, no fallback — only the retry counter moves.
+        plan2 = faults.FaultPlan(seed=8, rules=[
+            faults.FaultRule(site="kv.fetch", action="error", times=1),
+        ])
+        text_a2 = await _generate_text(eng_a, prompt=PROMPT2)  # cold serve
+        pages_b_before = eng_b._runner.kv_pages_imported
+        retries_before = eng_b.obs.metrics.kv_ship["retries"]
+        with faults.installed(plan2):
+            text_b2 = await _generate_text(
+                eng_b, kv_donor=peer_a.peer_id, prompt=PROMPT2)
+        assert len(plan2.log) == 1
+        assert text_b2 == text_a2, (text_b2, text_a2)
+        assert eng_b.obs.metrics.kv_ship["retries"] == retries_before + 1
+        assert eng_b.obs.metrics.kv_ship["fallbacks"] == 0
+        assert eng_b._runner.kv_pages_imported > pages_b_before
     finally:
         for p in peers:
             await p.stop()

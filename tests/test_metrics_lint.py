@@ -179,14 +179,24 @@ async def test_gateway_and_worker_metrics_lint():
                         "crowdllama_decode_step_seconds",
                         "crowdllama_kv_fetch_seconds"):
                 assert types.get(fam) == "histogram", f"{fam} missing"
-            for c in ("bytes", "fetches", "fallbacks"):
+            for c in ("bytes", "fetches", "fallbacks", "retries"):
                 fam = f"crowdllama_kv_ship_{c}_total"
+                assert types.get(fam) == "counter", f"{fam} missing"
+            # Live-migration families (docs/ROBUSTNESS.md) are swarm
+            # uniform too: drain counters on the worker that drains,
+            # migrated/replayed on whichever side moved the stream.
+            for c in ("initiated", "migrated_slots", "rejected_requests"):
+                fam = f"crowdllama_drain_{c}_total"
+                assert types.get(fam) == "counter", f"{fam} missing"
+            for fam in ("crowdllama_migrated_streams_total",
+                        "crowdllama_replayed_prefill_tokens_total"):
                 assert types.get(fam) == "counter", f"{fam} missing"
             for g in ("pending_depth", "active_slots", "batch_occupancy",
                       "kv_cache_utilization"):
                 assert types.get(f"crowdllama_engine_{g}") == "gauge"
         # Gateway-side routing counters for the KV-ship plane.
         for fam in ("crowdllama_gateway_affinity_evicted_total",
+                    "crowdllama_gateway_affinity_repointed_total",
                     "crowdllama_gateway_kv_hints_total"):
             assert gw_types.get(fam) == "counter", f"{fam} missing"
         # Traffic landed in BOTH sides' request histograms.
@@ -224,6 +234,25 @@ def test_spec_gauges_lint():
     for g in ("spec_steps", "spec_emitted", "spec_accept_echo",
               "spec_accept_gen", "spec_draft_len"):
         assert types.get(f"crowdllama_engine_{g}") == "gauge", g
+
+
+def test_multi_engine_fans_out_obs_to_children():
+    """Assigning `engine.obs` (peer.py does this at construction) must
+    reach the child engines — they do the serving, so a container-only
+    handle means kv_ship/replayed_prefill/migrated_slots counters stay
+    zero on every multi-model CLI worker."""
+    from crowdllama_tpu.engine.multi import MultiEngine
+
+    class _Child:
+        obs = None
+
+    me = MultiEngine.__new__(MultiEngine)
+    me._engines = {"a": _Child(), "b": _Child()}
+    me._obs = None
+    sentinel = object()
+    me.obs = sentinel
+    assert me.obs is sentinel
+    assert all(e.obs is sentinel for e in me._engines.values())
 
 
 def test_multi_engine_forwards_spec_gauges():
