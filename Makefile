@@ -4,7 +4,7 @@
 PY ?= python
 PYTEST = env JAX_PLATFORMS=cpu $(PY) -m pytest -p no:cacheprovider
 
-.PHONY: test tier1 chaos distill-smoke bench-kv
+.PHONY: test tier1 chaos chaos-multi-gateway distill-smoke bench-kv
 
 # Full suite (slow soaks included).  Runs the chaos matrix FIRST: the
 # fault-injection scenarios are the cheapest way to catch a request-
@@ -18,11 +18,20 @@ tier1:
 
 # Deterministic fault-injection matrix (docs/ROBUSTNESS.md): seeded
 # FaultPlans from crowdllama_tpu/testing/faults.py kill streams, fail
-# handshakes, exhaust budgets, and drain workers mid-stream; assertions
-# check the request plane heals (mid-stream failover, live migration
-# with KV handoff, 504 budgets, 503 shedding).
-chaos:
+# handshakes, exhaust budgets, drain workers mid-stream, and drop/delay/
+# partition gossip frames; assertions check the request plane heals
+# (mid-stream failover, live migration with KV handoff, 504 budgets,
+# jittered 503 shedding, gateway-crash failover across replicas).
+chaos: chaos-multi-gateway
 	$(PYTEST) tests/ -q -m chaos
+
+# Replicated-gateway slice of the matrix (tests/test_gossip.py): a
+# gateway replica killed mid-burst with survivors byte-identical plus
+# the gossiped-pin continuation, gossip convergence through a seeded
+# drop/delay/partition plan, and per-tenant shedding over HTTP.
+chaos-multi-gateway:
+	$(PYTEST) tests/test_gossip.py -q \
+		-k 'two_gateways or converges_under or tenant_quota_sheds'
 
 # Draft-distillation training tests (docs/SPECULATIVE.md): 30-step CPU
 # distillation smoke + native-checkpoint round-trip + the trained-draft

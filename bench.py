@@ -40,6 +40,9 @@ Phases (CROWDLLAMA_BENCH_PHASES to select, comma-separated):
   mini_swarm  REAL tiny engines behind the gateway on CPU — end-to-end
             tok/s + TTFT under concurrent load, with a FakeEngine
             control curve (VERDICT #5; subprocess, CPU)
+  multi_gateway  replicated gateway plane — req/s 1->4 replicas,
+            cross-replica affinity hit-rate through the gossip map, and
+            tenant isolation under a hot-tenant flood (subprocess, CPU)
   capacity  static params+KV HBM accounting per registry model against
             the attached chip (largest-servable report; subprocess)
 
@@ -113,7 +116,8 @@ PARTIAL_PATH = Path(__file__).resolve().parent / "BENCH_partial.jsonl"
 # ~3 min of on-chip param init alone).
 _ALL_PHASES = ("kernel", "decode", "decode_paged", "decode8b",
                "decode8b_paged", "decode8b_ctx4k", "ttft", "swarm",
-               "ep_dispatch", "kv_transfer", "mini_swarm", "capacity",
+               "ep_dispatch", "kv_transfer", "mini_swarm", "multi_gateway",
+               "capacity",
                "decode_spec", "decode_spec_draft", "decode_kv8",
                "decode8b_int4")
 
@@ -889,6 +893,13 @@ def _mini_swarm_phase() -> dict:
     return _subprocess_phase("mini_swarm.py", {"JAX_PLATFORMS": "cpu"})
 
 
+def _multi_gateway_phase() -> dict:
+    # Replicated gateway plane (ISSUE 7): req/s scaling across in-process
+    # replicas, cross-replica affinity hit-rate via gossip, and tenant
+    # isolation under a hot-tenant flood.  Control plane — CPU by design.
+    return _subprocess_phase("multi_gateway.py", {"JAX_PLATFORMS": "cpu"})
+
+
 def _capacity_phase() -> dict:
     # Static HBM accounting per registry model (BASELINE config 2/3
     # feasibility); reads the attached chip's HBM, assumes one v5e on
@@ -998,6 +1009,7 @@ def main() -> None:
         "ep_dispatch": _ep_dispatch_phase,
         "kv_transfer": _kv_transfer_phase,
         "mini_swarm": _mini_swarm_phase,
+        "multi_gateway": _multi_gateway_phase,
         "capacity": _capacity_phase,
     }
 

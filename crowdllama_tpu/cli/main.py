@@ -547,14 +547,39 @@ async def run_node(cfg: Configuration, worker_mode: bool) -> None:
     await peer.start()
 
     gateway = None
+    gossip = None
     obs_server = None
     if not worker_mode:
+        # Replicated gateway plane (docs/ROBUSTNESS.md): gossip routing
+        # state with the other replicas (--gateway-peers) and/or enforce
+        # per-tenant quotas (--tenant-quota).  The gossip node is built
+        # even with no peers when a snapshot path is set, so a bounced
+        # single gateway still rehydrates its affinity map.
+        from crowdllama_tpu.swarm.gossip import (
+            GossipNode,
+            TenantQuotas,
+            parse_tenant_quotas,
+        )
+
+        quotas = None
+        if cfg.tenant_quota:
+            quotas = TenantQuotas(parse_tenant_quotas(cfg.tenant_quota),
+                                  node_id=peer.peer_id)
+        if cfg.gateway_peers or cfg.gossip_snapshot_path or quotas:
+            gossip = GossipNode(peer, peers=cfg.gateway_peers,
+                                interval=cfg.gossip_interval,
+                                snapshot_path=cfg.gossip_snapshot_path,
+                                quotas=quotas)
         gateway = Gateway(peer, port=cfg.gateway_port,
                           trace_buffer=cfg.trace_buffer,
                           request_timeout=cfg.request_timeout,
                           admission_max_inflight=cfg.admission_max_inflight,
                           retry_after_s=cfg.retry_after_s,
-                          kv_ship=cfg.kv_ship)
+                          kv_ship=cfg.kv_ship,
+                          gossip=gossip, tenant_quotas=quotas)
+        if gossip is not None:
+            gossip.metrics = gateway.obs.metrics
+            await gossip.start()
         await gateway.start()
     elif cfg.worker_metrics_port:
         from crowdllama_tpu.obs.http import ObsServer
@@ -622,6 +647,12 @@ async def run_node(cfg: Configuration, worker_mode: bool) -> None:
             await ipc.stop()
         if obs_server is not None:
             await obs_server.stop()
+        if gossip is not None:
+            # Snapshot-on-shutdown (docs/ROBUSTNESS.md): the LWW map —
+            # affinity pins + quarantines — lands in
+            # cfg.gossip_snapshot_path, and the restarted gateway
+            # rehydrates it so a bounce keeps its affinity hit-rate.
+            await gossip.stop(save=True)
         if gateway is not None:
             await gateway.stop()
         await peer.stop()

@@ -173,6 +173,21 @@ class Configuration:
     # Wall-clock cap on one fetch (dial + frames); charged against the
     # request's deadline budget like any other phase.
     kv_ship_timeout: float = 5.0
+    # Replicated gateway plane (docs/ROBUSTNESS.md "replicated gateway"):
+    # p2p listener addresses ("host:port") of the OTHER gateway replicas
+    # this gateway gossips routing state with.  Empty = single gateway,
+    # everything stays process-local (the seed behavior).
+    gateway_peers: list[str] = field(default_factory=list)
+    # Per-tenant admission quotas, "name=requests_per_sec" comma-separated
+    # (e.g. "default=20,acme=100"); tenant key = X-Tenant header, unknown
+    # tenants charge "default".  Empty = the global shed only.
+    tenant_quota: str = ""
+    # Seconds between gossip anti-entropy rounds.
+    gossip_interval: float = 2.0
+    # Snapshot file for the gossip map (affinity pins + quarantines):
+    # saved on SIGTERM, rehydrated on start so a gateway bounce keeps its
+    # affinity hit-rate.  Empty = no persistence.
+    gossip_snapshot_path: str = ""
     # Directory for jax.profiler traces; empty disables the profile surface
     # (SURVEY §5: "TPU build: JAX profiler traces + per-request timing").
     profile_dir: str = ""
@@ -282,6 +297,18 @@ class Configuration:
             "CROWDLLAMA_TPU_KV_SHIP_MIN_TOKENS", cfg.kv_ship_min_tokens))
         cfg.kv_ship_timeout = float(env.get(
             "CROWDLLAMA_TPU_KV_SHIP_TIMEOUT", cfg.kv_ship_timeout))
+        if env.get("CROWDLLAMA_TPU_GATEWAY_PEERS"):
+            cfg.gateway_peers = [
+                a.strip()
+                for a in env["CROWDLLAMA_TPU_GATEWAY_PEERS"].split(",")
+                if a.strip()
+            ]
+        cfg.tenant_quota = env.get("CROWDLLAMA_TPU_TENANT_QUOTA",
+                                   cfg.tenant_quota)
+        cfg.gossip_interval = float(env.get(
+            "CROWDLLAMA_TPU_GOSSIP_INTERVAL", cfg.gossip_interval))
+        cfg.gossip_snapshot_path = env.get(
+            "CROWDLLAMA_TPU_GOSSIP_SNAPSHOT", cfg.gossip_snapshot_path)
         cfg.profile_dir = env.get("CROWDLLAMA_TPU_PROFILE_DIR", cfg.profile_dir)
         cfg.trace_buffer = int(env.get("CROWDLLAMA_TPU_TRACE_BUFFER",
                                        cfg.trace_buffer))
@@ -332,6 +359,14 @@ class Configuration:
         if cfg.kv_ship_timeout <= 0:
             raise ValueError(f"kv_ship_timeout must be positive, "
                              f"got {cfg.kv_ship_timeout}")
+        if cfg.gossip_interval <= 0:
+            raise ValueError(f"gossip_interval must be positive, "
+                             f"got {cfg.gossip_interval}")
+        if cfg.tenant_quota:
+            # Fail at startup, not on the first shed decision.
+            from crowdllama_tpu.swarm.gossip import parse_tenant_quotas
+
+            parse_tenant_quotas(cfg.tenant_quota)
         if cfg.drain_timeout <= 0:
             raise ValueError(f"drain_timeout must be positive, "
                              f"got {cfg.drain_timeout}")
@@ -487,6 +522,22 @@ class Configuration:
                             help="graceful-drain window in seconds: how "
                                  "long a SIGTERM'd/drained worker stays up "
                                  "as a KV donor for its migrated streams")
+        parser.add_argument("--gateway-peers", dest="gateway_peers",
+                            help="comma-separated host:port p2p addresses "
+                                 "of the other gateway replicas to gossip "
+                                 "routing state with")
+        parser.add_argument("--tenant-quota", dest="tenant_quota",
+                            help="per-tenant admission quotas, "
+                                 "name=req_per_sec comma-separated "
+                                 "(tenant key: X-Tenant header; unknown "
+                                 "tenants charge 'default')")
+        parser.add_argument("--gossip-interval", dest="gossip_interval",
+                            type=float,
+                            help="seconds between gossip anti-entropy "
+                                 "rounds between gateway replicas")
+        parser.add_argument("--gossip-snapshot", dest="gossip_snapshot_path",
+                            help="file the gossip map is saved to on "
+                                 "SIGTERM and rehydrated from on start")
 
     @classmethod
     def from_flags(cls, args: argparse.Namespace) -> "Configuration":
@@ -503,11 +554,16 @@ class Configuration:
                 "request_timeout", "admission_max_inflight",
                 "admission_pending_max", "retry_after_s",
                 "kv_ship", "kv_ship_min_tokens", "kv_ship_timeout",
-                "drain_timeout",
+                "drain_timeout", "tenant_quota", "gossip_interval",
+                "gossip_snapshot_path",
                 "dist_coordinator", "dist_num_processes", "dist_process_id",
             )
         }
         bp = getattr(args, "bootstrap_peers", None)
         if isinstance(bp, str):
             overrides["bootstrap_peers"] = [a.strip() for a in bp.split(",") if a.strip()]
+        gp = getattr(args, "gateway_peers", None)
+        if isinstance(gp, str):
+            overrides["gateway_peers"] = [a.strip() for a in gp.split(",")
+                                          if a.strip()]
         return cls.from_environment(**overrides)

@@ -169,6 +169,38 @@ def extract_migrate_frame(msg: pb.BaseMessage) -> pb.MigrateFrame:
     return msg.migrate_frame
 
 
+def gossip_frame_msg(
+    origin: str,
+    entries: Iterable[Mapping] = (),
+    usage: Iterable[Mapping] = (),
+    sync: bool = False,
+    clock: int = 0,
+) -> pb.BaseMessage:
+    """One replicated-gateway anti-entropy frame.  ``entries``/``usage``
+    are mappings with the GossipEntry / TenantUsage field names (the
+    gossip module keeps its state in plain dicts and only touches
+    protobuf at the wire boundary, like every other message here)."""
+    fr = pb.GossipFrame(origin=origin, sync=bool(sync), clock=int(clock))
+    for e in entries:
+        fr.entries.add(
+            key=str(e["key"]), value=str(e.get("value", "")),
+            version=int(e.get("version", 0)),
+            tombstone=bool(e.get("tombstone", False)),
+            origin=str(e.get("origin", "")))
+    for u in usage:
+        fr.usage.add(
+            origin=str(u["origin"]), tenant=str(u["tenant"]),
+            admitted=int(u.get("admitted", 0)),
+            version=int(u.get("version", 0)))
+    return pb.BaseMessage(gossip_frame=fr)
+
+
+def extract_gossip_frame(msg: pb.BaseMessage) -> pb.GossipFrame:
+    if msg.WhichOneof("message") != "gossip_frame":
+        raise ValueError("message does not contain a GossipFrame")
+    return msg.gossip_frame
+
+
 def flatten_chat(messages: Iterable[Mapping[str, str]]) -> str:
     """Flatten Ollama-style chat messages into a single prompt string.
 

@@ -21,6 +21,7 @@ import asyncio
 import json
 import logging
 import os
+import random
 import time
 from collections import OrderedDict
 
@@ -113,10 +114,17 @@ class Gateway:
     def __init__(self, peer: Peer, port: int = 9001, host: str = "0.0.0.0",
                  trace_buffer: int = 64, request_timeout: float = 600.0,
                  admission_max_inflight: int = 0,
-                 retry_after_s: float = 1.0, kv_ship: bool = False):
+                 retry_after_s: float = 1.0, kv_ship: bool = False,
+                 gossip=None, tenant_quotas=None):
         self.peer = peer
         self.port = port
         self.host = host
+        # Replicated gateway plane (docs/ROBUSTNESS.md): the swarm/gossip.py
+        # GossipNode sharing affinity pins + quarantines with the other
+        # replicas (None = single-gateway, everything stays process-local),
+        # and the per-tenant token buckets replacing the global shed.
+        self.gossip = gossip
+        self.tenant_quotas = tenant_quotas
         # KV shipping (docs/KV_TRANSFER.md): on an affinity MISS, hint the
         # remembered worker as a page donor so the chosen worker fetches
         # the shared prefix instead of recomputing it.
@@ -231,6 +239,12 @@ class Gateway:
         self._affinity_evicted = 0
         self._affinity_repointed = 0
         self._kv_hints = 0
+        # Cross-replica affinity: continuations whose pin came from the
+        # gossip map rather than this process's own LRU (the number the
+        # multi_gateway bench reports as cross-replica hit-rate).
+        self._gossip_affinity_hits = 0
+        # Per-tenant inflight (weighted-fair admission): tenant -> count.
+        self._tenant_inflight: dict[str, int] = {}
 
     # ----------------------------------------------------------- lifecycle
 
@@ -248,6 +262,36 @@ class Gateway:
                 self._affinity_drop_worker(peer_id)
 
             pm.on_peer_removed = _on_removed
+            if self.gossip is not None:
+                # Quarantine publication: OUR observation of a drain
+                # (mark_draining) enters the replicated map, so the other
+                # replicas stop routing to the worker within one gossip
+                # round instead of a probe interval later.
+                prev_drain = pm.on_draining
+
+                def _on_draining(peer_id: str) -> None:
+                    if prev_drain is not None:
+                        prev_drain(peer_id)
+                    self.gossip.record_quarantine(peer_id)
+
+                pm.on_draining = _on_draining
+        if self.gossip is not None:
+            # Remote entries applied by anti-entropy: another replica's
+            # quarantine decision quarantines the worker HERE (split-brain
+            # safe — mark_draining is idempotent and versioned entries
+            # can't regress).  Affinity entries need no eager action: the
+            # routing path consults the gossip map on local miss.
+            from crowdllama_tpu.swarm.gossip import QUARANTINE_PREFIX
+
+            def _on_entry(entry) -> None:
+                if entry.tombstone \
+                        or not entry.key.startswith(QUARANTINE_PREFIX):
+                    return
+                pm2 = self.peer.peer_manager
+                if pm2 is not None:
+                    pm2.mark_draining(entry.key[len(QUARANTINE_PREFIX):])
+
+            self.gossip.on_entry = _on_entry
         self._runner = web.AppRunner(self.app, access_log=None)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
@@ -308,7 +352,13 @@ class Gateway:
         return self.request_timeout
 
     def _shed_headers(self) -> dict:
-        return {"Retry-After": str(max(1, round(self.retry_after_s)))}
+        # Jittered Retry-After in [base, 2*base]: a constant value tells
+        # every shed client to come back at the SAME instant, so a
+        # recovering gateway eats its own retry stampede.  Integer seconds
+        # (the HTTP-date alternative is the only other legal form).
+        base = self.retry_after_s
+        return {"Retry-After": str(max(1, round(random.uniform(base,
+                                                               2 * base))))}
 
     def _shed_response(self, shape: str, model: str,
                        message: str) -> web.Response:
@@ -833,6 +883,11 @@ class Gateway:
         lines.append("# TYPE crowdllama_gateway_kv_hints_total counter")
         lines.append(
             f"crowdllama_gateway_kv_hints_total {self._kv_hints}")
+        lines.append(
+            "# TYPE crowdllama_gateway_gossip_affinity_hits_total counter")
+        lines.append(
+            f"crowdllama_gateway_gossip_affinity_hits_total "
+            f"{self._gossip_affinity_hits}")
         # Robustness plane (docs/ROBUSTNESS.md): failover/replay/shed/budget
         # counters plus dead-transport pool evictions.
         lines.append("# TYPE crowdllama_gateway_failovers_total counter")
@@ -1114,13 +1169,25 @@ class Gateway:
     def _affinity_get(self, akey: str | None, model: str):
         """The remembered worker for this conversation, if it is still a
         routable (healthy, complete-group leader), non-saturated server
-        of ``model``."""
+        of ``model``.  On a local miss the gossip map is consulted: a
+        continuation whose first turns went through ANOTHER replica still
+        routes to the worker holding its KV (the pin is seeded into the
+        local LRU so later turns hit locally)."""
         if akey is None:
             return None
         entry = self._affinity.get(akey)
         if entry is None or time.monotonic() - entry[1] > self._AFFINITY_TTL_S:
             self._affinity.pop(akey, None)
-            return None
+            entry = None
+            if self.gossip is not None:
+                remote = self.gossip.lookup_affinity(
+                    akey, max_age_s=self._AFFINITY_TTL_S)
+                if remote is not None:
+                    self._affinity_put(akey, remote[0])
+                    self._gossip_affinity_hits += 1
+                    entry = self._affinity.get(akey)
+            if entry is None:
+                return None
         self._affinity.move_to_end(akey)  # LRU touch: live conversation
         pm = self.peer.peer_manager
         cand = pm.is_routable(entry[0], model) if pm is not None else None
@@ -1139,6 +1206,10 @@ class Gateway:
             self._affinity_evicted += 1
         self._affinity[akey] = (worker_id, time.monotonic())
         self._affinity.move_to_end(akey)
+        if self.gossip is not None:
+            # Mirror the pin into the replicated map so the OTHER
+            # replicas route this conversation's continuations here too.
+            self.gossip.record_affinity(akey, worker_id)
 
     def _affinity_drop_worker(self, worker_id: str,
                               successor: str = "") -> None:
@@ -1154,8 +1225,12 @@ class Gateway:
             if successor:
                 self._affinity[akey] = (successor, now)
                 self._affinity_repointed += 1
+                if self.gossip is not None:
+                    self.gossip.record_affinity(akey, successor)
             else:
                 del self._affinity[akey]
+                if self.gossip is not None:
+                    self.gossip.drop_affinity(akey)
 
     def _kv_donor_for(self, akey: str | None, model: str,
                       chosen_worker: str) -> str:
@@ -1168,13 +1243,30 @@ class Gateway:
         if not self.kv_ship or akey is None:
             return ""
         entry = self._affinity.get(akey)
-        if entry is None or entry[0] == chosen_worker \
-                or time.monotonic() - entry[1] > self._AFFINITY_TTL_S:
+        if entry is None or time.monotonic() - entry[1] > self._AFFINITY_TTL_S:
+            # Local miss: a donor hint remembered by ANOTHER replica is
+            # just as good — its worker holds the conversation's pages.
+            entry = None
+            if self.gossip is not None:
+                remote = self.gossip.lookup_affinity(
+                    akey, max_age_s=self._AFFINITY_TTL_S)
+                if remote is not None:
+                    entry = (remote[0], time.monotonic())
+            if entry is None:
+                return ""
+        if entry[0] == chosen_worker:
             return ""
         pm = self.peer.peer_manager
         if pm is None or pm.is_routable(entry[0], model) is None:
             return ""
         return entry[0]
+
+    def _tenant_of(self, request: web.Request) -> str:
+        """Tenant key for admission: the X-Tenant header, bounded through
+        the same label hygiene as every exposition label (an attacker
+        varying the header must not mint unbounded buckets/series)."""
+        raw = request.headers.get("X-Tenant", "") or "default"
+        return self.obs.metrics.tenant_guard.value(raw)
 
     async def _route(self, request, model, stream, options,
                      messages=None, prompt="",
@@ -1183,20 +1275,65 @@ class Gateway:
 
         Shedding happens BEFORE a trace id is minted or a worker touched:
         an overloaded gateway must answer 503 + Retry-After from pure
-        in-memory state (docs/ROBUSTNESS.md)."""
+        in-memory state (docs/ROBUSTNESS.md).  With tenant quotas
+        configured the global shed becomes per-tenant: a token bucket
+        bounds each tenant's rate CLUSTER-WIDE (remote replicas' admits
+        arrive as gossiped usage digests and drain the same buckets), and
+        under inflight pressure a tenant at/above its weighted fair share
+        of the cap is shed while lighter tenants keep being admitted —
+        one hot tenant cannot starve the rest no matter which replica it
+        hits."""
+        tq = self.tenant_quotas
+        tenant = self._tenant_of(request) if tq is not None else ""
         if self.admission_max_inflight \
                 and self._inflight >= self.admission_max_inflight:
+            if tq is not None:
+                tq.shed_total += 1
+                self.obs.metrics.tenant_inc(
+                    self.obs.metrics.tenant_shed, tenant)
             return self._shed_response(
                 shape, model,
                 f"overloaded: {self._inflight} requests in flight "
                 f"(admission cap {self.admission_max_inflight})")
+        if tq is not None:
+            if not tq.try_admit(tenant):
+                self.obs.metrics.tenant_inc(
+                    self.obs.metrics.tenant_shed, tenant)
+                return self._shed_response(
+                    shape, model,
+                    f"tenant {tenant!r} over quota "
+                    f"({tq.quotas.get(tenant, tq.quotas.get('default', 0))}"
+                    f" req/s)")
+            cap = self.admission_max_inflight
+            if cap:
+                active = {t for t, n in self._tenant_inflight.items()
+                          if n > 0}
+                share = tq.fair_share(tenant, cap, active)
+                if self._tenant_inflight.get(tenant, 0) >= share:
+                    self.obs.metrics.tenant_inc(
+                        self.obs.metrics.tenant_shed, tenant)
+                    return self._shed_response(
+                        shape, model,
+                        f"tenant {tenant!r} over fair share "
+                        f"({share:.1f} of {cap} inflight)")
+            self.obs.metrics.tenant_inc(
+                self.obs.metrics.tenant_admitted, tenant)
         self._inflight += 1
+        if tq is not None:
+            self._tenant_inflight[tenant] = \
+                self._tenant_inflight.get(tenant, 0) + 1
+            self.obs.metrics.tenant_inflight[tenant] = \
+                self._tenant_inflight[tenant]
         try:
             return await self._route_admitted(
                 request, model, stream, options, messages=messages,
                 prompt=prompt, shape=shape)
         finally:
             self._inflight -= 1
+            if tq is not None:
+                self._tenant_inflight[tenant] -= 1
+                self.obs.metrics.tenant_inflight[tenant] = \
+                    self._tenant_inflight[tenant]
 
     async def _route_admitted(self, request, model, stream, options,
                               messages=None, prompt="",

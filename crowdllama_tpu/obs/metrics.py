@@ -221,12 +221,34 @@ class NodeMetrics:
                       "rejected_requests": 0}
         self.migrated_streams = 0
         self.replayed_prefill_tokens = 0
+        # Replicated gateway plane (docs/ROBUSTNESS.md "replicated
+        # gateway"): gossip anti-entropy traffic + LWW map health, and
+        # per-tenant admission outcomes.  In NodeMetrics (not gateway-only
+        # state) so both scrape surfaces — gateway /metrics and the
+        # worker-side ObsServer — expose the families at zero.
+        self.gossip = {"frames_sent": 0, "frames_received": 0,
+                       "entries_applied": 0, "entries_stale": 0,
+                       "full_syncs": 0, "send_failures": 0,
+                       "snapshot_saves": 0,
+                       # gauges
+                       "map_entries": 0, "snapshot_entries_loaded": 0}
+        self.tenant_guard = LabelGuard(max_values=32)
+        self.tenant_admitted: dict[str, int] = {}
+        self.tenant_shed: dict[str, int] = {}
+        self.tenant_inflight: dict[str, int] = {}
 
     def kv_ship_inc(self, key: str, n: int = 1) -> None:
         self.kv_ship[key] = self.kv_ship.get(key, 0) + int(n)
 
     def drain_inc(self, key: str, n: int = 1) -> None:
         self.drain[key] = self.drain.get(key, 0) + int(n)
+
+    def gossip_inc(self, key: str, n: int = 1) -> None:
+        self.gossip[key] = self.gossip.get(key, 0) + int(n)
+
+    def tenant_inc(self, family: dict, tenant: str, n: int = 1) -> None:
+        key = self.tenant_guard.value(tenant or "default")
+        family[key] = family.get(key, 0) + int(n)
 
     def expose(self) -> list[str]:
         out = self.request_seconds.expose("crowdllama_request_seconds")
@@ -251,6 +273,27 @@ class NodeMetrics:
         out.append("# TYPE crowdllama_replayed_prefill_tokens_total counter")
         out.append(f"crowdllama_replayed_prefill_tokens_total "
                    f"{self.replayed_prefill_tokens}")
+        for key in ("frames_sent", "frames_received", "entries_applied",
+                    "entries_stale", "full_syncs", "send_failures",
+                    "snapshot_saves"):
+            name = f"crowdllama_gossip_{key}_total"
+            out.append(f"# TYPE {name} counter")
+            out.append(f"{name} {self.gossip.get(key, 0)}")
+        for key in ("map_entries", "snapshot_entries_loaded"):
+            name = f"crowdllama_gossip_{key}"
+            out.append(f"# TYPE {name} gauge")
+            out.append(f"{name} {self.gossip.get(key, 0)}")
+        for fam, kind, series in (
+            ("crowdllama_tenant_admitted_total", "counter",
+             self.tenant_admitted),
+            ("crowdllama_tenant_shed_total", "counter", self.tenant_shed),
+            ("crowdllama_tenant_inflight", "gauge", self.tenant_inflight),
+        ):
+            out.append(f"# TYPE {fam} {kind}")
+            if not series:
+                out.append(f'{fam}{{tenant="default"}} 0')
+            for tenant in sorted(series):
+                out.append(f'{fam}{{tenant="{tenant}"}} {series[tenant]}')
         return out
 
 

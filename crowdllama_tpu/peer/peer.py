@@ -131,6 +131,10 @@ class Peer:
         self.relay_client = None  # net/relay.py RelayClient when relaying
         self.relay_service = None  # RelayService when hosting one (public)
         self._draining = False  # graceful drain entered (docs/ROBUSTNESS.md)
+        # Replicated gateway plane: consumers attach a swarm/gossip.py
+        # GossipNode here; the inference serve loop hands it inbound
+        # gossip_frame arms.  None on workers and single-gateway setups.
+        self.gossip_node = None
         # Per-node observability plane (trace ring + histograms): served by
         # obs/http.ObsServer on workers, read directly by tests/benches.
         self.obs = NodeObs(
@@ -639,6 +643,19 @@ class Peer:
                 return True
             if which == "kv_fetch_request":
                 await self._serve_kv_fetch(stream, msg)
+                return True
+            if which == "gossip_frame":
+                # Replicated gateway anti-entropy (swarm/gossip.py): merge
+                # the sender's LWW map + usage digests, reply with our own
+                # full frame when sync is requested.  A node with no gossip
+                # plane attached ignores the frame (back-compat: workers
+                # and pre-gossip gateways just keep the stream alive).
+                if self.gossip_node is not None:
+                    reply = await self.gossip_node.handle_frame(msg)
+                    if reply is not None:
+                        reply.trace_id = tid
+                        await wire.write_length_prefixed_pb(
+                            stream.writer, reply)
                 return True
             req = msg.generate_request
             if which != "generate_request":

@@ -102,6 +102,7 @@ class PeerManager:
         metadata_fetcher: MetadataFetcher | None = None,
         discovery: DiscoveryFunc | None = None,
         on_peer_removed: Callable[[str], None] | None = None,
+        on_draining: Callable[[str], None] | None = None,
     ):
         self.self_peer_id = self_peer_id
         self.config = config or PeerHealthConfig()
@@ -110,6 +111,11 @@ class PeerManager:
         # Fired on eviction so other layers (e.g. the local DHT's provider
         # store, net/dht.py evict_peer) drop the dead peer immediately.
         self.on_peer_removed = on_peer_removed
+        # Fired on a FIRST mark_draining so the replicated-gateway gossip
+        # plane (swarm/gossip.py) can publish the quarantine to the other
+        # replicas; one replica observing a MigrateFrame stops ALL
+        # replicas routing to the drained worker within a gossip round.
+        self.on_draining = on_draining
         self.peers: dict[str, PeerInfo] = {}
         self.recently_removed: dict[str, float] = {}  # peer_id -> removed_at
         self._tasks: list[asyncio.Task] = []
@@ -204,6 +210,11 @@ class PeerManager:
             return False
         info.resource.draining = True
         self._bump_routing_epoch()
+        if self.on_draining is not None:
+            try:
+                self.on_draining(peer_id)
+            except Exception:
+                log.debug("on_draining callback failed", exc_info=True)
         return True
 
     # -------------------------------------------------------------- queries

@@ -1,4 +1,5 @@
-"""Swarm-level control loops (elastic drain/scale, docs/ROBUSTNESS.md)."""
+"""Swarm-level control loops (elastic drain/scale, replicated gateway
+gossip — docs/ROBUSTNESS.md)."""
 
 from crowdllama_tpu.swarm.autoscale import (
     AutoscaleConfig,
@@ -9,13 +10,31 @@ from crowdllama_tpu.swarm.autoscale import (
     pick_drain_candidate,
     simulate,
 )
+from crowdllama_tpu.swarm.gossip import (
+    AFFINITY_PREFIX,
+    QUARANTINE_PREFIX,
+    Entry,
+    GossipNode,
+    LWWMap,
+    TenantQuotas,
+    hybrid_clock,
+    parse_tenant_quotas,
+)
 
 __all__ = [
+    "AFFINITY_PREFIX",
     "AutoscaleConfig",
     "AutoscaleController",
     "Decision",
+    "Entry",
+    "GossipNode",
+    "LWWMap",
+    "QUARANTINE_PREFIX",
     "Sample",
+    "TenantQuotas",
+    "hybrid_clock",
     "parse_gauges",
+    "parse_tenant_quotas",
     "pick_drain_candidate",
     "simulate",
 ]

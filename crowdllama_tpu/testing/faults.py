@@ -27,6 +27,14 @@ site                  attrs / where
 ``kv.serve``          donor side, before a KvFetchRequest is served
                       (peer.py ``_serve_kv_fetch``): ``worker`` (the
                       donor), ``model``
+``gossip.send``       before a gateway replica pushes an anti-entropy
+                      frame (swarm/gossip.py ``GossipNode._exchange``):
+                      ``src`` (sender peer id), ``dst`` (target address)
+``gossip.recv``       before an inbound gossip frame is merged
+                      (``GossipNode.handle_frame``): ``src`` (origin peer
+                      id), ``dst`` (receiver peer id).  A partition is a
+                      pair of ``error`` rules matching both directions;
+                      ``delay`` models gossip latency.
 ====================  =====================================================
 
 Actions:

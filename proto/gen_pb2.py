@@ -30,6 +30,7 @@ F = descriptor_pb2.FieldDescriptorProto
 OPT, REP = F.LABEL_OPTIONAL, F.LABEL_REPEATED
 STR, BYTES, I32, BOOL = (F.TYPE_STRING, F.TYPE_BYTES, F.TYPE_INT32,
                          F.TYPE_BOOL)
+U64 = F.TYPE_UINT64
 MSG = F.TYPE_MESSAGE
 
 
@@ -70,7 +71,8 @@ def extract_serialized(src: str) -> bytes:
 
 def apply_schema_edits(fdp: descriptor_pb2.FileDescriptorProto) -> None:
     """PR 5: peer-to-peer paged-KV shipping messages.
-    PR 6: live request migration (graceful drain)."""
+    PR 6: live request migration (graceful drain).
+    PR 7: replicated gateway plane (gossip LWW map + tenant digests)."""
     # GenerateRequest.kv_donor: peer id of a worker believed to hold this
     # conversation's prefix KV hot (gateway affinity memory).  Proto3
     # back-compat: absent == "" == no hint.
@@ -117,6 +119,34 @@ def apply_schema_edits(fdp: descriptor_pb2.FileDescriptorProto) -> None:
     _ensure_field(mig, _field("reason", 7, STR))
     _ensure_message(fdp, mig)
 
+    # Replicated gateway plane (docs/ROBUSTNESS.md "replicated gateway"):
+    # versioned LWW entries + per-tenant usage digests exchanged between
+    # gateway replicas over the authenticated inference stream protocol.
+    gent = descriptor_pb2.DescriptorProto(name="GossipEntry")
+    _ensure_field(gent, _field("key", 1, STR))
+    _ensure_field(gent, _field("value", 2, STR))
+    _ensure_field(gent, _field("version", 3, U64))
+    _ensure_field(gent, _field("tombstone", 4, BOOL))
+    _ensure_field(gent, _field("origin", 5, STR))
+    _ensure_message(fdp, gent)
+
+    tuse = descriptor_pb2.DescriptorProto(name="TenantUsage")
+    _ensure_field(tuse, _field("origin", 1, STR))
+    _ensure_field(tuse, _field("tenant", 2, STR))
+    _ensure_field(tuse, _field("admitted", 3, U64))
+    _ensure_field(tuse, _field("version", 4, U64))
+    _ensure_message(fdp, tuse)
+
+    gfr = descriptor_pb2.DescriptorProto(name="GossipFrame")
+    _ensure_field(gfr, _field("origin", 1, STR))
+    _ensure_field(gfr, _field("entries", 2, MSG, REP,
+                              type_name=".llama.v1.GossipEntry"))
+    _ensure_field(gfr, _field("usage", 3, MSG, REP,
+                              type_name=".llama.v1.TenantUsage"))
+    _ensure_field(gfr, _field("sync", 4, BOOL))
+    _ensure_field(gfr, _field("clock", 5, U64))
+    _ensure_message(fdp, gfr)
+
     (base,) = [m for m in fdp.message_type if m.name == "BaseMessage"]
     _ensure_field(base, _field("kv_fetch_request", 7, MSG,
                                type_name=".llama.v1.KvFetchRequest",
@@ -126,6 +156,9 @@ def apply_schema_edits(fdp: descriptor_pb2.FileDescriptorProto) -> None:
                                oneof_index=0))
     _ensure_field(base, _field("migrate_frame", 9, MSG,
                                type_name=".llama.v1.MigrateFrame",
+                               oneof_index=0))
+    _ensure_field(base, _field("gossip_frame", 10, MSG,
+                               type_name=".llama.v1.GossipFrame",
                                oneof_index=0))
 
 

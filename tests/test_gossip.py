@@ -1,0 +1,615 @@
+"""Replicated gateway plane (docs/ROBUSTNESS.md "replicated gateway").
+
+Units: LWW map merge semantics (commutative, idempotent, deterministic
+tie-break, tombstones), GossipFrame wire round-trip, tenant token
+buckets + gossiped usage digests, Retry-After jitter.
+
+Integration: seeded-fault gossip convergence over REAL loopback peers
+(drop/delay/partition on the gossip.send/gossip.recv sites must still
+converge every replica to the identical map), snapshot rehydration
+across a gateway bounce, per-tenant HTTP shedding, and the acceptance
+e2e — two gateways over two real engines, one killed mid-burst, the
+survivor's streams byte-identical and a continuation still landing an
+affinity hit via the gossiped pin.
+"""
+
+import asyncio
+import json
+import time
+from types import SimpleNamespace
+
+import aiohttp
+import pytest
+from crowdllama_tpu.utils.crypto_compat import Ed25519PrivateKey
+
+from crowdllama_tpu.config import Configuration, Intervals
+from crowdllama_tpu.core import wire
+from crowdllama_tpu.core.messages import (
+    extract_gossip_frame,
+    gossip_frame_msg,
+)
+from crowdllama_tpu.engine.engine import FakeEngine
+from crowdllama_tpu.gateway.gateway import Gateway
+from crowdllama_tpu.net.discovery import new_host_and_dht
+from crowdllama_tpu.peer.peer import Peer
+from crowdllama_tpu.swarm.gossip import (
+    Entry,
+    GossipNode,
+    LWWMap,
+    TenantQuotas,
+    hybrid_clock,
+    parse_tenant_quotas,
+)
+from crowdllama_tpu.testing import faults
+from crowdllama_tpu.testing.faults import FaultPlan, FaultRule
+
+MODEL = "tiny-test"
+
+
+# ------------------------------------------------------------- LWW units
+
+
+def test_lww_merge_commutative_and_idempotent():
+    """Replicas that saw the same SET of entries hold the same map, no
+    matter the delivery order or duplication (the CRDT property the
+    anti-entropy loop relies on)."""
+    a, b = LWWMap("A"), LWWMap("B")
+    entries = [a.set("aff/1", "w1"), a.set("aff/2", "w2"),
+               b.set("aff/3", "w3"), b.set("aff/1", "w9")]
+    for e in entries:                     # in order, duplicated
+        a.apply(e), a.apply(e)
+    for e in reversed(entries):           # reversed
+        b.apply(e)
+    assert a.digest() == b.digest()
+    # b's "aff/1" write carried the later hybrid clock: it wins on both.
+    assert a.get("aff/1").value == "w9"
+
+
+def test_lww_tie_break_is_deterministic():
+    """Equal versions break on (origin, value) — every replica picks the
+    SAME winner, so a write race cannot split the brain."""
+    a, b = LWWMap("A"), LWWMap("B")
+    e1 = Entry(key="k", value="x", version=100, origin="A")
+    e2 = Entry(key="k", value="y", version=100, origin="B")
+    a.apply(e1), a.apply(e2)
+    b.apply(e2), b.apply(e1)
+    assert a.get("k").value == b.get("k").value == "y"  # "B" > "A"
+    assert a.digest() == b.digest()
+
+
+def test_tombstone_propagates_and_prunes():
+    a, b = LWWMap("A"), LWWMap("B")
+    a.set("aff/gone", "w1")
+    for e in a.snapshot():
+        b.apply(e)
+    dead = a.delete("aff/gone")
+    assert b.get("aff/gone") is not None
+    b.apply(dead)
+    assert b.get("aff/gone") is None           # deletion propagated
+    assert len(b) == 0
+    # Stale re-adds lose to the tombstone.
+    assert not b.apply(Entry(key="aff/gone", value="w1",
+                             version=dead.version - 1, origin="C"))
+    # Past the TTL horizon the tombstone itself is pruned.
+    assert b.prune(now_ms=dead.version + 3_600_001) == 1
+    assert "aff/gone" not in b.entries
+
+
+def test_hybrid_clock_monotonic_past_prev():
+    now_ms = int(time.time() * 1000)
+    assert hybrid_clock(0) >= now_ms
+    future = now_ms + 10_000_000
+    assert hybrid_clock(future) == future + 1
+
+
+def test_gossip_frame_wire_roundtrip():
+    msg = gossip_frame_msg(
+        "gw1",
+        entries=[{"key": "aff/x", "value": "w1", "version": 7,
+                  "tombstone": False, "origin": "gw1"}],
+        usage=[{"origin": "gw1", "tenant": "acme", "admitted": 3,
+                "version": 9}],
+        sync=True, clock=11)
+    out = wire.decode_payload(wire.encode_frame(msg)[4:])
+    fr = extract_gossip_frame(out)
+    assert fr.origin == "gw1" and fr.sync and fr.clock == 11
+    e = fr.entries[0]
+    assert (e.key, e.value, e.version) == ("aff/x", "w1", 7)
+    u = fr.usage[0]
+    assert (u.tenant, u.admitted, u.version) == ("acme", 3, 9)
+    # Old parsers: a frame without the new arm still decodes (nothing
+    # was renumbered on BaseMessage).
+    assert out.WhichOneof("message") == "gossip_frame"
+
+
+# ----------------------------------------------------------- tenant units
+
+
+def test_parse_tenant_quotas():
+    assert parse_tenant_quotas("default=20, acme=100") == {
+        "default": 20.0, "acme": 100.0}
+    assert parse_tenant_quotas("*=5") == {"default": 5.0}
+    assert parse_tenant_quotas("") == {}
+    with pytest.raises(ValueError):
+        parse_tenant_quotas("acme=loads")
+    with pytest.raises(ValueError):
+        parse_tenant_quotas("acme=-1")
+
+
+def test_tenant_bucket_sheds_over_rate_and_refills():
+    q = TenantQuotas({"default": 2.0}, node_id="g1")
+    t0 = 100.0
+    assert q.try_admit("t", now=t0)
+    assert q.try_admit("t", now=t0)
+    assert not q.try_admit("t", now=t0)          # burst (= 1s of quota) spent
+    assert q.try_admit("t", now=t0 + 1.0)        # refilled at 2 req/s
+    assert q.admitted_total == 3 and q.shed_total == 1
+    # No default quota and no tenant quota → explicit configs shed
+    # unknown tenants.
+    q2 = TenantQuotas({"acme": 1.0})
+    assert not q2.try_admit("stranger", now=t0)
+
+
+def test_usage_digest_charges_buckets_cluster_wide():
+    """Remote replicas' admits drain the LOCAL bucket (via the gossiped
+    monotonic digest), so a tenant's total rate converges to its quota,
+    not quota × replicas — and the digest is idempotent."""
+    g1 = TenantQuotas({"default": 2.0}, node_id="g1")
+    t0 = 50.0
+    for _ in range(2):
+        assert g1.try_admit("acme", now=t0)
+    for _ in range(3):
+        g1.local_admitted["acme"] = g1.local_admitted.get("acme", 0) + 1
+    d = g1.usage_digest()
+    assert d == [{"origin": "g1", "tenant": "acme", "admitted": 5,
+                  "version": g1.usage_version}]
+
+    g2 = TenantQuotas({"default": 2.0}, node_id="g2")
+    assert g2.apply_usage(d) == 5
+    assert g2.apply_usage(d) == 0                 # monotonic: no double charge
+    assert not g2.try_admit("acme")               # bucket driven negative
+    assert g2.cluster_admitted("acme") == 5
+    # A different tenant is untouched.
+    assert g2.try_admit("other")
+    # Own digests are ignored (no self-charge loop through gossip).
+    assert g1.apply_usage(g1.usage_digest()) == 0
+
+
+def test_fair_share_is_quota_weighted():
+    q = TenantQuotas({"default": 10.0, "big": 30.0})
+    assert q.fair_share("big", 8, {"default"}) == pytest.approx(6.0)
+    assert q.fair_share("default", 8, {"big"}) == pytest.approx(2.0)
+    # Sole active tenant gets the whole cap.
+    assert q.fair_share("big", 8, set()) == pytest.approx(8.0)
+
+
+# --------------------------------------------------- Retry-After jitter
+
+
+def test_retry_after_jitter_window():
+    """Satellite: shed responses jitter Retry-After across [base, 2*base]
+    so synchronized client retries cannot stampede a recovering gateway."""
+    gw = Gateway(SimpleNamespace(peer_manager=None), port=0,
+                 retry_after_s=3.0)
+    vals = {int(gw._shed_headers()["Retry-After"]) for _ in range(300)}
+    assert all(3 <= v <= 6 for v in vals), vals
+    assert len(vals) > 1, "Retry-After is constant — no jitter"
+    # Degenerate base still yields the minimum legal hint.
+    gw0 = Gateway(SimpleNamespace(peer_manager=None), port=0,
+                  retry_after_s=0.0)
+    assert gw0._shed_headers()["Retry-After"] == "1"
+
+
+# ------------------------------------------------- snapshot (restart)
+
+
+def test_snapshot_bounce_preserves_affinity(tmp_path):
+    """Satellite: the gossip map snapshotted on SIGTERM and rehydrated on
+    start keeps the affinity hit-rate across a gateway bounce — the
+    restarted process answers continuations from the persisted pins."""
+    snap = str(tmp_path / "gossip.json")
+    g1_node = GossipNode(SimpleNamespace(peer_id="gw1"), snapshot_path=snap)
+    gw1 = Gateway(SimpleNamespace(peer_manager=None), port=0, gossip=g1_node)
+    gw1._affinity_put("conv-bounce", "w-keeper")
+    gw1._affinity_put("conv-other", "w-two")
+    g1_node.record_quarantine("w-dead")
+    saved_clock = g1_node.state.clock
+    assert g1_node.save_snapshot() == snap
+
+    # The bounce: a FRESH process (new gossip node, empty gateway LRU).
+    g2_node = GossipNode(SimpleNamespace(peer_id="gw1"), snapshot_path=snap)
+    assert g2_node.load_snapshot() == 3
+    assert g2_node.state.clock >= saved_clock     # clock survives restart
+    pm = SimpleNamespace(is_routable=lambda pid, model: SimpleNamespace(
+        peer_id=pid, resource=SimpleNamespace(load=0.0)))
+    gw2 = Gateway(SimpleNamespace(peer_manager=pm), port=0, gossip=g2_node)
+    assert gw2._affinity == {}                    # LRU did NOT survive
+    cand = gw2._affinity_get("conv-bounce", MODEL)
+    assert cand is not None and cand.peer_id == "w-keeper"
+    assert gw2._gossip_affinity_hits == 1
+    assert g2_node.quarantined() == ["w-dead"]
+    # Unknown conversation still misses.
+    assert gw2._affinity_get("conv-unknown", MODEL) is None
+    # A corrupt snapshot degrades to empty, not a crash.
+    (tmp_path / "gossip.json").write_text("{not json")
+    assert GossipNode(SimpleNamespace(peer_id="gw1"),
+                      snapshot_path=snap).load_snapshot() == 0
+
+
+# -------------------------------------------- gateway <-> gossip wiring
+
+
+async def test_quarantine_flows_both_ways_through_gateway():
+    """One replica's drain observation quarantines the worker on ALL
+    replicas: locally mark_draining publishes a quar/ entry; a remote
+    quar/ entry applies back into the local PeerManager."""
+    marked = []
+    pm = SimpleNamespace(on_peer_removed=None, on_draining=None,
+                         mark_draining=lambda pid: marked.append(pid) or True)
+    node = GossipNode(SimpleNamespace(peer_id="gw1"), peers=())
+    gw = Gateway(SimpleNamespace(peer_manager=pm), port=0, host="127.0.0.1",
+                 gossip=node)
+    await gw.start()
+    try:
+        # Local drain observation → replicated map entry.
+        pm.on_draining("w-drained")
+        assert node.quarantined() == ["w-drained"]
+        # Remote replica's quarantine → local routing exclusion.
+        frame = gossip_frame_msg("gw2", entries=[
+            {"key": "quar/w-remote", "value": "drain",
+             "version": hybrid_clock(), "origin": "gw2"}])
+        assert await node.handle_frame(frame) is None  # push-only: no reply
+        assert marked == ["w-remote"]
+        # A sync frame gets our full map back.
+        reply = await node.handle_frame(gossip_frame_msg(
+            "gw2", sync=True, clock=1))
+        keys = {e.key for e in reply.gossip_frame.entries}
+        assert {"quar/w-drained", "quar/w-remote"} <= keys
+    finally:
+        await gw.stop()
+
+
+# ------------------------------------- convergence under the fault harness
+
+
+async def _gossip_mesh(n=3):
+    """N consumer peers on real loopback sockets, each with a GossipNode
+    fully meshed to the others.  Loops are NOT started — tests drive
+    run_round() by hand for determinism."""
+    peers = []
+    for _ in range(n):
+        cfg = Configuration(listen_host="127.0.0.1", bootstrap_peers=[],
+                            relay_mode="off", intervals=Intervals.default())
+        p = Peer(Ed25519PrivateKey.generate(), cfg,
+                 engine=FakeEngine(models=[]), worker_mode=False)
+        await p.start()
+        peers.append(p)
+    addrs = [f"127.0.0.1:{p.host.listen_port}" for p in peers]
+    nodes = []
+    for i, p in enumerate(peers):
+        node = GossipNode(p, peers=[a for j, a in enumerate(addrs) if j != i],
+                          interval=0.2)
+        p.gossip_node = node  # receive side only; no background loop
+        nodes.append(node)
+
+    async def teardown():
+        faults.clear()
+        for node in nodes:
+            await node.stop(save=False)
+        for p in peers:
+            await p.stop()
+
+    return peers, nodes, addrs, teardown
+
+
+async def test_gossip_converges_under_drop_delay_partition():
+    """Satellite: a seeded FaultPlan drops, delays, and partitions gossip
+    frames — after the plan exhausts, one full anti-entropy round per
+    replica converges every LWW map to the identical digest (faults cost
+    convergence LATENCY, never divergence)."""
+    peers, nodes, addrs, teardown = await _gossip_mesh(3)
+    try:
+        ids = [n.state.node_id for n in nodes]
+        # Divergent writes, including a same-key race across replicas.
+        nodes[0].record_affinity("conv-1", "w1")
+        nodes[1].record_affinity("conv-2", "w2")
+        nodes[2].record_quarantine("w-dead")
+        nodes[0].record_affinity("conv-race", "wA")
+        nodes[1].record_affinity("conv-race", "wB")
+
+        plan = FaultPlan(seed=7, rules=[
+            # Drop the first two pushes node0 -> node1.
+            FaultRule(site="gossip.send", action="error",
+                      match={"src": ids[0], "dst": addrs[1]}, times=2),
+            # Delay everything node2 receives (gossip latency).
+            FaultRule(site="gossip.recv", action="delay",
+                      match={"dst": ids[2]}, delay_s=0.02, jitter_s=0.02,
+                      times=4),
+            # Partition node1 <-> node2 (both directions).
+            FaultRule(site="gossip.send", action="error",
+                      match={"src": ids[1], "dst": addrs[2]}, times=2),
+            FaultRule(site="gossip.send", action="error",
+                      match={"src": ids[2], "dst": addrs[1]}, times=2),
+        ])
+        with faults.installed(plan):
+            for _ in range(2):
+                for node in nodes:
+                    await node.run_round()
+            assert any(a == "error" for _, _, a in plan.log), \
+                "fault plan never fired"
+        # Partition healed (rules exhausted): one more full round each.
+        for node in nodes:
+            await node.run_round()
+
+        d0 = nodes[0].state.digest()
+        assert d0 == nodes[1].state.digest() == nodes[2].state.digest(), \
+            "replicas diverged"
+        for node in nodes:
+            assert node.lookup_affinity("conv-1")[0] == "w1"
+            assert node.lookup_affinity("conv-2")[0] == "w2"
+            assert node.quarantined() == ["w-dead"]
+        # The race converged to ONE winner everywhere (whichever version/
+        # origin won, it is the same on all three).
+        winners = {n.lookup_affinity("conv-race")[0] for n in nodes}
+        assert len(winners) == 1
+    finally:
+        await teardown()
+
+
+async def test_gossip_tombstone_and_usage_propagate_between_peers():
+    """Deletes and tenant usage digests ride the same exchange: a dropped
+    pin disappears swarm-wide, and one replica's admits drain the other's
+    buckets."""
+    peers, nodes, addrs, teardown = await _gossip_mesh(2)
+    try:
+        q0 = TenantQuotas({"default": 2.0}, node_id=nodes[0].state.node_id)
+        q1 = TenantQuotas({"default": 2.0}, node_id=nodes[1].state.node_id)
+        nodes[0].quotas, nodes[1].quotas = q0, q1
+
+        nodes[0].record_affinity("conv-del", "w1")
+        await nodes[0].run_round()
+        assert nodes[1].lookup_affinity("conv-del")[0] == "w1"
+
+        nodes[0].drop_affinity("conv-del")
+        t0 = 10.0
+        assert q0.try_admit("acme", now=t0)
+        assert q0.try_admit("acme", now=t0)
+        await nodes[0].run_round()
+        assert nodes[1].lookup_affinity("conv-del") is None
+        assert not q1.try_admit("acme"), \
+            "remote admits did not drain the local bucket"
+    finally:
+        await teardown()
+
+
+# --------------------------------------------- per-tenant HTTP admission
+
+
+def _cfg(bootstrap, **kw):
+    cfg = Configuration(listen_host="127.0.0.1", bootstrap_peers=[bootstrap],
+                        intervals=Intervals.default())
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+async def _wait_for(cond, timeout=30.0, interval=0.1, what="condition"):
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        if cond():
+            return
+        await asyncio.sleep(interval)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _ndjson_lines(raw):
+    return [json.loads(l) for l in raw.splitlines() if l.strip()]
+
+
+def _content(lines):
+    return "".join(l.get("message", {}).get("content", "") for l in lines)
+
+
+@pytest.mark.chaos
+async def test_tenant_quota_sheds_hot_tenant_only():
+    """A hot tenant burning through its token bucket is shed with the
+    standard 503 + Retry-After contract; a light tenant on the SAME
+    gateway keeps being served."""
+    boot_host, _ = await new_host_and_dht(
+        Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    bootstrap = f"127.0.0.1:{boot_host.listen_port}"
+    worker = Peer(Ed25519PrivateKey.generate(), _cfg(bootstrap),
+                  engine=FakeEngine(models=[MODEL]), worker_mode=True)
+    await worker.start()
+    consumer = Peer(Ed25519PrivateKey.generate(), _cfg(bootstrap),
+                    engine=FakeEngine(models=[]), worker_mode=False)
+    await consumer.start()
+    quotas = TenantQuotas(parse_tenant_quotas("default=1000,hot=2"))
+    gateway = Gateway(consumer, port=0, host="127.0.0.1",
+                      tenant_quotas=quotas)
+    await gateway.start()
+    gw_port = gateway._runner.addresses[0][1]
+    try:
+        await _wait_for(
+            lambda: consumer.peer_manager.find_best_worker(MODEL) is not None,
+            what="worker discovery")
+        url = f"http://127.0.0.1:{gw_port}/api/chat"
+        body = {"model": MODEL, "stream": False,
+                "messages": [{"role": "user", "content": "hi"}]}
+
+        async def one(s, tenant):
+            async with s.post(url, json=body,
+                              headers={"X-Tenant": tenant}) as resp:
+                return resp.status, resp.headers.get("Retry-After")
+
+        async with aiohttp.ClientSession() as s:
+            statuses = [await one(s, "hot") for _ in range(3)]
+            assert [st for st, _ in statuses[:2]] == [200, 200]
+            assert statuses[2][0] == 503
+            assert statuses[2][1] is not None       # Retry-After present
+            # The light tenant is untouched by the hot tenant's shed.
+            assert (await one(s, "light"))[0] == 200
+        m = gateway.obs.metrics
+        assert m.tenant_shed.get("hot") == 1
+        assert m.tenant_admitted.get("hot") == 2
+        assert m.tenant_admitted.get("light") == 1
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"http://127.0.0.1:{gw_port}/metrics") as resp:
+                text = await resp.text()
+        assert 'crowdllama_tenant_shed_total{tenant="hot"} 1' in text
+    finally:
+        faults.clear()
+        await gateway.stop()
+        await consumer.stop()
+        await worker.stop()
+        await boot_host.close()
+
+
+# --------------------------------------------------- acceptance e2e
+
+
+@pytest.mark.chaos
+async def test_two_gateways_one_swarm_kill_one_midburst():
+    """Acceptance (ISSUE 7): 2 gateway replicas over 2 REAL engines.  A
+    conversation's first turn lands on gateway A; its affinity pin
+    gossips to gateway B.  A is killed mid-burst: every stream on B
+    completes byte-identically, and the conversation's continuation —
+    now routed to B — still gets an affinity hit via the gossiped pin
+    (same worker, hot KV) with zero replayed prefill."""
+    from crowdllama_tpu.engine.engine import JaxEngine
+
+    kv_kw = dict(model=MODEL, kv_layout="paged", kv_page_size=16,
+                 kv_ship=True, kv_ship_min_tokens=16, kv_ship_timeout=2.0)
+    boot_host, _ = await new_host_and_dht(
+        Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    bootstrap = f"127.0.0.1:{boot_host.listen_port}"
+
+    engines = [JaxEngine(_cfg(bootstrap, **kv_kw), max_context_length=256,
+                         warmup=False) for _ in range(2)]
+    for e in engines:
+        await e.start()
+    workers = [Peer(Ed25519PrivateKey.generate(), _cfg(bootstrap, **kv_kw),
+                    engine=e, worker_mode=True) for e in engines]
+    for w in workers:
+        await w.start()
+
+    consumers, gateways, gnodes = [], [], []
+    for _ in range(2):
+        c = Peer(Ed25519PrivateKey.generate(), _cfg(bootstrap),
+                 engine=FakeEngine(models=[]), worker_mode=False)
+        await c.start()
+        consumers.append(c)
+    for i, c in enumerate(consumers):
+        other = consumers[1 - i]
+        node = GossipNode(
+            c, peers=[f"127.0.0.1:{other.host.listen_port}"], interval=0.2)
+        gw = Gateway(c, port=0, host="127.0.0.1", kv_ship=True, gossip=node)
+        await node.start()
+        await gw.start()
+        gnodes.append(node)
+        gateways.append(gw)
+    ports = [g._runner.addresses[0][1] for g in gateways]
+    stopped = [False, False]
+
+    async def kill_gateway(i):
+        if stopped[i]:
+            return
+        stopped[i] = True
+        await gnodes[i].stop(save=False)
+        await gateways[i].stop()
+        await consumers[i].stop()
+
+    # Keep turn 1 + its reply + the continuation inside the 256-token
+    # test context: short prompt, short num_predict.
+    convo = ("Replicated gateways gossip affinity pins so any replica "
+             "routes a returning user to the worker with hot KV.")
+    burst_prompt = ("Tell the story of the swarm that survived its own "
+                    "entry point dying and kept every other stream alive.")
+
+    def chat_body(messages, n=24):
+        return {"model": MODEL, "stream": True, "messages": messages,
+                "options": {"num_predict": n}}
+
+    async def stream_req(s, port, body):
+        async with s.post(f"http://127.0.0.1:{port}/api/chat",
+                          json=body) as resp:
+            assert resp.status == 200
+            return _ndjson_lines(await resp.text())
+
+    try:
+        for c in consumers:
+            await _wait_for(
+                lambda c=c: len({p.peer_id for p in
+                                 c.peer_manager.get_healthy_peers()
+                                 if p.is_worker}) == 2,
+                what="both workers discovered on both consumers")
+        turn1 = [{"role": "user", "content": convo}]
+        async with aiohttp.ClientSession() as s:
+            # Turn 1 through gateway A.
+            lines = await stream_req(s, ports[0], chat_body(turn1, n=12))
+            assert lines[-1]["done"] is True
+            reply1 = _content(lines)
+            assert gateways[0]._affinity, "turn 1 recorded no affinity"
+
+            # The pin reaches gateway B within the anti-entropy interval.
+            akey, cont = Gateway._affinity_key(MODEL, turn1, "")
+            assert not cont                      # turn 1 is not a continuation
+            await _wait_for(
+                lambda: gnodes[1].lookup_affinity(akey) is not None,
+                timeout=10.0, what="affinity pin gossiped to replica B")
+            pinned_worker = gnodes[1].lookup_affinity(akey)[0]
+
+            # Baseline for the burst prompt (fault-free, via B).
+            base = _content(await stream_req(
+                s, ports[1], chat_body([{"role": "user",
+                                         "content": burst_prompt}])))
+
+            # Burst on BOTH replicas; kill A while everything is inflight.
+            burst_body = chat_body([{"role": "user",
+                                     "content": burst_prompt}])
+            b_tasks = [asyncio.create_task(
+                stream_req(s, ports[1], dict(burst_body)))
+                for _ in range(2)]
+            a_task = asyncio.create_task(
+                stream_req(s, ports[0], dict(burst_body)))
+            await _wait_for(
+                lambda: gateways[1]._inflight >= 2
+                and gateways[0]._inflight >= 1,
+                timeout=20.0, what="burst in flight on both replicas")
+            # Gateway A "crashes": its in-flight socket dies; nothing else.
+            a_task.cancel()
+            await asyncio.gather(a_task, return_exceptions=True)
+            await kill_gateway(0)
+
+            for lines in await asyncio.gather(*b_tasks):
+                assert lines[-1]["done"] is True
+                assert lines[-1].get("done_reason") in ("stop", "length")
+                assert _content(lines) == base, \
+                    "survivor stream diverged from fault-free baseline"
+
+            # Continuation of the A-born conversation, now through B.
+            hits_before = gateways[1]._gossip_affinity_hits
+            cont_lines = await stream_req(s, ports[1], chat_body(
+                turn1 + [{"role": "assistant", "content": reply1},
+                         {"role": "user", "content": "continue the story"}],
+                n=8))
+            assert cont_lines[-1]["done"] is True
+        assert gateways[1]._gossip_affinity_hits == hits_before + 1, \
+            "continuation did not use the gossiped pin"
+        # Same worker -> hot prefix KV -> nothing recomputed or replayed.
+        assert gnodes[1].lookup_affinity(akey)[0] == pinned_worker
+        for w in workers:
+            assert w.obs.metrics.replayed_prefill_tokens == 0
+    finally:
+        faults.clear()
+        await kill_gateway(0)
+        await kill_gateway(1)
+        for w in workers:
+            try:
+                await w.stop()
+            except Exception:
+                pass
+        for e in engines:
+            await e.stop()
+        await boot_host.close()

@@ -191,13 +191,30 @@ async def test_gateway_and_worker_metrics_lint():
             for fam in ("crowdllama_migrated_streams_total",
                         "crowdllama_replayed_prefill_tokens_total"):
                 assert types.get(fam) == "counter", f"{fam} missing"
+            # Replicated-gateway families (docs/ROBUSTNESS.md): gossip
+            # anti-entropy + per-tenant admission, present (at zero) on
+            # BOTH scrape surfaces like every swarm-uniform family.
+            for c in ("frames_sent", "frames_received", "entries_applied",
+                      "entries_stale", "full_syncs", "send_failures",
+                      "snapshot_saves"):
+                fam = f"crowdllama_gossip_{c}_total"
+                assert types.get(fam) == "counter", f"{fam} missing"
+            for g in ("map_entries", "snapshot_entries_loaded"):
+                fam = f"crowdllama_gossip_{g}"
+                assert types.get(fam) == "gauge", f"{fam} missing"
+            for fam, kind in (("crowdllama_tenant_admitted_total",
+                               "counter"),
+                              ("crowdllama_tenant_shed_total", "counter"),
+                              ("crowdllama_tenant_inflight", "gauge")):
+                assert types.get(fam) == kind, f"{fam} missing"
             for g in ("pending_depth", "active_slots", "batch_occupancy",
                       "kv_cache_utilization"):
                 assert types.get(f"crowdllama_engine_{g}") == "gauge"
         # Gateway-side routing counters for the KV-ship plane.
         for fam in ("crowdllama_gateway_affinity_evicted_total",
                     "crowdllama_gateway_affinity_repointed_total",
-                    "crowdllama_gateway_kv_hints_total"):
+                    "crowdllama_gateway_kv_hints_total",
+                    "crowdllama_gateway_gossip_affinity_hits_total"):
             assert gw_types.get(fam) == "counter", f"{fam} missing"
         # Traffic landed in BOTH sides' request histograms.
         for text in (gw_text, wk_text):
