@@ -4,7 +4,7 @@
 PY ?= python
 PYTEST = env JAX_PLATFORMS=cpu $(PY) -m pytest -p no:cacheprovider
 
-.PHONY: test tier1 chaos chaos-multi-gateway distill-smoke bench-kv
+.PHONY: test tier1 chaos chaos-multi-gateway distill-smoke bench-kv trace-demo
 
 # Full suite (slow soaks included).  Runs the chaos matrix FIRST: the
 # fault-injection scenarios are the cheapest way to catch a request-
@@ -39,6 +39,12 @@ chaos-multi-gateway:
 # standalone loop for iterating on train/distill.py.
 distill-smoke:
 	$(PYTEST) tests/ -q -m train
+
+# Stitched-trace demo (docs/OBSERVABILITY.md): boots a loopback relay
+# swarm in process, sends one chat request, and prints its cross-node
+# trace as a waterfall — gateway, relay hop, and worker on one timeline.
+trace-demo:
+	env JAX_PLATFORMS=cpu PYTHONPATH=. $(PY) examples/trace_demo.py
 
 # KV-shipping benchmark (docs/KV_TRANSFER.md): fetch-vs-recompute TTFT
 # over real p2p streams with an injected-RTT sweep; writes the artifact

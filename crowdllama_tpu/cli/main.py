@@ -53,6 +53,12 @@ def build_parser() -> argparse.ArgumentParser:
     sub.add_parser("version", help="print version")
     status = sub.add_parser("network-status", help="probe a gateway's health endpoint")
     status.add_argument("--gateway", default="http://127.0.0.1:9001")
+    trace = sub.add_parser(
+        "trace", help="fetch a cross-node stitched trace from a gateway "
+                      "and print it as a waterfall")
+    trace.add_argument("trace_id", help="trace id (from a response header, "
+                                        "exemplar, or /debug/flightrecorder)")
+    trace.add_argument("--gateway", default="http://127.0.0.1:9001")
     run = sub.add_parser(
         "run", help="chat with a model through a gateway (ollama-run style)")
     run.add_argument("model", help="model name (see /api/tags)")
@@ -130,6 +136,8 @@ def main(argv: list[str] | None = None) -> int:
         return 0
     if args.command == "network-status":
         return asyncio.run(_network_status(args.gateway))
+    if args.command == "trace":
+        return asyncio.run(_trace(args))
     if args.command == "run":
         try:
             return asyncio.run(_run_chat(args))
@@ -402,6 +410,31 @@ async def _network_status(gateway: str) -> int:
     return 0
 
 
+async def _trace(args) -> int:
+    """``trace <trace_id>`` — ask the gateway's collector to stitch the
+    cross-node trace and render it as an indented waterfall
+    (docs/OBSERVABILITY.md: debug a slow request in 3 commands)."""
+    import aiohttp
+
+    from crowdllama_tpu.obs.collector import render_waterfall
+
+    url = f"{args.gateway}/debug/trace/{args.trace_id}"
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(url,
+                             timeout=aiohttp.ClientTimeout(total=15)) as resp:
+                body = await resp.json()
+                if resp.status != 200:
+                    print(f"error: {body.get('error', resp.status)}",
+                          file=sys.stderr)
+                    return 1
+    except Exception as e:
+        print(f"gateway unreachable: {e}", file=sys.stderr)
+        return 1
+    print(render_waterfall(body))
+    return 0
+
+
 async def _run_chat(args) -> int:
     """``run <model>`` — the ollama-run-style chat client.
 
@@ -576,7 +609,10 @@ async def run_node(cfg: Configuration, worker_mode: bool) -> None:
                           admission_max_inflight=cfg.admission_max_inflight,
                           retry_after_s=cfg.retry_after_s,
                           kv_ship=cfg.kv_ship,
-                          gossip=gossip, tenant_quotas=quotas)
+                          gossip=gossip, tenant_quotas=quotas,
+                          flight_recorder=cfg.flight_recorder,
+                          trace_ttl=cfg.trace_ttl,
+                          metrics_exemplars=cfg.metrics_exemplars)
         if gossip is not None:
             gossip.metrics = gateway.obs.metrics
             await gossip.start()

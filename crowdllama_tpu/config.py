@@ -198,6 +198,16 @@ class Configuration:
     # no other HTTP surface).
     trace_buffer: int = 64
     worker_metrics_port: int = 0
+    # Flight recorder (obs/collector.py): how many stitched traces of
+    # "interesting" requests (p99 tail, failovers, migrations, sheds,
+    # kv-ship fallbacks) the gateway retains for GET /debug/flightrecorder.
+    flight_recorder: int = 32
+    # Age-based span eviction: trace ring entries older than this many
+    # seconds are dropped at snapshot/record time (0 = capacity-only).
+    trace_ttl: float = 0.0
+    # Attach OpenMetrics exemplars (`# {trace_id="..."} <v>`) to latency
+    # histogram bucket lines so a tail bucket links straight to a trace.
+    metrics_exemplars: bool = False
 
     # Multi-worker sharded serving (BASELINE configs 4-5): a node with
     # shard_count > 1 serves one shard of an N-way split; shard_group names
@@ -314,6 +324,13 @@ class Configuration:
                                        cfg.trace_buffer))
         cfg.worker_metrics_port = int(env.get(
             "CROWDLLAMA_TPU_WORKER_METRICS_PORT", cfg.worker_metrics_port))
+        cfg.flight_recorder = int(env.get(
+            "CROWDLLAMA_TPU_FLIGHT_RECORDER", cfg.flight_recorder))
+        cfg.trace_ttl = float(env.get(
+            "CROWDLLAMA_TPU_TRACE_TTL", cfg.trace_ttl))
+        if env.get("CROWDLLAMA_TPU_METRICS_EXEMPLARS"):
+            cfg.metrics_exemplars = (
+                env["CROWDLLAMA_TPU_METRICS_EXEMPLARS"] in ("1", "true"))
         if env.get("CROWDLLAMA_TPU_WARMUP"):
             cfg.warmup = env["CROWDLLAMA_TPU_WARMUP"] in ("1", "true")
         for k, v in overrides.items():
@@ -373,6 +390,12 @@ class Configuration:
         if cfg.worker_metrics_port < 0:
             raise ValueError(f"worker_metrics_port must be >= 0, "
                              f"got {cfg.worker_metrics_port}")
+        if cfg.flight_recorder < 1:
+            raise ValueError(f"flight_recorder must be >= 1, "
+                             f"got {cfg.flight_recorder}")
+        if cfg.trace_ttl < 0:
+            raise ValueError(f"trace_ttl must be >= 0, "
+                             f"got {cfg.trace_ttl}")
         cfg.relay_mode = (cfg.relay_mode or "auto").strip().lower()
         if cfg.relay_mode not in ("auto", "always", "off"):
             raise ValueError(f"unknown relay_mode {cfg.relay_mode!r} "
@@ -488,6 +511,18 @@ class Configuration:
                             dest="worker_metrics_port", type=int,
                             help="worker-side /metrics + /debug/trace "
                                  "listener port (0 = disabled)")
+        parser.add_argument("--flight-recorder", dest="flight_recorder",
+                            type=int,
+                            help="stitched traces of interesting requests "
+                                 "kept for GET /debug/flightrecorder "
+                                 "(default 32)")
+        parser.add_argument("--trace-ttl", dest="trace_ttl", type=float,
+                            help="evict trace-ring spans older than this "
+                                 "many seconds (0 = capacity-only)")
+        parser.add_argument("--metrics-exemplars", dest="metrics_exemplars",
+                            action="store_const", const=True, default=None,
+                            help="attach trace_id exemplars to latency "
+                                 "histogram buckets on /metrics")
         parser.add_argument("--request-timeout", dest="request_timeout",
                             type=float,
                             help="per-request wall-clock budget in seconds, "
@@ -551,6 +586,7 @@ class Configuration:
                 "kv_dtype", "relay_mode", "spec_decode", "spec_draft",
                 "spec_draft_model", "spec_draft_path", "spec_draft_max",
                 "profile_dir", "trace_buffer", "worker_metrics_port",
+                "flight_recorder", "trace_ttl", "metrics_exemplars",
                 "request_timeout", "admission_max_inflight",
                 "admission_pending_max", "retry_after_s",
                 "kv_ship", "kv_ship_min_tokens", "kv_ship_timeout",

@@ -201,6 +201,32 @@ def extract_gossip_frame(msg: pb.BaseMessage) -> pb.GossipFrame:
     return msg.gossip_frame
 
 
+def trace_fetch_msg(trace_id: str) -> pb.BaseMessage:
+    """Collector → node: "send me your span fragment for this trace"."""
+    return pb.BaseMessage(trace_fetch=pb.TraceFetch(trace_id=trace_id))
+
+
+def extract_trace_fetch(msg: pb.BaseMessage) -> pb.TraceFetch:
+    if msg.WhichOneof("message") != "trace_fetch":
+        raise ValueError("message does not contain a TraceFetch")
+    return msg.trace_fetch
+
+
+def trace_spans_msg(trace_id: str, node: str = "", payload: bytes = b"",
+                    found: bool = False, error: str = "") -> pb.BaseMessage:
+    """Node → collector: one span fragment (payload = JSON trace record,
+    the same shape the node's own /debug/trace serves)."""
+    return pb.BaseMessage(trace_spans=pb.TraceSpans(
+        trace_id=trace_id, node=node, payload=bytes(payload),
+        found=bool(found), error=error))
+
+
+def extract_trace_spans(msg: pb.BaseMessage) -> pb.TraceSpans:
+    if msg.WhichOneof("message") != "trace_spans":
+        raise ValueError("message does not contain a TraceSpans")
+    return msg.trace_spans
+
+
 def flatten_chat(messages: Iterable[Mapping[str, str]]) -> str:
     """Flatten Ollama-style chat messages into a single prompt string.
 

@@ -47,6 +47,10 @@ class Chunk:
     # donor pages before prefill — becomes a kv_fetch span on the worker's
     # trace surface.  Zero = no fetch attempted.
     kv_fetch_ns: int = 0
+    # True when a fetch was attempted but yielded no pages (donor error or
+    # empty payload) and prefill ran plain — the flight recorder's
+    # kv_ship_fallback trigger confirms against this span meta post-stitch.
+    kv_fallback: bool = False
 
 
 class StopMatcher:
@@ -188,9 +192,11 @@ class Engine:
             # The donor fetch ran before submit, so it is in neither the
             # queue nor the prefill stamp — give it its own span and keep
             # it out of the decode residual below.
+            kv_meta = ({"fallback": True}
+                       if getattr(final, "kv_fallback", False) else {})
             self.obs.trace.record(
                 getattr(msg, "trace_id", ""), "kv_fetch", kv_ns,
-                parent=getattr(msg, "parent_span", ""))
+                parent=getattr(msg, "parent_span", ""), **kv_meta)
         if not prefill_ns:
             prefill_ns = max(0, (first_ns or end_ns) - t0 - queue_ns - kv_ns)
         decode_ns = max(0, (end_ns - t0) - queue_ns - prefill_ns - kv_ns)
@@ -872,6 +878,7 @@ class JaxEngine(Engine):
             kv_import, kv_ns = await self._fetch_kv_payload(
                 kv_donor, model, prompt_ids, trace_id=kv_trace,
                 migrate=migrate)
+        kv_fallback = kv_import is None and kv_ns > 0
         req = GenRequest(
             prompt_ids=prompt_ids,
             max_tokens=max_tokens,
@@ -912,7 +919,7 @@ class JaxEngine(Engine):
                         prompt_tokens=len(prompt_ids),
                         completion_tokens=completion,
                         queue_ns=q_ns, prefill_ns=p_ns,
-                        kv_fetch_ns=kv_ns,
+                        kv_fetch_ns=kv_ns, kv_fallback=kv_fallback,
                     )
                     return
                 completion += 1
@@ -931,7 +938,7 @@ class JaxEngine(Engine):
                         prompt_tokens=len(prompt_ids),
                         completion_tokens=completion,
                         queue_ns=q_ns, prefill_ns=p_ns,
-                        kv_fetch_ns=kv_ns,
+                        kv_fetch_ns=kv_ns, kv_fallback=kv_fallback,
                     )
                     return
                 if emit:

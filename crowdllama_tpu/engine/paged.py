@@ -54,6 +54,7 @@ from crowdllama_tpu.engine.sampling import (
     split_slot_keys,
 )
 from crowdllama_tpu.models import transformer as T
+from crowdllama_tpu.obs.metrics import ENGINE_TELEMETRY
 from crowdllama_tpu.ops.attention import decode_attention, decode_attention_q
 from crowdllama_tpu.ops.pallas.paged import (
     flash_paged_decode_attention,
@@ -432,6 +433,7 @@ class PagedModelRunner(ModelRunner):
         signature."""
         pages = np.full((self.max_pages_per_slot,), self.total_pages,
                         np.int32)
+        t_c = ENGINE_TELEMETRY.compile_begin("ctx_prefill", self.buckets[0])
         self._prefill_ctx(
             self.params, jnp.zeros((1, self.buckets[0]), jnp.int32),
             jnp.int32(1), jnp.int32(0), state.pool_k, state.pool_v,
@@ -440,6 +442,7 @@ class PagedModelRunner(ModelRunner):
             jnp.float32(1.0),
             jnp.asarray(self._recent_from_prompt([])),
             jax.random.PRNGKey(0))
+        ENGINE_TELEMETRY.compile_end("ctx_prefill", self.buckets[0], t_c)
 
     def prefill_prefers_monolithic(self, prompt_ids: list[int]) -> bool:
         """True when the prefix cache covers enough of the prompt that the
@@ -520,6 +523,12 @@ class PagedModelRunner(ModelRunner):
         tokens[0, :len(suffix)] = suffix
         pages = np.full((self.max_pages_per_slot,), self.total_pages, np.int32)
         pages[:len(matched)] = matched  # dump-page padded
+        # One ctx_prefill program per SUFFIX bucket (the dump-page scatter's
+        # page-table width is static) — the prefix-hit analog of prefill's
+        # per-bucket compile.
+        ENGINE_TELEMETRY.padding_inc(useful=len(suffix),
+                                     waste=bucket - len(suffix))
+        t_c = ENGINE_TELEMETRY.compile_begin("ctx_prefill", bucket)
         tok, ks, vs = self._prefill_ctx(
             self.params, jnp.asarray(tokens), jnp.int32(len(suffix)),
             jnp.int32(ctx_len), state.pool_k, state.pool_v,
@@ -529,6 +538,7 @@ class PagedModelRunner(ModelRunner):
             jnp.float32(repeat_penalty),
             jnp.asarray(self._recent_from_prompt(prompt_ids)), key,
         )
+        ENGINE_TELEMETRY.compile_end("ctx_prefill", bucket, t_c)
         self._pending_match = (keys, matched)
         return int(tok), ks, vs, plen
 
@@ -767,16 +777,22 @@ class PagedModelRunner(ModelRunner):
             slot_key = default_slot_key(slot)
         recent_row = self._recent_from_prompt(
             list(prompt_tokens or []), first_token, plen=plen)
-        return self._insert_paged(
+        t_c = ENGINE_TELEMETRY.compile_begin("insert_paged", ks.shape[3])
+        out = self._insert_paged(
             state, jnp.asarray(fresh, jnp.int32), ks, vs, jnp.int32(slot),
             jnp.int32(plen), jnp.int32(first_token),
             jnp.float32(temperature), jnp.float32(top_p), jnp.int32(top_k),
             jnp.float32(repeat_penalty), jnp.asarray(recent_row), slot_key,
         )
+        ENGINE_TELEMETRY.compile_end("insert_paged", ks.shape[3], t_c)
+        return out
 
     def release(self, state: PagedDecodeState, slot: int):
         self._free(slot)
-        return self._release_paged(state, jnp.int32(slot))
+        t_c = ENGINE_TELEMETRY.compile_begin("release_paged", 0)
+        out = self._release_paged(state, jnp.int32(slot))
+        ENGINE_TELEMETRY.compile_end("release_paged", 0, t_c)
+        return out
 
     def _ensure_slot(self, slot: int, steps: int) -> None:
         """Grow one slot's page table to cover ``steps`` more tokens."""
@@ -816,8 +832,10 @@ class PagedModelRunner(ModelRunner):
         # waiting for earlier chunks to finish (see ModelRunner
         # .decode_steps_device on why pipelining matters).
         self._ensure_capacity(num_steps)
+        t_c = ENGINE_TELEMETRY.compile_begin("decode_paged", num_steps)
         tokens, new_state = self._decode_paged(
             self.params, state, jnp.asarray(self.page_table), num_steps)
+        ENGINE_TELEMETRY.compile_end("decode_paged", num_steps, t_c)
         for slot in self._slot_pages:
             self._host_seq[slot] = min(self._host_seq[slot] + num_steps,
                                        self.max_seq)
@@ -990,8 +1008,10 @@ class PagedModelRunner(ModelRunner):
             sc_np = np.dtype(jnp.bfloat16)
             ksp = stack(payload["k_scales"][skip:n], sc_np, (l, hkv, pg))
             vsp = stack(payload["v_scales"][skip:n], sc_np, (l, hkv, pg))
+        t_c = ENGINE_TELEMETRY.compile_begin("import_paged", width)
         state = self._import_paged(state, jnp.asarray(page_idx), kp, vp,
                                    ksp, vsp)
+        ENGINE_TELEMETRY.compile_end("import_paged", width, t_c)
         for i, page in enumerate(fresh):
             key = keys[skip + i]
             self._prefix_index[key] = page

@@ -37,6 +37,7 @@ from crowdllama_tpu.engine.sampling import (
 )
 from crowdllama_tpu.models import transformer as T
 from crowdllama_tpu.models.config import ModelConfig
+from crowdllama_tpu.obs.metrics import ENGINE_TELEMETRY
 from crowdllama_tpu.parallel.mesh import (
     AXIS_DP,
     AXIS_PP,
@@ -436,9 +437,14 @@ class ModelRunner:
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :take] = job.prompt_ids[
             job.done_tokens:job.done_tokens + take]
+        # Chunk compiles are per (chunk bucket, ctx width) shape pair.
+        sig = f"{bucket}x{width}"
+        ENGINE_TELEMETRY.padding_inc(useful=take, waste=bucket - take)
+        t_c = ENGINE_TELEMETRY.compile_begin("prefill_chunk", sig)
         job.last_logits, job.ctx_k, job.ctx_v = self._prefill_chunk(
             self.params, jnp.asarray(tokens), jnp.int32(take),
             jnp.int32(job.done_tokens), job.ctx_k, job.ctx_v)
+        ENGINE_TELEMETRY.compile_end("prefill_chunk", sig, t_c)
         job.done_tokens += take
         return job.finished
 
@@ -510,12 +516,15 @@ class ModelRunner:
         bucket = self.bucket_for(plen)
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :plen] = prompt_ids
+        ENGINE_TELEMETRY.padding_inc(useful=plen, waste=bucket - plen)
+        t_c = ENGINE_TELEMETRY.compile_begin("prefill", bucket)
         tok, ks, vs = self._prefill(
             self.params, jnp.asarray(tokens), jnp.int32(plen),
             jnp.float32(temperature), jnp.float32(top_p), jnp.int32(top_k),
             jnp.float32(repeat_penalty),
             jnp.asarray(self._recent_from_prompt(prompt_ids)), key,
         )
+        ENGINE_TELEMETRY.compile_end("prefill", bucket, t_c)
         return int(tok), ks, vs, plen
 
     _EMBED_BATCH = (1, 2, 4, 8)  # padded batch sizes (bounds compile count)
@@ -545,9 +554,15 @@ class ModelRunner:
                 for row, i in enumerate(chunk):
                     tokens[row, :len(prompts[i])] = prompts[i]
                     plens[row] = len(prompts[i])
+                useful = sum(len(prompts[i]) for i in chunk)
+                ENGINE_TELEMETRY.padding_inc(
+                    useful=useful, waste=bs * bucket - useful)
+                sig = f"{bs}x{bucket}"
+                t_c = ENGINE_TELEMETRY.compile_begin("embed", sig)
                 vecs = np.asarray(self._embed_fwd(
                     self.params, jnp.asarray(tokens), jnp.asarray(plens)),
                     np.float32)
+                ENGINE_TELEMETRY.compile_end("embed", sig, t_c)
                 for row, i in enumerate(chunk):
                     out[i] = vecs[row]
         return out
@@ -586,19 +601,27 @@ class ModelRunner:
             slot_key = default_slot_key(slot)
         recent_row = self._recent_from_prompt(
             list(prompt_tokens or []), first_token, plen=plen)
-        return self._insert(
+        # Insert compiles once per prefill-bucket KV width (ks [L,1,Hkv,T,Dh]).
+        sig = ks.shape[3]
+        t_c = ENGINE_TELEMETRY.compile_begin("insert", sig)
+        out = self._insert(
             state, jnp.int32(slot), ks, vs, jnp.int32(plen),
             jnp.int32(first_token), jnp.float32(temperature),
             jnp.float32(top_p), jnp.int32(top_k),
             jnp.float32(repeat_penalty), jnp.asarray(recent_row), slot_key,
         )
+        ENGINE_TELEMETRY.compile_end("insert", sig, t_c)
+        return out
 
     def release(self, state: DecodeState, slot: int) -> DecodeState:
-        return self._release(state, jnp.int32(slot))
+        t_c = ENGINE_TELEMETRY.compile_begin("release", 0)
+        out = self._release(state, jnp.int32(slot))
+        ENGINE_TELEMETRY.compile_end("release", 0, t_c)
+        return out
 
     def decode_steps(self, state: DecodeState, num_steps: int = 1):
         """Run ``num_steps`` decode steps; returns (tokens [K, B] np, state)."""
-        tokens, new_state = self._decode(self.params, state, num_steps)
+        tokens, new_state = self.decode_steps_device(state, num_steps)
         return np.asarray(tokens), new_state
 
     def decode_steps_device(self, state: DecodeState, num_steps: int = 1):
@@ -610,4 +633,8 @@ class ModelRunner:
         tunnel: ~70 ms RTT vs ~5 ms/step of compute).  The scheduler and
         bench.py read tokens back with ``np.asarray`` when they need them.
         """
-        return self._decode(self.params, state, num_steps)
+        # Each distinct chunk length is a static arg → its own XLA program.
+        t_c = ENGINE_TELEMETRY.compile_begin("decode", num_steps)
+        out = self._decode(self.params, state, num_steps)
+        ENGINE_TELEMETRY.compile_end("decode", num_steps, t_c)
+        return out

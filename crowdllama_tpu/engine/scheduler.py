@@ -37,6 +37,7 @@ import jax
 import numpy as np
 
 from crowdllama_tpu.engine.runner import ModelRunner
+from crowdllama_tpu.obs.metrics import ENGINE_TELEMETRY
 
 log = logging.getLogger("crowdllama.engine.scheduler")
 
@@ -875,6 +876,13 @@ class Scheduler:
         now = time.monotonic()
         dt = max(now - max(self._last_retire_at, fl.dispatched_at), 1e-6)
         self._last_retire_at = now
+        # Decode chunks run the full fixed batch shape: every slot that was
+        # empty at dispatch computed throwaway rows for the whole chunk.
+        live = sum(1 for s in fl.snapshot if isinstance(s, _SlotInfo))
+        steps = tokens.shape[0]
+        batch = tokens.shape[-1]
+        ENGINE_TELEMETRY.padding_inc(useful=live * steps,
+                                     waste=max(0, batch - live) * steps)
         emitted = 0
         chunk_acc = 0  # draft tokens accepted in this chunk (live slots)
         chunk_off = 0  # draft tokens offered in this chunk (live slots)

@@ -48,6 +48,7 @@ from crowdllama_tpu.engine.sampling import (
     split_slot_keys,
 )
 from crowdllama_tpu.models import transformer as T
+from crowdllama_tpu.obs.metrics import ENGINE_TELEMETRY
 
 log = logging.getLogger("crowdllama.engine.spec")
 
@@ -283,9 +284,15 @@ class SpecModelRunner(_AdaptiveDraftLen, ModelRunner):
             # rides through the plain scan untouched; it goes stale, which
             # only costs proposal quality after a resume, never correctness.
             return ModelRunner.decode_steps_device(self, state, num_steps)
-        return self._spec_decode(self.params, state,
-                                 jnp.asarray(self._spec_plens), num_steps,
-                                 self.draft_len)
+        # draft_len is a static arg: every retune is a NEW XLA program —
+        # exactly the recompile signal the compile counters exist to show.
+        sig = f"{num_steps}x{self.draft_len}"
+        t_c = ENGINE_TELEMETRY.compile_begin("spec_decode", sig)
+        out = self._spec_decode(self.params, state,
+                                jnp.asarray(self._spec_plens), num_steps,
+                                self.draft_len)
+        ENGINE_TELEMETRY.compile_end("spec_decode", sig, t_c)
+        return out
 
 
 class SpecPagedModelRunner(_AdaptiveDraftLen, PagedModelRunner):
@@ -465,9 +472,12 @@ class SpecPagedModelRunner(_AdaptiveDraftLen, PagedModelRunner):
                                                         num_steps)
         j = 1 + self.draft_len
         self._ensure_capacity(num_steps * j)
+        sig = f"{num_steps}x{self.draft_len}"
+        t_c = ENGINE_TELEMETRY.compile_begin("spec_decode_paged", sig)
         packed, new_state = self._spec_decode(
             self.params, state, jnp.asarray(self.page_table),
             jnp.asarray(self._spec_plens), num_steps, self.draft_len)
+        ENGINE_TELEMETRY.compile_end("spec_decode_paged", sig, t_c)
         for slot in self._slot_pages:
             self._host_seq[slot] = min(self._host_seq[slot] + num_steps * j,
                                        self.max_seq)

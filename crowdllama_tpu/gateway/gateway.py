@@ -37,7 +37,12 @@ from crowdllama_tpu.core.messages import (
 from crowdllama_tpu.core.protocol import INFERENCE_PROTOCOL
 from crowdllama_tpu.obs import GATEWAY_ROOT_SPAN, NodeObs, new_trace_id
 from crowdllama_tpu.obs.http import host_stat_lines
-from crowdllama_tpu.obs.metrics import LabelGuard, engine_gauge_lines
+from crowdllama_tpu.obs.metrics import (
+    ENGINE_TELEMETRY,
+    LabelGuard,
+    device_memory_lines,
+    engine_gauge_lines,
+)
 from crowdllama_tpu.peer.peer import Peer
 
 log = logging.getLogger("crowdllama.gateway")
@@ -115,7 +120,8 @@ class Gateway:
                  trace_buffer: int = 64, request_timeout: float = 600.0,
                  admission_max_inflight: int = 0,
                  retry_after_s: float = 1.0, kv_ship: bool = False,
-                 gossip=None, tenant_quotas=None):
+                 gossip=None, tenant_quotas=None, flight_recorder: int = 32,
+                 trace_ttl: float = 0.0, metrics_exemplars: bool = False):
         self.peer = peer
         self.port = port
         self.host = host
@@ -160,6 +166,13 @@ class Gateway:
                                  self.handle_openai_embeddings)
         self.app.router.add_get("/metrics", self.handle_metrics)
         self.app.router.add_get("/debug/trace", self.handle_trace)
+        # Cross-node trace assembly + flight recorder (PR 8): the stitched
+        # endpoint fans TraceFetch out over the p2p plane per hit, so it is
+        # a debugging surface, not a hot path.
+        self.app.router.add_get("/debug/trace/{trace_id}",
+                                self.handle_trace_stitched)
+        self.app.router.add_get("/debug/flightrecorder",
+                                self.handle_flightrecorder)
         for route in ("/api/delete", "/api/create", "/api/copy", "/api/push"):
             self.app.router.add_route("*", route, self.handle_unsupported)
         # Prometheus-style counters fed by the logging middleware
@@ -187,7 +200,25 @@ class Gateway:
         # Tracing + histogram plane (obs/): trace ids minted per routed
         # request, spans recorded into the ring served at /debug/trace,
         # histograms rendered into /metrics alongside the PR 1 counters.
-        self.obs = NodeObs(trace_capacity=trace_buffer, node="gateway")
+        self.obs = NodeObs(trace_capacity=trace_buffer, node="gateway",
+                           trace_ttl=trace_ttl, exemplars=metrics_exemplars)
+        # Swarm-stitched traces + flight recorder (PR 8): the collector
+        # assembles this gateway's fragment with every remote node's via
+        # TraceFetch fan-out; the recorder keeps complete stitched traces
+        # for interesting requests (p99 tail, failover, migrate, shed,
+        # kv-ship fallback) in its own ring so they outlive the general one.
+        from crowdllama_tpu.obs.collector import FlightRecorder, TraceCollector
+
+        self.collector = TraceCollector(peer, self.obs)
+        self.flight = FlightRecorder(capacity=flight_recorder)
+        # Rolling-p99 capture needs a floor of observations before the
+        # quantile means anything; below it only event triggers capture.
+        self._flight_min_count = 30
+        # A 5xx storm (mass shedding) must not fan a stitch out per failed
+        # request: captures beyond this many in flight are dropped — the
+        # ring only keeps the newest N complete traces anyway.
+        self._flight_inflight = 0
+        self._flight_max_inflight = 4
         # Inference-stream pool: a request to a worker reuses an idle
         # encrypted stream instead of paying TCP connect + signed-hello
         # handshake (Ed25519 sign/verify + X25519) per request — the
@@ -318,9 +349,11 @@ class Gateway:
         self._stream_pool.put(worker_id, s)
 
     async def _dial(self, worker_id: str, acc: dict | None = None,
-                    timeout: float | None = None):
+                    timeout: float | None = None, trace_id: str = ""):
         """``timeout`` caps the dial + handshake at the request's remaining
-        budget (never above the protocol's own handshake timeout)."""
+        budget (never above the protocol's own handshake timeout).
+        ``trace_id`` rides a relay-splice fallback's connect frame so the
+        relay node records a relay_splice span the collector can stitch."""
         from crowdllama_tpu.net.host import HANDSHAKE_TIMEOUT
 
         t0 = time.perf_counter_ns()
@@ -330,7 +363,7 @@ class Gateway:
         hs = (HANDSHAKE_TIMEOUT if timeout is None
               else max(0.05, min(HANDSHAKE_TIMEOUT, timeout)))
         s = await self.peer.host.new_stream(contact, INFERENCE_PROTOCOL,
-                                            timeout=hs)
+                                            timeout=hs, trace_id=trace_id)
         if acc is not None:
             acc["dial_ns"] = acc.get("dial_ns", 0) \
                 + time.perf_counter_ns() - t0
@@ -726,7 +759,7 @@ class Gateway:
                 s.close()
                 log.debug("pooled stream to %s stale (%s); redialing",
                           worker_id[:8], e)
-        s = await self._dial(worker_id, acc=acc)
+        s = await self._dial(worker_id, acc=acc, trace_id=msg.trace_id)
         try:
             await self._send_frame(s, frame, acc=acc)
             reply = await self._recv_pb(s, timeout=timeout, acc=acc)
@@ -940,13 +973,47 @@ class Gateway:
                 lines.extend(engine_gauge_lines(engine.obs_gauges()))
             except Exception as e:
                 log.debug("engine gauges unavailable: %s", e)
+        # Engine compile/padding telemetry + device memory (PR 8): process
+        # singletons, so a gateway co-located with an engine reports real
+        # numbers and a pure consumer reports the zero series (present
+        # families keep absent()-style alerts working).
+        lines.extend(ENGINE_TELEMETRY.expose())
+        lines.extend(device_memory_lines())
         lines.extend(host_stat_lines(self.peer.host))
         return web.Response(text="\n".join(lines) + "\n",
                             content_type="text/plain")
 
     async def handle_trace(self, request: web.Request) -> web.Response:
-        """GET /debug/trace — JSON dump of the span ring buffer."""
-        return web.json_response(self.obs.trace.snapshot())
+        """GET /debug/trace — JSON dump of the span ring buffer.
+
+        ``?trace_id=`` filters to one trace, ``?limit=N`` keeps the N
+        newest records (this node's fragment only — the stitched
+        cross-node view lives at /debug/trace/<trace_id>)."""
+        try:
+            limit = max(0, int(request.query.get("limit", "0") or 0))
+        except ValueError:
+            limit = 0
+        return web.json_response(self.obs.trace.snapshot(
+            trace_id=request.query.get("trace_id", ""), limit=limit))
+
+    async def handle_trace_stitched(self,
+                                    request: web.Request) -> web.Response:
+        """GET /debug/trace/<trace_id> — one clock-aligned cross-node span
+        tree: this gateway's fragment as the root, plus every fragment a
+        TraceFetch fan-out pulls from the swarm (workers, relay hosts)."""
+        tid = request.match_info.get("trace_id", "")
+        stitched = await self.collector.collect(tid)
+        if stitched is None:
+            return web.json_response(
+                {"error": f"trace {tid!r} not found on any reachable node"},
+                status=404)
+        return web.json_response(stitched)
+
+    async def handle_flightrecorder(self,
+                                    request: web.Request) -> web.Response:
+        """GET /debug/flightrecorder — the captured stitched traces of
+        recent interesting requests, newest last."""
+        return web.json_response(self.flight.snapshot())
 
     async def handle_unsupported(self, request: web.Request) -> web.Response:
         """Model management (delete/create/copy/push) has no meaning at the
@@ -1590,9 +1657,73 @@ class Gateway:
                           parent=GATEWAY_ROOT_SPAN)
         tr.finish(tid, total_ns, status=status,
                   worker=worker_id[:8] if worker_id else "")
-        self.obs.metrics.request_seconds.labels(model).observe(total_ns / 1e9)
+        hist = self.obs.metrics.request_seconds.labels(model)
+        total_s = total_ns / 1e9
+        # Flight-recorder decision BEFORE observing this request: a tail
+        # request must be compared against the p99 of everything before it,
+        # not a distribution it already dragged upward.
+        reasons = self._flight_reasons(tid, hist, total_s, status)
+        hist.observe(total_s, exemplar=tid)
+        if reasons:
+            self._flight_capture(tid, reasons)
 
-    def _observe_ttfb(self, dt: float) -> None:
+    def _flight_reasons(self, tid: str, hist, total_s: float,
+                        status: int) -> list[str]:
+        """Why this request is interesting enough for the flight recorder
+        (empty = it is not).  Gateway-visible triggers only; worker-side
+        kv-ship fallbacks are confirmed post-stitch in _flight_capture."""
+        reasons: list[str] = []
+        if hist.count >= self._flight_min_count \
+                and total_s > hist.quantile(0.99):
+            reasons.append("p99_latency")
+        if status >= 500:
+            reasons.append(f"status_{status}")
+        rec = self.obs.trace.get(tid)
+        if rec is not None:
+            names = {s.get("name", "") for s in rec.get("spans", [])}
+            if "failover" in names:
+                reasons.append("failover")
+            if "migrate" in names:
+                reasons.append("migrate")
+            if "kv_hint" in names:
+                # Candidate only: kept iff the stitched worker fragment
+                # shows the donor fetch actually fell back.
+                reasons.append("kv_hint")
+        return reasons
+
+    def _flight_capture(self, tid: str, reasons: list[str]) -> None:
+        """Stitch + capture asynchronously: the fan-out must never sit on
+        the request path (we are inside _route's finally)."""
+        if (self.flight.get(tid) is not None
+                or self._flight_inflight >= self._flight_max_inflight):
+            return
+        self._flight_inflight += 1
+
+        async def _go() -> None:
+            try:
+                stitched = await self.collector.collect(tid)
+            except Exception as e:
+                log.debug("flight-recorder stitch for %s failed: %s",
+                          tid, e)
+                return
+            finally:
+                self._flight_inflight -= 1
+            if stitched is None:
+                return
+            final = list(reasons)
+            if "kv_hint" in final:
+                final.remove("kv_hint")
+                if any(s.get("name") == "kv_fetch"
+                       and (s.get("meta", {}).get("fallback")
+                            or s.get("meta", {}).get("error"))
+                       for s in stitched.get("spans", [])):
+                    final.append("kv_ship_fallback")
+            if final:
+                self.flight.capture(tid, final, stitched)
+
+        asyncio.ensure_future(_go())
+
+    def _observe_ttfb(self, dt: float, tid: str = "") -> None:
         for i, le in enumerate(self._ttfb_le):
             if dt <= le:
                 self._ttfb_buckets[i] += 1
@@ -1601,7 +1732,7 @@ class Gateway:
             self._ttfb_buckets[-1] += 1
         self._ttfb_sum += dt
         self._ttfb_count += 1
-        self.obs.metrics.ttft_seconds.observe(dt)
+        self.obs.metrics.ttft_seconds.observe(dt, exemplar=tid)
 
     async def _terminal_error_frame(self, ctx: _StreamCtx, shape: str,
                                     model: str,
@@ -1723,7 +1854,8 @@ class Gateway:
         if s is None:
             s = await self._dial(worker_id, acc=acc,
                                  timeout=(remaining()
-                                          if deadline is not None else None))
+                                          if deadline is not None else None),
+                                 trace_id=msg.trace_id)
             try:
                 await self._send_frame(s, frame, acc=acc)
                 first = classify(
@@ -1743,7 +1875,8 @@ class Gateway:
             if first.done_reason == "error":
                 raise RuntimeError(first.response)
             if ctx.out is None:
-                self._observe_ttfb(time.monotonic() - t0)
+                self._observe_ttfb(time.monotonic() - t0,
+                                   tid=msg.trace_id)
                 out = web.StreamResponse(
                     status=200,
                     headers={"Content-Type": ("text/event-stream" if openai
@@ -1821,7 +1954,7 @@ class Gateway:
                     raise
                 t_now = time.perf_counter_ns()
                 self.obs.metrics.decode_step_seconds.observe(
-                    (t_now - t_prev) / 1e9)
+                    (t_now - t_prev) / 1e9, exemplar=msg.trace_id)
                 t_prev = t_now
             if openai:
                 try:

@@ -510,13 +510,19 @@ class Host:
     async def new_stream(
         self, target: Contact | str, protocol: str,
         timeout: float = HANDSHAKE_TIMEOUT, reuse_sock: bool = False,
-        local_port: int = 0,
+        local_port: int = 0, trace_id: str = "",
     ) -> Stream:
         """Dial a peer and open an authenticated stream for ``protocol``.
 
         ``target`` may be a Contact (identity verified against its peer_id) or
         a bare "host:port" address (identity learned from the remote hello, as
         when dialing a bootstrap address, cf. discovery.go:92-141).
+
+        ``trace_id`` rides the relay ``connect`` control frame when the dial
+        falls back to a splice: the relay forwards only sealed ciphertext and
+        can never see the envelope's trace fields, so this is the one place
+        the id can cross to the relay node for span recording.  The control
+        channel is authenticated, and a trace id carries no payload data.
 
         ``reuse_sock`` dials from a SO_REUSEADDR/SO_REUSEPORT socket:
         hole punching rebinds the LOCAL port of a live signaling stream
@@ -529,7 +535,8 @@ class Host:
             "host.new_stream", protocol=protocol,
             peer=target.peer_id if isinstance(target, Contact) else "")
         if isinstance(target, Contact) and target.relay:
-            return await self._new_stream_via_relay(target, protocol, timeout)
+            return await self._new_stream_via_relay(target, protocol, timeout,
+                                                    trace_id)
         if isinstance(target, Contact):
             host, port, expect_id = target.host, target.port, target.peer_id
         else:
@@ -654,7 +661,8 @@ class Host:
         )
 
     async def _new_stream_via_relay(self, target: Contact, protocol: str,
-                                    timeout: float) -> Stream:
+                                    timeout: float,
+                                    trace_id: str = "") -> Stream:
         """Open ``protocol`` to a NATed peer through its relay: dial the
         relay, ask it to splice us to ``target.peer_id``, then run the
         normal end-to-end handshake through the splice — the relay carries
@@ -710,8 +718,10 @@ class Host:
         outer = await self.new_stream(f"{target.host}:{target.port}",
                                       RELAY_PROTOCOL, timeout)
         try:
-            await write_json_frame(outer.writer,
-                                   {"op": "connect", "target": target.peer_id})
+            connect = {"op": "connect", "target": target.peer_id}
+            if trace_id:
+                connect["trace_id"] = trace_id
+            await write_json_frame(outer.writer, connect)
             reply = await read_json_frame(outer.reader, timeout)
             if not reply.get("ok"):
                 raise HandshakeError(

@@ -50,6 +50,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import time
 
 from crowdllama_tpu.core.protocol import RELAY_PROTOCOL
 from crowdllama_tpu.testing import faults
@@ -93,6 +94,12 @@ class RelayService:
         # conn_id -> future resolved with (worker Stream, done Event)
         self._pending: dict[str, asyncio.Future] = {}
         self._closed = False
+        # NodeObs of the hosting Peer (attached by Peer._start_relay_service).
+        # When a connect frame carries a trace_id, the splice records a
+        # relay_splice span here so the trace collector can stitch the relay
+        # hop into the cross-node tree — the spliced bytes themselves are
+        # sealed end-to-end and carry nothing the relay can read.
+        self.obs = None
         host.set_stream_handler(RELAY_PROTOCOL, self.handle)
 
     def close(self) -> None:
@@ -122,7 +129,8 @@ class RelayService:
             elif op == "register":
                 await self._handle_register(stream)
             elif op == "connect":
-                await self._handle_connect(stream, str(req.get("target", "")))
+                await self._handle_connect(stream, str(req.get("target", "")),
+                                           str(req.get("trace_id", "")))
             elif op == "connect_reverse":
                 await self._handle_connect_reverse(
                     stream, str(req.get("target", "")),
@@ -175,7 +183,13 @@ class RelayService:
 
     # -------------------------------------------------------------- connect
 
-    async def _handle_connect(self, stream: Stream, target: str) -> None:
+    async def _handle_connect(self, stream: Stream, target: str,
+                              trace_id: str = "") -> None:
+        if trace_id and self.obs is not None:
+            # The spliced bytes are sealed end-to-end, so this control-frame
+            # id is the relay's only chance to join the stitched trace.
+            self.obs.trace.begin(trace_id)
+        t0 = time.monotonic_ns()
         reg = self._workers.get(target)
         if reg is None:
             await write_json_frame(
@@ -205,6 +219,16 @@ class RelayService:
             return
         await write_json_frame(stream.writer, {"ok": True})
         reg.splices += 1
+        if trace_id and self.obs is not None:
+            # Recorded at establishment, not teardown: a pooled stream keeps
+            # the splice alive across many requests, and the trace must be
+            # fetchable while its request is still the one on the wire.  The
+            # span covers the relay's setup work (worker accept round-trip).
+            dur = time.monotonic_ns() - t0
+            self.obs.trace.record(
+                trace_id, "relay_splice", dur,
+                **{"from": stream.remote_peer_id[:8], "to": target[:8]})
+            self.obs.trace.finish(trace_id, dur)
         try:
             await _splice(stream, worker_stream)
         finally:

@@ -44,12 +44,19 @@ ENGINE_GAUGES = (
 
 
 class NodeObs:
-    """One node's tracing + metrics state (gateway or worker)."""
+    """One node's tracing + metrics state (gateway or worker).
 
-    def __init__(self, trace_capacity: int = 64, node: str = "") -> None:
+    ``trace_ttl`` (seconds, 0 = off) age-evicts span fragments so the
+    trace collector never stitches stale data; ``exemplars`` enables the
+    OpenMetrics trace_id exemplar suffix on the request-path histograms.
+    """
+
+    def __init__(self, trace_capacity: int = 64, node: str = "",
+                 trace_ttl: float = 0.0, exemplars: bool = False) -> None:
         self.node = node
-        self.trace = TraceBuffer(capacity=trace_capacity, node=node)
-        self.metrics = NodeMetrics()
+        self.trace = TraceBuffer(capacity=trace_capacity, node=node,
+                                 ttl=trace_ttl)
+        self.metrics = NodeMetrics(exemplars=exemplars)
 
     def observe_generate(self, trace_id: str, parent: str, model: str,
                          queue_ns: int, prefill_ns: int, decode_ns: int,
@@ -59,8 +66,10 @@ class NodeObs:
         Called at the Engine seam so FakeEngine and JaxEngine produce the
         same span taxonomy (worker_queue / prefill / decode_step).
         """
-        self.metrics.request_seconds.labels(model).observe(total_ns / 1e9)
-        self.metrics.ttft_seconds.observe((queue_ns + prefill_ns) / 1e9)
+        self.metrics.request_seconds.labels(model).observe(
+            total_ns / 1e9, exemplar=trace_id)
+        self.metrics.ttft_seconds.observe(
+            (queue_ns + prefill_ns) / 1e9, exemplar=trace_id)
         if trace_id:
             t = self.trace
             t.begin(trace_id, model=model, **meta)

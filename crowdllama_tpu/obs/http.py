@@ -18,7 +18,11 @@ import logging
 
 from aiohttp import web
 
-from crowdllama_tpu.obs.metrics import engine_gauge_lines
+from crowdllama_tpu.obs.metrics import (
+    ENGINE_TELEMETRY,
+    device_memory_lines,
+    engine_gauge_lines,
+)
 
 log = logging.getLogger("crowdllama.obs")
 
@@ -78,12 +82,23 @@ class ObsServer:
                 lines.extend(engine_gauge_lines(engine.obs_gauges()))
             except Exception as e:  # a sick engine must not break the scrape
                 log.debug("engine gauges unavailable: %s", e)
+        # XLA compile/padding telemetry + device memory (PR 8): process
+        # singletons, real numbers on the node that actually compiles.
+        lines.extend(ENGINE_TELEMETRY.expose())
+        lines.extend(device_memory_lines())
         lines.extend(host_stat_lines(self.peer.host))
         return web.Response(text="\n".join(lines) + "\n",
                             content_type="text/plain")
 
     async def handle_trace(self, request: web.Request) -> web.Response:
-        return web.json_response(self.peer.obs.trace.snapshot())
+        """``?trace_id=`` filters to one trace, ``?limit=N`` keeps the N
+        newest records (PR 8 satellite — same contract as the gateway's)."""
+        try:
+            limit = max(0, int(request.query.get("limit", "0") or 0))
+        except ValueError:
+            limit = 0
+        return web.json_response(self.peer.obs.trace.snapshot(
+            trace_id=request.query.get("trace_id", ""), limit=limit))
 
     async def handle_drain(self, request: web.Request) -> web.Response:
         drain = getattr(self.peer, "drain", None)

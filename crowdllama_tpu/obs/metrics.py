@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import re
 import threading
+import time
 from typing import Iterable
 
 # Bucket upper bounds in seconds.  Request/TTFT cover loopback FakeEngine
@@ -30,6 +31,11 @@ TTFT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                 1.0, 2.5, 5.0, 10.0)
 DECODE_STEP_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
                        0.05, 0.1, 0.25, 0.5, 1.0)
+# XLA compile wall time per (program, bucket) first dispatch: CPU-jitted
+# tiny test models compile in tens of ms, big-model TPU prefill programs in
+# minutes.
+COMPILE_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                   10.0, 30.0, 60.0, 120.0)
 
 _LABEL_VALUE_RE = re.compile(r"^[A-Za-z0-9_.:/\-]{1,64}$")
 
@@ -74,17 +80,24 @@ class LabelGuard:
 
 
 class Histogram:
-    """Fixed-bucket histogram, rendered cumulatively at exposition time."""
+    """Fixed-bucket histogram, rendered cumulatively at exposition time.
+
+    Observations may carry a trace_id *exemplar* — the last one lands on
+    the bucket it fell into and, when exemplar rendering is enabled, is
+    emitted in OpenMetrics syntax (`` # {trace_id="..."} <value>``) so a
+    dashboard spike links straight to a stitched trace."""
 
     def __init__(self, buckets: Iterable[float]) -> None:
         self.buckets = tuple(sorted(float(b) for b in buckets))
         if not self.buckets:
             raise ValueError("histogram needs at least one bucket")
         self._counts = [0] * (len(self.buckets) + 1)  # +1 = overflow (+Inf)
+        self._exemplars: list[tuple[str, float] | None] = \
+            [None] * (len(self.buckets) + 1)
         self._sum = 0.0
         self._lock = threading.Lock()
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: str = "") -> None:
         v = float(value)
         idx = len(self.buckets)
         for i, b in enumerate(self.buckets):
@@ -94,6 +107,8 @@ class Histogram:
         with self._lock:
             self._counts[idx] += 1
             self._sum += v
+            if exemplar:
+                self._exemplars[idx] = (exemplar, v)
 
     @property
     def count(self) -> int:
@@ -118,22 +133,35 @@ class Histogram:
         the scraped series."""
         return quantile_from_counts(self.buckets, self.snapshot_counts(), q)
 
-    def lines(self, name: str, labels: str = "") -> list[str]:
+    def lines(self, name: str, labels: str = "",
+              exemplars: bool = False) -> list[str]:
         """Series lines (no TYPE header) for one child of a family.
 
         ``labels`` is a pre-rendered ``key="value"`` list without braces.
+        With ``exemplars`` each bucket that captured one gets the
+        OpenMetrics exemplar suffix on its _bucket line.
         """
         with self._lock:
             counts = list(self._counts)
+            exs = list(self._exemplars)
             total_sum = self._sum
         sep = "," if labels else ""
+
+        def _ex(i: int) -> str:
+            if not exemplars or exs[i] is None:
+                return ""
+            tid, v = exs[i]
+            return f' # {{trace_id="{tid}"}} {_fmt(v)}'
+
         out: list[str] = []
         cum = 0
-        for b, c in zip(self.buckets, counts):
+        for i, (b, c) in enumerate(zip(self.buckets, counts)):
             cum += c
-            out.append(f'{name}_bucket{{{labels}{sep}le="{_fmt(b)}"}} {cum}')
+            out.append(f'{name}_bucket{{{labels}{sep}le="{_fmt(b)}"}} '
+                       f'{cum}{_ex(i)}')
         cum += counts[-1]
-        out.append(f'{name}_bucket{{{labels}{sep}le="+Inf"}} {cum}')
+        out.append(f'{name}_bucket{{{labels}{sep}le="+Inf"}} '
+                   f'{cum}{_ex(len(counts) - 1)}')
         out.append(f"{name}_sum{{{labels}}} {_fmt(total_sum)}"
                    if labels else f"{name}_sum {_fmt(total_sum)}")
         out.append(f"{name}_count{{{labels}}} {cum}"
@@ -183,19 +211,24 @@ class HistogramVec:
                 self._children[key] = h
             return h
 
-    def expose(self, name: str) -> list[str]:
+    def expose(self, name: str, exemplars: bool = False) -> list[str]:
         out = [f"# TYPE {name} histogram"]
         with self._lock:
             children = sorted(self._children.items())
         for key, h in children:
-            out.extend(h.lines(name, f'{self._label}="{key}"'))
+            out.extend(h.lines(name, f'{self._label}="{key}"',
+                               exemplars=exemplars))
         return out
 
 
 class NodeMetrics:
     """The three per-node histogram families, one instance per node."""
 
-    def __init__(self) -> None:
+    def __init__(self, exemplars: bool = False) -> None:
+        # OpenMetrics trace_id exemplars on the request-path histograms
+        # (--metrics-exemplars): off by default — classic Prometheus text
+        # parsers reject the suffix.
+        self.exemplars = bool(exemplars)
         self.model_guard = LabelGuard(max_values=32)
         self.request_seconds = HistogramVec(
             REQUEST_BUCKETS, "model", self.model_guard)
@@ -251,12 +284,15 @@ class NodeMetrics:
         family[key] = family.get(key, 0) + int(n)
 
     def expose(self) -> list[str]:
-        out = self.request_seconds.expose("crowdllama_request_seconds")
+        ex = self.exemplars
+        out = self.request_seconds.expose("crowdllama_request_seconds",
+                                          exemplars=ex)
         out.append("# TYPE crowdllama_ttft_seconds histogram")
-        out.extend(self.ttft_seconds.lines("crowdllama_ttft_seconds"))
+        out.extend(self.ttft_seconds.lines("crowdllama_ttft_seconds",
+                                           exemplars=ex))
         out.append("# TYPE crowdllama_decode_step_seconds histogram")
         out.extend(self.decode_step_seconds.lines(
-            "crowdllama_decode_step_seconds"))
+            "crowdllama_decode_step_seconds", exemplars=ex))
         for key in ("bytes", "fetches", "fallbacks", "retries"):
             name = f"crowdllama_kv_ship_{key}_total"
             out.append(f"# TYPE {name} counter")
@@ -308,4 +344,132 @@ def engine_gauge_lines(gauges: dict) -> list[str]:
         name = f"crowdllama_engine_{key}"
         out.append(f"# TYPE {name} gauge")
         out.append(f"{name} {_fmt(val)}")
+    return out
+
+
+class EngineTelemetry:
+    """Process-wide XLA compile + padding accounting (PR 8 tentpole).
+
+    Module-level (like net/secure's aead counters) rather than hung off
+    NodeObs: the runners compile during engine construction and warmup,
+    BEFORE the peer wires ``engine.obs`` — a per-node object would miss
+    exactly the compiles the operator most wants to see.  Thread-safe:
+    the scheduler's jax-dispatch thread records while the event loop
+    scrapes.
+
+    Compile detection is first-dispatch timing: the first call of a jitted
+    program per static signature (program name + bucket) pays trace +
+    lower + XLA compile synchronously, so its wall time IS the compile
+    cost to within one dispatch — deterministic, backend-agnostic, and
+    exactly the recompile-storm signal (a retuned spec draft_len or an
+    unexpected prefill bucket shows up as a new (program, bucket) count).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.compile_seconds = Histogram(COMPILE_BUCKETS)
+        self.program_guard = LabelGuard(max_values=64)
+        self.bucket_guard = LabelGuard(max_values=256)
+        self._compiles: dict[tuple[str, str], int] = {}
+        self._seen: set[tuple[str, str]] = set()
+        self._padding = {"waste": 0, "useful": 0}
+
+    def _key(self, program: str, bucket: object) -> tuple[str, str]:
+        return (self.program_guard.value(program),
+                self.bucket_guard.value(str(bucket)))
+
+    def compile_begin(self, program: str, bucket: object) -> float:
+        """0.0 when (program, bucket) already dispatched; otherwise claim
+        the signature and return a perf_counter() start for compile_end.
+        The membership probe is the only cost on the steady-state path."""
+        key = self._key(program, bucket)
+        with self._lock:
+            if key in self._seen:
+                return 0.0
+            self._seen.add(key)
+        return time.perf_counter()
+
+    def compile_end(self, program: str, bucket: object, t0: float) -> None:
+        if not t0:
+            return
+        dt = max(0.0, time.perf_counter() - t0)
+        key = self._key(program, bucket)
+        with self._lock:
+            self._compiles[key] = self._compiles.get(key, 0) + 1
+        self.compile_seconds.observe(dt)
+
+    def padding_inc(self, useful: int, waste: int) -> None:
+        """Account one padded dispatch: ``useful`` real tokens rode it,
+        ``waste`` were padding (bucket rounding, inactive decode slots)."""
+        with self._lock:
+            self._padding["useful"] += max(0, int(useful))
+            self._padding["waste"] += max(0, int(waste))
+
+    def snapshot_compiles(self) -> dict[tuple[str, str], int]:
+        """(program, bucket) -> count; tests diff two snapshots to assert
+        e.g. a draft_len retune added exactly one new decode bucket."""
+        with self._lock:
+            return dict(self._compiles)
+
+    def padding_snapshot(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._padding)
+
+    def expose(self) -> list[str]:
+        out = ["# TYPE crowdllama_xla_compile_seconds histogram"]
+        out.extend(self.compile_seconds.lines(
+            "crowdllama_xla_compile_seconds"))
+        with self._lock:
+            compiles = sorted(self._compiles.items())
+            padding = dict(self._padding)
+        out.append("# TYPE crowdllama_xla_compiles_total counter")
+        if not compiles:
+            out.append('crowdllama_xla_compiles_total{program="none",'
+                       'bucket="0"} 0')
+        for (program, bucket), n in compiles:
+            out.append(f'crowdllama_xla_compiles_total{{'
+                       f'program="{program}",bucket="{bucket}"}} {n}')
+        out.append("# TYPE crowdllama_padding_waste_tokens_total counter")
+        out.append(f"crowdllama_padding_waste_tokens_total "
+                   f"{padding['waste']}")
+        out.append("# TYPE crowdllama_useful_tokens_total counter")
+        out.append(f"crowdllama_useful_tokens_total {padding['useful']}")
+        return out
+
+
+# The process-wide engine profiling plane; runners and schedulers record
+# into it directly, both scrape surfaces render it.
+ENGINE_TELEMETRY = EngineTelemetry()
+
+
+def device_memory_lines() -> list[str]:
+    """Per-device memory gauges from jax.local_devices()[*].memory_stats(),
+    sampled at scrape time.  Platforms without the API (CPU) report zeros —
+    the series must exist for absent()-style alerts either way."""
+    devices = []
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:
+        pass
+    out = ["# TYPE crowdllama_device_memory_bytes_in_use gauge",
+           "# TYPE crowdllama_device_memory_bytes_limit gauge"]
+    if not devices:
+        out.append('crowdllama_device_memory_bytes_in_use{device="0"} 0')
+        out.append('crowdllama_device_memory_bytes_limit{device="0"} 0')
+        return out
+    for i, d in enumerate(devices):
+        stats: dict = {}
+        try:
+            stats = d.memory_stats() or {}
+        except Exception:
+            pass
+        in_use = int(stats.get("bytes_in_use") or 0)
+        limit = int(stats.get("bytes_limit")
+                    or stats.get("bytes_reservable_limit") or 0)
+        out.append(f'crowdllama_device_memory_bytes_in_use{{'
+                   f'device="{i}"}} {in_use}')
+        out.append(f'crowdllama_device_memory_bytes_limit{{'
+                   f'device="{i}"}} {limit}')
     return out

@@ -76,15 +76,35 @@ _MAX_SPANS_PER_TRACE = 64
 
 
 class TraceBuffer:
-    """Bounded ring of the last N requests' span trees, oldest evicted."""
+    """Bounded ring of the last N requests' span trees, oldest evicted.
 
-    def __init__(self, capacity: int = 64, node: str = "") -> None:
+    ``ttl`` (seconds, 0 = off) additionally age-evicts: a long-lived,
+    lightly-loaded worker must not serve week-old fragments to the trace
+    collector as if they described the request being debugged."""
+
+    def __init__(self, capacity: int = 64, node: str = "",
+                 ttl: float = 0.0) -> None:
         self.capacity = max(1, int(capacity))
         self.node = node
+        self.ttl = max(0.0, float(ttl))
         self._lock = threading.Lock()
         self._traces: OrderedDict[str, _TraceRecord] = OrderedDict()
 
+    def _evict_expired(self) -> None:
+        """Drop records older than the TTL (caller holds the lock).  The
+        ring is insertion-ordered, so expiry scans stop at the first
+        still-fresh record."""
+        if not self.ttl:
+            return
+        cutoff = time.time() - self.ttl
+        while self._traces:
+            oldest = next(iter(self._traces.values()))
+            if oldest.started_unix >= cutoff:
+                break
+            self._traces.popitem(last=False)
+
     def _get_or_create(self, trace_id: str, meta: dict) -> _TraceRecord:
+        self._evict_expired()
         rec = self._traces.get(trace_id)
         if rec is None:
             rec = _TraceRecord(trace_id, meta)
@@ -135,12 +155,24 @@ class TraceBuffer:
 
     def get(self, trace_id: str) -> dict[str, Any] | None:
         with self._lock:
+            self._evict_expired()
             rec = self._traces.get(trace_id)
             return rec.to_json() if rec is not None else None
 
-    def snapshot(self) -> dict[str, Any]:
-        """JSON-ready dump, oldest first, for ``GET /debug/trace``."""
+    def snapshot(self, trace_id: str = "",
+                 limit: int = 0) -> dict[str, Any]:
+        """JSON-ready dump, oldest first, for ``GET /debug/trace``.
+
+        ``trace_id`` filters to one trace; ``limit`` keeps only the N
+        NEWEST records (the ones a debugging operator is after)."""
         with self._lock:
-            traces = [rec.to_json() for rec in self._traces.values()]
+            self._evict_expired()
+            if trace_id:
+                rec = self._traces.get(trace_id)
+                traces = [rec.to_json()] if rec is not None else []
+            else:
+                traces = [rec.to_json() for rec in self._traces.values()]
+        if limit > 0:
+            traces = traces[-limit:]
         return {"node": self.node, "capacity": self.capacity,
                 "traces": traces}

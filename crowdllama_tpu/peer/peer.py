@@ -139,7 +139,9 @@ class Peer:
         # obs/http.ObsServer on workers, read directly by tests/benches.
         self.obs = NodeObs(
             trace_capacity=getattr(config, "trace_buffer", 64) or 64,
-            node="worker" if worker_mode else "consumer")
+            node="worker" if worker_mode else "consumer",
+            trace_ttl=getattr(config, "trace_ttl", 0.0) or 0.0,
+            exemplars=bool(getattr(config, "metrics_exemplars", False)))
 
     # ----------------------------------------------------------- lifecycle
 
@@ -323,6 +325,9 @@ class Peer:
 
         if self.relay_service is None:
             self.relay_service = RelayService(self.host)
+            # Traced relay splices record relay_splice spans into this
+            # node's ring so the trace collector can fetch the relay hop.
+            self.relay_service.obs = self.obs
             self.resource.relay_capable = True
             log.info("hosting relay service for NATed peers")
 
@@ -644,6 +649,9 @@ class Peer:
             if which == "kv_fetch_request":
                 await self._serve_kv_fetch(stream, msg)
                 return True
+            if which == "trace_fetch":
+                await self._serve_trace_fetch(stream, msg)
+                return True
             if which == "gossip_frame":
                 # Replicated gateway anti-entropy (swarm/gossip.py): merge
                 # the sender's LWW map + usage digests, reply with our own
@@ -747,6 +755,30 @@ class Peer:
             return True  # error frame delivered; the exchange is complete
 
     _KV_FRAME_BYTES = 4 * 1024 * 1024  # page payload per KvPages frame
+
+    async def _serve_trace_fetch(self, stream: Stream, msg) -> None:
+        """Serve the trace collector's span-fragment fetch (PR 8).
+
+        The payload is the SAME JSON record this node's own /debug/trace
+        serves — schema-stable as span vocabularies evolve, and the
+        collector never needs per-span proto churn.  A node that never
+        saw the id answers ``found=false``: the collector's fan-out IS
+        the index, so a miss is the common, cheap case."""
+        import json as _json
+
+        from crowdllama_tpu.core.messages import trace_spans_msg
+
+        trace_id = msg.trace_fetch.trace_id
+        node = f"{self.obs.trace.node or 'peer'}:{self.peer_id[:8]}"
+        rec = self.obs.trace.get(trace_id) if trace_id else None
+        if rec is None:
+            out = trace_spans_msg(trace_id, node=node, found=False)
+        else:
+            out = trace_spans_msg(
+                trace_id, node=node,
+                payload=_json.dumps(rec).encode("utf-8"), found=True)
+        out.trace_id = trace_id
+        await wire.write_length_prefixed_pb(stream.writer, out)
 
     async def _serve_kv_fetch(self, stream: Stream, msg) -> None:
         """Serve a peer's paged-KV fetch (docs/KV_TRANSFER.md, donor side).

@@ -1,0 +1,95 @@
+"""One-shot demo of the swarm-stitched trace plane (docs/OBSERVABILITY.md).
+
+Boots a loopback swarm IN PROCESS — a relay-hosting bootstrap peer, two
+workers forced onto the relay splice path, and a gateway — pushes a single
+chat request through it, then renders the stitched cross-node trace as a
+waterfall, exactly what `crowdllama-tpu trace <id>` shows against a real
+deployment.  Run it via `make trace-demo`.
+"""
+
+import asyncio
+import os
+from types import SimpleNamespace
+
+import aiohttp
+
+from crowdllama_tpu.cli.main import _trace
+from crowdllama_tpu.config import Configuration, Intervals
+from crowdllama_tpu.engine.engine import FakeEngine
+from crowdllama_tpu.gateway.gateway import Gateway
+from crowdllama_tpu.peer.peer import Peer
+from crowdllama_tpu.utils.crypto_compat import Ed25519PrivateKey
+
+
+def _cfg(bootstrap=None, **kw):
+    cfg = Configuration(
+        listen_host="127.0.0.1",
+        bootstrap_peers=[bootstrap] if bootstrap else [],
+        intervals=Intervals.default(),
+    )
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+async def main() -> int:
+    # Force the relay SPLICE data path so the waterfall includes the
+    # relay hop; on loopback, hole punching would otherwise win.
+    os.environ["CROWDLLAMA_TPU_NO_PUNCH"] = "1"
+    os.environ["CROWDLLAMA_TPU_NO_REVERSE"] = "1"
+
+    relay_peer = Peer(Ed25519PrivateKey.generate(), _cfg(),
+                      engine=FakeEngine(models=["relay-noop"]),
+                      worker_mode=True)
+    await relay_peer.start()
+    bootstrap = f"127.0.0.1:{relay_peer.host.listen_port}"
+
+    workers = [Peer(Ed25519PrivateKey.generate(),
+                    _cfg(bootstrap, relay_mode="always"),
+                    engine=FakeEngine(models=["tiny-test"]),
+                    worker_mode=True)
+               for _ in range(2)]
+    for w in workers:
+        await w.start()
+    consumer = Peer(Ed25519PrivateKey.generate(), _cfg(bootstrap),
+                    engine=FakeEngine(models=[]), worker_mode=False)
+    await consumer.start()
+    gateway = Gateway(consumer, port=0, host="127.0.0.1",
+                      metrics_exemplars=True)
+    await gateway.start()
+    gw = f"http://127.0.0.1:{gateway._runner.addresses[0][1]}"
+
+    try:
+        print("waiting for the swarm to assemble ...")
+        deadline = asyncio.get_running_loop().time() + 30.0
+        while asyncio.get_running_loop().time() < deadline:
+            ready = [p for p in consumer.peer_manager.get_workers()
+                     if "tiny-test" in p.resource.supported_models]
+            if len(ready) == 2:
+                break
+            await asyncio.sleep(0.1)
+        else:
+            print("swarm never assembled")
+            return 1
+
+        async with aiohttp.ClientSession() as s:
+            body = {"model": "tiny-test", "stream": False,
+                    "messages": [{"role": "user",
+                                  "content": "tell me about the swarm"}]}
+            async with s.post(f"{gw}/api/chat", json=body) as resp:
+                resp.raise_for_status()
+                await resp.json()
+
+        tid = gateway.obs.trace.snapshot()["traces"][-1]["trace_id"]
+        print(f"\n$ crowdllama-tpu trace {tid} --gateway {gw}\n")
+        return await _trace(SimpleNamespace(trace_id=tid, gateway=gw))
+    finally:
+        await gateway.stop()
+        await consumer.stop()
+        for w in workers:
+            await w.stop()
+        await relay_peer.stop()
+
+
+if __name__ == "__main__":
+    raise SystemExit(asyncio.run(main()))

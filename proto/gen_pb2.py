@@ -147,6 +147,26 @@ def apply_schema_edits(fdp: descriptor_pb2.FileDescriptorProto) -> None:
     _ensure_field(gfr, _field("clock", 5, U64))
     _ensure_message(fdp, gfr)
 
+    # PR 8: swarm-stitched traces (docs/OBSERVABILITY.md collector).  The
+    # gateway's collector fans a TraceFetch out to every node a request
+    # touched; each answers with its span fragment for that trace_id.
+    tfr = descriptor_pb2.DescriptorProto(name="TraceFetch")
+    _ensure_field(tfr, _field("trace_id", 1, STR))
+    _ensure_message(fdp, tfr)
+
+    # TraceSpans: one node's fragment.  ``payload`` is the node's trace
+    # record as JSON (the exact /debug/trace shape — spans with start_us
+    # offsets from the node's own clock plus started_at wall time, which
+    # the collector aligns per hop); ``found`` distinguishes "no such
+    # trace here" from an empty record.
+    tsp = descriptor_pb2.DescriptorProto(name="TraceSpans")
+    _ensure_field(tsp, _field("trace_id", 1, STR))
+    _ensure_field(tsp, _field("node", 2, STR))
+    _ensure_field(tsp, _field("payload", 3, BYTES))
+    _ensure_field(tsp, _field("found", 4, BOOL))
+    _ensure_field(tsp, _field("error", 5, STR))
+    _ensure_message(fdp, tsp)
+
     (base,) = [m for m in fdp.message_type if m.name == "BaseMessage"]
     _ensure_field(base, _field("kv_fetch_request", 7, MSG,
                                type_name=".llama.v1.KvFetchRequest",
@@ -159,6 +179,12 @@ def apply_schema_edits(fdp: descriptor_pb2.FileDescriptorProto) -> None:
                                oneof_index=0))
     _ensure_field(base, _field("gossip_frame", 10, MSG,
                                type_name=".llama.v1.GossipFrame",
+                               oneof_index=0))
+    _ensure_field(base, _field("trace_fetch", 11, MSG,
+                               type_name=".llama.v1.TraceFetch",
+                               oneof_index=0))
+    _ensure_field(base, _field("trace_spans", 12, MSG,
+                               type_name=".llama.v1.TraceSpans",
                                oneof_index=0))
 
 

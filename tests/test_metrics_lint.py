@@ -26,6 +26,10 @@ _LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"')
 _VALUE_RE = re.compile(r"^[A-Za-z0-9_.:+/\- ]{0,128}$")
 _SAMPLE_RE = re.compile(
     r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{([^}]*)\})? (\S+)$")
+# OpenMetrics exemplar suffix (--metrics-exemplars): only histogram
+# _bucket lines may carry one, and the label set is exactly a trace_id
+# in the gateway's 64-bit-hex mint format.
+_EXEMPLAR_RE = re.compile(r' # \{trace_id="[0-9a-f]{1,64}"\} \S+$')
 
 
 def _parse(text):
@@ -47,6 +51,16 @@ def _parse(text):
             assert fam not in types, f"line {ln}: duplicate TYPE for {fam}"
             types[fam] = kind
             continue
+        if " # " in line:
+            sample, sep, _ = line.partition(" # ")
+            assert _EXEMPLAR_RE.search(line), (
+                f"line {ln}: malformed exemplar {line!r}")
+            assert _SAMPLE_RE.match(sample) and \
+                _SAMPLE_RE.match(sample).group(1).endswith("_bucket"), (
+                f"line {ln}: exemplar on a non-bucket line {line!r}")
+            ex_val = float(line.rsplit(" ", 1)[1])
+            assert ex_val >= 0, f"line {ln}: negative exemplar value"
+            line = sample
         m = _SAMPLE_RE.match(line)
         assert m, f"line {ln}: unparseable sample {line!r}"
         name, _, labels, value = m.groups()
@@ -110,6 +124,7 @@ def _lint(text: str) -> dict[str, str]:
 def _cfg(bootstrap):
     return Configuration(listen_host="127.0.0.1",
                          bootstrap_peers=[bootstrap],
+                         metrics_exemplars=True,
                          intervals=Intervals.default())
 
 
@@ -138,7 +153,8 @@ async def test_gateway_and_worker_metrics_lint():
     consumer = Peer(Ed25519PrivateKey.generate(), _cfg(bootstrap),
                     engine=FakeEngine(models=[]), worker_mode=False)
     await consumer.start()
-    gateway = Gateway(consumer, port=0, host="127.0.0.1")
+    gateway = Gateway(consumer, port=0, host="127.0.0.1",
+                      metrics_exemplars=True)
     await gateway.start()
     gw_port = gateway._runner.addresses[0][1]
 
@@ -210,6 +226,19 @@ async def test_gateway_and_worker_metrics_lint():
             for g in ("pending_depth", "active_slots", "batch_occupancy",
                       "kv_cache_utilization"):
                 assert types.get(f"crowdllama_engine_{g}") == "gauge"
+            # Engine flight-recorder telemetry (docs/OBSERVABILITY.md):
+            # XLA compile timing/counters + padding-waste accounting +
+            # device memory, present on BOTH surfaces (zero-valued on a
+            # node that never compiled).
+            assert types.get(
+                "crowdllama_xla_compile_seconds") == "histogram"
+            for fam in ("crowdllama_xla_compiles_total",
+                        "crowdllama_padding_waste_tokens_total",
+                        "crowdllama_useful_tokens_total"):
+                assert types.get(fam) == "counter", f"{fam} missing"
+            for fam in ("crowdllama_device_memory_bytes_in_use",
+                        "crowdllama_device_memory_bytes_limit"):
+                assert types.get(fam) == "gauge", f"{fam} missing"
         # Gateway-side routing counters for the KV-ship plane.
         for fam in ("crowdllama_gateway_affinity_evicted_total",
                     "crowdllama_gateway_affinity_repointed_total",
@@ -221,6 +250,12 @@ async def test_gateway_and_worker_metrics_lint():
             assert re.search(r'crowdllama_request_seconds_count\{'
                              r'model="tiny-test"\} [1-9]', text), (
                 "no tiny-test request samples recorded")
+        # Exemplars on: the routed requests must have attached a trace_id
+        # exemplar to at least one gateway request_seconds bucket (and the
+        # suffix passed the OpenMetrics shape check in _parse above).
+        assert re.search(r'crowdllama_request_seconds_bucket\{[^}]*\}'
+                         r' \S+ # \{trace_id="[0-9a-f]+"\} ', gw_text), (
+            "no trace_id exemplar on the gateway request histogram")
     finally:
         await gateway.stop()
         await consumer.stop()

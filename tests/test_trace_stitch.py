@@ -1,0 +1,288 @@
+"""Swarm-stitched traces + flight recorder (obs/collector.py): cross-node
+trace assembly over a REAL relay-spliced loopback swarm, flight-recorder
+capture on an injected mid-stream worker kill, and the XLA compile-counter
+contract that a speculative draft_len retune claims exactly one new
+program bucket."""
+
+import asyncio
+import json
+from types import SimpleNamespace
+
+import aiohttp
+import pytest
+from crowdllama_tpu.utils.crypto_compat import Ed25519PrivateKey
+
+from crowdllama_tpu.config import Configuration, Intervals
+from crowdllama_tpu.engine.engine import FakeEngine
+from crowdllama_tpu.gateway.gateway import Gateway
+from crowdllama_tpu.peer.peer import Peer
+from crowdllama_tpu.testing import faults
+from crowdllama_tpu.testing.faults import FaultPlan, FaultRule
+
+
+def _cfg(bootstrap=None, **kw):
+    cfg = Configuration(
+        listen_host="127.0.0.1",
+        bootstrap_peers=[bootstrap] if bootstrap else [],
+        intervals=Intervals.default(),
+    )
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+async def _wait_for(cond, timeout=30.0, interval=0.1, what="condition"):
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        if cond():
+            return
+        await asyncio.sleep(interval)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _chat_body(stream=False):
+    return {"model": "tiny-test", "stream": stream,
+            "messages": [{"role": "user",
+                          "content": "tell me a long story about the "
+                                     "swarm and its peers"}]}
+
+
+async def test_stitched_trace_across_relay_spliced_swarm(monkeypatch,
+                                                         capsys):
+    """Tentpole e2e: two relayed workers behind a relay-hosting peer; a
+    routed request's trace stitches gateway + relay + worker fragments
+    into ONE orphan-free tree served at /debug/trace/<id>, and the
+    ``crowdllama-tpu trace`` CLI renders it as a waterfall."""
+    # Pin the relay SPLICE data path: reversal/punch would win on loopback
+    # and the relay hop (and its relay_splice span) would never exist.
+    monkeypatch.setenv("CROWDLLAMA_TPU_NO_PUNCH", "1")
+    monkeypatch.setenv("CROWDLLAMA_TPU_NO_REVERSE", "1")
+
+    # The bootstrap node is a full Peer (not a bare host): with no
+    # bootstrap peers of its own it hosts the RelayService, and being a
+    # Peer it has the obs plane + TraceFetch serving the collector needs
+    # to pull the relay hop's fragment.
+    # NB: FakeEngine(models=[]) falls back to tiny-test; a decoy name keeps
+    # the relay host out of the tiny-test routing pool while still letting
+    # the collector fan out to it (it IS a worker to the peer manager).
+    relay_peer = Peer(Ed25519PrivateKey.generate(), _cfg(),
+                      engine=FakeEngine(models=["relay-noop"]),
+                      worker_mode=True)
+    await relay_peer.start()
+    assert relay_peer.relay_service is not None
+    assert relay_peer.relay_service.obs is relay_peer.obs
+    bootstrap = f"127.0.0.1:{relay_peer.host.listen_port}"
+
+    workers = [Peer(Ed25519PrivateKey.generate(),
+                    _cfg(bootstrap, relay_mode="always"),
+                    engine=FakeEngine(models=["tiny-test"]),
+                    worker_mode=True)
+               for _ in range(2)]
+    for w in workers:
+        await w.start()
+        assert w.resource.reachability == "relay"
+
+    consumer = Peer(Ed25519PrivateKey.generate(), _cfg(bootstrap),
+                    engine=FakeEngine(models=[]), worker_mode=False)
+    await consumer.start()
+    gateway = Gateway(consumer, port=0, host="127.0.0.1")
+    await gateway.start()
+    gw_port = gateway._runner.addresses[0][1]
+
+    try:
+        await _wait_for(
+            lambda: len([p for p in consumer.peer_manager.get_workers()
+                         if "tiny-test" in p.resource.supported_models]) == 2
+            and len(consumer.peer_manager.get_workers()) == 3,
+            what="both relayed workers + the relay peer discovered")
+
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"http://127.0.0.1:{gw_port}/api/chat",
+                              json=_chat_body()) as resp:
+                assert resp.status == 200, await resp.text()
+                served_by = (await resp.json())["worker_id"]
+
+            traces = gateway.obs.trace.snapshot()["traces"]
+            assert traces, "gateway recorded no trace"
+            tid = traces[-1]["trace_id"]
+
+            async with s.get(f"http://127.0.0.1:{gw_port}"
+                             f"/debug/trace/{tid}") as resp:
+                assert resp.status == 200, await resp.text()
+                stitched = await resp.json()
+
+            # Unknown ids 404 with a JSON error, not a stack trace.
+            async with s.get(f"http://127.0.0.1:{gw_port}"
+                             f"/debug/trace/feedbeefdeadbeef") as resp:
+                assert resp.status == 404
+                assert "error" in await resp.json()
+
+        assert stitched["stitched"] is True
+        assert stitched["trace_id"] == tid
+        # Three processes touched the request: the gateway root fragment,
+        # the relay hop, and the serving worker.  The idle second worker
+        # answered found=false and is absent.
+        assert len(stitched["nodes"]) == 3, stitched["nodes"]
+        assert stitched["nodes"][0] == "gateway"
+        names = {sp["name"] for sp in stitched["spans"]}
+        assert "relay_splice" in names, names
+        assert {"route", "serde", "aead", "io_wait"} <= names
+        assert {"worker_queue", "prefill"} <= names
+
+        worker_nodes = {sp["node"] for sp in stitched["spans"]
+                        if sp["name"] in ("worker_queue", "prefill")}
+        assert worker_nodes == {f"worker:{served_by[:8]}"}
+
+        # Orphan-free tree: every parent resolves to a rendered span, and
+        # every span window nests inside the gateway request window.
+        total = stitched["total_us"]
+        for sp in stitched["spans"]:
+            assert sp["parent"] in names | {""}, f"orphan span {sp}"
+            assert sp["start_us"] >= 0.0
+            assert sp["start_us"] <= total + 1e-6, sp
+
+        # The CLI surface: `crowdllama-tpu trace <id>` prints the same
+        # stitched tree as an indented waterfall.
+        from crowdllama_tpu.cli.main import _trace
+
+        rc = await _trace(SimpleNamespace(
+            trace_id=tid, gateway=f"http://127.0.0.1:{gw_port}"))
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert tid in out
+        assert "relay_splice" in out
+        assert "▇" in out  # bars actually rendered
+    finally:
+        await gateway.stop()
+        await consumer.stop()
+        for w in workers:
+            await w.stop()
+        await relay_peer.stop()
+
+
+async def test_flight_recorder_captures_killed_worker_failover():
+    """A seeded kill_stream mid-stream forces a failover; the flight
+    recorder must capture that request's COMPLETE stitched trace with the
+    failover span intact, served at /debug/flightrecorder."""
+    boot = Peer(Ed25519PrivateKey.generate(), _cfg(),
+                engine=FakeEngine(models=["boot-noop"]), worker_mode=True)
+    await boot.start()
+    bootstrap = f"127.0.0.1:{boot.host.listen_port}"
+
+    workers = [Peer(Ed25519PrivateKey.generate(), _cfg(bootstrap),
+                    engine=FakeEngine(models=["tiny-test"]),
+                    worker_mode=True)
+               for _ in range(2)]
+    for w in workers:
+        await w.start()
+    consumer = Peer(Ed25519PrivateKey.generate(), _cfg(bootstrap),
+                    engine=FakeEngine(models=[]), worker_mode=False)
+    await consumer.start()
+    gateway = Gateway(consumer, port=0, host="127.0.0.1",
+                      flight_recorder=8)
+    await gateway.start()
+    gw_port = gateway._runner.addresses[0][1]
+
+    try:
+        await _wait_for(
+            lambda: len([p for p in consumer.peer_manager.get_workers()
+                         if "tiny-test" in p.resource.supported_models]) == 2,
+            what="both workers discovered")
+        plan = FaultPlan(seed=42, rules=[
+            FaultRule(site="engine.stream_chunk", action="kill_stream",
+                      after=3, times=1)])
+        async with aiohttp.ClientSession() as s:
+            with faults.installed(plan):
+                async with s.post(f"http://127.0.0.1:{gw_port}/api/chat",
+                                  json=_chat_body(stream=True)) as resp:
+                    assert resp.status == 200
+                    raw = await resp.text()
+            lines = [json.loads(l) for l in raw.splitlines() if l.strip()]
+            assert lines[-1]["done"] is True
+            assert plan.log and plan.log[0][2] == "kill_stream"
+
+            failover_tids = [
+                t["trace_id"]
+                for t in gateway.obs.trace.snapshot()["traces"]
+                if any(sp["name"] == "failover" for sp in t["spans"])]
+            assert len(failover_tids) == 1
+            tid = failover_tids[0]
+
+            # The capture stitches asynchronously off the request path.
+            await _wait_for(lambda: gateway.flight.get(tid) is not None,
+                            timeout=15.0, what="flight-recorder capture")
+
+            async with s.get(f"http://127.0.0.1:{gw_port}"
+                             f"/debug/flightrecorder") as resp:
+                assert resp.status == 200
+                snap = await resp.json()
+
+        assert snap["capacity"] == 8
+        assert snap["captured_total"] >= 1
+        entry = next(e for e in snap["traces"] if e["trace_id"] == tid)
+        assert "failover" in entry["reasons"]
+        # The failover span survived into the stitched capture, under the
+        # gateway root, naming both sides of the move.
+        fo = [sp for sp in entry["trace"]["spans"]
+              if sp["name"] == "failover"]
+        assert len(fo) == 1
+        assert fo[0]["parent"] == "gateway"
+        assert fo[0]["meta"]["from_worker"] != fo[0]["meta"]["to_worker"]
+        # A boring request (no failover, sub-p99) was NOT captured.
+        boring = [t["trace_id"]
+                  for t in gateway.obs.trace.snapshot()["traces"]
+                  if t["trace_id"] != tid]
+        assert all(gateway.flight.get(t) is None for t in boring)
+    finally:
+        faults.clear()
+        await gateway.stop()
+        await consumer.stop()
+        for w in workers:
+            await w.stop()
+        await boot.stop()
+
+
+def test_spec_draft_retune_claims_one_new_compile_bucket():
+    """Acceptance: draft_len is a STATIC argument of the speculative
+    decode program, so an acceptance-driven retune compiles a NEW XLA
+    program — the compile counter must grow by exactly one new
+    (program, bucket) signature, and re-running at the old length must
+    not recompile."""
+    import jax
+    import jax.numpy as jnp
+
+    from crowdllama_tpu.engine.spec import SpecModelRunner
+    from crowdllama_tpu.models import transformer as T
+    from crowdllama_tpu.models.config import get_config
+    from crowdllama_tpu.obs.metrics import ENGINE_TELEMETRY
+
+    cfg = get_config("tiny-test", max_context_length=128)
+    params = T.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    spec = SpecModelRunner(cfg, params=params, max_slots=2, max_seq=128,
+                           dtype=jnp.float32, draft_len=2)
+    state = spec.init_state()
+    prompt = [1, 5, 9, 5, 9, 5]
+    first, ks, vs, plen = spec.prefill(prompt, 0.0, 1.0,
+                                       jax.random.PRNGKey(7))
+    state = spec.insert(state, 0, ks, vs, plen, first, 0.0, 1.0,
+                        prompt_tokens=prompt)
+
+    _, state = spec.decode_steps(state, 2)  # claims ("spec_decode", "2x2")
+    before = ENGINE_TELEMETRY.snapshot_compiles()
+    assert before.get(("spec_decode", "2x2"), 0) >= 1
+
+    spec.set_draft_len(3)  # the acceptance-adaptive retune signal
+    _, state = spec.decode_steps(state, 2)
+    after = ENGINE_TELEMETRY.snapshot_compiles()
+
+    new_keys = {k for k in after if k not in before
+                and k[0].startswith("spec_decode")}
+    assert new_keys == {("spec_decode", "2x3")}, new_keys
+    assert after[("spec_decode", "2x3")] == 1
+
+    # Back at the old length: the program is cached, no new compile.
+    spec.set_draft_len(2)
+    _, state = spec.decode_steps(state, 2)
+    again = ENGINE_TELEMETRY.snapshot_compiles()
+    assert again[("spec_decode", "2x2")] == before[("spec_decode", "2x2")]
