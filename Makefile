@@ -4,7 +4,8 @@
 PY ?= python
 PYTEST = env JAX_PLATFORMS=cpu $(PY) -m pytest -p no:cacheprovider
 
-.PHONY: test tier1 chaos chaos-multi-gateway distill-smoke bench-kv trace-demo
+.PHONY: test tier1 chaos chaos-multi-gateway distill-smoke bench-kv \
+	bench-mixed trace-demo
 
 # Full suite (slow soaks included).  Runs the chaos matrix FIRST: the
 # fault-injection scenarios are the cheapest way to catch a request-
@@ -51,3 +52,11 @@ trace-demo:
 # under benchmarks/results/.
 bench-kv:
 	env JAX_PLATFORMS=cpu CROWDLLAMA_BENCH_PHASES=kv_transfer $(PY) bench.py
+
+# Unified-ragged-batch benchmark (docs/RAGGED_BATCH.md): decode-step p95
+# while a long prefill chunks through the same jitted step (swept over
+# step_token_budget, with the retired alternating loop as the control),
+# plus a 32k-token prefill the monolithic one-shot path could not fit.
+bench-mixed:
+	env JAX_PLATFORMS=cpu CROWDLLAMA_BENCH_PHASES=mixed_batch,ctx32k \
+		$(PY) bench.py

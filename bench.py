@@ -45,6 +45,14 @@ Phases (CROWDLLAMA_BENCH_PHASES to select, comma-separated):
             tenant isolation under a hot-tenant flood (subprocess, CPU)
   capacity  static params+KV HBM accounting per registry model against
             the attached chip (largest-servable report; subprocess)
+  mixed_batch  unified ragged batch (docs/RAGGED_BATCH.md): decode-step
+            p95 while a LONG prefill is in flight, with vs without
+            unification, swept over step_token_budget — the knob that
+            trades prefill completion time for decode smoothness
+  ctx32k    a 32768-token prefill COMPLETED through ragged chunking — a
+            context whose monolithic one-shot prefill step cannot fit
+            (the reference attention path would materialize an
+            [H, 32k, 32k] fp32 score matrix, beyond the chip's HBM)
 
 The reference publishes no measured numbers (SURVEY §6); the only
 throughput figure in its tree is the hardcoded 150 tokens/sec a worker
@@ -117,7 +125,7 @@ PARTIAL_PATH = Path(__file__).resolve().parent / "BENCH_partial.jsonl"
 _ALL_PHASES = ("kernel", "decode", "decode_paged", "decode8b",
                "decode8b_paged", "decode8b_ctx4k", "ttft", "swarm",
                "ep_dispatch", "kv_transfer", "mini_swarm", "multi_gateway",
-               "capacity",
+               "capacity", "mixed_batch", "ctx32k",
                "decode_spec", "decode_spec_draft", "decode_kv8",
                "decode8b_int4")
 
@@ -721,6 +729,237 @@ def _spec_draft_phase() -> dict:
         draft_path=os.environ.get("CROWDLLAMA_TPU_SPEC_DRAFT_PATH", ""))
 
 
+# ------------------------------------- unified ragged batch (RAGGED_BATCH)
+
+
+def _latency_stats(samples: list[float]) -> dict:
+    import numpy as np
+
+    a = np.asarray(samples, float) * 1e3
+    return {"n": len(samples),
+            "p50_ms": round(float(np.percentile(a, 50)), 2),
+            "p95_ms": round(float(np.percentile(a, 95)), 2)}
+
+
+def _mixed_batch_phase() -> dict:
+    """Decode-step latency while a LONG prefill is in flight
+    (docs/RAGGED_BATCH.md).  Short decode streams keep every slot but one
+    busy; the free slot admits a long prompt.  WITHOUT unification the
+    pre-ragged scheduler alternated one prefill-chunk dispatch with one
+    decode dispatch, so every decode token during the prefill paid a full
+    512-token chunk on top of its step; WITH it the ragged step carries
+    the decode tokens and the chunk in ONE dispatch, and
+    ``step_token_budget`` bounds the chunk — the knob trading prefill
+    completion time for decode-step smoothness.  Swept over budgets;
+    headline = unified decode-step p95 / decode-only p95 at the tightest
+    budget (on the memory-bound TPU the chunk rides in the decode step's
+    idle compute; on the CPU fallback the chunk's flops are additive, so
+    only the tight budgets approach decode-only latency)."""
+    import jax
+    import numpy as np
+
+    from crowdllama_tpu.engine.paged import PagedModelRunner
+    from crowdllama_tpu.models.config import get_config
+
+    platform = jax.devices()[0].platform
+    if platform != "tpu":
+        model, slots, ctx, page = "tiny-test", 4, 2048, 16
+        long_len, rounds, chunks, base_n = 1536, 4, (512, 64, 16), 48
+    else:
+        model = os.environ.get("CROWDLLAMA_BENCH_MODEL", "tinyllama-1.1b")
+        slots = int(os.environ.get("CROWDLLAMA_BENCH_SLOTS", "8"))
+        ctx, page = 4096, 128
+        long_len, rounds, chunks, base_n = 3072, 4, (512, 128), 64
+    cfg = get_config(model)
+    cfg = replace(cfg, max_context_length=ctx)
+    rng = np.random.default_rng(0)
+    long_slot = slots - 1  # the long prompt's slot; the rest decode
+
+    def timed_decode_steps(runner, state, n):
+        out = []
+        for _ in range(n):
+            t0 = time.monotonic()
+            toks, state = runner.decode_steps_device(state, 1)
+            np.asarray(toks)  # sync: per-step latency, not throughput
+            out.append(time.monotonic() - t0)
+        return out, state
+
+    sweep: dict[str, object] = {}
+    legacy: dict | None = None
+    headline: dict | None = None
+    for chunk in chunks:
+        # budget = chunk + slots yields exactly ``chunk`` prefill tokens
+        # per unified step; 0 keeps the identity-preserving default
+        # (ragged_chunk == prefill_chunk).
+        budget = 0 if chunk >= PagedModelRunner.prefill_chunk else \
+            chunk + slots
+        runner = PagedModelRunner(cfg, max_slots=slots, max_seq=ctx,
+                                  page_size=page, step_token_budget=budget)
+        state = runner.init_state()
+        key = jax.random.PRNGKey(0)
+        for slot in range(slots - 1):
+            p = rng.integers(1, cfg.vocab_size, size=24).tolist()
+            key, sub = jax.random.split(key)
+            first, ks, vs, plen = runner.prefill(p, 0.7, 0.95, sub,
+                                                 state=state)
+            state = runner.insert(state, slot, ks, vs, plen, first,
+                                  0.7, 0.95)
+        _, state = runner.decode_steps(state, 1)  # decode compile
+        base, state = timed_decode_steps(runner, state, base_n)
+
+        unified: list[float] = []
+        totals: list[float] = []
+        for rnd in range(rounds):  # round 0 is the compile warmup
+            p = rng.integers(1, cfg.vocab_size, size=long_len).tolist()
+            job = runner.ragged_begin(p, long_slot, state)
+            t_r = time.monotonic()
+            while not job.finished:
+                t0 = time.monotonic()
+                toks, state = runner.ragged_step(state, job, 1)
+                np.asarray(toks)
+                if rnd:
+                    unified.append(time.monotonic() - t0)
+            if rnd:
+                totals.append(time.monotonic() - t_r)
+            key, sub = jax.random.split(key)
+            _, state = runner.ragged_finish(state, job, 0.7, 0.95, sub)
+            state = runner.release(state, long_slot)
+
+        entry = {
+            "ragged_chunk": runner.ragged_chunk,
+            "step_token_budget": runner.step_token_budget,
+            "decode_only": _latency_stats(base),
+            "unified_step": _latency_stats(unified),
+            "p95_vs_decode_only": round(
+                float(np.percentile(np.asarray(unified), 95))
+                / float(np.percentile(np.asarray(base), 95)), 3),
+            "long_prefill_complete_s": round(float(np.mean(totals)), 3),
+        }
+        sweep[f"chunk{runner.ragged_chunk}"] = entry
+        headline = entry  # tightest budget last in the sweep
+
+        if legacy is None:
+            # WITHOUT unification: the legacy interleave — one
+            # prefill-chunk dispatch, then one decode dispatch — priced
+            # per decode token produced during the long prefill.
+            lts: list[float] = []
+            for rnd in range(3):
+                p = rng.integers(1, cfg.vocab_size, size=long_len).tolist()
+                job = runner.prefill_begin(p, state)
+                done = False
+                while not done:
+                    t0 = time.monotonic()
+                    done = runner.prefill_step(job)
+                    toks, state = runner.decode_steps_device(state, 1)
+                    np.asarray(toks)
+                    if rnd:
+                        lts.append(time.monotonic() - t0)
+                key, sub = jax.random.split(key)
+                first, ks, vs, plen = runner.prefill_finish(job, 0.7, 0.95,
+                                                            sub)
+                state = runner.insert(state, long_slot, ks, vs, plen,
+                                      first, 0.7, 0.95, prompt_tokens=p)
+                state = runner.release(state, long_slot)
+            legacy = {"prefill_chunk": runner.prefill_chunk,
+                      "decode_step_during_prefill": _latency_stats(lts)}
+
+    return {
+        "metric": f"{model} mixed-batch decode-step p95 "
+                  f"(unified ragged vs decode-only)",
+        "value": headline["p95_vs_decode_only"],
+        "unit": "x decode-only p95",
+        "vs_baseline": None,
+        "extra": {
+            "platform": platform, "slots": slots, "ctx": ctx,
+            "long_prompt_tokens": long_len, "page_size": page,
+            "budget_sweep": sweep,
+            "without_unification": legacy,
+            "reading": "1.0 = a decode stream cannot tell a long prefill "
+                       "is sharing its batch; without_unification is the "
+                       "retired alternating loop, where every decode "
+                       "token during the prefill waits a full chunk",
+        },
+    }
+
+
+def _ctx32k_phase() -> dict:
+    """A 32k-token prefill COMPLETED through the unified ragged path.
+
+    The monolithic path cannot take this prompt in one step: one-shot
+    prefill pads to a 32768-wide bucket, and the reference attention
+    path materializes an [H, 32768, 32768] fp32 score matrix — more
+    bytes than the serving chip's 16 GiB HBM for every registry model.
+    Ragged chunking bounds live scores to [H, chunk, ctx] and streams
+    the prompt into the paged pool in page-multiple chunks, so the
+    context a worker can serve is set by its KV pool, not by the widest
+    prefill program it can compile."""
+    import jax
+    import numpy as np
+
+    from crowdllama_tpu.engine.paged import PagedModelRunner
+    from crowdllama_tpu.models.config import get_config
+
+    platform = jax.devices()[0].platform
+    model = ("tiny-test" if platform != "tpu"
+             else os.environ.get("CROWDLLAMA_BENCH_MODEL", "tinyllama-1.1b"))
+    target = int(os.environ.get("CROWDLLAMA_BENCH_CTX32K", "32768"))
+    cfg = replace(get_config(model), max_context_length=target + 256)
+    runner = PagedModelRunner(cfg, max_slots=1, max_seq=target + 256,
+                              page_size=128, pool_tokens=target + 512)
+    state = runner.init_state()
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(1, cfg.vocab_size, size=target).tolist()
+
+    job = runner.ragged_begin(prompt, 0, state)
+    t0 = time.monotonic()
+    toks, state = runner.ragged_step(state, job, 1)
+    np.asarray(toks)
+    compile_s = time.monotonic() - t0
+    t0 = time.monotonic()
+    dispatches = 1
+    while not job.finished:
+        toks, state = runner.ragged_step(state, job, 1)
+        dispatches += 1
+    np.asarray(toks)  # sync the chained dispatches
+    steady_s = time.monotonic() - t0
+    first, state = runner.ragged_finish(state, job, 0.7, 0.95,
+                                        jax.random.PRNGKey(1))
+    decode_toks, state = runner.decode_steps(state, 4)  # slot is LIVE
+    assert job.finished and decode_toks.shape[0] == 4
+    assert int(np.asarray(state.seq_lens)[0]) == target + 4
+
+    # What the one-shot program would have needed: ref-path prefill
+    # scores for the padded bucket, fp32.
+    bucket = runner.bucket_for(target)
+    mono_scores = cfg.num_heads * bucket * bucket * 4
+    chunk_scores = (cfg.num_heads * runner.ragged_chunk
+                    * runner.max_pages_per_slot * runner.page_size * 4)
+    hbm = 16 * 2 ** 30  # the attached v5e
+    tok_s = (target - runner.ragged_chunk) / steady_s
+    return {
+        "metric": f"{model} 32k-context ragged chunked prefill",
+        "value": round(tok_s, 1),
+        "unit": "prefill tokens/sec",
+        "vs_baseline": None,
+        "extra": {
+            "platform": platform, "prompt_tokens": target,
+            "ragged_chunk": runner.ragged_chunk,
+            "dispatches": dispatches,
+            "compile_s": round(compile_s, 2),
+            "steady_s": round(steady_s, 2),
+            "completed": True, "first_token": int(first),
+            "decode_after_prefill_ok": True,
+            "monolithic_one_step": {
+                "bucket": bucket,
+                "ref_scores_bytes": int(mono_scores),
+                "chip_hbm_bytes": hbm,
+                "fits": mono_scores < hbm,
+            },
+            "ragged_step_scores_bytes": int(chunk_scores),
+        },
+    }
+
+
 # ----------------------------------------------------------------- kernel
 
 
@@ -1011,6 +1250,8 @@ def main() -> None:
         "mini_swarm": _mini_swarm_phase,
         "multi_gateway": _multi_gateway_phase,
         "capacity": _capacity_phase,
+        "mixed_batch": _mixed_batch_phase,
+        "ctx32k": _ctx32k_phase,
     }
 
     remaining = [p for p in phases if p in runners]

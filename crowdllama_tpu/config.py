@@ -118,6 +118,15 @@ class Configuration:
     max_context_length: int = 2048
     mesh_shape: str = ""  # e.g. "1x8" → (dp=1, tp=8); empty = all devices on tp
     decode_chunk: int = 8  # decode steps per device dispatch
+    # Unified ragged batch (docs/RAGGED_BATCH.md): long prompts prefill
+    # INSIDE the decode dispatch — each step decodes every active slot and
+    # carries one prefill chunk of up to (step_token_budget -
+    # max_batch_slots) prompt tokens over the same paged pool.  0 = auto
+    # (runner prefill_chunk + max_batch_slots: a full 512-token chunk
+    # rides every step).  ragged_prefill=False keeps the legacy
+    # alternating chunked-prefill dispatch (the bench.py mixed_batch A/B).
+    step_token_budget: int = 0
+    ragged_prefill: bool = True
     warmup: bool = True  # compile prefill/decode at engine start
     quantize: str = ""  # "" (bf16) | "int8" | "int4" weight-only (ops/quant.py)
     # KV cache layout: "paged" (engine/paged.py, the default: page pool +
@@ -258,6 +267,11 @@ class Configuration:
         cfg.engine_backend = env.get("CROWDLLAMA_TPU_ENGINE", cfg.engine_backend)
         cfg.mesh_shape = env.get("CROWDLLAMA_TPU_MESH", cfg.mesh_shape)
         cfg.decode_chunk = int(env.get("CROWDLLAMA_TPU_DECODE_CHUNK", cfg.decode_chunk))
+        cfg.step_token_budget = int(env.get(
+            "CROWDLLAMA_TPU_STEP_TOKEN_BUDGET", cfg.step_token_budget))
+        if env.get("CROWDLLAMA_TPU_RAGGED_PREFILL"):
+            cfg.ragged_prefill = env["CROWDLLAMA_TPU_RAGGED_PREFILL"] in (
+                "1", "true")
         cfg.shard_group = env.get("CROWDLLAMA_TPU_SHARD_GROUP", cfg.shard_group)
         cfg.shard_index = int(env.get("CROWDLLAMA_TPU_SHARD_INDEX", cfg.shard_index))
         cfg.shard_count = int(env.get("CROWDLLAMA_TPU_SHARD_COUNT", cfg.shard_count))
@@ -502,6 +516,16 @@ class Configuration:
                             help="enable acceptance-adaptive draft length: "
                                  "retune k in [0, max] between dispatches "
                                  "(0 = fixed --spec-draft)")
+        parser.add_argument("--step-token-budget", dest="step_token_budget",
+                            type=int,
+                            help="unified ragged batch: per-step token "
+                                 "budget (decode slots + one prefill "
+                                 "chunk; 0 = auto)")
+        parser.add_argument("--no-ragged-prefill", dest="ragged_prefill",
+                            action="store_const", const=False, default=None,
+                            help="disable unified ragged prefill: long "
+                                 "prompts use the legacy alternating "
+                                 "chunked-prefill dispatch")
         parser.add_argument("--profile-dir", dest="profile_dir",
                             help="enable jax.profiler captures into this dir")
         parser.add_argument("--trace-buffer", dest="trace_buffer", type=int,
@@ -585,6 +609,7 @@ class Configuration:
                 "quantize", "kv_layout", "kv_page_size", "kv_pool_tokens",
                 "kv_dtype", "relay_mode", "spec_decode", "spec_draft",
                 "spec_draft_model", "spec_draft_path", "spec_draft_max",
+                "step_token_budget", "ragged_prefill",
                 "profile_dir", "trace_buffer", "worker_metrics_port",
                 "flight_recorder", "trace_ttl", "metrics_exemplars",
                 "request_timeout", "admission_max_inflight",

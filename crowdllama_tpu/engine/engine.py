@@ -113,7 +113,8 @@ class Engine:
         breaks absent()-style alerts across engine kinds.
         """
         return {"pending_depth": 0.0, "active_slots": 0.0,
-                "batch_occupancy": 0.0, "kv_cache_utilization": 0.0}
+                "batch_occupancy": 0.0, "kv_cache_utilization": 0.0,
+                "prefill_chunk_slots": 0.0, "step_token_budget_used": 0.0}
 
     async def drain(self, timeout: float = 30.0) -> bool:
         """Finish in-flight work before shutdown; True when drained."""
@@ -445,7 +446,9 @@ class JaxEngine(Engine):
             self._runner,
             decode_chunk=self.config.decode_chunk,
             admission_pending_max=self.config.admission_pending_max,
-            spec_draft_max=self.config.spec_draft_max)
+            spec_draft_max=self.config.spec_draft_max,
+            ragged=self.config.ragged_prefill)
+        self.scheduler.drain_requested_cb = self._chaos_drain
         self.scheduler.start()
         log.info(
             "engine up: model=%s mesh=%s slots=%d max_seq=%d",
@@ -486,6 +489,22 @@ class JaxEngine(Engine):
             r.prefill_finish(job, 0.0, 1.0, jax.random.PRNGKey(0))
         r.embed_prompts([[1, 2, 3]])
         state = r.release(state, 0)
+        if (self.config.ragged_prefill
+                and getattr(r, "supports_ragged", False)
+                and r.max_seq > r.ragged_chunk + 1):
+            # Unified ragged batch (docs/RAGGED_BATCH.md): compile the
+            # single-step unified program + finish activation so the first
+            # long prompt admitted under load doesn't pay the compile in
+            # its TTFT.  The decode_chunk-step variant compiles on first
+            # use (only dispatched while the batch is saturated, where one
+            # compile amortizes immediately).
+            job = r.ragged_begin(list(range(1, r.ragged_chunk + 2)), 0,
+                                 state=state)
+            while not job.finished:
+                _, state = r.ragged_step(state, job, 1)
+            _, state = r.ragged_finish(state, job, 0.0, 1.0,
+                                       jax.random.PRNGKey(0))
+            state = r.release(state, 0)
         log.info("warmup compile done")
 
     async def drain(self, timeout: float = 30.0) -> bool:
@@ -504,6 +523,18 @@ class JaxEngine(Engine):
         if moved and self.obs is not None:
             self.obs.metrics.drain_inc("migrated_slots", moved)
         return moved
+
+    def _chaos_drain(self) -> None:
+        """The scheduler's "scheduler.ragged_chunk" drain hook: start a
+        graceful drain exactly as the "engine.stream_chunk" site does —
+        through the peer when attached (publishes draining to the swarm),
+        else the engine's own migrate."""
+        peer = getattr(self, "_peer", None)
+        loop = asyncio.get_running_loop()
+        if peer is not None and hasattr(peer, "drain"):
+            loop.create_task(peer.drain())
+        else:
+            loop.create_task(self.migrate())
 
     def _migrate_export_meta(self, req: pb.GenerateRequest
                              ) -> tuple[list[bytes], int]:

@@ -27,7 +27,8 @@ def build_runner(config, plan, cfg, params):
             page_size=config.kv_page_size,
             pool_tokens=config.kv_pool_tokens,
             prefix_cache=config.kv_prefix_cache,
-            kv_dtype=plan.kv_dtype)
+            kv_dtype=plan.kv_dtype,
+            step_token_budget=config.step_token_budget)
         if plan.runner == "DraftSpecPagedModelRunner":
             from dataclasses import replace as _replace
 

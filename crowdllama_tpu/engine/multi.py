@@ -126,10 +126,13 @@ class MultiEngine(Engine):
         log.info("hot-registered model %s from %s", name, path or "<default>")
 
     # Point-in-time gauges (spec_draft_len is the controller's CURRENT k,
-    # the ratios a per-child fullness): max across children.  Everything
-    # else (depths, counts, spec acceptance totals) sums.
+    # the ratios a per-child fullness, step_token_budget_used the last
+    # dispatched step's load): max across children.  Everything else
+    # (depths, counts — prefill_chunk_slots included — spec acceptance
+    # totals) sums.
     _GAUGE_MAX = frozenset(
-        {"batch_occupancy", "kv_cache_utilization", "spec_draft_len"})
+        {"batch_occupancy", "kv_cache_utilization", "spec_draft_len",
+         "step_token_budget_used"})
 
     def obs_gauges(self) -> dict:
         out: dict = {}

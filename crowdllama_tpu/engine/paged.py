@@ -60,6 +60,8 @@ from crowdllama_tpu.ops.pallas.paged import (
     flash_paged_decode_attention,
     flash_paged_decode_attention_tp,
     paged_pallas_supported,
+    ragged_paged_attention,
+    ragged_pallas_supported,
 )
 from crowdllama_tpu.ops.quant import quantize_kv
 from crowdllama_tpu.ops.rope import rope_table
@@ -117,8 +119,15 @@ class PagedModelRunner(ModelRunner):
     #: prompts the prefix cache mostly covers keep the suffix-only path.
     prefill_chunk = 512
 
+    #: The scheduler dispatches prefill chunks and decode tokens in ONE
+    #: jitted step when this is True (docs/RAGGED_BATCH.md).  Wrapper
+    #: runners that replay frames (parallel/replicated.py) opt out with an
+    #: explicit False.
+    supports_ragged = True
+
     def __init__(self, cfg, *args, page_size: int = 128, pool_tokens: int = 0,
-                 prefix_cache: bool = True, **kwargs):
+                 prefix_cache: bool = True, step_token_budget: int = 0,
+                 **kwargs):
         # Default mesh: tp-only.  The auto-chooser spills spare devices to
         # dp, but the shared page pool cannot shard over dp (pages belong
         # to no fixed slot), so unrequested dp would just replicate it.
@@ -178,6 +187,21 @@ class PagedModelRunner(ModelRunner):
         self.kv_pages_exported = 0
         self.kv_pages_imported = 0
 
+        # Unified ragged batch (docs/RAGGED_BATCH.md): per-step token
+        # budget = one decode token per slot + one prefill chunk of
+        # ``ragged_chunk`` tokens.  The chunk width stays prefill_chunk by
+        # default so ragged chunk BOUNDARIES match the monolithic chunked
+        # path exactly (byte-identity of the resulting streams); an
+        # explicit smaller budget trades identity for smoother decode
+        # steps and rounds down to a page multiple.
+        budget = step_token_budget or (self.prefill_chunk + self.max_slots)
+        self.step_token_budget = budget
+        c = min(self.prefill_chunk, max(budget - self.max_slots, page_size))
+        self.ragged_chunk = max(page_size, (c // page_size) * page_size)
+        # Slot owned by an in-progress ragged prefill: the generic
+        # grow/advance loops must not treat it as a decoding slot.
+        self._ragged_slot: int | None = None
+
         self._insert_paged = jax.jit(self._insert_paged_impl,
                                      donate_argnums=(0,))
         self._decode_paged = jax.jit(self._decode_paged_impl,
@@ -185,6 +209,9 @@ class PagedModelRunner(ModelRunner):
         self._release_paged = jax.jit(self._release_paged_impl,
                                       donate_argnums=(0,))
         self._prefill_ctx = jax.jit(self._prefill_ctx_impl)
+        self._ragged_step_fn = jax.jit(self._ragged_step_impl,
+                                       donate_argnums=(1,),
+                                       static_argnums=(7,))
 
     # ------------------------------------------------------------ allocator
 
@@ -444,10 +471,13 @@ class PagedModelRunner(ModelRunner):
             jax.random.PRNGKey(0))
         ENGINE_TELEMETRY.compile_end("ctx_prefill", self.buckets[0], t_c)
 
-    def prefill_prefers_monolithic(self, prompt_ids: list[int]) -> bool:
+    def prefill_prefers_monolithic(self, prompt_ids: list[int],
+                                   chunk: int | None = None) -> bool:
         """True when the prefix cache covers enough of the prompt that the
         suffix-only (ctx) prefill beats chunked admission: the uncovered
-        suffix fits within one admission chunk."""
+        suffix fits within one admission chunk (``chunk`` — the scheduler
+        passes ``ragged_chunk`` under unified ragged admission, where a
+        tight step token budget shrinks what one dispatch may carry)."""
         if not self.prefix_cache:
             return False
         pg = self.page_size
@@ -457,7 +487,8 @@ class PagedModelRunner(ModelRunner):
             if k not in self._prefix_index:
                 break
             matched += pg
-        return plen - matched <= self.prefill_chunk
+        return plen - matched <= (self.prefill_chunk if chunk is None
+                                  else chunk)
 
     def prefill(self, prompt_ids: list[int], temperature: float, top_p: float,
                 key, state: PagedDecodeState | None = None, top_k: int = 0,
@@ -669,6 +700,145 @@ class PagedModelRunner(ModelRunner):
         new_state, tokens = jax.lax.scan(step, state, length=num_steps)
         return tokens, new_state
 
+    def _ragged_step_impl(self, params, state: PagedDecodeState, page_table,
+                          chunk_tokens, ctx_arr, total_len, chunk_slot,
+                          num_steps: int):
+        """The unified ragged batch step (docs/RAGGED_BATCH.md).
+
+        Each of ``num_steps`` scan iterations runs ONE jitted forward over
+        B+C query rows: one decode token per active slot (rows 0..B-1,
+        exactly the plain decode step's math) plus one prefill chunk of up
+        to C tokens for ``chunk_slot`` (rows B.., exactly the monolithic
+        chunk's math with the slot's pages as cached context).  KV for all
+        rows scatters into the shared pool in the same layer pass, and
+        attention runs through :func:`ragged_paged_attention` with
+        per-sequence (q_len, kv_len) metadata.
+
+        chunk_tokens: [K, C] prompt tokens per step (0-padded);
+        ctx_arr: [K] tokens already prefilled before each step;
+        total_len: prompt length; chunk_slot: the reserved slot.
+        Returns (decode tokens [K, B], last prompt-token logits [V], state).
+        """
+        cfg = self.cfg
+        pg = self.page_size
+        b = self.max_slots
+        c = chunk_tokens.shape[1]
+        dh = cfg.resolved_head_dim()
+        hkv = cfg.num_kv_heads
+        scale = T.attn_scale(cfg)
+        cos, sin = rope_table(cfg.max_context_length, dh, cfg.rope_theta,
+                              scaling=cfg.rope_scaling)
+        windows = T.layer_sliding_windows(cfg)
+        slot_idx = jnp.arange(b)
+        quant = self.kv_dtype == "int8"
+        pool_itemsize = jnp.dtype(jnp.int8 if quant else self.dtype).itemsize
+        # Multi-device meshes take the jnp reference path (GSPMD partitions
+        # the gather views; the kernel pair's shard_map wiring is future
+        # work) — the unified step still saves the dispatch, which is what
+        # the decode-jitter problem is about.
+        use_pallas = (self.mesh.size == 1 and ragged_pallas_supported(
+            pg, dh, 1, hkv, itemsize=pool_itemsize, quant=quant))
+
+        def step(st: PagedDecodeState, xs):
+            ctx_i, ctoks = xs
+            valid = jnp.clip(total_len - ctx_i, 0, c)
+            positions_dec = jnp.minimum(st.seq_lens, self.max_seq - 1)
+            lens_dec = jnp.minimum(st.seq_lens + 1, self.max_seq)
+            cpos = jnp.minimum(ctx_i + jnp.arange(c), self.max_seq - 1)
+            x = T._embed(params, cfg, jnp.concatenate([st.tokens, ctoks]))
+            positions = jnp.concatenate([positions_dec, cpos])
+            # Decode rows of inactive slots (including the chunk's own
+            # still-inactive decode lane) write to the dump page, exactly
+            # like the plain decode step; chunk rows past the valid length
+            # dump too.
+            cur_page = jnp.where(st.active,
+                                 page_table[slot_idx, positions_dec // pg],
+                                 self.total_pages)
+            crow_ok = jnp.arange(c) < valid
+            cpages = jnp.where(crow_ok,
+                               page_table[chunk_slot, cpos // pg],
+                               self.total_pages)
+            wpages = jnp.concatenate([cur_page, cpages])
+            woffs = jnp.concatenate([positions_dec % pg, cpos % pg])
+            q_lens = jnp.concatenate([
+                jnp.where(st.active, 1, 0).astype(jnp.int32),
+                valid.astype(jnp.int32)[None]])
+            kv_lens = jnp.concatenate([
+                lens_dec.astype(jnp.int32),
+                (ctx_i + valid).astype(jnp.int32)[None]])
+
+            def body(x, scanned):
+                lp, pk, pv, ksc, vsc, window = scanned
+                pool = {}
+
+                def attn_fn(q, k, v):
+                    if quant:
+                        kq, k_sc = quantize_kv(k, scale_dtype=ksc.dtype)
+                        vq, v_sc = quantize_kv(v, scale_dtype=vsc.dtype)
+                        pk2 = pk.at[wpages, :, woffs].set(kq)
+                        pv2 = pv.at[wpages, :, woffs].set(vq)
+                        ks2 = ksc.at[wpages, :, woffs].set(k_sc)
+                        vs2 = vsc.at[wpages, :, woffs].set(v_sc)
+                    else:
+                        pk2 = pk.at[wpages, :, woffs].set(k.astype(pk.dtype))
+                        pv2 = pv.at[wpages, :, woffs].set(v.astype(pv.dtype))
+                        ks2 = vs2 = None
+                    pool.update(pk=pk2, pv=pv2, ks=ks2, vs=vs2)
+                    # The chunk's fresh KV rides along as explicit operands
+                    # so the reference path's self block matches monolithic
+                    # prefill bitwise (bf16 pools).
+                    chunk_k = k[b:].transpose(1, 0, 2)[None]
+                    chunk_v = v[b:].transpose(1, 0, 2)[None]
+                    return ragged_paged_attention(
+                        q, chunk_k, chunk_v, pk2, pv2, page_table,
+                        q_lens, kv_lens, chunk_slot, scale,
+                        softcap=cfg.attn_logit_softcap,
+                        sliding_window=window, k_scale=ks2, v_scale=vs2,
+                        use_pallas=use_pallas)
+
+                x = T.decode_layer_body(lp, cfg, x, positions, cos, sin,
+                                        attn_fn)
+                return x, (pool["pk"], pool["pv"], pool["ks"], pool["vs"])
+
+            x, (pool_k, pool_v, k_scale, v_scale) = jax.lax.scan(
+                body, x, (params["layers"], st.pool_k, st.pool_v,
+                          st.k_scale, st.v_scale, windows))
+            # Unembed the B decode rows + ONE chunk row (the last valid
+            # one) — the rest of the chunk never needs logits.
+            x_last = x[b + jnp.clip(valid - 1, 0, c - 1)]
+            logits = T._unembed(params, cfg,
+                                jnp.concatenate([x[:b], x_last[None]]))
+            chunk_logits = logits[b]
+            carry, sub = split_slot_keys(st.keys)
+            dec_logits = apply_repeat_penalty(logits[:b], st.recent,
+                                              st.repeat_penalty)
+            next_tokens = sample_tokens_slots(dec_logits, st.temperature,
+                                              st.top_p, sub, top_k=st.top_k)
+            next_tokens = jnp.where(st.active, next_tokens, 0)
+            bidx2 = jnp.arange(st.recent.shape[0])
+            cursor = (st.seq_lens + 1) % REPEAT_LAST_N
+            recent = st.recent.at[bidx2, cursor].set(
+                jnp.where(st.active, next_tokens,
+                          st.recent[bidx2, cursor]))
+            new_state = PagedDecodeState(
+                pool_k=pool_k, pool_v=pool_v,
+                k_scale=k_scale, v_scale=v_scale,
+                seq_lens=jnp.where(st.active, st.seq_lens + 1, st.seq_lens),
+                tokens=next_tokens, active=st.active,
+                temperature=st.temperature, top_p=st.top_p,
+                top_k=st.top_k, repeat_penalty=st.repeat_penalty,
+                recent=recent, keys=carry, hist=st.hist,
+                draft_k=st.draft_k, draft_v=st.draft_v,
+            )
+            return new_state, (next_tokens, chunk_logits, valid > 0)
+
+        new_state, (tokens, chunk_logits, flags) = jax.lax.scan(
+            step, state, (ctx_arr, chunk_tokens))
+        # Logits of the final prompt token = the last step that had valid
+        # chunk rows (later steps past the prompt end leave it untouched).
+        ridx = (num_steps - 1) - jnp.argmax(flags[::-1])
+        return tokens, chunk_logits[ridx], new_state
+
     # ------------------------------------------------------------------ API
 
     def init_state(self, seed: int = 0) -> PagedDecodeState:
@@ -701,6 +871,7 @@ class PagedModelRunner(ModelRunner):
         self._index_lru.clear()
         self._key_children.clear()
         self._pending_match = None
+        self._ragged_slot = None
         b = self.max_slots
         return PagedDecodeState(
             pool_k=jax.device_put(jnp.zeros(shape, pool_dtype), pool_sharding),
@@ -812,6 +983,8 @@ class PagedModelRunner(ModelRunner):
         ends instead of the whole engine failing."""
         starved = []
         for slot in list(self._slot_pages):
+            if slot == self._ragged_slot:
+                continue  # grows by chunk inside ragged_step, never decodes
             try:
                 self._ensure_slot(slot, steps)
             except PagesExhausted:
@@ -820,6 +993,8 @@ class PagedModelRunner(ModelRunner):
 
     def _ensure_capacity(self, steps: int) -> None:
         for slot in list(self._slot_pages):
+            if slot == self._ragged_slot:
+                continue
             self._ensure_slot(slot, steps)
 
     def decode_steps(self, state: PagedDecodeState, num_steps: int = 1):
@@ -837,9 +1012,221 @@ class PagedModelRunner(ModelRunner):
             self.params, state, jnp.asarray(self.page_table), num_steps)
         ENGINE_TELEMETRY.compile_end("decode_paged", num_steps, t_c)
         for slot in self._slot_pages:
+            if slot == self._ragged_slot:
+                continue
             self._host_seq[slot] = min(self._host_seq[slot] + num_steps,
                                        self.max_seq)
         return tokens, new_state
+
+    # ----------------------- unified ragged batch (docs/RAGGED_BATCH.md)
+
+    class RaggedPrefillJob:
+        """Host handle for a prefill running INSIDE the decode loop.
+
+        Unlike the monolithic PrefillJob there are no context
+        accumulators: every chunk's KV lands directly in the slot's pool
+        pages, so ``done_tokens`` of progress is exactly ``done_tokens``
+        of resumable, exportable KV (full pages are prefix-indexed as
+        they complete — a mid-prefill migration ships them like any
+        cached prefix)."""
+
+        ragged = True  # scheduler routes abort/advance by this marker
+
+        def __init__(self, prompt_ids, slot, keys):
+            self.prompt_ids = prompt_ids
+            self.slot = slot
+            self.keys = keys          # chain hashes of full prompt pages
+            self.done_tokens = 0
+            self.last_logits = None   # [V] f32, final prompt token
+            self.indexed = 0          # pages already prefix-indexed
+
+        @property
+        def finished(self) -> bool:
+            return self.done_tokens >= len(self.prompt_ids)
+
+    def ragged_begin(self, prompt_ids: list[int], slot: int,
+                     state: PagedDecodeState) -> "RaggedPrefillJob":
+        """Reserve ``slot`` for chunked-in-the-decode-loop prefill.
+
+        Cached prefix pages become the slot's leading pages immediately
+        (pinned as the slot's reference, same protocol as insert), so a
+        mostly-cached prompt starts ``done_tokens`` deep and only the
+        uncovered tail streams through the unified step."""
+        if self._ragged_slot is not None:
+            raise RuntimeError("one ragged prefill at a time")
+        plen = len(prompt_ids)
+        if plen >= self.max_seq:
+            raise ValueError(
+                f"prompt of {plen} tokens exceeds max context "
+                f"{self.max_seq}")
+        self._clear_pending()
+        pg = self.page_size
+        keys = self._chain_keys(list(prompt_ids), plen // pg)
+        job = self.RaggedPrefillJob(list(prompt_ids), slot, keys)
+        self._free(slot)  # defensive: slot must not leak prior pages
+        matched: list[int] = []
+        if self.prefix_cache:
+            # Cap one page early: >= 1 suffix token must remain for logits.
+            for k in keys[:max(0, (plen - 1) // pg)]:
+                page = self._prefix_index.get(k)
+                if page is None:
+                    break
+                matched.append(page)
+                self._lru_tick += 1
+                self._index_lru[k] = self._lru_tick
+            if matched:
+                self.prefix_hits += 1
+                self.prefix_tokens_reused += len(matched) * pg
+            else:
+                self.prefix_misses += 1
+        for p in matched:  # pin becomes the slot's reference
+            self._page_refs[p] = self._page_refs.get(p, 0) + 1
+        self._slot_pages[slot] = list(matched)
+        self._host_seq[slot] = len(matched) * pg
+        self.page_table[slot] = 0
+        self.page_table[slot, :len(matched)] = matched
+        job.done_tokens = len(matched) * pg
+        job.indexed = len(matched)
+        self._ragged_slot = slot
+        return job
+
+    def ragged_step(self, state: PagedDecodeState, job: "RaggedPrefillJob",
+                    num_steps: int = 1):
+        """Dispatch ``num_steps`` unified steps: every active decode slot
+        advances one token per step AND the job prefills up to
+        ``ragged_chunk`` prompt tokens per step.  Returns (decode tokens
+        [num_steps, B] device array, new state) — the same contract as
+        decode_steps_device, so the scheduler's double-buffered retire
+        path consumes it unchanged.  Raises PagesExhausted when the pool
+        cannot cover the job's next pages (the scheduler fails the
+        request and aborts the job)."""
+        c = self.ragged_chunk
+        pg = self.page_size
+        slot = job.slot
+        total = len(job.prompt_ids)
+        ctx0 = job.done_tokens
+        end = min(ctx0 + num_steps * c, total)
+        # Grow the chunk slot for this dispatch's writes...
+        pages = self._slot_pages[slot]
+        needed = math.ceil(end / pg)
+        if needed > len(pages):
+            new = self._alloc(needed - len(pages))
+            self.page_table[slot, len(pages):len(pages) + len(new)] = new
+            pages.extend(new)
+        # ...and every decoding slot for its num_steps tokens.
+        for s in list(self._slot_pages):
+            if s != slot:
+                self._ensure_slot(s, num_steps)
+        chunk_tokens = np.zeros((num_steps, c), np.int32)
+        flat = job.prompt_ids[ctx0:end]
+        chunk_tokens.reshape(-1)[:len(flat)] = flat
+        ctx_arr = ctx0 + np.arange(num_steps, dtype=np.int32) * c
+        sig = f"{num_steps}x{c}"
+        ENGINE_TELEMETRY.padding_inc(useful=end - ctx0,
+                                     waste=num_steps * c - (end - ctx0))
+        t_c = ENGINE_TELEMETRY.compile_begin("ragged_step", sig)
+        tokens, last, new_state = self._ragged_step_fn(
+            self.params, state, jnp.asarray(self.page_table),
+            jnp.asarray(chunk_tokens), jnp.asarray(ctx_arr),
+            jnp.int32(total), jnp.int32(slot), num_steps)
+        ENGINE_TELEMETRY.compile_end("ragged_step", sig, t_c)
+        job.done_tokens = end
+        job.last_logits = last
+        self._host_seq[slot] = end
+        for s in self._slot_pages:
+            if s != slot:
+                self._host_seq[s] = min(self._host_seq[s] + num_steps,
+                                        self.max_seq)
+        self._ragged_index(job)
+        return tokens, new_state
+
+    def _ragged_index(self, job: "RaggedPrefillJob") -> None:
+        """Prefix-index the job's freshly completed full pages.
+
+        Incremental (vs insert's after-the-fact pass) so a mid-prefill
+        export/migration already finds the finished pages under their
+        chain keys — replayed_prefill_tokens then counts only the
+        unshipped tail."""
+        if not self.prefix_cache:
+            return
+        pages = self._slot_pages.get(job.slot, [])
+        pg = self.page_size
+        limit = min(len(job.keys), len(pages))
+        while (job.indexed < limit
+               and (job.indexed + 1) * pg <= job.done_tokens):
+            i = job.indexed
+            key, page = job.keys[i], pages[i]
+            if key not in self._prefix_index:
+                self._prefix_index[key] = page
+                self._page_key[page] = key
+                self._lru_tick += 1
+                self._index_lru[key] = self._lru_tick
+                if i > 0:  # chain edge for cascade eviction
+                    self._key_children.setdefault(
+                        job.keys[i - 1], set()).add(key)
+            job.indexed += 1
+
+    @partial(jax.jit, static_argnums=0, donate_argnums=(1,))
+    def _ragged_activate(self, state: PagedDecodeState, slot, plen,
+                         first_token, temperature, top_p, top_k,
+                         repeat_penalty, recent_row, slot_key):
+        """Flip a ragged-prefilled slot live: the KV is already in its
+        pages, so this is _insert_paged minus the pool scatter."""
+        return PagedDecodeState(
+            pool_k=state.pool_k, pool_v=state.pool_v,
+            k_scale=state.k_scale, v_scale=state.v_scale,
+            seq_lens=state.seq_lens.at[slot].set(plen),
+            tokens=state.tokens.at[slot].set(first_token),
+            active=state.active.at[slot].set(True),
+            temperature=state.temperature.at[slot].set(temperature),
+            top_p=state.top_p.at[slot].set(top_p),
+            top_k=state.top_k.at[slot].set(top_k),
+            repeat_penalty=state.repeat_penalty.at[slot].set(repeat_penalty),
+            recent=state.recent.at[slot].set(recent_row),
+            keys=state.keys.at[slot].set(slot_key),
+            hist=state.hist, draft_k=state.draft_k, draft_v=state.draft_v,
+        )
+
+    def ragged_finish(self, state: PagedDecodeState, job: "RaggedPrefillJob",
+                      temperature: float, top_p: float, key,
+                      slot_key=None, top_k: int = 0,
+                      repeat_penalty: float = 1.0):
+        """Sample the first token (prefill_finish's exact math) and
+        activate the slot.  Returns (first_token, new_state)."""
+        assert job.finished and job.last_logits is not None
+        plen = len(job.prompt_ids)
+        logits = apply_repeat_penalty(
+            job.last_logits[None, :],
+            jnp.asarray(self._recent_from_prompt(job.prompt_ids))[None],
+            jnp.float32(repeat_penalty)[None])
+        tok = sample_tokens(logits,
+                            jnp.float32(temperature)[None],
+                            jnp.float32(top_p)[None], key,
+                            top_k=jnp.int32(top_k)[None])[0]
+        first = int(tok)
+        if slot_key is None:
+            slot_key = default_slot_key(job.slot)
+        recent_row = self._recent_from_prompt(job.prompt_ids, first,
+                                              plen=plen)
+        t_c = ENGINE_TELEMETRY.compile_begin("ragged_finish", 0)
+        state = self._ragged_activate(
+            state, jnp.int32(job.slot), jnp.int32(plen), jnp.int32(first),
+            jnp.float32(temperature), jnp.float32(top_p), jnp.int32(top_k),
+            jnp.float32(repeat_penalty), jnp.asarray(recent_row), slot_key)
+        ENGINE_TELEMETRY.compile_end("ragged_finish", 0, t_c)
+        self._host_seq[job.slot] = plen
+        self._ragged_index(job)
+        self._ragged_slot = None
+        return first, state
+
+    def ragged_abort(self, job: "RaggedPrefillJob") -> None:
+        """Abandon a mid-flight ragged prefill (cancel / migrate / error):
+        the slot was never activated, so freeing its pages is the whole
+        cleanup.  Completed pages already indexed stay cached — a
+        resubmission (or a migration successor's fetch) reuses them."""
+        if self._ragged_slot == job.slot:
+            self._free(job.slot)
+            self._ragged_slot = None
 
     # -------------------------------------- KV shipping (docs/KV_TRANSFER.md)
 

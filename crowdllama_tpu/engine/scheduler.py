@@ -38,6 +38,7 @@ import numpy as np
 
 from crowdllama_tpu.engine.runner import ModelRunner
 from crowdllama_tpu.obs.metrics import ENGINE_TELEMETRY
+from crowdllama_tpu.testing import faults
 
 log = logging.getLogger("crowdllama.engine.scheduler")
 
@@ -102,12 +103,16 @@ class _InFlightChunk:
     tokens_dev: object                  # device array [K, B]
     snapshot: list["_SlotInfo | None"]  # slot infos at dispatch time
     dispatched_at: float
+    # Unified ragged dispatch (docs/RAGGED_BATCH.md): how many prefill
+    # chunks rode along in this decode chunk (0 = plain decode).  Retire
+    # observes crowdllama_prefill_chunk_seconds from this.
+    ragged_steps: int = 0
 
 
 class Scheduler:
     def __init__(self, runner: ModelRunner, max_queue: int = 256,
                  decode_chunk: int = 8, admission_pending_max: int = 0,
-                 spec_draft_max: int = 0):
+                 spec_draft_max: int = 0, ragged: bool = True):
         self.runner = runner
         self.decode_chunk = max(1, decode_chunk)
         # Load shedding (docs/ROBUSTNESS.md): reject at submit() once the
@@ -192,6 +197,20 @@ class Scheduler:
         self._accept_off = 0     # window: draft tokens offered
         self._plain_since_probe = 0
         self._spec_probing = False
+        # Unified ragged batch (ISSUE 9, docs/RAGGED_BATCH.md): when the
+        # runner supports it, long prompts prefill INSIDE the decode
+        # dispatch (fixed-token chunks riding the per-step token budget)
+        # instead of alternating whole prefill steps with decode chunks.
+        self._ragged = ragged and getattr(runner, "supports_ragged", False)
+        # Tokens of work the last dispatched step carried (live decode
+        # slots + prefill-chunk tokens per step); telemetry gauge.
+        self._step_budget_used = 0.0
+        self.ragged_chunks = 0  # prefill chunks dispatched unified
+        # Chaos hook: the "scheduler.ragged_chunk" fault site's "drain"
+        # action calls this to start a graceful drain mid-chunked-prefill
+        # (the engine points it at the peer's drain, like the
+        # "engine.stream_chunk" site does for mid-stream drains).
+        self.drain_requested_cb = None
 
     # ---------------------------------------------------------------- public
 
@@ -320,7 +339,7 @@ class Scheduler:
             self._chunking = None
             self._admitting -= 1
             self.slots[slot] = None  # release the _RESERVED slot
-            abort = getattr(self.runner, "prefill_abort", None)
+            abort = self._abort_fn(job)
             if abort is not None:
                 abort(job)
             req.out.put_nowait((_DONE, "migrate"))
@@ -389,6 +408,12 @@ class Scheduler:
             used = sum(s.prompt_len + s.generated for s in self.slots
                        if isinstance(s, _SlotInfo))
             g["kv_cache_utilization"] = used / (total * max(1, r.max_seq))
+        # Unified ragged batch (docs/RAGGED_BATCH.md): slots mid-chunked-
+        # prefill (0 or 1 — one chunked admission at a time) and the token
+        # budget the last dispatched step actually carried (live decode
+        # rows + prefill-chunk tokens).
+        g["prefill_chunk_slots"] = 1.0 if self._chunking is not None else 0.0
+        g["step_token_budget_used"] = float(self._step_budget_used)
         if hasattr(r, "draft_len"):
             # Speculation acceptance on BOTH /metrics surfaces (gateway
             # aggregates worker gauges): emitted/steps is the live
@@ -409,6 +434,14 @@ class Scheduler:
             if s is None:
                 return i
         return None
+
+    def _abort_fn(self, job):
+        """Runner abort for a parked admission job: ragged jobs (marker
+        attribute) abort via ragged_abort, monolithic chunked jobs via
+        prefill_abort; None when the runner has neither."""
+        name = ("ragged_abort" if getattr(job, "ragged", False)
+                else "prefill_abort")
+        return getattr(self.runner, name, None)
 
     def _req_key(self, req: GenRequest, lane: int) -> jax.Array:
         """PRNG key for one sampling lane of a request (0 = prefill's first
@@ -644,7 +677,7 @@ class Scheduler:
                 self._chunking = None
                 self._admitting -= 1
                 self.slots[slot] = None  # release the _RESERVED slot
-                abort = getattr(self.runner, "prefill_abort", None)
+                abort = self._abort_fn(job)
                 if abort is not None:
                     await loop_.run_in_executor(self._exec, abort, job)
                 req.out.put_nowait((_DONE, "migrate"))
@@ -694,7 +727,27 @@ class Scheduler:
         # BEFORE admission also lets this chunk execute while a long
         # prefill runs — the dominant decode stall under prompt bursts.
         dispatched: _InFlightChunk | None = None
-        if any(isinstance(s, _SlotInfo) for s in self.slots):
+        # Unified ragged batch (docs/RAGGED_BATCH.md): a parked
+        # RaggedPrefillJob advances INSIDE this decode dispatch — each
+        # step decodes every active slot AND prefills one fixed-token
+        # chunk of the long prompt over the same paged pool, so a long
+        # prompt never stalls token streaming.  Cancellation is handled
+        # before dispatch so an abandoned job never costs another chunk.
+        rjob = (self._chunking
+                if (self._chunking is not None
+                    and getattr(self._chunking[2], "ragged", False))
+                else None)
+        if rjob is not None and rjob[0].cancelled:
+            req, slot, job = rjob
+            self._chunking = None
+            rjob = None
+            self._admitting -= 1
+            self.slots[slot] = None  # release the reservation
+            abort = self._abort_fn(job)
+            if abort is not None:
+                await loop.run_in_executor(self._exec, abort, job)
+        if (rjob is not None
+                or any(isinstance(s, _SlotInfo) for s in self.slots)):
             k = self._chunk_size()
             # Paged-KV runners grow page tables before the chunk; slots an
             # overcommitted pool cannot grow finish with "length" (their
@@ -727,16 +780,94 @@ class Scheduler:
                         self._exec, self.runner.release, self.state, slot)
                     starved = await loop.run_in_executor(self._exec,
                                                          check, k)
-            if any(isinstance(s, _SlotInfo) for s in self.slots):
+            live = sum(1 for s in self.slots if isinstance(s, _SlotInfo))
+            if rjob is not None:
+                import functools
+
+                req, slot, job = rjob
+                c = getattr(self.runner, "ragged_chunk", 1)
+                chunk_toks = min(k * c,
+                                 len(job.prompt_ids) - job.done_tokens)
+                n_chunks = -(-chunk_toks // max(1, c))
+                try:
+                    await faults.inject("scheduler.ragged_chunk",
+                                        done=job.done_tokens,
+                                        total=len(job.prompt_ids))
+                except faults.DrainRequested:
+                    # Chaos trigger for MID-CHUNKED-PREFILL migration: start
+                    # the drain concurrently and keep chunking — migrate()
+                    # aborts the job at the next safe point, the completed
+                    # pages stay prefix-cached for the successor's KV fetch.
+                    if self.drain_requested_cb is not None:
+                        self.drain_requested_cb()
+                    else:
+                        loop.create_task(self.migrate())
+                try:
+                    tokens_dev, self.state = await loop.run_in_executor(
+                        self._exec, functools.partial(
+                            self.runner.ragged_step, self.state, job, k))
+                except ValueError as e:
+                    # Pool cannot cover the job's next chunk pages
+                    # (PagesExhausted is a ValueError): fail THIS request,
+                    # engine stays up — mirrors the legacy chunked path.
+                    self._chunking = None
+                    self._admitting -= 1
+                    self.slots[slot] = None
+                    abort = self._abort_fn(job)
+                    if abort is not None:
+                        await loop.run_in_executor(self._exec, abort, job)
+                    log.warning("ragged admit failed: %s", e)
+                    req.out.put_nowait((_DONE, f"error: {e}"))
+                else:
+                    # On BaseException _chunking stays set: _loop's
+                    # recovery fails the request and resets state.
+                    self.ragged_chunks += n_chunks
+                    self._step_budget_used = float(
+                        live + chunk_toks / max(1, k))
+                    dispatched = _InFlightChunk(
+                        tokens_dev=tokens_dev, snapshot=list(self.slots),
+                        dispatched_at=time.monotonic(),
+                        ragged_steps=n_chunks)
+                    if job.finished:
+                        # Whole prompt is in the pool: sample the first
+                        # token and activate the slot (the ragged
+                        # counterpart of prefill_finish + _place; no KV
+                        # insert — the pages are already there).
+                        self._chunking = None
+                        self._admitting -= 1
+                        sub = self._req_key(req, 0)
+                        try:
+                            first, self.state = await loop.run_in_executor(
+                                self._exec, functools.partial(
+                                    self.runner.ragged_finish, self.state,
+                                    job, req.temperature, req.top_p, sub,
+                                    slot_key=self._req_key(req, 1),
+                                    top_k=req.top_k,
+                                    repeat_penalty=req.repeat_penalty))
+                        except BaseException:
+                            self.slots[slot] = None
+                            req.out.put_nowait(
+                                (_DONE, "error: engine failure"))
+                            raise
+                        info = _SlotInfo(req=req,
+                                         prompt_len=len(req.prompt_ids))
+                        self.slots[slot] = info
+                        req.first_token_at = time.monotonic()
+                        self._emit(req, first, info)
+                        await self._flush_releases(loop)
+            elif live:
                 tokens_dev, self.state = await loop.run_in_executor(
                     self._exec, self.runner.decode_steps_device,
                     self.state, k)  # [K,B] on device
+                self._step_budget_used = float(live)
                 dispatched = _InFlightChunk(
                     tokens_dev=tokens_dev, snapshot=list(self.slots),
                     dispatched_at=time.monotonic())
 
-        # Advance an in-progress chunked admission by ONE prefill chunk.
-        if self._chunking is not None:
+        # Advance an in-progress LEGACY chunked admission by ONE prefill
+        # chunk (ragged jobs already advanced inside the dispatch above).
+        if (self._chunking is not None
+                and not getattr(self._chunking[2], "ragged", False)):
             req, slot, job = self._chunking
             try:
                 if req.cancelled:
@@ -799,12 +930,20 @@ class Scheduler:
                 # path, exactly like a local cache hit would.
                 await self._apply_kv_import(req, loop)
             chunk = getattr(self.runner, "prefill_chunk", 0)
+            if self._ragged:
+                # Unified ragged admission gates on what ONE dispatch may
+                # carry: under the default budget ragged_chunk equals
+                # prefill_chunk, but a tight step_token_budget shrinks it,
+                # and prompts above it chunk instead of stalling decode
+                # behind a monolithic prefill.
+                chunk = getattr(self.runner, "ragged_chunk", chunk)
             # Paged runners keep the suffix-only (prefix-cache) path for
             # prompts the cache mostly covers — chunked admission would
             # re-prefill what cached pages already hold.
             hint = getattr(self.runner, "prefill_prefers_monolithic", None)
             if (chunk and len(req.prompt_ids) > chunk
-                    and not (hint is not None and hint(req.prompt_ids))):
+                    and not (hint is not None
+                             and hint(req.prompt_ids, chunk=chunk))):
                 if self._chunking is not None:
                     # One chunked admission at a time; park it and keep
                     # admitting short requests from pending.
@@ -822,10 +961,19 @@ class Scheduler:
                     import functools
 
                     req.admitted_at = time.monotonic()
-                    job = await loop.run_in_executor(
-                        self._exec, functools.partial(
-                            self.runner.prefill_begin, req.prompt_ids,
-                            state=self.state))
+                    if self._ragged:
+                        # Unified ragged admission: the job prefills inside
+                        # subsequent decode dispatches (KV straight into
+                        # the slot's pool pages, no accumulators).
+                        job = await loop.run_in_executor(
+                            self._exec, functools.partial(
+                                self.runner.ragged_begin, req.prompt_ids,
+                                slot, state=self.state))
+                    else:
+                        job = await loop.run_in_executor(
+                            self._exec, functools.partial(
+                                self.runner.prefill_begin, req.prompt_ids,
+                                state=self.state))
                 except ValueError as e:
                     log.warning("admit failed: %s", e)
                     req.out.put_nowait((_DONE, f"error: {e}"))
@@ -876,6 +1024,13 @@ class Scheduler:
         now = time.monotonic()
         dt = max(now - max(self._last_retire_at, fl.dispatched_at), 1e-6)
         self._last_retire_at = now
+        if fl.ragged_steps:
+            # Per-chunk prefill latency inside the unified dispatch (the
+            # chunks ran back-to-back in one program; attribute the wall
+            # time evenly).
+            per = max(now - fl.dispatched_at, 1e-6) / fl.ragged_steps
+            for _ in range(fl.ragged_steps):
+                ENGINE_TELEMETRY.prefill_chunk_seconds.observe(per)
         # Decode chunks run the full fixed batch shape: every slot that was
         # empty at dispatch computed throwaway rows for the whole chunk.
         live = sum(1 for s in fl.snapshot if isinstance(s, _SlotInfo))

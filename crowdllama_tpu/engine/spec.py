@@ -479,6 +479,8 @@ class SpecPagedModelRunner(_AdaptiveDraftLen, PagedModelRunner):
             jnp.asarray(self._spec_plens), num_steps, self.draft_len)
         ENGINE_TELEMETRY.compile_end("spec_decode_paged", sig, t_c)
         for slot in self._slot_pages:
+            if slot == self._ragged_slot:
+                continue
             self._host_seq[slot] = min(self._host_seq[slot] + num_steps * j,
                                        self.max_seq)
         return packed, new_state
@@ -486,6 +488,32 @@ class SpecPagedModelRunner(_AdaptiveDraftLen, PagedModelRunner):
     def decode_steps(self, state, num_steps: int = 1):
         packed, new_state = self.decode_steps_device(state, num_steps)
         return np.asarray(packed), new_state
+
+    # ------------------------------------------------- unified ragged batch
+
+    # While a ragged prefill is in flight the scheduler dispatches
+    # ragged_step (inherited: the PLAIN unified program, 2-D tokens) —
+    # speculation pauses for the whole batch exactly like a draft_len=0
+    # retune, and resumes at the next ordinary decode dispatch.  hist goes
+    # stale for tokens emitted meanwhile, which only lowers proposal
+    # quality until overwritten — never correctness.
+
+    def ragged_finish(self, state, job, temperature, top_p, key,
+                      slot_key=None, top_k: int = 0,
+                      repeat_penalty: float = 1.0):
+        first, state = super().ragged_finish(
+            state, job, temperature, top_p, key, slot_key=slot_key,
+            top_k=top_k, repeat_penalty=repeat_penalty)
+        plen = len(job.prompt_ids)
+        self._spec_plens[job.slot] = plen
+        if state.hist is not None:
+            row = np.zeros((self.max_seq,), np.int32)
+            row[:plen] = job.prompt_ids[:plen]
+            if plen < self.max_seq:
+                row[plen] = first
+            state = self._set_hist(state, jnp.int32(job.slot),
+                                   jnp.asarray(row))
+        return first, state
 
 
 class DraftSpecPagedModelRunner(SpecPagedModelRunner):
@@ -575,6 +603,25 @@ class DraftSpecPagedModelRunner(SpecPagedModelRunner):
             jnp.asarray(tokens), state.draft_k, state.draft_v,
             jnp.int32(slot), jnp.int32(plen))
         return state
+
+    def ragged_finish(self, state, job, temperature, top_p, key,
+                      slot_key=None, top_k: int = 0,
+                      repeat_penalty: float = 1.0):
+        first, state = super().ragged_finish(
+            state, job, temperature, top_p, key, slot_key=slot_key,
+            top_k=top_k, repeat_penalty=repeat_penalty)
+        # Ragged chunking fills only the MAIN pool; the draft still needs
+        # the whole prompt in its own contiguous cache (same small prefill
+        # insert() runs).
+        prompt = list(job.prompt_ids)
+        if prompt:
+            bucket = self.bucket_for(len(prompt))
+            tokens = np.zeros((1, bucket), np.int32)
+            tokens[0, :len(prompt)] = prompt
+            state.draft_k, state.draft_v = self._draft_prefill(
+                jnp.asarray(tokens), state.draft_k, state.draft_v,
+                jnp.int32(job.slot), jnp.int32(len(prompt)))
+        return first, state
 
     # ---------------------------------------------------------------- drafts
 

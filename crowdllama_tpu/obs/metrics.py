@@ -373,6 +373,11 @@ class EngineTelemetry:
         self._compiles: dict[tuple[str, str], int] = {}
         self._seen: set[tuple[str, str]] = set()
         self._padding = {"waste": 0, "useful": 0}
+        # Unified ragged batch (docs/RAGGED_BATCH.md): wall time per
+        # prefill chunk carried inside a decode dispatch.  Engine-plane
+        # like the compile histogram (the scheduler's dispatch loop
+        # records it), rendered on both scrape surfaces.
+        self.prefill_chunk_seconds = Histogram(DECODE_STEP_BUCKETS)
 
     def _key(self, program: str, bucket: object) -> tuple[str, str]:
         return (self.program_guard.value(program),
@@ -434,6 +439,9 @@ class EngineTelemetry:
                    f"{padding['waste']}")
         out.append("# TYPE crowdllama_useful_tokens_total counter")
         out.append(f"crowdllama_useful_tokens_total {padding['useful']}")
+        out.append("# TYPE crowdllama_prefill_chunk_seconds histogram")
+        out.extend(self.prefill_chunk_seconds.lines(
+            "crowdllama_prefill_chunk_seconds"))
         return out
 
 

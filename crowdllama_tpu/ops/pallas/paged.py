@@ -270,6 +270,351 @@ def flash_paged_decode_attention(
     return out.reshape(b, h, dh)
 
 
+# Query rows per chunk-kernel grid block.  32 keeps the fp32 online-
+# softmax scratch ([Hkv, QB*G, Dh] acc + two [Hkv, QB*G, _LANES] carries)
+# comfortably inside VMEM for Llama-class head counts.
+_CHUNK_QB = 32
+
+
+def ragged_pallas_supported(page_size: int, head_dim: int,
+                            n_shards: int = 1,
+                            num_kv_heads: int = 0,
+                            itemsize: int = 2,
+                            quant: bool = False) -> bool:
+    """Gate for the fused ragged (decode + prefill-chunk) kernel pair.
+
+    The unified step runs the decode rows through the existing paged
+    decode kernel and the chunk rows through the chunk kernel below, so
+    the constraints are the decode gate plus the chunk kernel's VMEM
+    footprint (QB*G query rows instead of G per kv head)."""
+    if not paged_pallas_supported(page_size, head_dim, n_shards,
+                                  num_kv_heads, itemsize, quant):
+        return False
+    # Chunk kernel holds [Hkv, QB*G, Dh] fp32 acc + 2x [Hkv, QB*G, _LANES]
+    # carries; with num_kv_heads=0 (availability probe) assume one head.
+    hkv_local = max(max(num_kv_heads, 1) // max(n_shards, 1), 1)
+    # G is unknown at probe time; bound by a generous 16 query groups.
+    rows = _CHUNK_QB * 16
+    scratch = hkv_local * rows * (head_dim + 2 * _LANES) * 4
+    return scratch <= 2 * _VMEM_TILE_BUDGET
+
+
+def _chunk_kernel(
+    # scalar prefetch
+    pages_ref,    # [NP] int32 — the chunk slot's page-table row
+    info_ref,     # [3] int32 — (ctx, kv_len, window)
+    # operands: q, then PAIRS x (k, v), then PAIRS x (ks, vs) if quant
+    q_ref,        # [Hkv, QB, G, Dh] — one query block of the chunk
+    *refs,
+    scale: float,
+    softcap: float,
+    page: int,
+    pairs: int,
+    quant: bool,
+):
+    """Causal prefill-chunk attention over the slot's paged KV.
+
+    Structurally the decode kernel with QB*G query rows per kv head in
+    place of G: grid (q_blocks, kv_steps), online softmax carried across
+    the sequential kv dimension, causal + window masking per query row.
+    The fresh chunk's own KV has already been scattered into the pool by
+    the caller, so positions [ctx, kv_len) are read back like any other
+    page (self-attention within the chunk falls out of the causal mask)."""
+    kv = refs[: 2 * pairs]
+    scs = refs[2 * pairs: 4 * pairs] if quant else ()
+    o_ref, acc_ref, m_ref, l_ref = refs[-4:]
+
+    qb = pl.program_id(0)
+    p = pl.program_id(1)
+    num_steps = pl.num_programs(1)
+    ctx = info_ref[0]
+    kv_len = info_ref[1]
+    window = info_ref[2]
+    hkv, qbw, g, dh = q_ref.shape
+    rows = qbw * g
+
+    @pl.when(p == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # Keys this q block can see: validity bound AND the causal bound of
+    # the block's last row — later pages are compute-skipped entirely.
+    block_bound = jnp.minimum(kv_len, ctx + (qb + 1) * qbw)
+    # Query positions per row: row r covers query (qb*QB + r//G).
+    qpos = (ctx + qb * qbw
+            + jax.lax.broadcasted_iota(jnp.int32, (1, rows, 1), 1) // g)
+
+    def _tile(j):
+        k_ref, v_ref = kv[2 * j], kv[2 * j + 1]
+        base = (p * pairs + j) * page
+
+        @pl.when(base < block_bound)
+        def _body():
+            q = q_ref[...].astype(jnp.float32).reshape(hkv, rows, dh)
+            k_tile = k_ref[...].astype(jnp.float32)  # [Hkv, page, Dh]
+            v_tile = v_ref[...].astype(jnp.float32)
+            kpos = base + jax.lax.broadcasted_iota(jnp.int32, (1, 1, page), 2)
+
+            logits = jax.lax.dot_general(
+                q, k_tile, (((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            ) * scale
+            if quant:
+                logits = logits * scs[2 * j][...].astype(jnp.float32)
+            logits = _softcap(logits, softcap)
+
+            mask = (kpos < kv_len) & (kpos <= qpos)
+            mask &= (window <= 0) | (kpos > qpos - window)
+            logits = jnp.where(mask, logits, NEG_INF)
+
+            m_prev = m_ref[:, :, :1]
+            l_prev = l_ref[:, :, :1]
+            m_new = jnp.maximum(m_prev,
+                                jnp.max(logits, axis=-1, keepdims=True))
+            alpha = jnp.exp(m_prev - m_new)
+            pr = jnp.exp(logits - m_new) * mask.astype(jnp.float32)
+            l_new = l_prev * alpha + jnp.sum(pr, axis=-1, keepdims=True)
+            if quant:
+                pr = pr * scs[2 * j + 1][...].astype(jnp.float32)
+            pv = jax.lax.dot_general(
+                pr, v_tile, (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            )
+            acc_ref[...] = acc_ref[...] * alpha + pv
+            m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+            l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    for j in range(pairs):
+        _tile(j)
+
+    @pl.when(p == num_steps - 1)
+    def _finalize():
+        l = l_ref[:, :, :1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        out = (acc_ref[...] / l).astype(o_ref.dtype)
+        o_ref[...] = out.reshape(hkv, qbw, g, dh)
+
+
+def flash_ragged_chunk_attention(
+    q: jnp.ndarray,           # [C, H, Dh] — the chunk's query rows
+    pool_k: jnp.ndarray,      # [P, Hkv, page, Dh]
+    pool_v: jnp.ndarray,
+    pages: jnp.ndarray,       # [NP] int32 — the chunk slot's page row
+    ctx_len: jnp.ndarray,     # scalar int32 — tokens already in the pool
+    kv_len: jnp.ndarray,      # scalar int32 — ctx_len + valid chunk rows
+    scale: float,
+    softcap: float = 0.0,
+    sliding_window: int | jnp.ndarray = 0,
+    k_scale: jnp.ndarray | None = None,
+    v_scale: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """One prefill chunk's attention over its slot's paged KV.
+
+    The chunk's own K/V must already be scattered into the pool (the
+    engine writes them in the same step); query row j attends kv
+    positions < ctx_len + j + 1.  Rows past the valid chunk length
+    produce garbage the caller drops.  Output [C, H, Dh]."""
+    c, h, dh = q.shape
+    _, hkv, page, _ = pool_k.shape
+    g = h // hkv
+    np_ = pages.shape[0]
+    quant = k_scale is not None
+
+    qb = _CHUNK_QB
+    qblocks = -(-c // qb)
+    # [C, H, Dh] -> [Hkv, Cpad, G, Dh]: kv-head-major so the kernel's dot
+    # batches over Hkv like the decode kernel.
+    qx = q.reshape(c, hkv, g, dh).transpose(1, 0, 2, 3)
+    if qblocks * qb != c:
+        qx = jnp.pad(qx, ((0, 0), (0, qblocks * qb - c), (0, 0), (0, 0)))
+
+    info = jnp.stack([
+        jnp.asarray(ctx_len, jnp.int32).reshape(()),
+        jnp.asarray(kv_len, jnp.int32).reshape(()),
+        jnp.asarray(sliding_window, jnp.int32).reshape(()),
+    ])
+    pages = pages.astype(jnp.int32)
+
+    itemsize = pool_k.dtype.itemsize
+    pairs = 2 if (np_ >= 2 and 4 * _pairs_bytes(hkv, page, dh, itemsize)
+                  <= _VMEM_TILE_BUDGET) else 1
+    steps = -(-np_ // pairs)
+
+    def q_map(qi, pi, pr, ir):
+        return (0, qi, 0, 0)
+
+    def kv_map_at(j):
+        def kv_map(qi, pi, pr, ir):
+            idx = jnp.minimum(pi * pairs + j, np_ - 1)
+            return (pr[idx], 0, 0, 0)
+        return kv_map
+
+    in_specs = [pl.BlockSpec((hkv, qb, g, dh), q_map)]
+    operands = [qx]
+    for j in range(pairs):
+        in_specs += [pl.BlockSpec((None, hkv, page, dh), kv_map_at(j))] * 2
+        operands += [pool_k, pool_v]
+    if quant:
+        ks4 = k_scale.reshape(*k_scale.shape[:2], 1, page)
+        vs4 = v_scale.reshape(*v_scale.shape[:2], 1, page)
+        for j in range(pairs):
+            in_specs += [pl.BlockSpec((None, hkv, 1, page),
+                                      kv_map_at(j))] * 2
+            operands += [ks4, vs4]
+
+    kernel = functools.partial(
+        _chunk_kernel,
+        scale=scale, softcap=float(softcap or 0.0), page=page,
+        pairs=pairs, quant=quant,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(qblocks, steps),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((hkv, qb, g, dh), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((hkv, qb * g, dh), jnp.float32),
+            pltpu.VMEM((hkv, qb * g, _LANES), jnp.float32),
+            pltpu.VMEM((hkv, qb * g, _LANES), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((hkv, qblocks * qb, g, dh), q.dtype),
+        interpret=_interpret(),
+    )(pages, info, *operands)
+    return out[:, :c].transpose(1, 0, 2, 3).reshape(c, h, dh)
+
+
+def ragged_paged_attention_ref(
+    q: jnp.ndarray,            # [B + C, H, Dh] — decode rows then chunk rows
+    chunk_k: jnp.ndarray,      # [1, Hkv, C, Dh] — the chunk's fresh keys
+    chunk_v: jnp.ndarray,      # [1, Hkv, C, Dh]
+    pool_k: jnp.ndarray,       # [P, Hkv, page, Dh]
+    pool_v: jnp.ndarray,
+    page_table: jnp.ndarray,   # [B, NP] int32
+    q_lens: jnp.ndarray,       # [B + 1] int32 — per-sequence query lengths
+    kv_lens: jnp.ndarray,      # [B + 1] int32 — incl. this step's tokens
+    chunk_slot: jnp.ndarray,   # scalar int32 — page-table row of seq B
+    scale: float,
+    softcap: float = 0.0,
+    sliding_window: int | jnp.ndarray = 0,
+    k_scale: jnp.ndarray | None = None,
+    v_scale: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Pure-JAX unified ragged batch attention (reference semantics).
+
+    One call covers B+1 ragged sequences over the same paged pool: B
+    decode sequences (q_len 0 or 1, rows 0..B-1) plus one prefill-chunk
+    sequence (q_len = q_lens[B] <= C, rows B..).  Query i of sequence s
+    attends kv positions < kv_lens[s] - q_lens[s] + i + 1.
+
+    Byte-identity contract (tier-1, CPU): decode rows run exactly the
+    gather + :func:`decode_attention` math of the plain paged decode
+    step, and chunk rows run exactly :func:`prefill_attention_ctx` with
+    the paged prefix as the cached context — the same code paths the
+    monolithic admission path uses — so unified streams match monolithic
+    streams bitwise on bf16 pools."""
+    from crowdllama_tpu.ops.attention import (
+        decode_attention,
+        decode_attention_q,
+        prefill_attention_ctx,
+    )
+
+    b = page_table.shape[0]
+    c = chunk_k.shape[2]
+    _, hkv, page, dh = pool_k.shape
+    np_ = page_table.shape[1]
+    w = np_ * page
+    quant = k_scale is not None
+
+    # --- decode rows: identical to the plain paged decode fallback ---
+    view_k = pool_k[page_table].transpose(0, 2, 1, 3, 4).reshape(
+        b, hkv, w, dh)
+    view_v = pool_v[page_table].transpose(0, 2, 1, 3, 4).reshape(
+        b, hkv, w, dh)
+    if quant:
+        vs_k = k_scale[page_table].transpose(0, 2, 1, 3).reshape(b, hkv, w)
+        vs_v = v_scale[page_table].transpose(0, 2, 1, 3).reshape(b, hkv, w)
+        out_dec = decode_attention_q(
+            q[:b], view_k, vs_k, view_v, vs_v, kv_lens[:b], scale,
+            softcap=softcap, sliding_window=sliding_window)
+    else:
+        out_dec = decode_attention(
+            q[:b], view_k, view_v, kv_lens[:b], scale, softcap=softcap,
+            sliding_window=sliding_window)
+
+    # --- chunk rows: prefix pages as cached context + fresh self block ---
+    ctx = kv_lens[b] - q_lens[b]
+    cpk = pool_k[page_table[chunk_slot]]
+    cpv = pool_v[page_table[chunk_slot]]
+    ctx_k = cpk.transpose(1, 0, 2, 3).reshape(1, hkv, w, dh)
+    ctx_v = cpv.transpose(1, 0, 2, 3).reshape(1, hkv, w, dh)
+    if quant:
+        csk = k_scale[page_table[chunk_slot]].transpose(1, 0, 2).reshape(
+            1, hkv, w, 1)
+        csv = v_scale[page_table[chunk_slot]].transpose(1, 0, 2).reshape(
+            1, hkv, w, 1)
+        ctx_k = ctx_k.astype(jnp.float32) * csk.astype(jnp.float32)
+        ctx_v = ctx_v.astype(jnp.float32) * csv.astype(jnp.float32)
+    kvpos = jnp.arange(w)[None, :]
+    ctx_valid = kvpos < ctx
+    positions = (ctx + jnp.arange(c))[None, :]
+    kv_valid = (jnp.arange(c) < q_lens[b])[None, :]
+    out_chunk = prefill_attention_ctx(
+        q[b:][None], chunk_k, chunk_v, positions, ctx_k, ctx_v, ctx_valid,
+        scale, softcap=softcap, sliding_window=sliding_window,
+        kv_valid=kv_valid)[0]
+
+    return jnp.concatenate([out_dec, out_chunk], axis=0)
+
+
+def ragged_paged_attention(
+    q: jnp.ndarray,            # [B + C, H, Dh]
+    chunk_k: jnp.ndarray,      # [1, Hkv, C, Dh]
+    chunk_v: jnp.ndarray,
+    pool_k: jnp.ndarray,
+    pool_v: jnp.ndarray,
+    page_table: jnp.ndarray,   # [B, NP] int32
+    q_lens: jnp.ndarray,       # [B + 1] int32
+    kv_lens: jnp.ndarray,      # [B + 1] int32
+    chunk_slot: jnp.ndarray,
+    scale: float,
+    softcap: float = 0.0,
+    sliding_window: int | jnp.ndarray = 0,
+    k_scale: jnp.ndarray | None = None,
+    v_scale: jnp.ndarray | None = None,
+    use_pallas: bool = False,
+) -> jnp.ndarray:
+    """Unified ragged batch attention over the paged pool.
+
+    ``use_pallas`` (a static flag the runner resolves via
+    :func:`ragged_pallas_supported`) routes the decode rows through the
+    fused paged decode kernel and the chunk rows through the chunk
+    kernel; otherwise the pure-JAX reference runs (tier-1 / CPU).  Both
+    require the chunk's fresh KV to already be scattered into the pool;
+    the ref additionally takes it as ``chunk_k``/``chunk_v`` operands so
+    its self block matches the monolithic prefill bitwise."""
+    if not use_pallas:
+        return ragged_paged_attention_ref(
+            q, chunk_k, chunk_v, pool_k, pool_v, page_table, q_lens,
+            kv_lens, chunk_slot, scale, softcap=softcap,
+            sliding_window=sliding_window, k_scale=k_scale, v_scale=v_scale)
+    b = page_table.shape[0]
+    out_dec = flash_paged_decode_attention(
+        q[:b], pool_k, pool_v, page_table, kv_lens[:b], scale,
+        softcap=softcap, sliding_window=sliding_window,
+        k_scale=k_scale, v_scale=v_scale)
+    out_chunk = flash_ragged_chunk_attention(
+        q[b:], pool_k, pool_v, page_table[chunk_slot],
+        kv_lens[b] - q_lens[b], kv_lens[b], scale, softcap=softcap,
+        sliding_window=sliding_window, k_scale=k_scale, v_scale=v_scale)
+    return jnp.concatenate([out_dec, out_chunk], axis=0)
+
+
 def flash_paged_decode_attention_tp(
     q: jnp.ndarray,           # [B, H, Dh] — heads tp-sharded (kv-major)
     pool_k: jnp.ndarray,      # [P, Hkv, page, Dh] — kv heads tp-sharded

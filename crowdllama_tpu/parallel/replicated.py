@@ -130,6 +130,10 @@ class ReplicatedRunner:
     # so the scheduler's feature gate sees False even when the inner
     # runner supports it.
     supports_adaptive_draft = False
+    # Ragged chunked prefill dispatches are leader-local (no replay frame
+    # op yet); same explicit-False pattern keeps the scheduler on the
+    # monolithic/legacy-chunked path for replicated engines.
+    supports_ragged = False
 
     def __init__(self, inner):
         self.inner = inner

@@ -17,6 +17,11 @@ site                  attrs / where
 ``engine.stream_chunk``  before the worker yields chunk N of a streamed
                       response (``Engine.handle_streaming``): ``worker``,
                       ``model``, ``index``
+``scheduler.ragged_chunk``  before the scheduler dispatches a unified
+                      ragged prefill-chunk step (engine/scheduler.py):
+                      ``done`` (prompt tokens already in the pool),
+                      ``total`` (prompt length) — the mid-chunked-prefill
+                      drain trigger (docs/RAGGED_BATCH.md)
 ``host.new_stream``   before a dial + handshake (net/host.py): ``peer``
                       (empty for bare addresses), ``protocol``
 ``relay.op``          relay service op dispatch (net/relay.py): ``op``
@@ -47,10 +52,11 @@ Actions:
   the gateway (mid-stream EOF) — the trigger for mid-stream failover.
 - ``"delay"`` — ``asyncio.sleep(delay_s + seeded jitter)`` then continue.
 - ``"drain"`` — raise :class:`DrainRequested`.  Only meaningful at
-  ``engine.stream_chunk``: the worker reacts by starting its own graceful
-  drain (as if SIGTERM / POST /drain arrived mid-stream) and the stream
-  continues until the scheduler hands it off with a MigrateFrame — the
-  chaos trigger for live request migration (docs/ROBUSTNESS.md).
+  ``engine.stream_chunk`` and ``scheduler.ragged_chunk``: the worker
+  reacts by starting its own graceful drain (as if SIGTERM / POST /drain
+  arrived mid-stream, or mid-chunked-prefill) and the request continues
+  until the scheduler hands it off with a MigrateFrame — the chaos
+  trigger for live request migration (docs/ROBUSTNESS.md).
 
 Usage::
 

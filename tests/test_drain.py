@@ -458,3 +458,104 @@ async def test_drain_midstream_kv_handoff_end_to_end():
             assert "deadline exceeded" in d["error"]
     finally:
         await teardown()
+
+
+# A prompt LONGER than the budget-shrunk ragged admission chunk
+# (byte-level tokenizer: ~1 token per char), so the worker takes the
+# unified ragged chunked-prefill path and a drain can land with the
+# prompt half-built inside tiny-test's 256-token context.
+RAGGED_CONTENT = (
+    "A drain landing mid-chunked-prefill must not forfeit the work: the "
+    "donor keeps every completed page in its prefix index and the "
+    "successor resumes chunking from where the donor stopped.")
+
+
+@pytest.mark.chaos
+async def test_drain_mid_chunked_prefill_resumes_on_successor():
+    """Acceptance (ISSUE 9): a drain landing MID-CHUNKED-PREFILL (the
+    "scheduler.ragged_chunk" chaos site) migrates the request before a
+    single token streamed — the MigrateFrame carries the prompt's chain
+    hashes, the successor fetches the pages the donor already computed
+    and resumes chunking the tail, and replayed_prefill_tokens counts
+    ONLY the unshipped tail (0 < replayed < prompt)."""
+    from crowdllama_tpu.engine.engine import JaxEngine
+
+    # step_token_budget 48 on 16-token pages → 32-token ragged chunks;
+    # decode_chunk 1 → 32 prompt tokens per dispatch, so the ~200-token
+    # prompt needs ~7 dispatches and the after=1 drain rule fires with
+    # most of the prompt still unbuilt.
+    kv_cfg = dict(model=MODEL, kv_layout="paged", kv_page_size=16,
+                  kv_ship=True, kv_ship_min_tokens=16, kv_ship_timeout=2.0,
+                  step_token_budget=48, decode_chunk=1)
+    workers, engines, _obs, consumer, gateway, gw_port, teardown = \
+        await _topology(
+            lambda cfg: JaxEngine(cfg, max_context_length=256,
+                                  warmup=False),
+            cfg_kw=kv_cfg, kv_ship=True)
+    try:
+        by_id = {w.peer_id: (w, e) for w, e in zip(workers, engines)}
+        url = f"http://127.0.0.1:{gw_port}/api/chat"
+        body = _chat_body(RAGGED_CONTENT, num_predict=16)
+        # The delay rule (listed first so the drain's raise cannot skip
+        # its pass counts) parks the scheduler loop between the next two
+        # chunk dispatches, guaranteeing the drain task reaches its
+        # migrate safe point while the job is still mid-prefill.
+        plan = FaultPlan(seed=13, rules=[
+            FaultRule(site="scheduler.ragged_chunk", action="delay",
+                      delay_s=0.3, after=2, times=2),
+            FaultRule(site="scheduler.ragged_chunk", action="drain",
+                      after=1, times=1)])
+        async with aiohttp.ClientSession() as s:
+            with faults.installed(plan):
+                async with s.post(url, json=body) as resp:
+                    assert resp.status == 200
+                    lines = _ndjson_lines(await resp.text())
+            # The drain fired mid-prefill: some tokens built, most not.
+            assert plan.log and plan.log[0][2] == "drain"
+            attrs = plan.log[0][1]
+            assert 0 < attrs["done"] < attrs["total"], attrs
+
+            donor_id = next(w.peer_id for w in workers
+                            if w.obs.metrics.drain["initiated"])
+            donor_peer, donor_eng = by_id[donor_id]
+            succ_id = next(p for p in by_id if p != donor_id)
+            succ_peer, succ_eng = by_id[succ_id]
+
+            # The stream completed cleanly on the successor — no token had
+            # streamed yet, so the client sees one uninterrupted stream.
+            assert lines[-1]["done"] is True
+            assert lines[-1].get("done_reason") in ("stop", "length")
+            assert lines[-1]["worker_id"] == succ_id
+            migrated_text = _content(lines)
+            assert migrated_text
+
+            # Partial handoff: pages moved donor → successor, and the
+            # replay counter holds ONLY the unshipped tail — more than
+            # zero (the drain interrupted the prefill) but strictly less
+            # than the prompt (the shipped prefix was NOT recomputed).
+            assert donor_eng._runner.kv_pages_exported > 0
+            assert succ_eng._runner.kv_pages_imported > 0
+            replayed = succ_eng.obs.metrics.replayed_prefill_tokens
+            assert 0 < replayed < attrs["total"], (replayed, attrs)
+
+            # Both sides chunked: the donor before the drain, the
+            # successor resuming the tail (the unshipped remainder is
+            # longer than one admission chunk, so it re-enters the ragged
+            # path rather than the monolithic fallback).
+            assert donor_eng.scheduler.ragged_chunks > 0
+            assert succ_eng.scheduler.ragged_chunks > 0
+
+            # Worker-side drain accounting + gateway-side migration.
+            assert donor_peer.obs.metrics.drain["initiated"] == 1
+            assert donor_peer.obs.metrics.drain["migrated_slots"] >= 1
+            assert gateway.obs.metrics.migrated_streams == 1
+            assert consumer.peer_manager.is_routable(donor_id, MODEL) is None
+
+            # Byte-identity: a rerun of the same request (greedy, same
+            # weights) on the surviving worker is the reference.
+            async with s.post(url, json=body) as resp:
+                assert resp.status == 200
+                reference = _content(_ndjson_lines(await resp.text()))
+            assert migrated_text == reference
+    finally:
+        await teardown()

@@ -224,8 +224,16 @@ async def test_gateway_and_worker_metrics_lint():
                               ("crowdllama_tenant_inflight", "gauge")):
                 assert types.get(fam) == kind, f"{fam} missing"
             for g in ("pending_depth", "active_slots", "batch_occupancy",
-                      "kv_cache_utilization"):
+                      "kv_cache_utilization",
+                      # Unified ragged batch (docs/RAGGED_BATCH.md):
+                      # chunked-prefill occupancy + per-step token load,
+                      # present on every engine kind (zero on FakeEngine).
+                      "prefill_chunk_slots", "step_token_budget_used"):
                 assert types.get(f"crowdllama_engine_{g}") == "gauge"
+            # Per-chunk prefill latency inside the unified dispatch rides
+            # the engine-telemetry plane onto both surfaces.
+            assert types.get(
+                "crowdllama_prefill_chunk_seconds") == "histogram"
             # Engine flight-recorder telemetry (docs/OBSERVABILITY.md):
             # XLA compile timing/counters + padding-waste accounting +
             # device memory, present on BOTH surfaces (zero-valued on a
@@ -286,6 +294,38 @@ def test_spec_gauges_lint():
     for g in ("spec_steps", "spec_emitted", "spec_accept_echo",
               "spec_accept_gen", "spec_draft_len"):
         assert types.get(f"crowdllama_engine_{g}") == "gauge", g
+
+
+def test_ragged_gauges_lint():
+    """The unified-ragged-batch gauges (scheduler.telemetry_gauges) render
+    as lint-clean crowdllama_engine_* families, and the per-chunk latency
+    histogram renders lint-clean through the engine-telemetry plane."""
+    from crowdllama_tpu.engine.scheduler import Scheduler
+    from crowdllama_tpu.obs.metrics import (
+        ENGINE_TELEMETRY,
+        engine_gauge_lines,
+    )
+
+    class _Runner:  # gauge rendering needs no device work
+        max_slots = 2
+        max_seq = 128
+
+    r = _Runner()
+    sched = Scheduler.__new__(Scheduler)
+    sched.runner = r
+    sched.slots = [None, None]
+    import asyncio
+
+    sched.pending = asyncio.Queue()
+    sched._deferred = []
+    sched._admitting = 0
+    sched._chunking = None
+    sched._step_budget_used = 3.5
+    types = _lint("\n".join(engine_gauge_lines(sched.telemetry_gauges())))
+    for g in ("prefill_chunk_slots", "step_token_budget_used"):
+        assert types.get(f"crowdllama_engine_{g}") == "gauge", g
+    types = _lint("\n".join(ENGINE_TELEMETRY.expose()))
+    assert types.get("crowdllama_prefill_chunk_seconds") == "histogram"
 
 
 def test_multi_engine_fans_out_obs_to_children():
