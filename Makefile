@@ -4,18 +4,25 @@
 PY ?= python
 PYTEST = env JAX_PLATFORMS=cpu $(PY) -m pytest -p no:cacheprovider
 
-.PHONY: test tier1 chaos chaos-multi-gateway distill-smoke bench-kv \
+.PHONY: test tier1 lint chaos chaos-multi-gateway distill-smoke bench-kv \
 	bench-mixed trace-demo
 
-# Full suite (slow soaks included).  Runs the chaos matrix FIRST: the
-# fault-injection scenarios are the cheapest way to catch a request-
-# plane regression, so they gate the long tail instead of trailing it.
-test: chaos
+# Full suite (slow soaks included).  Runs lint + the chaos matrix FIRST:
+# swarmlint finishes in seconds and the fault-injection scenarios are the
+# cheapest way to catch a request-plane regression, so they gate the
+# long tail instead of trailing it.
+test: lint chaos
 	$(PYTEST) tests/ -q -m 'not chaos'
 
 # The tier-1 gate: what CI (and ROADMAP.md) holds the repo to.
-tier1:
+tier1: lint
 	$(PYTEST) tests/ -q -m 'not slow' --continue-on-collection-errors
+
+# swarmlint (docs/STATIC_ANALYSIS.md): async-hotpath / jax-purity /
+# contract-exhaustiveness checkers over the package.  Exit 1 on any
+# finding not waived by crowdllama_tpu/analysis/baseline.toml.
+lint:
+	env JAX_PLATFORMS=cpu $(PY) -m crowdllama_tpu.analysis
 
 # Deterministic fault-injection matrix (docs/ROBUSTNESS.md): seeded
 # FaultPlans from crowdllama_tpu/testing/faults.py kill streams, fail

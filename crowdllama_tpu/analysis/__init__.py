@@ -1,0 +1,63 @@
+"""swarmlint — the repo-native static-analysis plane (docs/STATIC_ANALYSIS.md).
+
+Nine PRs of hand-maintained invariants, enforced mechanically:
+
+- :mod:`.async_hotpath`  — no blocking calls / lost coroutines / unlocked
+  shared-state mutation inside the asyncio request plane (gateway, peer,
+  peermanager, net, swarm, obs).
+- :mod:`.jax_purity`     — no host syncs, Python RNG/wall-clock, or
+  use-after-donate inside jit-traced / Pallas code (engine, ops,
+  parallel, train).
+- :mod:`.contracts`      — every string-keyed cross-node contract stays
+  exhaustive: llama.v1 oneof arms vs constructors/extractors/dispatch,
+  the FAULT_SITES registry vs instrumented ``faults.inject`` sites,
+  ``crowdllama_*`` metric families vs docs, CLI-flag/env parity in
+  config.py.
+
+Findings resolve against ``analysis/baseline.toml`` (each waiver carries a
+one-line justification); anything NOT waived fails ``make lint`` and the
+tier-1 ``tests/test_static_analysis.py`` module.  Run it as::
+
+    make lint                                  # human-readable
+    python -m crowdllama_tpu.analysis --format=json   # CI annotation
+"""
+
+from __future__ import annotations
+
+from crowdllama_tpu.analysis.base import (
+    Baseline,
+    Finding,
+    load_baseline,
+    repo_root,
+)
+
+
+def all_checkers():
+    """name -> callable(root) for every checker family, import deferred so
+    ``import crowdllama_tpu.analysis`` stays cheap."""
+    from crowdllama_tpu.analysis.async_hotpath import check_async_hotpath
+    from crowdllama_tpu.analysis.contracts import check_contracts
+    from crowdllama_tpu.analysis.jax_purity import check_jax_purity
+
+    return {
+        "async-hotpath": check_async_hotpath,
+        "jax-purity": check_jax_purity,
+        "contracts": check_contracts,
+    }
+
+
+def run_all(root: str | None = None,
+            baseline: Baseline | None = None) -> list[Finding]:
+    """Run every checker over the package; returns NON-waived findings
+    (pass an empty Baseline to see everything)."""
+    root = root or repo_root()
+    if baseline is None:
+        baseline = load_baseline()
+    findings: list[Finding] = []
+    for name, fn in all_checkers().items():
+        findings.extend(fn(root))
+    return [f for f in findings if not baseline.waives(f)]
+
+
+__all__ = ["Finding", "Baseline", "load_baseline", "repo_root",
+           "all_checkers", "run_all"]

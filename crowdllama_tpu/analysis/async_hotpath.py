@@ -1,0 +1,267 @@
+"""async-hotpath checker: the asyncio request plane must not block.
+
+Walks every ``async def`` in the request-plane packages (gateway, peer,
+peermanager, net, swarm, obs) and flags:
+
+``blocking-call``
+    Synchronous calls that stall the event loop: ``time.sleep``,
+    ``subprocess.run``-family, sync socket/DNS helpers, sync file IO via
+    ``open(...)``, and ``.result()`` on futures.  Bodies of *nested sync
+    functions* are exempt — that is the ``run_in_executor`` idiom (the
+    blocking work runs on a thread, e.g. engine.capture_profile).
+
+``unawaited-coroutine``
+    A bare expression statement calling a function whose every definition
+    in the repo is ``async def`` — the coroutine is created and dropped,
+    so the work silently never runs (the PR 6 ``engine.obs`` fan-out bug
+    class).  Calls wrapped in ``create_task`` / ``ensure_future`` /
+    ``gather`` are fine; names that also have sync definitions anywhere
+    are skipped (cannot tell which binding this is without types).
+
+``unlocked-mutation``
+    Lock-consistency inference, per class: if an attribute is mutated
+    under ``async with self.<lock>`` in one coroutine method, mutating the
+    same attribute in another coroutine of that class *outside* the lock
+    is flagged.  The guard relation is discovered from the code itself, so
+    there is no hand-maintained attribute list to rot.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from crowdllama_tpu.analysis.base import (
+    Finding,
+    SourceFile,
+    dotted_name,
+    load_sources,
+)
+
+CHECKER = "async-hotpath"
+
+SUBDIRS = ("gateway", "peer", "peermanager", "net", "swarm", "obs")
+
+# Dotted-name suffixes that block the loop when called from a coroutine.
+BLOCKING_CALLS = frozenset({
+    "time.sleep",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output",
+    "os.system", "os.wait", "os.waitpid",
+    "socket.create_connection", "socket.getaddrinfo",
+    "socket.gethostbyname",
+    "urllib.request.urlopen",
+    "requests.get", "requests.post", "requests.request",
+    "shutil.rmtree", "shutil.copytree",
+})
+
+# Wrappers that legitimately consume a coroutine object.
+_TASK_WRAPPERS = frozenset({
+    "create_task", "ensure_future", "gather", "wait", "wait_for",
+    "run_coroutine_threadsafe", "shield", "run", "as_completed",
+})
+
+
+def _call_name(call: ast.Call) -> str:
+    return dotted_name(call.func)
+
+
+def collect_async_defs(sources: list[SourceFile]) -> dict[str, list[bool]]:
+    """function/method name -> [is_async per definition] across the repo.
+    Used to decide which bare calls certainly create a coroutine."""
+    defs: dict[str, list[bool]] = {}
+    for src in sources:
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, []).append(
+                    isinstance(node, ast.AsyncFunctionDef))
+    return defs
+
+
+def _iter_async_body(fn: ast.AsyncFunctionDef):
+    """Yield nodes of the coroutine body WITHOUT descending into nested
+    sync defs/lambdas (executor bodies) or nested async defs (they are
+    visited as coroutines of their own by the outer walk)."""
+    stack: list[ast.AST] = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _blocking_findings(src: SourceFile, fn: ast.AsyncFunctionDef,
+                       qual: str) -> list[Finding]:
+    out: list[Finding] = []
+    for node in _iter_async_body(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        if name and any(name == b or name.endswith("." + b)
+                        for b in BLOCKING_CALLS):
+            out.append(Finding(
+                CHECKER, "blocking-call", src.path, node.lineno, qual,
+                f"`{name}(...)` blocks the event loop; await an async "
+                "equivalent or push it through run_in_executor"))
+        elif name == "open":
+            out.append(Finding(
+                CHECKER, "blocking-call", src.path, node.lineno, qual,
+                "sync file IO `open(...)` on the event loop; use "
+                "run_in_executor (or accept+waive tiny startup reads)"))
+        elif (isinstance(node.func, ast.Attribute)
+              and node.func.attr == "result" and not node.args
+              and not node.keywords):
+            out.append(Finding(
+                CHECKER, "blocking-result", src.path, node.lineno, qual,
+                "`.result()` on a future blocks (or raises "
+                "InvalidStateError) — await it instead"))
+    return out
+
+
+def _unawaited_findings(src: SourceFile, fn: ast.AsyncFunctionDef,
+                        qual: str,
+                        async_only: frozenset[str]) -> list[Finding]:
+    out: list[Finding] = []
+    for node in _iter_async_body(fn):
+        if not (isinstance(node, ast.Expr)
+                and isinstance(node.value, ast.Call)):
+            continue
+        call = node.value
+        func = call.func
+        callee = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else "")
+        if callee in async_only and callee not in _TASK_WRAPPERS:
+            out.append(Finding(
+                CHECKER, "unawaited-coroutine", src.path, node.lineno, qual,
+                f"call to coroutine `{callee}` is neither awaited nor "
+                "wrapped in create_task — the work never runs"))
+    return out
+
+
+class _ClassLocks(ast.NodeVisitor):
+    """Per class: which self attributes hold asyncio locks, which
+    attributes are mutated under which lock, and every mutation site."""
+
+    def __init__(self) -> None:
+        self.locks: set[str] = set()
+        # attr -> set of lock names it was seen guarded by
+        self.guarded: dict[str, set[str]] = {}
+        # (attr, lineno, qualname, locks_held_at_site)
+        self.mutations: list[tuple[str, int, str, frozenset[str]]] = []
+
+
+def _self_attr(node: ast.AST) -> str:
+    """'x' for a bare ``self.x`` access."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return ""
+
+
+def _lock_attrs(cls: ast.ClassDef) -> set[str]:
+    locks: set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        value_name = dotted_name(node.value.func) \
+            if isinstance(node.value, ast.Call) else ""
+        if value_name.endswith("asyncio.Lock") or value_name == "Lock":
+            for tgt in node.targets:
+                attr = _self_attr(tgt)
+                if attr:
+                    locks.add(attr)
+    return locks
+
+
+def _mutated_attr(stmt: ast.AST) -> list[str]:
+    """self attributes a statement mutates (assignment or augmented)."""
+    out = []
+    if isinstance(stmt, ast.Assign):
+        for tgt in stmt.targets:
+            a = _self_attr(tgt)
+            if a:
+                out.append(a)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        a = _self_attr(stmt.target)
+        if a:
+            out.append(a)
+    return out
+
+
+def _walk_with_locks(body, held: frozenset[str], qual: str,
+                     info: _ClassLocks) -> None:
+    for stmt in body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        for attr in _mutated_attr(stmt):
+            if attr in info.locks:
+                continue  # assigning the lock itself (construction)
+            info.mutations.append((attr, stmt.lineno, qual, held))
+            for lk in held:
+                info.guarded.setdefault(attr, set()).add(lk)
+        if isinstance(stmt, ast.AsyncWith):
+            new = set(held)
+            for item in stmt.items:
+                lk = _self_attr(item.context_expr)
+                if lk in info.locks:
+                    new.add(lk)
+            _walk_with_locks(stmt.body, frozenset(new), qual, info)
+            continue
+        # Recurse into compound statements, keeping the held-lock set.
+        for field_body in ("body", "orelse", "finalbody", "handlers"):
+            sub = getattr(stmt, field_body, None)
+            if not sub:
+                continue
+            if field_body == "handlers":
+                for h in sub:
+                    _walk_with_locks(h.body, held, qual, info)
+            else:
+                _walk_with_locks(sub, held, qual, info)
+
+
+def _unlocked_findings(src: SourceFile) -> list[Finding]:
+    out: list[Finding] = []
+    for cls in ast.walk(src.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        info = _ClassLocks()
+        info.locks = _lock_attrs(cls)
+        if not info.locks:
+            continue
+        for fn in cls.body:
+            if isinstance(fn, ast.AsyncFunctionDef):
+                _walk_with_locks(fn.body, frozenset(),
+                                 f"{cls.name}.{fn.name}", info)
+        for attr, line, qual, held in info.mutations:
+            needed = info.guarded.get(attr, set())
+            if needed and not (held & needed):
+                out.append(Finding(
+                    CHECKER, "unlocked-mutation", src.path, line, qual,
+                    f"`self.{attr}` is mutated under `async with "
+                    f"self.{sorted(needed)[0]}` elsewhere in {cls.name} "
+                    "but not here — racy across awaits"))
+    return out
+
+
+def check_async_hotpath(root: str,
+                        subdirs: tuple[str, ...] = SUBDIRS) -> list[Finding]:
+    sources = load_sources(root, subdirs)
+    # The exclusively-async name set spans the WHOLE package, not just the
+    # hot-path dirs, so `engine.handle(...)` dropped in peer code is seen.
+    all_sources = load_sources(root, ("",))
+    defs = collect_async_defs(all_sources)
+    async_only = frozenset(
+        name for name, kinds in defs.items() if all(kinds))
+    out: list[Finding] = []
+    for src in sources:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.AsyncFunctionDef):
+                continue
+            qual = node.name
+            out.extend(_blocking_findings(src, node, qual))
+            out.extend(_unawaited_findings(src, node, qual, async_only))
+        out.extend(_unlocked_findings(src))
+    return out

@@ -1,0 +1,385 @@
+"""contract-exhaustiveness checker: string-keyed cross-node contracts.
+
+Four sub-checkers, all descriptor- or registry-driven so the source of
+truth is the artifact itself, never a hand-copied list:
+
+``oneof-*``
+    The llama.v1 ``BaseMessage.message`` oneof (read from the compiled
+    descriptor) vs. ``core/messages.py`` constructors/extractors and the
+    ``peer/peer.py`` serve dispatch.  Adding a proto arm without wiring
+    all three fails lint — the PR 8 "field-path that 500'd every
+    /api/chat" bug class.
+
+``fault-site-*``
+    ``testing/faults.py``'s FAULT_SITES registry vs. the
+    ``faults.inject("<site>")`` call sites actually instrumented in
+    production code, and the site strings chaos tests build FaultRules
+    from.  A typo'd site in a test now fails lint (and plan build)
+    instead of silently never firing.
+
+``metrics-*``
+    Every ``crowdllama_*`` metric family named in code must be documented
+    in ``docs/OBSERVABILITY.md`` (exact name, or a documented family
+    prefix like ``crowdllama_gossip_``).  tests/test_metrics_lint.py
+    closes the other half of the loop at runtime: every statically
+    collected family must appear on a real scrape surface.
+
+``config-*``
+    CLI-flag/env parity in ``config.py``: every Configuration field is
+    settable from the environment, every registered flag dest is a real
+    field, and every dest is consumed by ``from_flags``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from crowdllama_tpu.analysis.base import Finding, dotted_name, load_sources
+
+CHECKER = "contracts"
+
+# Oneof arms that are responses on the wire (worker/donor -> caller).
+# They need constructors + extractors but no serve-dispatch arm; a NEW
+# arm that is neither dispatched in peer.py nor added here fails lint,
+# which is exactly the forcing function we want.
+RESPONSE_ARMS = frozenset({
+    "generate_response", "embed_response", "kv_pages", "migrate_frame",
+    "trace_spans",
+})
+
+# Configuration fields intentionally without a CROWDLLAMA_TPU_* env read.
+CONFIG_ENV_EXEMPT = frozenset({
+    "intervals",  # derived wholesale from CROWDLLAMA_TPU_TEST_MODE
+})
+
+_FAMILY_RE = re.compile(r"crowdllama_[a-z0-9_]+")
+# Tokens that look like families but are package/protocol identifiers.
+_FAMILY_JUNK_PREFIXES = ("crowdllama_tpu", "crowdllama_native")
+
+
+def _read(root: str, rel: str) -> str:
+    return (Path(root) / rel).read_text(encoding="utf-8")
+
+
+# ---------------------------------------------------------------- oneof
+
+def _oneof_arms() -> list[str]:
+    from crowdllama_tpu.core import llama_v1_pb2 as pb
+
+    oneof = pb.BaseMessage.DESCRIPTOR.oneofs_by_name["message"]
+    return [f.name for f in oneof.fields]
+
+
+def check_oneof(root: str) -> list[Finding]:
+    out: list[Finding] = []
+    messages_src = _read(root, "crowdllama_tpu/core/messages.py")
+    peer_src = _read(root, "crowdllama_tpu/peer/peer.py")
+    arms = _oneof_arms()
+    for arm in arms:
+        if f"{arm}=" not in messages_src:
+            out.append(Finding(
+                CHECKER, "oneof-constructor", "crowdllama_tpu/core/messages.py",
+                0, arm,
+                f"oneof arm `{arm}` has no BaseMessage({arm}=...) "
+                "constructor in core/messages.py"))
+        if f'"{arm}"' not in messages_src:
+            out.append(Finding(
+                CHECKER, "oneof-extractor", "crowdllama_tpu/core/messages.py",
+                0, arm,
+                f"oneof arm `{arm}` has no WhichOneof-guarded extractor "
+                "in core/messages.py"))
+        if arm in RESPONSE_ARMS:
+            continue
+        dispatched = (f'which == "{arm}"' in peer_src
+                      or f'which != "{arm}"' in peer_src)
+        if not dispatched:
+            out.append(Finding(
+                CHECKER, "oneof-dispatch", "crowdllama_tpu/peer/peer.py",
+                0, arm,
+                f"request arm `{arm}` is not handled by the peer serve "
+                "dispatch (_serve_one_inference) — wire it, or declare "
+                "it a response arm in analysis/contracts.py RESPONSE_ARMS"))
+    return out
+
+
+# ---------------------------------------------------------- fault sites
+
+def _inject_sites(root: str) -> dict[str, str]:
+    """site literal -> 'path:line' for every faults.inject("<lit>") in
+    production code (the faults module itself excluded)."""
+    sites: dict[str, str] = {}
+    for src in load_sources(root, ("",)):
+        if src.path.endswith("testing/faults.py") \
+                or src.path.startswith("crowdllama_tpu/analysis/"):
+            continue
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if not (name == "inject" or name.endswith(".inject")):
+                continue
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                sites[node.args[0].value] = f"{src.path}:{node.lineno}"
+    return sites
+
+
+def _test_rule_sites(root: str) -> dict[str, str]:
+    """site literal -> 'path:line' for every FaultRule(site="<lit>") under
+    tests/ (and benchmarks/, which drive chaos phases too)."""
+    sites: dict[str, str] = {}
+    for sub in ("tests", "benchmarks"):
+        d = Path(root) / sub
+        if not d.is_dir():
+            continue
+        for f in sorted(d.rglob("*.py")):
+            try:
+                tree = ast.parse(f.read_text(encoding="utf-8"))
+            except SyntaxError:
+                continue
+            rel = f.relative_to(root).as_posix()
+            # Lines inside `with pytest.raises(...)` blocks hold
+            # DELIBERATE bad-site fixtures (the registry's own tests);
+            # a rule built there never reaches a plan.
+            negative: list[tuple[int, int]] = []
+            for node in ast.walk(tree):
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        ctx = item.context_expr
+                        if isinstance(ctx, ast.Call) and dotted_name(
+                                ctx.func).endswith("raises"):
+                            negative.append(
+                                (node.lineno, node.end_lineno or node.lineno))
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                if dotted_name(node.func).rsplit(".", 1)[-1] != "FaultRule":
+                    continue
+                if any(a <= node.lineno <= b for a, b in negative):
+                    continue
+                for kw in node.keywords:
+                    if kw.arg == "site" \
+                            and isinstance(kw.value, ast.Constant) \
+                            and isinstance(kw.value.value, str):
+                        sites[kw.value.value] = f"{rel}:{node.lineno}"
+    return sites
+
+
+def check_fault_sites(root: str) -> list[Finding]:
+    from crowdllama_tpu.testing.faults import FAULT_SITES
+
+    out: list[Finding] = []
+    instrumented = _inject_sites(root)
+    for site, where in instrumented.items():
+        if site not in FAULT_SITES:
+            out.append(Finding(
+                CHECKER, "fault-site-unregistered",
+                where.rsplit(":", 1)[0], int(where.rsplit(":", 1)[1]),
+                site,
+                f"faults.inject site `{site}` is not in the FAULT_SITES "
+                "registry (testing/faults.py) — register it with a "
+                "one-line description"))
+    for site in FAULT_SITES:
+        if site not in instrumented:
+            out.append(Finding(
+                CHECKER, "fault-site-uninstrumented",
+                "crowdllama_tpu/testing/faults.py", 0, site,
+                f"FAULT_SITES registers `{site}` but no production "
+                "faults.inject call uses it — dead registry entry"))
+    for site, where in _test_rule_sites(root).items():
+        if site not in FAULT_SITES:
+            out.append(Finding(
+                CHECKER, "fault-site-unknown-in-test",
+                where.rsplit(":", 1)[0], int(where.rsplit(":", 1)[1]),
+                site,
+                f"FaultRule(site=\"{site}\") names an unregistered site — "
+                "the rule would never fire (FaultRule also rejects this "
+                "at plan build now)"))
+    return out
+
+
+# -------------------------------------------------------------- metrics
+
+def collect_metric_families(root: str) -> tuple[set[str], set[str]]:
+    """(exact family names, dynamic family prefixes) read from string
+    literals and f-string constant parts across the package.
+
+    ``_bucket``/``_sum``/``_count`` exposition suffixes collapse onto the
+    histogram family; junk tokens (module paths) are filtered.
+    """
+    exact: set[str] = set()
+    prefixes: set[str] = set()
+
+    def _add(token: str, dynamic_tail: bool) -> None:
+        if token.startswith(_FAMILY_JUNK_PREFIXES):
+            return
+        # A trailing-underscore token is a family-prefix fragment whether
+        # it came from an f-string (f"crowdllama_engine_{key}") or a
+        # regex/startswith literal (r"^crowdllama_engine_(...)") — valid
+        # exposition names never end in "_".
+        if dynamic_tail or token.endswith("_"):
+            if token.endswith("_"):
+                prefixes.add(token)
+            return
+        for suffix in ("_bucket", "_sum", "_count"):
+            if token.endswith(suffix):
+                token = token[: -len(suffix)]
+        exact.add(token)
+
+    for src in load_sources(root, ("",)):
+        if src.path.startswith("crowdllama_tpu/analysis/"):
+            continue
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                for m in _FAMILY_RE.finditer(node.value):
+                    _add(m.group(0), dynamic_tail=False)
+            elif isinstance(node, ast.JoinedStr):
+                for i, part in enumerate(node.values):
+                    if not (isinstance(part, ast.Constant)
+                            and isinstance(part.value, str)):
+                        continue
+                    for m in _FAMILY_RE.finditer(part.value):
+                        # A match running to the end of a constant part
+                        # followed by a {format} field is a dynamic
+                        # family prefix, e.g. f"crowdllama_kv_ship_{k}".
+                        at_end = m.end() == len(part.value)
+                        has_field = i + 1 < len(node.values)
+                        _add(m.group(0), dynamic_tail=at_end and has_field)
+    return exact, prefixes
+
+
+def check_metrics_docs(root: str) -> list[Finding]:
+    doc_path = "docs/OBSERVABILITY.md"
+    doc = _read(root, doc_path)
+    doc_tokens = set(_FAMILY_RE.findall(doc))
+    doc_prefixes = {t for t in doc_tokens if t.endswith("_")}
+    out: list[Finding] = []
+    exact, prefixes = collect_metric_families(root)
+    for fam in sorted(exact):
+        documented = fam in doc_tokens or any(
+            fam.startswith(p) for p in doc_prefixes)
+        if not documented:
+            out.append(Finding(
+                CHECKER, "metrics-undocumented", doc_path, 0, fam,
+                f"metric family `{fam}` is emitted in code but not "
+                "documented in docs/OBSERVABILITY.md"))
+    for pref in sorted(prefixes):
+        documented = pref in doc_tokens or any(
+            t.startswith(pref) for t in doc_tokens)
+        if not documented:
+            out.append(Finding(
+                CHECKER, "metrics-undocumented", doc_path, 0, pref + "*",
+                f"dynamic metric family `{pref}*` is emitted in code but "
+                f"no `{pref}...` family appears in docs/OBSERVABILITY.md"))
+    # Families documented but gone from code: stale docs mislead oncall.
+    for tok in sorted(doc_tokens):
+        if tok.startswith(_FAMILY_JUNK_PREFIXES) or tok.endswith("_"):
+            continue
+        base = tok
+        for suffix in ("_bucket", "_sum", "_count"):
+            if base.endswith(suffix):
+                base = base[: -len(suffix)]
+        in_code = base in exact or any(base.startswith(p) for p in prefixes)
+        if not in_code:
+            out.append(Finding(
+                CHECKER, "metrics-stale-doc", doc_path, 0, tok,
+                f"docs/OBSERVABILITY.md documents `{tok}` but no code "
+                "emits that family any more"))
+    return out
+
+
+# --------------------------------------------------------------- config
+
+def _config_tree(root: str) -> ast.Module:
+    return ast.parse(_read(root, "crowdllama_tpu/config.py"))
+
+
+def _class_def(tree: ast.Module, name: str) -> ast.ClassDef:
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == name:
+            return node
+    raise AssertionError(f"config.py: class {name} not found")
+
+
+def _method(cls: ast.ClassDef, name: str) -> ast.FunctionDef:
+    for node in cls.body:
+        if isinstance(node, ast.FunctionDef) and node.name == name:
+            return node
+    raise AssertionError(f"config.py: {cls.name}.{name} not found")
+
+
+def check_config_parity(root: str) -> list[Finding]:
+    path = "crowdllama_tpu/config.py"
+    tree = _config_tree(root)
+    cls = _class_def(tree, "Configuration")
+    fields = [n.target.id for n in cls.body
+              if isinstance(n, ast.AnnAssign) and isinstance(n.target, ast.Name)]
+
+    from_env = _method(cls, "from_environment")
+    env_assigned: set[str] = set()
+    for node in ast.walk(from_env):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "cfg"):
+                    env_assigned.add(tgt.attr)
+
+    add_flags = _method(cls, "add_flags")
+    dests: dict[str, int] = {}
+    for node in ast.walk(add_flags):
+        if not (isinstance(node, ast.Call)
+                and dotted_name(node.func).endswith("add_argument")):
+            continue
+        dest = ""
+        for kw in node.keywords:
+            if kw.arg == "dest" and isinstance(kw.value, ast.Constant):
+                dest = kw.value.value
+        if not dest:
+            for arg in node.args:
+                if isinstance(arg, ast.Constant) \
+                        and str(arg.value).startswith("--"):
+                    dest = str(arg.value)[2:].replace("-", "_")
+        if dest:
+            dests[dest] = node.lineno
+
+    from_flags = _method(cls, "from_flags")
+    flags_consumed: set[str] = set()
+    for node in ast.walk(from_flags):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            flags_consumed.add(node.value)
+
+    out: list[Finding] = []
+    for f in fields:
+        if f in CONFIG_ENV_EXEMPT:
+            continue
+        if f not in env_assigned:
+            out.append(Finding(
+                CHECKER, "config-no-env", path, 0, f,
+                f"Configuration.{f} cannot be set from the environment — "
+                "add a CROWDLLAMA_TPU_* read in from_environment (env/"
+                "flag parity keeps container deploys scriptable)"))
+    for dest, line in dests.items():
+        if dest not in fields:
+            out.append(Finding(
+                CHECKER, "config-unknown-dest", path, line, dest,
+                f"flag dest `{dest}` is not a Configuration field — the "
+                "flag parses and is silently dropped"))
+        elif dest not in flags_consumed:
+            out.append(Finding(
+                CHECKER, "config-flag-unconsumed", path, line, dest,
+                f"flag dest `{dest}` is registered in add_flags but "
+                "never read by from_flags — the flag parses and is "
+                "silently dropped"))
+    return out
+
+
+def check_contracts(root: str) -> list[Finding]:
+    out: list[Finding] = []
+    out.extend(check_oneof(root))
+    out.extend(check_fault_sites(root))
+    out.extend(check_metrics_docs(root))
+    out.extend(check_config_parity(root))
+    return out

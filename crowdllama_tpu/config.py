@@ -266,6 +266,10 @@ class Configuration:
                 "1", "true")
         cfg.engine_backend = env.get("CROWDLLAMA_TPU_ENGINE", cfg.engine_backend)
         cfg.mesh_shape = env.get("CROWDLLAMA_TPU_MESH", cfg.mesh_shape)
+        cfg.max_batch_slots = int(env.get(
+            "CROWDLLAMA_TPU_MAX_BATCH_SLOTS", cfg.max_batch_slots))
+        cfg.max_context_length = int(env.get(
+            "CROWDLLAMA_TPU_MAX_CONTEXT_LENGTH", cfg.max_context_length))
         cfg.decode_chunk = int(env.get("CROWDLLAMA_TPU_DECODE_CHUNK", cfg.decode_chunk))
         cfg.step_token_budget = int(env.get(
             "CROWDLLAMA_TPU_STEP_TOKEN_BUDGET", cfg.step_token_budget))

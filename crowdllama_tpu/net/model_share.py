@@ -228,8 +228,9 @@ async def fetch_model(host: Host, source: Contact, model: str,
     staging = dest.with_name(dest.name + ".partial")
     if staging.exists():
         # A dirty staging dir from an aborted pull must not leak stale
-        # (unverified) shards into the promoted checkpoint.
-        shutil.rmtree(staging)
+        # (unverified) shards into the promoted checkpoint.  rmtree over
+        # a multi-GB half-pull blocks for seconds — keep it off the loop.
+        await asyncio.to_thread(shutil.rmtree, staging)
     staging.mkdir(parents=True)
 
     stream = await host.new_stream(source, MODEL_PROTOCOL)
@@ -247,7 +248,7 @@ async def fetch_model(host: Host, source: Contact, model: str,
     total = sum(int(f.get("size", 0)) for f in files)
     free = shutil.disk_usage(staging).free
     if total * 1.05 + (256 << 20) > free:
-        shutil.rmtree(staging, ignore_errors=True)
+        await asyncio.to_thread(shutil.rmtree, staging, ignore_errors=True)
         raise RuntimeError(
             f"not enough disk for {model!r}: need {total} bytes, "
             f"{free} free under {staging.parent}")
@@ -286,7 +287,7 @@ async def fetch_model(host: Host, source: Contact, model: str,
 
     # Atomic-ish promote: all files verified, swap staging into place.
     if dest.exists():
-        shutil.rmtree(dest)
+        await asyncio.to_thread(shutil.rmtree, dest)
     staging.rename(dest)
     return dest
 

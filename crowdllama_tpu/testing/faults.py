@@ -77,6 +77,26 @@ from contextlib import contextmanager
 from dataclasses import dataclass, field
 
 
+# The site registry: every choke point production code instruments with
+# ``await faults.inject("<site>", ...)``, with a one-line description.
+# FaultRule rejects unknown names at plan-build time, so a typo'd site in
+# a chaos test fails loudly instead of silently never firing; swarmlint
+# (crowdllama_tpu/analysis/contracts.py) cross-checks this dict against
+# the inject call sites actually present in code, both directions.
+FAULT_SITES: dict[str, str] = {
+    "engine.request": "non-streamed inference entry (engine/engine.py)",
+    "engine.stream_chunk": "before the worker yields chunk N of a stream",
+    "scheduler.ragged_chunk": "before a unified ragged prefill-chunk step",
+    "host.new_stream": "before a dial + handshake (net/host.py)",
+    "relay.op": "relay service op dispatch (net/relay.py)",
+    "relay.splice": "before a relay starts its bidirectional copy loop",
+    "kv.fetch": "before a worker dials a KV-page donor",
+    "kv.serve": "donor side, before a KvFetchRequest is served",
+    "gossip.send": "before a gateway replica pushes an anti-entropy frame",
+    "gossip.recv": "before an inbound gossip frame is merged",
+}
+
+
 class FaultError(RuntimeError):
     """An injected failure (generic: dial failed, request failed, ...)."""
 
@@ -109,6 +129,15 @@ class FaultRule:
     # Runtime state (owned by the plan; reset by FaultPlan.reset()).
     passes: int = 0
     fired: int = 0
+
+    def __post_init__(self) -> None:
+        if self.site not in FAULT_SITES:
+            raise ValueError(
+                f"unknown fault site {self.site!r} — registered sites: "
+                f"{', '.join(sorted(FAULT_SITES))} (see FAULT_SITES in "
+                "testing/faults.py; a typo here would silently never fire)")
+        if self.action not in ("error", "kill_stream", "delay", "drain"):
+            raise ValueError(f"unknown fault action {self.action!r}")
 
 
 class FaultPlan:

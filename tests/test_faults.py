@@ -10,53 +10,53 @@ from crowdllama_tpu.testing.faults import FaultError, FaultPlan, FaultRule, Kill
 
 
 async def test_rule_fires_at_exact_pass_index():
-    plan = FaultPlan(rules=[FaultRule(site="s", after=2, times=1)])
+    plan = FaultPlan(rules=[FaultRule(site="engine.request", after=2, times=1)])
     for i in range(5):
         if i == 2:
             with pytest.raises(FaultError):
-                await plan.inject("s")
+                await plan.inject("engine.request")
         else:
-            await plan.inject("s")  # passes 0,1 (before) and 3,4 (spent)
+            await plan.inject("engine.request")  # passes 0,1 (before) and 3,4 (spent)
     assert [a for (_, _, a) in plan.log] == ["error"]
     assert plan.rules[0].passes == 5 and plan.rules[0].fired == 1
 
 
 async def test_match_filter_selects_attrs_and_counts_only_matches():
     plan = FaultPlan(rules=[
-        FaultRule(site="s", match={"worker": "w1"}, after=1, times=1)])
+        FaultRule(site="engine.request", match={"worker": "w1"}, after=1, times=1)])
     # Non-matching passes must not advance the rule's pass counter.
-    await plan.inject("s", worker="w2")
-    await plan.inject("s", worker="w2")
-    await plan.inject("s", worker="w1")  # matching pass 0: before `after`
+    await plan.inject("engine.request", worker="w2")
+    await plan.inject("engine.request", worker="w2")
+    await plan.inject("engine.request", worker="w1")  # matching pass 0: before `after`
     with pytest.raises(FaultError):
-        await plan.inject("s", worker="w1")  # matching pass 1: fires
-    assert plan.log == [("s", {"worker": "w1"}, "error")]
+        await plan.inject("engine.request", worker="w1")  # matching pass 1: fires
+    assert plan.log == [("engine.request", {"worker": "w1"}, "error")]
 
 
 async def test_times_zero_is_unlimited():
-    plan = FaultPlan(rules=[FaultRule(site="s", times=0)])
+    plan = FaultPlan(rules=[FaultRule(site="engine.request", times=0)])
     for _ in range(4):
         with pytest.raises(FaultError):
-            await plan.inject("s")
+            await plan.inject("engine.request")
     assert plan.rules[0].fired == 4
 
 
 async def test_kill_stream_is_a_fault_error():
-    plan = FaultPlan(rules=[FaultRule(site="s", action="kill_stream")])
+    plan = FaultPlan(rules=[FaultRule(site="engine.request", action="kill_stream")])
     with pytest.raises(KillStream):
-        await plan.inject("s")
+        await plan.inject("engine.request")
     assert issubclass(KillStream, FaultError)
     assert issubclass(FaultError, RuntimeError)
 
 
 async def test_reset_replays_identically():
-    plan = FaultPlan(seed=7, rules=[FaultRule(site="s", after=1, times=2)])
+    plan = FaultPlan(seed=7, rules=[FaultRule(site="engine.request", after=1, times=2)])
 
     async def run():
         fired = []
         for i in range(5):
             try:
-                await plan.inject("s", i=i)
+                await plan.inject("engine.request", i=i)
             except FaultError:
                 fired.append(i)
         return fired, list(plan.log)
@@ -64,28 +64,28 @@ async def test_reset_replays_identically():
     first = await run()
     plan.reset()
     second = await run()
-    assert first == second == ([1, 2], [("s", {"i": 1}, "error"),
-                                        ("s", {"i": 2}, "error")])
+    assert first == second == ([1, 2], [("engine.request", {"i": 1}, "error"),
+                                        ("engine.request", {"i": 2}, "error")])
 
 
 async def test_module_hook_inert_without_plan_and_installed_clears():
     faults.clear()
-    await faults.inject("anything", x=1)  # no plan: must be a no-op
-    plan = FaultPlan(rules=[FaultRule(site="anything", times=0)])
+    await faults.inject("engine.request", x=1)  # no plan: must be a no-op
+    plan = FaultPlan(rules=[FaultRule(site="engine.request", times=0)])
     with faults.installed(plan):
         assert faults.active() is plan
         with pytest.raises(FaultError):
-            await faults.inject("anything")
+            await faults.inject("engine.request")
     assert faults.active() is None
-    await faults.inject("anything")  # cleared again
+    await faults.inject("engine.request")  # cleared again
 
 
 async def test_delay_action_sleeps_and_logs():
     plan = FaultPlan(seed=3, rules=[
-        FaultRule(site="s", action="delay", delay_s=0.0, jitter_s=0.01,
+        FaultRule(site="engine.request", action="delay", delay_s=0.0, jitter_s=0.01,
                   times=2)])
-    await plan.inject("s")
-    await plan.inject("s")
+    await plan.inject("engine.request")
+    await plan.inject("engine.request")
     assert [a for (_, _, a) in plan.log] == ["delay", "delay"]
 
 
@@ -107,3 +107,15 @@ async def test_drain_action_raises_drain_requested():
     # Part of the fault family (generic chaos tooling still counts it)
     # but always catchable on its own ahead of FaultError.
     assert issubclass(faults.DrainRequested, FaultError)
+
+
+async def test_unknown_site_rejected_at_plan_build():
+    """FAULT_SITES is the registry of instrumented choke points; a typo'd
+    site in a chaos test must fail at FaultRule construction — not
+    silently never fire (the bug class the registry exists to kill)."""
+    with pytest.raises(ValueError, match="unknown fault site"):
+        FaultRule(site="engine.stream_chnk")  # the classic transposition
+    with pytest.raises(ValueError, match="unknown fault action"):
+        FaultRule(site="engine.request", action="explode")
+    # Every registered site carries a description (swarmlint renders it).
+    assert all(isinstance(d, str) and d for d in faults.FAULT_SITES.values())

@@ -187,6 +187,20 @@ async def test_gateway_and_worker_metrics_lint():
 
         gw_types = _lint(gw_text)
         wk_types = _lint(wk_text)
+        # Completeness, closing the loop with swarmlint's static family
+        # collector (crowdllama_tpu/analysis/contracts.py): every
+        # crowdllama_* family named anywhere in code must be DECLARED on
+        # at least one of the two scrape surfaces — a counter that's
+        # bumped but never exposed is invisible to oncall.
+        from crowdllama_tpu.analysis.base import repo_root
+        from crowdllama_tpu.analysis.contracts import collect_metric_families
+
+        exact, _ = collect_metric_families(repo_root())
+        declared = set(gw_types) | set(wk_types)
+        missing = sorted(f for f in exact if f not in declared)
+        assert not missing, (
+            f"families named in code but declared on neither /metrics "
+            f"surface: {missing}")
         # The swarm-uniform families exist on BOTH scrape surfaces, with
         # the engine/scheduler gauges next to them.
         for types in (gw_types, wk_types):
