@@ -5,7 +5,7 @@ PY ?= python
 PYTEST = env JAX_PLATFORMS=cpu $(PY) -m pytest -p no:cacheprovider
 
 .PHONY: test tier1 lint chaos chaos-multi-gateway distill-smoke bench-kv \
-	bench-mixed trace-demo
+	bench-mixed bench-megastep trace-demo
 
 # Full suite (slow soaks included).  Runs lint + the chaos matrix FIRST:
 # swarmlint finishes in seconds and the fault-injection scenarios are the
@@ -66,4 +66,11 @@ bench-kv:
 # plus a 32k-token prefill the monolithic one-shot path could not fit.
 bench-mixed:
 	env JAX_PLATFORMS=cpu CROWDLLAMA_BENCH_PHASES=mixed_batch,ctx32k \
+		$(PY) bench.py
+
+# Kernel-looped decode megastep (docs/MEGASTEP.md): decode steps/sec and
+# host dispatches per token, swept over K in {1,2,4,8} against the
+# per-step dispatch+readback control.
+bench-megastep:
+	env JAX_PLATFORMS=cpu CROWDLLAMA_BENCH_PHASES=decode_megastep \
 		$(PY) bench.py

@@ -53,6 +53,10 @@ Phases (CROWDLLAMA_BENCH_PHASES to select, comma-separated):
             context whose monolithic one-shot prefill step cannot fit
             (the reference attention path would materialize an
             [H, 32k, 32k] fp32 score matrix, beyond the chip's HBM)
+  decode_megastep  kernel-looped decode (docs/MEGASTEP.md): K full decode
+            steps per host dispatch with on-device sampling, swept over
+            K in {1,2,4,8} against a per-step dispatch+readback control —
+            decode steps/sec and host dispatches per token
 
 The reference publishes no measured numbers (SURVEY §6); the only
 throughput figure in its tree is the hardcoded 150 tokens/sec a worker
@@ -125,7 +129,7 @@ PARTIAL_PATH = Path(__file__).resolve().parent / "BENCH_partial.jsonl"
 _ALL_PHASES = ("kernel", "decode", "decode_paged", "decode8b",
                "decode8b_paged", "decode8b_ctx4k", "ttft", "swarm",
                "ep_dispatch", "kv_transfer", "mini_swarm", "multi_gateway",
-               "capacity", "mixed_batch", "ctx32k",
+               "capacity", "mixed_batch", "ctx32k", "decode_megastep",
                "decode_spec", "decode_spec_draft", "decode_kv8",
                "decode8b_int4")
 
@@ -882,6 +886,110 @@ def _mixed_batch_phase() -> dict:
     }
 
 
+def _decode_megastep_phase() -> dict:
+    """Kernel-looped decode megastep (docs/MEGASTEP.md): K full decode
+    steps per host dispatch with on-device sampling + done-flags.
+
+    Control = the per-step loop: ONE decode_steps_device(1) dispatch and
+    one host readback per token row — the dispatch economy the megastep
+    retires.  The sweep dispatches decode_megastep(state, K) for
+    K ∈ {1,2,4,8}, reading the packed [K, B] token block + done-flags
+    back ONCE per flight with jax.device_get.  Headline = decode
+    steps/sec at K=4 over the control; each sweep entry also records
+    host dispatches per token, the quantity K exists to shrink (the
+    ISSUE acceptance wants it reduced ≥ K/2 at K=4 on the CPU ref
+    path).  Byte-identity of the streams is the test suite's job
+    (tests/test_megastep.py); this phase prices the win."""
+    import jax
+    import numpy as np
+
+    from crowdllama_tpu.engine.paged import PagedModelRunner
+    from crowdllama_tpu.models.config import get_config
+
+    platform = jax.devices()[0].platform
+    if platform != "tpu":
+        model, slots, ctx, page, steps = "tiny-test", 4, 512, 32, 96
+    else:
+        model = os.environ.get("CROWDLLAMA_BENCH_MODEL", "tinyllama-1.1b")
+        slots = int(os.environ.get("CROWDLLAMA_BENCH_SLOTS", "8"))
+        ctx, page, steps = 1024, 128, 256
+    cfg = get_config(model)
+    cfg = replace(cfg, max_context_length=ctx)
+
+    def fresh():
+        rng = np.random.default_rng(0)
+        runner = PagedModelRunner(cfg, max_slots=slots, max_seq=ctx,
+                                  page_size=page)
+        state = runner.init_state()
+        key = jax.random.PRNGKey(0)
+        for slot in range(slots):
+            p = rng.integers(1, cfg.vocab_size, size=24).tolist()
+            key, sub = jax.random.split(key)
+            first, ks, vs, plen = runner.prefill(p, 0.0, 1.0, sub,
+                                                 state=state)
+            state = runner.insert(state, slot, ks, vs, plen, first,
+                                  0.0, 1.0)
+        return runner, state
+
+    # Per-step control: dispatch + sync per token row.
+    runner, state = fresh()
+    _, state = runner.decode_steps(state, 1)  # decode compile
+    t0 = time.monotonic()
+    for _ in range(steps):
+        toks, state = runner.decode_steps_device(state, 1)
+        np.asarray(toks)
+    ctrl_dt = time.monotonic() - t0
+    ctrl_sps = steps / ctrl_dt
+    control = {
+        "steps_per_s": round(ctrl_sps, 2),
+        "host_dispatches": steps,
+        "host_dispatches_per_token": round(1.0 / slots, 5),
+    }
+
+    sweep: dict[str, object] = {}
+    headline = None
+    for k in (1, 2, 4, 8):
+        runner, state = fresh()
+        _, _, state = runner.decode_megastep(state, k)  # megastep compile
+        flights = max(1, steps // k)
+        t0 = time.monotonic()
+        for _ in range(flights):
+            tokens, done, state = runner.decode_megastep(state, k)
+            jax.device_get((tokens, done))  # ONE readback per flight
+        dt = time.monotonic() - t0
+        n_steps = flights * k
+        sps = n_steps / dt
+        entry = {
+            "steps_per_s": round(sps, 2),
+            "steps_per_s_vs_per_step": round(sps / ctrl_sps, 3),
+            "host_dispatches": flights,
+            "host_dispatches_per_token": round(
+                flights / (n_steps * slots), 5),
+            "dispatch_reduction_x": round(n_steps / flights, 2),
+        }
+        sweep[f"k{k}"] = entry
+        if k == 4:
+            headline = entry
+
+    return {
+        "metric": f"{model} decode megastep steps/sec (K=4 vs per-step)",
+        "value": headline["steps_per_s_vs_per_step"],
+        "unit": "x per-step decode throughput",
+        "vs_baseline": None,
+        "extra": {
+            "platform": platform, "slots": slots, "ctx": ctx,
+            "page_size": page, "timed_steps": steps,
+            "per_step_control": control,
+            "k_sweep": sweep,
+            "reading": "dispatch_reduction_x is host dispatches per "
+                       "token, control over megastep — K by "
+                       "construction; steps_per_s_vs_per_step is the "
+                       "wall-clock win from retiring K-1 host "
+                       "round-trips per K tokens",
+        },
+    }
+
+
 def _ctx32k_phase() -> dict:
     """A 32k-token prefill COMPLETED through the unified ragged path.
 
@@ -1252,6 +1360,7 @@ def main() -> None:
         "capacity": _capacity_phase,
         "mixed_batch": _mixed_batch_phase,
         "ctx32k": _ctx32k_phase,
+        "decode_megastep": _decode_megastep_phase,
     }
 
     remaining = [p for p in phases if p in runners]

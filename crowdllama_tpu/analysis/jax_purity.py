@@ -26,6 +26,19 @@ argument of ``pl.pallas_call``) — and flags, inside the traced bodies:
     buffer after the call (without the call's result being assigned back
     to that name) — the buffer's memory was handed to XLA, its contents
     are garbage (jax guides: buffer donation).
+
+``host-sync-in-decode-loop``
+    A ``for``/``while`` loop that both dispatches decode work
+    (``decode_steps_device`` / ``decode_megastep`` / ``ragged_step`` /
+    ``decode_steps``) and materializes device values on the host
+    (``np.asarray``/``np.array`` — called directly or handed to
+    ``run_in_executor`` — or ``.item()``/``.tolist()``).  A per-step
+    readback inside the dispatch loop serializes host and device and is
+    exactly what the megastep exists to remove (docs/MEGASTEP.md): read
+    the packed ``[K, B]`` block back ONCE per flight with
+    ``jax.device_get`` instead.  Unlike the other rules this walks every
+    function, not just traced ones — the scheduler's dispatch loop is
+    plain async Python.
 """
 
 from __future__ import annotations
@@ -50,6 +63,17 @@ _HOST_SYNC_CALLS = frozenset({
 })
 _IMPURE_PREFIXES = ("time.", "random.", "np.random.", "numpy.random.",
                     "datetime.")
+
+# host-sync-in-decode-loop: decode dispatch entry points (the device-side
+# flights the scheduler's loop launches) and the host-materializing calls
+# that must not share a loop body with them.
+_DISPATCH_CALLS = frozenset({
+    "decode_steps_device", "decode_megastep", "ragged_step", "decode_steps",
+})
+_LOOP_SYNC_NAMES = frozenset({
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "onp.asarray", "onp.array",
+})
 
 
 def _is_jax_jit(node: ast.AST) -> bool:
@@ -280,6 +304,54 @@ def _use_after_donate(src: SourceFile) -> list[Finding]:
     return out
 
 
+def _loop_sync_findings(src: SourceFile) -> list[Finding]:
+    """host-sync-in-decode-loop: see the module docstring.  One finding
+    per (function, sync line) — nested loops both containing the pair
+    collapse to a single report anchored at the first sync."""
+    out: list[Finding] = []
+    seen: set[tuple[str, int]] = set()
+
+    def visit(node: ast.AST, fname: str) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fname = node.name
+        if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            dispatches = False
+            syncs: list[tuple[int, str]] = []
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Attribute) \
+                        and sub.attr in _DISPATCH_CALLS:
+                    dispatches = True
+                elif isinstance(sub, ast.Name) \
+                        and sub.id in _DISPATCH_CALLS:
+                    dispatches = True
+                if isinstance(sub, ast.Call) \
+                        and isinstance(sub.func, ast.Attribute) \
+                        and sub.func.attr in ("item", "tolist"):
+                    syncs.append((sub.lineno, f".{sub.func.attr}()"))
+                elif isinstance(sub, ast.Attribute) \
+                        and dotted_name(sub) in _LOOP_SYNC_NAMES:
+                    # Catches both the direct call and the bare reference
+                    # handed to run_in_executor (a call's func node IS an
+                    # Attribute, so no separate Call case is needed).
+                    syncs.append((sub.lineno, dotted_name(sub)))
+            if dispatches and syncs:
+                line, what = min(syncs)
+                if (fname, line) not in seen:
+                    seen.add((fname, line))
+                    out.append(Finding(
+                        CHECKER, "host-sync-in-decode-loop", src.path,
+                        line, fname,
+                        f"`{what}` in the same loop as a decode dispatch "
+                        "serializes host and device per step — read the "
+                        "packed [K, B] block back once per flight with "
+                        "jax.device_get (docs/MEGASTEP.md)"))
+        for child in ast.iter_child_nodes(node):
+            visit(child, fname)
+
+    visit(src.tree, "<module>")
+    return out
+
+
 def check_jax_purity(root: str,
                      subdirs: tuple[str, ...] = SUBDIRS) -> list[Finding]:
     out: list[Finding] = []
@@ -287,4 +359,5 @@ def check_jax_purity(root: str,
         for fn in _traced_functions(src):
             out.extend(_purity_findings(src, fn))
         out.extend(_use_after_donate(src))
+        out.extend(_loop_sync_findings(src))
     return out

@@ -127,6 +127,11 @@ class Configuration:
     # alternating chunked-prefill dispatch (the bench.py mixed_batch A/B).
     step_token_budget: int = 0
     ragged_prefill: bool = True
+    # Kernel-looped decode megastep (docs/MEGASTEP.md): K full decode
+    # steps per host dispatch with on-device sampling + done-flags.
+    # 0 = legacy per-step-chunk path; runners without supports_megastep
+    # (replicated/sharded) fall back to legacy regardless.
+    megastep_k: int = 0
     warmup: bool = True  # compile prefill/decode at engine start
     quantize: str = ""  # "" (bf16) | "int8" | "int4" weight-only (ops/quant.py)
     # KV cache layout: "paged" (engine/paged.py, the default: page pool +
@@ -276,6 +281,8 @@ class Configuration:
         if env.get("CROWDLLAMA_TPU_RAGGED_PREFILL"):
             cfg.ragged_prefill = env["CROWDLLAMA_TPU_RAGGED_PREFILL"] in (
                 "1", "true")
+        cfg.megastep_k = int(env.get(
+            "CROWDLLAMA_TPU_MEGASTEP_K", cfg.megastep_k))
         cfg.shard_group = env.get("CROWDLLAMA_TPU_SHARD_GROUP", cfg.shard_group)
         cfg.shard_index = int(env.get("CROWDLLAMA_TPU_SHARD_INDEX", cfg.shard_index))
         cfg.shard_count = int(env.get("CROWDLLAMA_TPU_SHARD_COUNT", cfg.shard_count))
@@ -525,6 +532,11 @@ class Configuration:
                             help="unified ragged batch: per-step token "
                                  "budget (decode slots + one prefill "
                                  "chunk; 0 = auto)")
+        parser.add_argument("--megastep-k", dest="megastep_k", type=int,
+                            help="kernel-looped decode megastep: K full "
+                                 "decode steps per host dispatch with "
+                                 "on-device sampling (0 = legacy per-step "
+                                 "path)")
         parser.add_argument("--no-ragged-prefill", dest="ragged_prefill",
                             action="store_const", const=False, default=None,
                             help="disable unified ragged prefill: long "
@@ -613,7 +625,7 @@ class Configuration:
                 "quantize", "kv_layout", "kv_page_size", "kv_pool_tokens",
                 "kv_dtype", "relay_mode", "spec_decode", "spec_draft",
                 "spec_draft_model", "spec_draft_path", "spec_draft_max",
-                "step_token_budget", "ragged_prefill",
+                "step_token_budget", "ragged_prefill", "megastep_k",
                 "profile_dir", "trace_buffer", "worker_metrics_port",
                 "flight_recorder", "trace_ttl", "metrics_exemplars",
                 "request_timeout", "admission_max_inflight",

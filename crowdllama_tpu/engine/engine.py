@@ -114,7 +114,8 @@ class Engine:
         """
         return {"pending_depth": 0.0, "active_slots": 0.0,
                 "batch_occupancy": 0.0, "kv_cache_utilization": 0.0,
-                "prefill_chunk_slots": 0.0, "step_token_budget_used": 0.0}
+                "prefill_chunk_slots": 0.0, "step_token_budget_used": 0.0,
+                "host_dispatches_total": 0.0, "tokens_per_dispatch": 0.0}
 
     async def drain(self, timeout: float = 30.0) -> bool:
         """Finish in-flight work before shutdown; True when drained."""
@@ -447,7 +448,8 @@ class JaxEngine(Engine):
             decode_chunk=self.config.decode_chunk,
             admission_pending_max=self.config.admission_pending_max,
             spec_draft_max=self.config.spec_draft_max,
-            ragged=self.config.ragged_prefill)
+            ragged=self.config.ragged_prefill,
+            megastep_k=self.config.megastep_k)
         self.scheduler.drain_requested_cb = self._chaos_drain
         self.scheduler.start()
         log.info(
@@ -472,6 +474,11 @@ class JaxEngine(Engine):
         state = r.insert(state, 0, ks, vs, plen, tok, 0.0, 1.0)
         for k in {1, self.config.decode_chunk}:
             _, state = r.decode_steps(state, k)
+        if self.config.megastep_k and getattr(r, "supports_megastep", False):
+            # The megastep program (docs/MEGASTEP.md) is its own XLA
+            # signature; compile it now so the first saturated chunk
+            # doesn't pay for it.
+            _, _, state = r.decode_megastep(state, self.config.megastep_k)
         if getattr(r, "prefix_cache", False):
             r.warmup_ctx_prefill(state)
         if getattr(r, "prefill_chunk", 0) and r.max_seq > r.prefill_chunk + 1:

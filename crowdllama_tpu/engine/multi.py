@@ -132,7 +132,7 @@ class MultiEngine(Engine):
     # totals) sums.
     _GAUGE_MAX = frozenset(
         {"batch_occupancy", "kv_cache_utilization", "spec_draft_len",
-         "step_token_budget_used"})
+         "step_token_budget_used", "tokens_per_dispatch"})
 
     def obs_gauges(self) -> dict:
         out: dict = {}

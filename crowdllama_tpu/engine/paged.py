@@ -56,6 +56,7 @@ from crowdllama_tpu.engine.sampling import (
 from crowdllama_tpu.models import transformer as T
 from crowdllama_tpu.obs.metrics import ENGINE_TELEMETRY
 from crowdllama_tpu.ops.attention import decode_attention, decode_attention_q
+from crowdllama_tpu.ops.pallas.megastep import run_decode_megastep
 from crowdllama_tpu.ops.pallas.paged import (
     flash_paged_decode_attention,
     flash_paged_decode_attention_tp,
@@ -206,6 +207,9 @@ class PagedModelRunner(ModelRunner):
                                      donate_argnums=(0,))
         self._decode_paged = jax.jit(self._decode_paged_impl,
                                      donate_argnums=(1,), static_argnums=(3,))
+        self._decode_mega_paged = jax.jit(self._decode_mega_paged_impl,
+                                          donate_argnums=(1,),
+                                          static_argnums=(5,))
         self._release_paged = jax.jit(self._release_paged_impl,
                                       donate_argnums=(0,))
         self._prefill_ctx = jax.jit(self._prefill_ctx_impl)
@@ -573,8 +577,11 @@ class PagedModelRunner(ModelRunner):
         self._pending_match = (keys, matched)
         return int(tok), ks, vs, plen
 
-    def _decode_paged_impl(self, params, state: PagedDecodeState,
-                           page_table, num_steps: int):
+    def _paged_step_body(self, params, page_table):
+        """One paged decode step as a ``lax.scan`` body closure — shared
+        verbatim by the per-step program (``_decode_paged_impl``) and the
+        megastep (``_decode_mega_paged_impl``) so the two paths cannot
+        drift (byte-identity contract, docs/MEGASTEP.md)."""
         cfg = self.cfg
         pg = self.page_size
         b = self.max_slots
@@ -697,8 +704,21 @@ class PagedModelRunner(ModelRunner):
             )
             return new_state, next_tokens
 
-        new_state, tokens = jax.lax.scan(step, state, length=num_steps)
+        return step
+
+    def _decode_paged_impl(self, params, state: PagedDecodeState,
+                           page_table, num_steps: int):
+        new_state, tokens = jax.lax.scan(
+            self._paged_step_body(params, page_table), state,
+            length=num_steps)
         return tokens, new_state
+
+    def _decode_mega_paged_impl(self, params, state: PagedDecodeState,
+                                page_table, eos_ids, budgets, num_steps: int):
+        """K paged decode steps with on-device done-flags in one dispatch;
+        returns (tokens [K, B], done [K, B] bool, new state)."""
+        return run_decode_megastep(self._paged_step_body(params, page_table),
+                                   state, eos_ids, budgets, num_steps)
 
     def _ragged_step_impl(self, params, state: PagedDecodeState, page_table,
                           chunk_tokens, ctx_arr, total_len, chunk_slot,
@@ -1017,6 +1037,28 @@ class PagedModelRunner(ModelRunner):
             self._host_seq[slot] = min(self._host_seq[slot] + num_steps,
                                        self.max_seq)
         return tokens, new_state
+
+    def decode_megastep(self, state: PagedDecodeState, num_steps: int,
+                        eos_ids=None, budgets=None):
+        """Paged megastep (docs/MEGASTEP.md): see ModelRunner
+        .decode_megastep.  Page growth assumes the full ``num_steps`` even
+        when the scan early-exits — a conservative host-side overestimate
+        (the extra pages free at release, exactly like EOS overshoot in
+        the per-step chunked path)."""
+        eos_ids, budgets = self._mega_limits_dev(eos_ids, budgets)
+        self._ensure_capacity(num_steps)
+        t_c = ENGINE_TELEMETRY.compile_begin("decode_megastep_paged",
+                                             num_steps)
+        tokens, done, new_state = self._decode_mega_paged(
+            self.params, state, jnp.asarray(self.page_table),
+            eos_ids, budgets, num_steps)
+        ENGINE_TELEMETRY.compile_end("decode_megastep_paged", num_steps, t_c)
+        for slot in self._slot_pages:
+            if slot == self._ragged_slot:
+                continue
+            self._host_seq[slot] = min(self._host_seq[slot] + num_steps,
+                                       self.max_seq)
+        return tokens, done, new_state
 
     # ----------------------- unified ragged batch (docs/RAGGED_BATCH.md)
 

@@ -107,14 +107,27 @@ class _InFlightChunk:
     # chunks rode along in this decode chunk (0 = plain decode).  Retire
     # observes crowdllama_prefill_chunk_seconds from this.
     ragged_steps: int = 0
+    # Megastep dispatch (docs/MEGASTEP.md): the on-device per-slot
+    # done-flags [K, B], read back in the same transfer as the tokens.
+    # None for legacy per-step-chunk dispatches.
+    done_dev: object = None
 
 
 class Scheduler:
     def __init__(self, runner: ModelRunner, max_queue: int = 256,
                  decode_chunk: int = 8, admission_pending_max: int = 0,
-                 spec_draft_max: int = 0, ragged: bool = True):
+                 spec_draft_max: int = 0, ragged: bool = True,
+                 megastep_k: int = 0):
         self.runner = runner
         self.decode_chunk = max(1, decode_chunk)
+        # Kernel-looped megastep (docs/MEGASTEP.md): K full decode steps
+        # per host dispatch with on-device sampling + done-flags.  0 keeps
+        # the legacy per-step-chunk path; wrapper runners that replay
+        # frames and sharded multi-process runners opt out via
+        # supports_megastep (attribute absent = False).
+        self.megastep_k = max(0, megastep_k)
+        self._megastep = (self.megastep_k > 0
+                          and getattr(runner, "supports_megastep", False))
         # Load shedding (docs/ROBUSTNESS.md): reject at submit() once the
         # pending depth reaches this, instead of queueing work whose
         # deadline will expire before admission.  0 = no threshold (the
@@ -205,6 +218,12 @@ class Scheduler:
         # Tokens of work the last dispatched step carried (live decode
         # slots + prefill-chunk tokens per step); telemetry gauge.
         self._step_budget_used = 0.0
+        # Host-dispatch accounting (the megastep's reason to exist): every
+        # decode flight (plain / ragged / spec / megastep) counts one
+        # dispatch; tokens_per_dispatch is what the last retired flight
+        # actually emitted.
+        self.host_dispatches = 0
+        self._tokens_per_dispatch = 0.0
         self.ragged_chunks = 0  # prefill chunks dispatched unified
         # Chaos hook: the "scheduler.ragged_chunk" fault site's "drain"
         # action calls this to start a graceful drain mid-chunked-prefill
@@ -414,6 +433,11 @@ class Scheduler:
         # rows + prefill-chunk tokens).
         g["prefill_chunk_slots"] = 1.0 if self._chunking is not None else 0.0
         g["step_token_budget_used"] = float(self._step_budget_used)
+        # Host-dispatch economy (docs/MEGASTEP.md): the counter measures
+        # device programs launched, the gauge what the LAST retired flight
+        # emitted — together they show what megastep K is buying.
+        g["host_dispatches_total"] = float(self.host_dispatches)
+        g["tokens_per_dispatch"] = float(self._tokens_per_dispatch)
         if hasattr(r, "draft_len"):
             # Speculation acceptance on BOTH /metrics surfaces (gateway
             # aggregates worker gauges): emitted/steps is the live
@@ -574,6 +598,27 @@ class Scheduler:
         if not self.pending.empty() or self._deferred:
             return 1
         return self.decode_chunk
+
+    def _mega_limits(self) -> tuple[np.ndarray, np.ndarray]:
+        """Per-slot EOS ids and remaining token budgets for a megastep
+        dispatch, assembled from host bookkeeping.  The device done-flags
+        these drive must fire exactly when ``_emit`` would retire the slot
+        (same eos compare; budget = min of the request budget and context
+        headroom) — _emit remains the authority, the flags only let the
+        scan early-exit and spare the host per-step readbacks."""
+        b = len(self.slots)
+        eos = np.full((b,), -1, np.int32)
+        budgets = np.zeros((b,), np.int32)
+        for i, info in enumerate(self.slots):
+            if not isinstance(info, _SlotInfo):
+                continue
+            req = info.req
+            if req.eos_id is not None and req.eos_id >= 0:
+                eos[i] = req.eos_id
+            budgets[i] = max(0, min(
+                req.max_tokens - info.generated,
+                (self.runner.max_seq - 1) - info.prompt_len - info.generated))
+        return eos, budgets
 
     def _spec_retune(self, accepted: int, offered: int) -> None:
         """Fold one retired chunk's acceptance into the window; retune
@@ -749,6 +794,19 @@ class Scheduler:
         if (rjob is not None
                 or any(isinstance(s, _SlotInfo) for s in self.slots)):
             k = self._chunk_size()
+            # Megastep upgrade (docs/MEGASTEP.md): only full-size plain
+            # decode chunks become megasteps — size-1 dispatches
+            # (admittable request waiting, spec probes) keep their latency
+            # purpose, a ragged job's unified step has its own program,
+            # and a draft-speculating runner already packs K verify steps
+            # per dispatch (verify chunk = K is the megastep of that
+            # path).  Deciding BEFORE pre_decode_check sizes page growth
+            # for the real step count.
+            use_mega = (self._megastep and rjob is None
+                        and k == self.decode_chunk
+                        and getattr(self.runner, "draft_len", 0) == 0)
+            if use_mega:
+                k = self.megastep_k
             # Paged-KV runners grow page tables before the chunk; slots an
             # overcommitted pool cannot grow finish with "length" (their
             # pages free on release) instead of failing the whole engine.
@@ -824,6 +882,7 @@ class Scheduler:
                     self.ragged_chunks += n_chunks
                     self._step_budget_used = float(
                         live + chunk_toks / max(1, k))
+                    self.host_dispatches += 1
                     dispatched = _InFlightChunk(
                         tokens_dev=tokens_dev, snapshot=list(self.slots),
                         dispatched_at=time.monotonic(),
@@ -856,13 +915,28 @@ class Scheduler:
                         self._emit(req, first, info)
                         await self._flush_releases(loop)
             elif live:
-                tokens_dev, self.state = await loop.run_in_executor(
-                    self._exec, self.runner.decode_steps_device,
-                    self.state, k)  # [K,B] on device
+                done_dev = None
+                if use_mega:
+                    # K full steps in ONE device program, sampling +
+                    # done-flags on device; the host reads the packed
+                    # [K, B] block back in a single transfer at retire.
+                    import functools
+
+                    eos_ids, budgets = self._mega_limits()
+                    tokens_dev, done_dev, self.state = (
+                        await loop.run_in_executor(
+                            self._exec, functools.partial(
+                                self.runner.decode_megastep, self.state,
+                                k, eos_ids=eos_ids, budgets=budgets)))
+                else:
+                    tokens_dev, self.state = await loop.run_in_executor(
+                        self._exec, self.runner.decode_steps_device,
+                        self.state, k)  # [K,B] on device
                 self._step_budget_used = float(live)
+                self.host_dispatches += 1
                 dispatched = _InFlightChunk(
                     tokens_dev=tokens_dev, snapshot=list(self.slots),
-                    dispatched_at=time.monotonic())
+                    dispatched_at=time.monotonic(), done_dev=done_dev)
 
         # Advance an in-progress LEGACY chunked admission by ONE prefill
         # chunk (ragged jobs already advanced inside the dispatch above).
@@ -1019,8 +1093,12 @@ class Scheduler:
         if self._inflight is None:
             return
         fl, self._inflight = self._inflight, None
-        tokens = await loop.run_in_executor(
-            self._exec, np.asarray, fl.tokens_dev)  # [K,B] host
+        # ONE host transfer per flight: tokens and (megastep) done-flags
+        # come back together — device_get over the pair is the whole
+        # readback, there is no per-step host sync anywhere in the loop.
+        tokens, done = await loop.run_in_executor(
+            self._exec, jax.device_get, (fl.tokens_dev, fl.done_dev))
+        tokens = np.asarray(tokens)  # [K,B] (or packed [K,2+J,B]) host
         now = time.monotonic()
         dt = max(now - max(self._last_retire_at, fl.dispatched_at), 1e-6)
         self._last_retire_at = now
@@ -1036,8 +1114,18 @@ class Scheduler:
         live = sum(1 for s in fl.snapshot if isinstance(s, _SlotInfo))
         steps = tokens.shape[0]
         batch = tokens.shape[-1]
-        ENGINE_TELEMETRY.padding_inc(useful=live * steps,
-                                     waste=max(0, batch - live) * steps)
+        steps_run = steps
+        if done is not None:
+            # Megastep early exit: once every live slot fired its
+            # done-flag the scan's remaining iterations took the idle
+            # branch — count only the steps that computed.
+            d = np.asarray(done)
+            live_cols = np.array([isinstance(s, _SlotInfo)
+                                  for s in fl.snapshot], bool)
+            if live_cols.any() and d[:, live_cols].any(axis=0).all():
+                steps_run = int(d[:, live_cols].argmax(axis=0).max()) + 1
+        ENGINE_TELEMETRY.padding_inc(useful=live * steps_run,
+                                     waste=max(0, batch - live) * steps_run)
         emitted = 0
         chunk_acc = 0  # draft tokens accepted in this chunk (live slots)
         chunk_off = 0  # draft tokens offered in this chunk (live slots)
@@ -1106,6 +1194,7 @@ class Scheduler:
                 self._spec_probing = True
                 self.spec_probes += 1
                 self.runner.set_draft_len(1)
+        self._tokens_per_dispatch = float(emitted)
         await self._flush_releases(loop)
         if emitted == 0:
             # Pure-overshoot chunk (dispatched before its slots' EOS was

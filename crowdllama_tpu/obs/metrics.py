@@ -334,7 +334,11 @@ class NodeMetrics:
 
 
 def engine_gauge_lines(gauges: dict) -> list[str]:
-    """Render Engine.obs_gauges() as crowdllama_engine_* gauges."""
+    """Render Engine.obs_gauges() as crowdllama_engine_* series.
+
+    Keys are gauges except ``*_total``, which declare as counters (the
+    Prometheus suffix convention — e.g. host_dispatches_total counts
+    device programs launched and only ever grows)."""
     out: list[str] = []
     for key in sorted(gauges):
         try:
@@ -342,7 +346,8 @@ def engine_gauge_lines(gauges: dict) -> list[str]:
         except (TypeError, ValueError):
             continue
         name = f"crowdllama_engine_{key}"
-        out.append(f"# TYPE {name} gauge")
+        kind = "counter" if key.endswith("_total") else "gauge"
+        out.append(f"# TYPE {name} {kind}")
         out.append(f"{name} {_fmt(val)}")
     return out
 

@@ -1,0 +1,99 @@
+"""Kernel-looped decode megastep: K full decode steps per host dispatch.
+
+Per-step decode pays two synchronization taxes that Kernel Looping
+(PAPERS, arXiv 2410.23668) identifies as pure overhead: an XLA dispatch
+chain per layer stack per token, and a host round-trip per decode flight
+to read the sampled token back.  The megastep keeps the whole hot loop
+on device: ONE jitted program scans the layer stack (the runners'
+``lax.scan`` over stacked layer params — weights staged per scan
+iteration, fused RMSNorm/RoPE/paged-attention/MLP via the existing
+Mosaic kernels in :mod:`crowdllama_tpu.ops.pallas.paged` and
+:mod:`.flash`) and then scans THAT step body ``K`` times, sampling each
+token on device and feeding it straight back as the next step's input.
+The host sees a packed ``[K, B]`` token block plus per-slot done-flags
+in a single transfer every K steps.
+
+This module is the loop *harness*, not a new hand-written kernel: the
+per-step compute is the runner's existing fused step closure (which
+already lowers to the Pallas paged/flash kernels on TPU and to the
+pure-JAX reference path under ``JAX_PLATFORMS=cpu``), so the megastep
+inherits both paths for free and stays tier-1-testable on CPU.
+
+Byte-identity contract (vs. the per-step path):
+
+- The step body runs UNCHANGED for every scan iteration — no per-slot
+  freezing.  Slots that hit EOS mid-block keep stepping hot exactly as
+  the legacy chunked path does; the host discards their overshoot
+  tokens by snapshot identity, so the math (and every PRNG key split)
+  is bit-identical.
+- The only divergence is the whole-batch early exit: once EVERY live
+  slot has fired its done-flag, the device loop exits (state untouched
+  past that point, keys unsplit, untaken rows zero).  That skips
+  state evolution only for slots the host is about to release, and
+  ``insert`` re-seeds every slot-local field (keys, recent ring,
+  seq_lens, tokens, sampling params; stale KV is masked by lens), so
+  the divergence is invisible to all future streams.
+
+Done-flags are advisory acceleration for the host (and the early-exit
+trigger on device); the scheduler's ``_emit`` bookkeeping remains the
+authority on retirement, which is what makes byte-identity checkable.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# Budget sentinel for "no limit" (host always sends real remaining
+# budgets; runners default to this when called directly).
+NO_BUDGET = 1 << 30
+
+
+def run_decode_megastep(step_fn, state, eos_ids, budgets, num_steps):
+    """Run ``num_steps`` decode steps of ``step_fn`` in one scan.
+
+    ``step_fn(state, None) -> (new_state, tokens[B])`` is a runner's
+    per-step closure (the exact body its per-step ``lax.scan`` uses).
+    ``state`` must expose ``.active`` ([B] bool) and ``.tokens`` ([B]
+    int) — true of both ``DecodeState`` and the paged state.
+
+    ``eos_ids`` ([B] int32, -1 disables) and ``budgets`` ([B] int32,
+    remaining tokens the host still wants) drive the per-slot
+    done-flags: ``done_now = (tok == eos) | (emitted >= budget)``, fired
+    once per slot (``alive & done_now``).  When no slot is alive the
+    loop exits; untaken rows of the output block stay zero.
+
+    The loop is a ``lax.while_loop`` writing rows into pre-allocated
+    ``[K, B]`` buffers, not a scanned ``lax.cond``: XLA:CPU lowers a
+    conditional by materializing the carry (the whole KV pool) into
+    each branch, which costs more per step than the dispatch the
+    megastep saves, while the while-loop carry aliases its buffers.
+
+    Returns ``(tokens [K, B], done [K, B] bool, new_state)``.
+    """
+    eos_ids = jnp.asarray(eos_ids, jnp.int32)
+    budgets = jnp.asarray(budgets, jnp.int32)
+    alive0 = state.active & (budgets > 0)
+    token_dtype = state.tokens.dtype
+    b = eos_ids.shape[0]
+
+    def cond(carry):
+        _, alive, _, i, _, _ = carry
+        return (i < num_steps) & alive.any()
+
+    def body(carry):
+        st, alive, emitted, i, toks_buf, done_buf = carry
+        new_st, toks = step_fn(st, None)
+        emitted = emitted + 1
+        done_now = (toks.astype(jnp.int32) == eos_ids) | (emitted >= budgets)
+        fired = alive & done_now
+        toks_buf = jax.lax.dynamic_update_index_in_dim(toks_buf, toks, i, 0)
+        done_buf = jax.lax.dynamic_update_index_in_dim(done_buf, fired, i, 0)
+        return (new_st, alive & ~done_now, emitted, i + 1,
+                toks_buf, done_buf)
+
+    init = (state, alive0, jnp.zeros((b,), jnp.int32), jnp.int32(0),
+            jnp.zeros((num_steps, b), token_dtype),
+            jnp.zeros((num_steps, b), bool))
+    new_state, _, _, _, tokens, done = jax.lax.while_loop(cond, body, init)
+    return tokens, done, new_state

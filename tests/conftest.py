@@ -18,6 +18,19 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# Persistent XLA compilation cache: the suite compiles the same tiny-model
+# programs over and over across runner instances and test files, and on a
+# one-core box that compile time dominates tier-1 wall clock.  Entries are
+# keyed by content hash of the lowered program + compile options, so a hit
+# returns the identical executable — byte-identity tests see the same
+# numerics either way.  (Compile-telemetry tests count jit-entry claims,
+# not XLA work, so they are unaffected by hits.)
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.environ.get("CROWDLLAMA_TPU_JAX_CACHE_DIR",
+                   "/tmp/crowdllama-jax-cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 # Compressed intervals everywhere, mirroring CROWDLLAMA_TEST_MODE=1
 # (/root/reference/pkg/peer/peer.go:159-175).
 os.environ.setdefault("CROWDLLAMA_TPU_TEST_MODE", "1")

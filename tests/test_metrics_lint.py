@@ -242,8 +242,15 @@ async def test_gateway_and_worker_metrics_lint():
                       # Unified ragged batch (docs/RAGGED_BATCH.md):
                       # chunked-prefill occupancy + per-step token load,
                       # present on every engine kind (zero on FakeEngine).
-                      "prefill_chunk_slots", "step_token_budget_used"):
+                      "prefill_chunk_slots", "step_token_budget_used",
+                      # Megastep dispatch accounting (docs/MEGASTEP.md):
+                      # amortization visible per worker even at K=0.
+                      "tokens_per_dispatch"):
                 assert types.get(f"crowdllama_engine_{g}") == "gauge"
+            # host_dispatches_total is monotone — it must render as a
+            # counter (the `_total` suffix drives the TYPE line).
+            assert types.get(
+                "crowdllama_engine_host_dispatches_total") == "counter"
             # Per-chunk prefill latency inside the unified dispatch rides
             # the engine-telemetry plane onto both surfaces.
             assert types.get(
@@ -335,11 +342,43 @@ def test_ragged_gauges_lint():
     sched._admitting = 0
     sched._chunking = None
     sched._step_budget_used = 3.5
+    sched.host_dispatches = 0
+    sched._tokens_per_dispatch = 0.0
     types = _lint("\n".join(engine_gauge_lines(sched.telemetry_gauges())))
     for g in ("prefill_chunk_slots", "step_token_budget_used"):
         assert types.get(f"crowdllama_engine_{g}") == "gauge", g
     types = _lint("\n".join(ENGINE_TELEMETRY.expose()))
     assert types.get("crowdllama_prefill_chunk_seconds") == "histogram"
+
+
+def test_megastep_gauges_lint():
+    """The megastep dispatch-accounting pair (scheduler.telemetry_gauges)
+    renders lint-clean: host_dispatches_total as a counter (monotone,
+    `_total`-suffixed), tokens_per_dispatch as a gauge."""
+    import asyncio
+
+    from crowdllama_tpu.engine.scheduler import Scheduler
+    from crowdllama_tpu.obs.metrics import engine_gauge_lines
+
+    class _Runner:  # gauge rendering needs no device work
+        max_slots = 2
+        max_seq = 128
+
+    sched = Scheduler.__new__(Scheduler)
+    sched.runner = _Runner()
+    sched.slots = [None, None]
+    sched.pending = asyncio.Queue()
+    sched._deferred = []
+    sched._admitting = 0
+    sched._chunking = None
+    sched._step_budget_used = 0.0
+    sched.host_dispatches = 17
+    sched._tokens_per_dispatch = 6.0
+    types = _lint("\n".join(engine_gauge_lines(sched.telemetry_gauges())))
+    assert types.get(
+        "crowdllama_engine_host_dispatches_total") == "counter"
+    assert types.get(
+        "crowdllama_engine_tokens_per_dispatch") == "gauge"
 
 
 def test_multi_engine_fans_out_obs_to_children():

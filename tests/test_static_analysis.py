@@ -263,6 +263,60 @@ def test_use_after_donate_seeded_and_rebind_negative(tmp_path):
     assert "pool" in findings[0].message
 
 
+_LOOP_SYNC_FIXTURE = """
+    import jax
+    import numpy as np
+
+
+    class Sched:
+        async def loop_bad(self, state):
+            while True:
+                tokens_dev, state = self.runner.decode_steps_device(state, 8)
+                tokens = np.asarray(tokens_dev)
+                last = tokens[-1, 0].item()
+
+        async def loop_bad_executor(self, loop, state):
+            for _ in range(4):
+                tokens_dev, state = await loop.run_in_executor(
+                    self._exec, self.runner.decode_steps_device, state, 8)
+                tokens = await loop.run_in_executor(
+                    self._exec, np.asarray, tokens_dev)
+
+        async def loop_ok(self, loop, state):
+            while True:
+                tokens_dev, done_dev, state = self.runner.decode_megastep(
+                    state, 8)
+                tokens, done = await loop.run_in_executor(
+                    self._exec, jax.device_get, (tokens_dev, done_dev))
+
+        def retire_ok(self, fl):
+            tokens = np.asarray(fl.tokens_dev)
+            for step in range(tokens.shape[0]):
+                self.emit(int(tokens[step, 0]))
+"""
+
+
+def test_host_sync_in_decode_loop_seeded(tmp_path):
+    root = _fake_repo(tmp_path,
+                      {"crowdllama_tpu/engine/fx.py": _LOOP_SYNC_FIXTURE})
+    hits = {(f.code, f.symbol) for f in check_jax_purity(root, ("engine",))}
+    # Direct per-step readback AND the executor-wrapped form (np.asarray
+    # handed to run_in_executor) are both the seeded bug class.
+    assert ("host-sync-in-decode-loop", "loop_bad") in hits
+    assert ("host-sync-in-decode-loop", "loop_bad_executor") in hits
+
+
+def test_host_sync_in_decode_loop_true_negatives(tmp_path):
+    root = _fake_repo(tmp_path,
+                      {"crowdllama_tpu/engine/fx.py": _LOOP_SYNC_FIXTURE})
+    loop_hits = {f.symbol for f in check_jax_purity(root, ("engine",))
+                 if f.code == "host-sync-in-decode-loop"}
+    # The sanctioned megastep pattern (one jax.device_get of the packed
+    # block per flight) and a dispatch-free emit loop stay clean.
+    assert "loop_ok" not in loop_hits
+    assert "retire_ok" not in loop_hits
+
+
 # ----------------------------------------------------- contract seeds
 
 
