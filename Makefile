@@ -5,7 +5,7 @@ PY ?= python
 PYTEST = env JAX_PLATFORMS=cpu $(PY) -m pytest -p no:cacheprovider
 
 .PHONY: test tier1 lint chaos chaos-multi-gateway distill-smoke bench-kv \
-	bench-mixed bench-megastep trace-demo
+	bench-mixed bench-megastep trace-demo obs-demo
 
 # Full suite (slow soaks included).  Runs lint + the chaos matrix FIRST:
 # swarmlint finishes in seconds and the fault-injection scenarios are the
@@ -53,6 +53,12 @@ distill-smoke:
 # trace as a waterfall — gateway, relay hop, and worker on one timeline.
 trace-demo:
 	env JAX_PLATFORMS=cpu PYTHONPATH=. $(PY) examples/trace_demo.py
+
+# Swarm-observatory demo (docs/OBSERVABILITY.md): boots a loopback
+# 2-worker swarm in process, pushes a few requests, and prints the
+# `crowdllama-tpu top` table plus a /metrics/cluster excerpt.
+obs-demo:
+	env JAX_PLATFORMS=cpu PYTHONPATH=. $(PY) examples/obs_demo.py
 
 # KV-shipping benchmark (docs/KV_TRANSFER.md): fetch-vs-recompute TTFT
 # over real p2p streams with an injected-RTT sweep; writes the artifact

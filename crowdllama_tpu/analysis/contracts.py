@@ -46,7 +46,7 @@ CHECKER = "contracts"
 # which is exactly the forcing function we want.
 RESPONSE_ARMS = frozenset({
     "generate_response", "embed_response", "kv_pages", "migrate_frame",
-    "trace_spans",
+    "trace_spans", "metrics_snapshot",
 })
 
 # Configuration fields intentionally without a CROWDLLAMA_TPU_* env read.

@@ -19,6 +19,7 @@ import argparse
 import asyncio
 import logging
 import os
+import re
 import signal
 import sys
 
@@ -59,6 +60,12 @@ def build_parser() -> argparse.ArgumentParser:
     trace.add_argument("trace_id", help="trace id (from a response header, "
                                         "exemplar, or /debug/flightrecorder)")
     trace.add_argument("--gateway", default="http://127.0.0.1:9001")
+    top = sub.add_parser(
+        "top", help="live per-worker swarm table from a gateway's "
+                    "/metrics/cluster scrape")
+    top.add_argument("--gateway", default="http://127.0.0.1:9001")
+    top.add_argument("--watch", type=float, default=0.0, metavar="SECONDS",
+                     help="refresh every N seconds (default: one shot)")
     run = sub.add_parser(
         "run", help="chat with a model through a gateway (ollama-run style)")
     run.add_argument("model", help="model name (see /api/tags)")
@@ -138,6 +145,8 @@ def main(argv: list[str] | None = None) -> int:
         return asyncio.run(_network_status(args.gateway))
     if args.command == "trace":
         return asyncio.run(_trace(args))
+    if args.command == "top":
+        return asyncio.run(_top(args))
     if args.command == "run":
         try:
             return asyncio.run(_run_chat(args))
@@ -435,6 +444,119 @@ async def _trace(args) -> int:
     return 0
 
 
+def _parse_exposition(text: str) -> list[tuple[str, dict, float]]:
+    """Prometheus text → [(family, labels, value)] — just enough parsing
+    for the ``top`` table; TYPE/HELP/exemplar noise is skipped."""
+    out: list[tuple[str, dict, float]] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = re.match(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{([^}]*)\})? (\S+)",
+                     line)
+        if m is None:
+            continue
+        name, _, inner, value = m.groups()
+        labels: dict = {}
+        for part in (inner or "").split(","):
+            if "=" in part:
+                k, _, v = part.partition("=")
+                labels[k.strip()] = v.strip().strip('"')
+        try:
+            out.append((name, labels, float(value)))
+        except ValueError:
+            continue
+    return out
+
+
+def render_top(text: str) -> str:
+    """``/metrics/cluster`` exposition → the per-worker table.
+
+    Joins the gateway's routing view (``crowdllama_worker_*``, keyed by
+    ``peer``) with each worker's scraped engine gauges (keyed by
+    ``worker`` — same 16-char peer-id head)."""
+    samples = _parse_exposition(text)
+    rows: dict[str, dict] = {}
+    rollups: dict[str, float] = {}
+    for name, labels, value in samples:
+        if name.startswith("crowdllama_cluster_"):
+            rollups[name[len("crowdllama_cluster_"):]] = value
+            continue
+        wid = labels.get("peer") or labels.get("worker")
+        if not wid:
+            continue
+        row = rows.setdefault(wid, {})
+        if name == "crowdllama_worker_throughput_tokens_per_sec":
+            row["tok/s"] = value
+        elif name == "crowdllama_worker_load":
+            row["load"] = value
+        elif name == "crowdllama_worker_healthy":
+            row["ok"] = value
+        elif name == "crowdllama_engine_batch_occupancy":
+            row["occ"] = value
+        elif name == "crowdllama_engine_kv_cache_utilization":
+            row["kv"] = value
+        elif name == "crowdllama_engine_pending_depth":
+            row["pend"] = value
+        elif name == "crowdllama_engine_active_slots":
+            row["act"] = value
+        elif name == "crowdllama_engine_duty_cycle":
+            # highest-duty dispatch class is the one that matters
+            row["duty"] = max(row.get("duty", 0.0), value)
+    lines = [
+        f"workers {rollups.get('workers_total', 0):g} "
+        f"(scraped {rollups.get('workers_scraped', 0):g})   "
+        f"tok/s {rollups.get('tokens_per_second', 0):g}   "
+        f"occupancy {rollups.get('batch_occupancy', 0):.2f}   "
+        f"kv {rollups.get('kv_cache_utilization', 0):.2f}   "
+        f"inflight {rollups.get('inflight', 0):g}",
+        f"{'WORKER':<18}{'OK':>3}{'LOAD':>7}{'TOK/S':>8}{'ACT':>5}"
+        f"{'PEND':>6}{'OCC':>6}{'KV':>6}{'DUTY':>6}",
+    ]
+    for wid in sorted(rows):
+        r = rows[wid]
+        lines.append(
+            f"{wid:<18}{'y' if r.get('ok', 0) else 'n':>3}"
+            f"{r.get('load', 0.0):>7.2f}{r.get('tok/s', 0.0):>8.1f}"
+            f"{r.get('act', 0.0):>5.0f}{r.get('pend', 0.0):>6.0f}"
+            f"{r.get('occ', 0.0):>6.2f}{r.get('kv', 0.0):>6.2f}"
+            f"{r.get('duty', 0.0):>6.2f}")
+    if not rows:
+        lines.append("(no workers visible)")
+    return "\n".join(lines)
+
+
+async def _top(args) -> int:
+    """``top`` — the swarm observatory table (docs/OBSERVABILITY.md).
+
+    One GET /metrics/cluster per refresh; ``--watch N`` loops until ^C."""
+    import aiohttp
+
+    url = f"{args.gateway}/metrics/cluster"
+    while True:
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.get(
+                        url,
+                        timeout=aiohttp.ClientTimeout(total=30)) as resp:
+                    text = await resp.text()
+                    if resp.status != 200:
+                        print(f"error: HTTP {resp.status}", file=sys.stderr)
+                        return 1
+        except Exception as e:
+            print(f"gateway unreachable: {e}", file=sys.stderr)
+            return 1
+        if args.watch > 0:
+            print("\x1b[2J\x1b[H", end="")  # clear screen between frames
+        print(render_top(text))
+        if args.watch <= 0:
+            return 0
+        try:
+            await asyncio.sleep(args.watch)
+        except (KeyboardInterrupt, asyncio.CancelledError):
+            return 0
+
+
 async def _run_chat(args) -> int:
     """``run <model>`` — the ollama-run-style chat client.
 
@@ -612,7 +734,10 @@ async def run_node(cfg: Configuration, worker_mode: bool) -> None:
                           gossip=gossip, tenant_quotas=quotas,
                           flight_recorder=cfg.flight_recorder,
                           trace_ttl=cfg.trace_ttl,
-                          metrics_exemplars=cfg.metrics_exemplars)
+                          metrics_exemplars=cfg.metrics_exemplars,
+                          slo_ttft_ms=cfg.slo_ttft_ms,
+                          slo_decode_ms=cfg.slo_decode_ms,
+                          profile_dir=cfg.profile_dir)
         if gossip is not None:
             gossip.metrics = gateway.obs.metrics
             await gossip.start()

@@ -222,6 +222,11 @@ class Configuration:
     # Attach OpenMetrics exemplars (`# {trace_id="..."} <v>`) to latency
     # histogram bucket lines so a tail bucket links straight to a trace.
     metrics_exemplars: bool = False
+    # SLO burn-rate plane (obs/slo.py): gateway latency objectives in
+    # milliseconds — TTFT (admission to first token frame) and per
+    # decode-step gap.  0 disables the tracker and its gauges.
+    slo_ttft_ms: float = 0.0
+    slo_decode_ms: float = 0.0
 
     # Multi-worker sharded serving (BASELINE configs 4-5): a node with
     # shard_count > 1 serves one shard of an N-way split; shard_group names
@@ -356,6 +361,10 @@ class Configuration:
         if env.get("CROWDLLAMA_TPU_METRICS_EXEMPLARS"):
             cfg.metrics_exemplars = (
                 env["CROWDLLAMA_TPU_METRICS_EXEMPLARS"] in ("1", "true"))
+        cfg.slo_ttft_ms = float(env.get(
+            "CROWDLLAMA_TPU_SLO_TTFT_MS", cfg.slo_ttft_ms))
+        cfg.slo_decode_ms = float(env.get(
+            "CROWDLLAMA_TPU_SLO_DECODE_MS", cfg.slo_decode_ms))
         if env.get("CROWDLLAMA_TPU_WARMUP"):
             cfg.warmup = env["CROWDLLAMA_TPU_WARMUP"] in ("1", "true")
         for k, v in overrides.items():
@@ -421,6 +430,12 @@ class Configuration:
         if cfg.trace_ttl < 0:
             raise ValueError(f"trace_ttl must be >= 0, "
                              f"got {cfg.trace_ttl}")
+        if cfg.slo_ttft_ms < 0:
+            raise ValueError(f"slo_ttft_ms must be >= 0, "
+                             f"got {cfg.slo_ttft_ms}")
+        if cfg.slo_decode_ms < 0:
+            raise ValueError(f"slo_decode_ms must be >= 0, "
+                             f"got {cfg.slo_decode_ms}")
         cfg.relay_mode = (cfg.relay_mode or "auto").strip().lower()
         if cfg.relay_mode not in ("auto", "always", "off"):
             raise ValueError(f"unknown relay_mode {cfg.relay_mode!r} "
@@ -563,6 +578,13 @@ class Configuration:
                             action="store_const", const=True, default=None,
                             help="attach trace_id exemplars to latency "
                                  "histogram buckets on /metrics")
+        parser.add_argument("--slo-ttft-ms", dest="slo_ttft_ms", type=float,
+                            help="TTFT objective in ms for the SLO "
+                                 "burn-rate plane (0 = disabled)")
+        parser.add_argument("--slo-decode-ms", dest="slo_decode_ms",
+                            type=float,
+                            help="per decode-step objective in ms for the "
+                                 "SLO burn-rate plane (0 = disabled)")
         parser.add_argument("--request-timeout", dest="request_timeout",
                             type=float,
                             help="per-request wall-clock budget in seconds, "
@@ -628,6 +650,7 @@ class Configuration:
                 "step_token_budget", "ragged_prefill", "megastep_k",
                 "profile_dir", "trace_buffer", "worker_metrics_port",
                 "flight_recorder", "trace_ttl", "metrics_exemplars",
+                "slo_ttft_ms", "slo_decode_ms",
                 "request_timeout", "admission_max_inflight",
                 "admission_pending_max", "retry_after_s",
                 "kv_ship", "kv_ship_min_tokens", "kv_ship_timeout",

@@ -227,6 +227,35 @@ def extract_trace_spans(msg: pb.BaseMessage) -> pb.TraceSpans:
     return msg.trace_spans
 
 
+def metrics_fetch_msg(families: Iterable[str] = ()) -> pb.BaseMessage:
+    """Gateway → worker: "send me your metric exposition" (optionally
+    restricted to families with one of the given name prefixes)."""
+    mf = pb.MetricsFetch()
+    mf.families.extend(str(f) for f in families)
+    return pb.BaseMessage(metrics_fetch=mf)
+
+
+def extract_metrics_fetch(msg: pb.BaseMessage) -> pb.MetricsFetch:
+    if msg.WhichOneof("message") != "metrics_fetch":
+        raise ValueError("message does not contain a MetricsFetch")
+    return msg.metrics_fetch
+
+
+def metrics_snapshot_msg(node: str = "", payload: bytes = b"",
+                         found: bool = False,
+                         error: str = "") -> pb.BaseMessage:
+    """Worker → gateway: one scrape (payload = the node's own Prometheus
+    exposition text, the same bytes its /metrics endpoint serves)."""
+    return pb.BaseMessage(metrics_snapshot=pb.MetricsSnapshot(
+        node=node, payload=bytes(payload), found=bool(found), error=error))
+
+
+def extract_metrics_snapshot(msg: pb.BaseMessage) -> pb.MetricsSnapshot:
+    if msg.WhichOneof("message") != "metrics_snapshot":
+        raise ValueError("message does not contain a MetricsSnapshot")
+    return msg.metrics_snapshot
+
+
 def flatten_chat(messages: Iterable[Mapping[str, str]]) -> str:
     """Flatten Ollama-style chat messages into a single prompt string.
 

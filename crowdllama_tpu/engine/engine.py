@@ -112,10 +112,16 @@ class Engine:
         every worker (FakeEngine included, at zero) — an absent series
         breaks absent()-style alerts across engine kinds.
         """
-        return {"pending_depth": 0.0, "active_slots": 0.0,
-                "batch_occupancy": 0.0, "kv_cache_utilization": 0.0,
-                "prefill_chunk_slots": 0.0, "step_token_budget_used": 0.0,
-                "host_dispatches_total": 0.0, "tokens_per_dispatch": 0.0}
+        g = {"pending_depth": 0.0, "active_slots": 0.0,
+             "batch_occupancy": 0.0, "kv_cache_utilization": 0.0,
+             "prefill_chunk_slots": 0.0, "step_token_budget_used": 0.0,
+             "host_dispatches_total": 0.0, "tokens_per_dispatch": 0.0}
+        # Duty-cycle gauges (PR 13): labeled children, one per dispatch
+        # class, zero on engines without a scheduler for the same
+        # absent()-alert reason.
+        for cls in ("plain", "megastep", "ragged", "spec"):
+            g[f"duty_cycle|dispatch={cls}"] = 0.0
+        return g
 
     async def drain(self, timeout: float = 30.0) -> bool:
         """Finish in-flight work before shutdown; True when drained."""

@@ -138,7 +138,9 @@ class MultiEngine(Engine):
         out: dict = {}
         for eng in self._engines.values():
             for k, v in eng.obs_gauges().items():
-                if k in self._GAUGE_MAX:
+                # duty_cycle|dispatch=... is a ratio, not a depth: max,
+                # like the other point-in-time gauges.
+                if k in self._GAUGE_MAX or k.startswith("duty_cycle"):
                     out[k] = max(out.get(k, 0.0), v)
                 else:
                     out[k] = out.get(k, 0.0) + v

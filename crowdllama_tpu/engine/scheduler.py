@@ -224,6 +224,12 @@ class Scheduler:
         # actually emitted.
         self.host_dispatches = 0
         self._tokens_per_dispatch = 0.0
+        # Duty-cycle profiler (PR 13, docs/OBSERVABILITY.md): per dispatch
+        # class, an EWMA of device-window / (device-window + host-gap) —
+        # both sides measured from host timestamps already on the retire
+        # path (no new device syncs).  ~1.0 = the device never waits on
+        # the host between flights (the megastep's whole point).
+        self._duty: dict[str, float] = {}
         self.ragged_chunks = 0  # prefill chunks dispatched unified
         # Chaos hook: the "scheduler.ragged_chunk" fault site's "drain"
         # action calls this to start a graceful drain mid-chunked-prefill
@@ -438,6 +444,12 @@ class Scheduler:
         # emitted — together they show what megastep K is buying.
         g["host_dispatches_total"] = float(self.host_dispatches)
         g["tokens_per_dispatch"] = float(self._tokens_per_dispatch)
+        # Duty cycle per dispatch class (PR 13): always present (zeros
+        # for classes this engine never dispatched) so dashboards can
+        # compare megastep (high duty) vs per-step (low duty) directly.
+        duty = getattr(self, "_duty", {})
+        for cls in ("plain", "megastep", "ragged", "spec"):
+            g[f"duty_cycle|dispatch={cls}"] = float(duty.get(cls, 0.0))
         if hasattr(r, "draft_len"):
             # Speculation acceptance on BOTH /metrics surfaces (gateway
             # aggregates worker gauges): emitted/steps is the live
@@ -1101,6 +1113,22 @@ class Scheduler:
         tokens = np.asarray(tokens)  # [K,B] (or packed [K,2+J,B]) host
         now = time.monotonic()
         dt = max(now - max(self._last_retire_at, fl.dispatched_at), 1e-6)
+        # Duty-cycle accounting (PR 13): the host gap is the stretch after
+        # the previous flight retired with NOTHING queued on the device —
+        # admission, emit, asyncio overhead.  When dispatch N happened
+        # before retire N-1 finished (the pipelined steady state) the gap
+        # is zero by construction; dt is the remaining wall time
+        # attributed to waiting on this flight.  Host timestamps only —
+        # the device_get above is the one sync this loop already pays.
+        gap = (max(0.0, fl.dispatched_at - self._last_retire_at)
+               if self._last_retire_at else 0.0)
+        cls = ("megastep" if fl.done_dev is not None
+               else "ragged" if fl.ragged_steps
+               else "spec" if tokens.ndim == 3 else "plain")
+        ENGINE_TELEMETRY.host_gap_seconds.labels(cls).observe(gap)
+        duty = dt / max(dt + gap, 1e-9)
+        prev = self._duty.get(cls)
+        self._duty[cls] = duty if prev is None else 0.9 * prev + 0.1 * duty
         self._last_retire_at = now
         if fl.ragged_steps:
             # Per-chunk prefill latency inside the unified dispatch (the

@@ -121,7 +121,9 @@ class Gateway:
                  admission_max_inflight: int = 0,
                  retry_after_s: float = 1.0, kv_ship: bool = False,
                  gossip=None, tenant_quotas=None, flight_recorder: int = 32,
-                 trace_ttl: float = 0.0, metrics_exemplars: bool = False):
+                 trace_ttl: float = 0.0, metrics_exemplars: bool = False,
+                 slo_ttft_ms: float = 0.0, slo_decode_ms: float = 0.0,
+                 profile_dir: str = ""):
         self.peer = peer
         self.port = port
         self.host = host
@@ -173,6 +175,13 @@ class Gateway:
                                 self.handle_trace_stitched)
         self.app.router.add_get("/debug/flightrecorder",
                                 self.handle_flightrecorder)
+        # Swarm observatory (PR 13, docs/OBSERVABILITY.md): cluster-wide
+        # metric fan-in over the p2p plane, and an on-demand jax.profiler
+        # trace window.  Both are operator surfaces hit per request, never
+        # on the inference hot path.
+        self.app.router.add_get("/metrics/cluster",
+                                self.handle_metrics_cluster)
+        self.app.router.add_get("/debug/profile", self.handle_profile)
         for route in ("/api/delete", "/api/create", "/api/copy", "/api/push"):
             self.app.router.add_route("*", route, self.handle_unsupported)
         # Prometheus-style counters fed by the logging middleware
@@ -219,6 +228,17 @@ class Gateway:
         # ring only keeps the newest N complete traces anyway.
         self._flight_inflight = 0
         self._flight_max_inflight = 4
+        # Swarm observatory (PR 13): the /metrics/cluster scraper, the SLO
+        # burn-rate engine (objectives in ms; 0 = disabled), and the
+        # /debug/profile artifact dir ("" = endpoint answers 501).
+        from crowdllama_tpu.obs.cluster import ClusterScraper
+        from crowdllama_tpu.obs.slo import SloEngine
+
+        self.cluster = ClusterScraper(peer)
+        self.slo = SloEngine(ttft_ms=float(slo_ttft_ms),
+                             decode_ms=float(slo_decode_ms))
+        self.profile_dir = str(profile_dir or "")
+        self._profiling = False  # /debug/profile single-flight latch
         # Inference-stream pool: a request to a worker reuses an idle
         # encrypted stream instead of paying TCP connect + signed-hello
         # handshake (Ed25519 sign/verify + X25519) per request — the
@@ -397,6 +417,15 @@ class Gateway:
                        message: str) -> web.Response:
         """503 + Retry-After: the uniform load-shedding response."""
         self._robust["shed"] += 1
+        # Flight-recorder shed capture (PR 13): shedding happens before a
+        # trace id is minted, so mint one here — the recorded trace is a
+        # single gateway-side "shed" span, enough to see WHEN and WHY the
+        # gateway refused (the message carries cap/quota context).
+        tid = new_trace_id()
+        self.obs.trace.record(tid, "shed", 0, parent=GATEWAY_ROOT_SPAN,
+                              detail=message[:120], model=model)
+        self.obs.trace.finish(tid, 1, status=503)
+        self._flight_capture(tid, ["shed"])
         headers = self._shed_headers()
         if shape.startswith("openai"):
             return self._openai_error(message, 503, "server_error",
@@ -980,8 +1009,64 @@ class Gateway:
         lines.extend(ENGINE_TELEMETRY.expose())
         lines.extend(device_memory_lines())
         lines.extend(host_stat_lines(self.peer.host))
+        # SLO burn-rate plane (PR 13): objective/burn-rate/fast-burn
+        # gauges — the series swarm/autoscale.py parse_gauges consumes.
+        lines.extend(self.slo.expose())
         return web.Response(text="\n".join(lines) + "\n",
                             content_type="text/plain")
+
+    async def handle_metrics_cluster(self,
+                                     request: web.Request) -> web.Response:
+        """GET /metrics/cluster — the swarm-wide exposition (PR 13).
+
+        Fans MetricsFetch out to every reachable worker over the
+        authenticated p2p plane and re-exports each worker's families
+        re-labeled with ``worker=``, plus pre-aggregated
+        ``crowdllama_cluster_*`` rollups.  A dead or wedged worker costs a
+        per-node timeout and one missing block — the snapshot is partial,
+        never a 500.  ``?family=prefix`` (repeatable) narrows the scrape."""
+        families = tuple(request.query.getall("family", []))
+        text = await self.cluster.render(families)
+        return web.Response(text=text, content_type="text/plain")
+
+    async def handle_profile(self, request: web.Request) -> web.Response:
+        """GET /debug/profile?seconds=N — capture a jax.profiler trace
+        window into the artifact dir and return its path (PR 13).
+
+        Gated on --profile-dir (501 when unset) and single-flight (409
+        while a capture is already running): profiler overhead is real,
+        an operator gets one window at a time."""
+        if not self.profile_dir:
+            return web.json_response(
+                {"error": "profiling disabled: start the gateway with "
+                          "--profile-dir to enable /debug/profile"},
+                status=501)
+        if self._profiling:
+            return web.json_response(
+                {"error": "a profile capture is already in flight"},
+                status=409)
+        try:
+            seconds = float(request.query.get("seconds", "3") or 3)
+        except ValueError:
+            seconds = 3.0
+        seconds = min(60.0, max(0.1, seconds))
+        path = os.path.join(
+            self.profile_dir, f"profile-{int(time.time())}")
+        self._profiling = True
+        try:
+            import jax
+
+            jax.profiler.start_trace(path)
+            try:
+                await asyncio.sleep(seconds)
+            finally:
+                jax.profiler.stop_trace()
+        except Exception as e:
+            return web.json_response(
+                {"error": f"profiler capture failed: {e}"}, status=500)
+        finally:
+            self._profiling = False
+        return web.json_response({"artifact": path, "seconds": seconds})
 
     async def handle_trace(self, request: web.Request) -> web.Response:
         """GET /debug/trace — JSON dump of the span ring buffer.
@@ -1678,6 +1763,17 @@ class Gateway:
             reasons.append("p99_latency")
         if status >= 500:
             reasons.append(f"status_{status}")
+        if status == 504:
+            # Budget exhaustion gets its own reason on top of status_504
+            # so the recorder ring is filterable by failure mode.
+            reasons.append("budget_exhausted")
+        if self.slo.enabled:
+            # Edge-triggered: only the request that TIPS the SLO into
+            # fast burn is captured, not every request inside an episode.
+            before = self.slo.fast_burn_episodes_total
+            if self.slo.fast_burn() \
+                    and self.slo.fast_burn_episodes_total > before:
+                reasons.append("slo_fast_burn")
         rec = self.obs.trace.get(tid)
         if rec is not None:
             names = {s.get("name", "") for s in rec.get("spans", [])}
@@ -1733,6 +1829,7 @@ class Gateway:
         self._ttfb_sum += dt
         self._ttfb_count += 1
         self.obs.metrics.ttft_seconds.observe(dt, exemplar=tid)
+        self.slo.observe_ttft(dt)
 
     async def _terminal_error_frame(self, ctx: _StreamCtx, shape: str,
                                     model: str,
@@ -1955,6 +2052,7 @@ class Gateway:
                 t_now = time.perf_counter_ns()
                 self.obs.metrics.decode_step_seconds.observe(
                     (t_now - t_prev) / 1e9, exemplar=msg.trace_id)
+                self.slo.observe_decode((t_now - t_prev) / 1e9)
                 t_prev = t_now
             if openai:
                 try:

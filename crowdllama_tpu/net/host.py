@@ -442,6 +442,12 @@ class Host:
             "handshake_ns": 0,
         }
         self.stats_by_protocol: dict[str, int] = {}
+        # Dial-ladder attempts by (rung, outcome) — rungs are the NAT
+        # traversal strategies in fallback order (direct, reverse, punch,
+        # splice).  Rendered as crowdllama_dial_ladder_attempts_total by
+        # obs/http.py; rate(fail)/rate(ok) per rung is the connectivity
+        # health an operator reads before blaming the model for latency.
+        self.dial_ladder: dict[tuple[str, str], int] = {}
         # DISTINCT inbound peers by address class (the TCP analog of the
         # reference's local/external connection classification,
         # dht.go:279-321).  Deduped by peer id — streams are per-RPC, so a
@@ -452,6 +458,10 @@ class Host:
     def stats_by_addr_class(self) -> dict[str, int]:
         """Distinct authenticated inbound peers per address class."""
         return {k: len(v) for k, v in self._peers_by_addr_class.items()}
+
+    def _ladder_inc(self, rung: str, outcome: str) -> None:
+        key = (rung, outcome)
+        self.dial_ladder[key] = self.dial_ladder.get(key, 0) + 1
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -582,19 +592,33 @@ class Host:
                     last_err = e
                     sock.close()
             if writer is None:
+                if protocol != RELAY_PROTOCOL:
+                    self._ladder_inc("direct", "fail")
                 raise last_err or asyncio.TimeoutError(
                     f"dial to {host}:{port} timed out")
         else:
-            reader, writer = await asyncio.wait_for(
-                asyncio.open_connection(host, port), timeout
-            )
+            try:
+                reader, writer = await asyncio.wait_for(
+                    asyncio.open_connection(host, port), timeout
+                )
+            except Exception:
+                # Ladder accounting: end-to-end peer dials only — the
+                # outer TCP hop to a relay is part of the splice rung.
+                if protocol != RELAY_PROTOCOL:
+                    self._ladder_inc("direct", "fail")
+                raise
         try:
-            return await self._client_handshake(
+            stream = await self._client_handshake(
                 reader, writer, protocol, expect_id, timeout,
                 contact=lambda rid: Contact(rid, host, port))
         except Exception:
             writer.close()
+            if protocol != RELAY_PROTOCOL:
+                self._ladder_inc("direct", "fail")
             raise
+        if protocol != RELAY_PROTOCOL:
+            self._ladder_inc("direct", "ok")
+        return stream
 
     async def _client_handshake(self, reader, writer, protocol: str,
                                 expect_id: str | None, timeout: float,
@@ -688,10 +712,12 @@ class Host:
                 stream = await self._new_stream_reversed(target, protocol,
                                                          timeout)
                 self._reverse_failed_at.pop(target.peer_id, None)
+                self._ladder_inc("reverse", "ok")
                 return stream
             except asyncio.CancelledError:
                 raise
             except Exception as e:
+                self._ladder_inc("reverse", "fail")
                 self._reverse_failed_at[target.peer_id] = time.monotonic()
                 log.debug("reverse connect to %s failed (%s); falling "
                           "back to relay splice for %ds",
@@ -707,16 +733,22 @@ class Host:
                     self._new_stream_punched(target, protocol, timeout),
                     min(PUNCH_TOTAL_BUDGET, timeout / 2))
                 self._punch_failed_at.pop(target.peer_id, None)
+                self._ladder_inc("punch", "ok")
                 return stream
             except asyncio.CancelledError:
                 raise
             except Exception as e:
+                self._ladder_inc("punch", "fail")
                 self._punch_failed_at[target.peer_id] = time.monotonic()
                 log.debug("hole punch to %s failed (%s); falling back to "
                           "relay splice for %ds",
                           target.peer_id[:8], e, int(PUNCH_FAIL_COOLDOWN))
-        outer = await self.new_stream(f"{target.host}:{target.port}",
-                                      RELAY_PROTOCOL, timeout)
+        try:
+            outer = await self.new_stream(f"{target.host}:{target.port}",
+                                          RELAY_PROTOCOL, timeout)
+        except Exception:
+            self._ladder_inc("splice", "fail")
+            raise
         try:
             connect = {"op": "connect", "target": target.peer_id}
             if trace_id:
@@ -731,8 +763,10 @@ class Host:
                 timeout, contact=lambda rid: target)
             self.stats["streams_relayed_out"] = (
                 self.stats.get("streams_relayed_out", 0) + 1)
+            self._ladder_inc("splice", "ok")
             return stream
         except Exception:
+            self._ladder_inc("splice", "fail")
             outer.close()
             raise
 

@@ -40,6 +40,40 @@ def host_stat_lines(host) -> list[str]:
     lines.append(
         f"crowdllama_host_handshake_seconds_total "
         f"{host.stats.get('handshake_ns', 0) / 1e9:.6f}")
+    # Dial-ladder outcomes (docs/OBSERVABILITY.md): one counter per
+    # (rung, outcome) the connect path attempted — direct, then the relay
+    # escalation ladder (reverse / punch / splice).  Always present at
+    # zero for the rungs a node never climbs, so dashboards can rate()
+    # without sparse-series gaps.
+    lines.append("# TYPE crowdllama_dial_ladder_attempts_total counter")
+    ladder = getattr(host, "dial_ladder", {})
+    for rung in ("direct", "reverse", "punch", "splice"):
+        for outcome in ("ok", "fail"):
+            v = ladder.get((rung, outcome), 0)
+            lines.append(
+                f'crowdllama_dial_ladder_attempts_total'
+                f'{{rung="{rung}",outcome="{outcome}"}} {v}')
+    return lines
+
+
+def node_metric_lines(peer) -> list[str]:
+    """The full worker-side exposition — the exact lines ObsServer's
+    /metrics serves AND the payload a MetricsSnapshot carries over the p2p
+    plane (docs/OBSERVABILITY.md swarm observatory): one composition, so
+    the two scrape surfaces cannot drift."""
+    obs = peer.obs
+    lines = obs.metrics.expose()
+    engine = getattr(peer, "engine", None)
+    if engine is not None:
+        try:
+            lines.extend(engine_gauge_lines(engine.obs_gauges()))
+        except Exception as e:  # a sick engine must not break the scrape
+            log.debug("engine gauges unavailable: %s", e)
+    # XLA compile/padding telemetry + device memory (PR 8): process
+    # singletons, real numbers on the node that actually compiles.
+    lines.extend(ENGINE_TELEMETRY.expose())
+    lines.extend(device_memory_lines())
+    lines.extend(host_stat_lines(peer.host))
     return lines
 
 
@@ -74,21 +108,9 @@ class ObsServer:
             self._runner = None
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
-        obs = self.peer.obs
-        lines = obs.metrics.expose()
-        engine = getattr(self.peer, "engine", None)
-        if engine is not None:
-            try:
-                lines.extend(engine_gauge_lines(engine.obs_gauges()))
-            except Exception as e:  # a sick engine must not break the scrape
-                log.debug("engine gauges unavailable: %s", e)
-        # XLA compile/padding telemetry + device memory (PR 8): process
-        # singletons, real numbers on the node that actually compiles.
-        lines.extend(ENGINE_TELEMETRY.expose())
-        lines.extend(device_memory_lines())
-        lines.extend(host_stat_lines(self.peer.host))
-        return web.Response(text="\n".join(lines) + "\n",
-                            content_type="text/plain")
+        return web.Response(
+            text="\n".join(node_metric_lines(self.peer)) + "\n",
+            content_type="text/plain")
 
     async def handle_trace(self, request: web.Request) -> web.Response:
         """``?trace_id=`` filters to one trace, ``?limit=N`` keeps the N

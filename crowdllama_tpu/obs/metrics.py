@@ -39,6 +39,11 @@ COMPILE_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
 
 _LABEL_VALUE_RE = re.compile(r"^[A-Za-z0-9_.:/\-]{1,64}$")
 
+# The scheduler's decode dispatch classes (docs/OBSERVABILITY.md duty
+# cycle): how a flight reached the device — plain per-step chunk,
+# kernel-looped megastep, unified ragged step, or speculative verify.
+DISPATCH_CLASSES = ("plain", "megastep", "ragged", "spec")
+
 
 def _fmt(v: float) -> str:
     """Exposition number format: integers without a trailing .0."""
@@ -338,17 +343,28 @@ def engine_gauge_lines(gauges: dict) -> list[str]:
 
     Keys are gauges except ``*_total``, which declare as counters (the
     Prometheus suffix convention — e.g. host_dispatches_total counts
-    device programs launched and only ever grows)."""
+    device programs launched and only ever grows).  A ``base|label=value``
+    key renders as a labeled child of the ``base`` family (one TYPE line
+    per family) — the duty-cycle gauges use this to keep one family
+    across the four dispatch classes."""
     out: list[str] = []
+    typed: set[str] = set()
     for key in sorted(gauges):
         try:
             val = float(gauges[key])
         except (TypeError, ValueError):
             continue
-        name = f"crowdllama_engine_{key}"
-        kind = "counter" if key.endswith("_total") else "gauge"
-        out.append(f"# TYPE {name} {kind}")
-        out.append(f"{name} {_fmt(val)}")
+        base, _, label = key.partition("|")
+        name = f"crowdllama_engine_{base}"
+        kind = "counter" if base.endswith("_total") else "gauge"
+        if name not in typed:
+            typed.add(name)
+            out.append(f"# TYPE {name} {kind}")
+        if label:
+            lname, _, lval = label.partition("=")
+            out.append(f'{name}{{{lname}="{lval}"}} {_fmt(val)}')
+        else:
+            out.append(f"{name} {_fmt(val)}")
     return out
 
 
@@ -383,6 +399,16 @@ class EngineTelemetry:
         # like the compile histogram (the scheduler's dispatch loop
         # records it), rendered on both scrape surfaces.
         self.prefill_chunk_seconds = Histogram(DECODE_STEP_BUCKETS)
+        # Decode duty-cycle profiler (PR 13, docs/OBSERVABILITY.md): the
+        # host-side gap between one flight's retire and the next flight's
+        # dispatch, per dispatch class.  Children pre-created so every
+        # class renders a zero histogram from the first scrape (absent()-
+        # style alerts, and the fixed allowlist IS the LabelGuard).
+        self.host_gap_seconds = HistogramVec(
+            DECODE_STEP_BUCKETS, "dispatch",
+            LabelGuard(allowed=DISPATCH_CLASSES))
+        for cls in DISPATCH_CLASSES:
+            self.host_gap_seconds.labels(cls)
 
     def _key(self, program: str, bucket: object) -> tuple[str, str]:
         return (self.program_guard.value(program),
@@ -447,6 +473,8 @@ class EngineTelemetry:
         out.append("# TYPE crowdllama_prefill_chunk_seconds histogram")
         out.extend(self.prefill_chunk_seconds.lines(
             "crowdllama_prefill_chunk_seconds"))
+        out.extend(self.host_gap_seconds.expose(
+            "crowdllama_host_gap_seconds"))
         return out
 
 

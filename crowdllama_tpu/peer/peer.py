@@ -652,6 +652,9 @@ class Peer:
             if which == "trace_fetch":
                 await self._serve_trace_fetch(stream, msg)
                 return True
+            if which == "metrics_fetch":
+                await self._serve_metrics_fetch(stream, msg)
+                return True
             if which == "gossip_frame":
                 # Replicated gateway anti-entropy (swarm/gossip.py): merge
                 # the sender's LWW map + usage digests, reply with our own
@@ -778,6 +781,35 @@ class Peer:
                 trace_id, node=node,
                 payload=_json.dumps(rec).encode("utf-8"), found=True)
         out.trace_id = trace_id
+        await wire.write_length_prefixed_pb(stream.writer, out)
+
+    async def _serve_metrics_fetch(self, stream: Stream, msg) -> None:
+        """Serve the gateway's cluster-scrape fetch (PR 13, swarm
+        observatory).
+
+        The payload is the SAME exposition text this node's own ObsServer
+        /metrics serves — one composition (obs/http.node_metric_lines), so
+        the p2p scrape and the HTTP scrape cannot drift.  ``families``
+        prefix-filters the reply (TYPE headers follow their family), which
+        keeps a rollup-only scrape cheap on big swarms."""
+        from crowdllama_tpu.core.messages import metrics_snapshot_msg
+        from crowdllama_tpu.obs.http import node_metric_lines
+
+        node = f"{self.obs.trace.node or 'peer'}:{self.peer_id[:8]}"
+        try:
+            lines = node_metric_lines(self)
+            prefixes = tuple(msg.metrics_fetch.families)
+            if prefixes:
+                lines = [ln for ln in lines
+                         if ln.split()[-2 if ln.startswith("# TYPE") else 0]
+                         .startswith(prefixes)]
+            out = metrics_snapshot_msg(
+                node=node, payload="\n".join(lines).encode("utf-8"),
+                found=True)
+        except Exception as e:  # a sick node still answers, flagged
+            log.warning("metrics snapshot failed: %s", e)
+            out = metrics_snapshot_msg(node=node, found=False, error=str(e))
+        out.trace_id = msg.trace_id
         await wire.write_length_prefixed_pb(stream.writer, out)
 
     async def _serve_kv_fetch(self, stream: Stream, msg) -> None:

@@ -158,6 +158,9 @@ _GAUGE_RE = re.compile(
     r"([0-9.eE+-]+)\s*$", re.MULTILINE)
 _SHED_RE = re.compile(
     r"^crowdllama_gateway_shed_total\s+([0-9.eE+-]+)\s*$", re.MULTILINE)
+_BURN_RE = re.compile(
+    r"^crowdllama_slo_burn_rate\{[^}]*\}\s+([0-9.eE+-]+)\s*$",
+    re.MULTILINE)
 
 
 def parse_gauges(metrics_text: str) -> dict:
@@ -165,13 +168,20 @@ def parse_gauges(metrics_text: str) -> dict:
 
     Returns ``{"pending_depth": float, "batch_occupancy": float,
     "shed_total": float}`` with absent families as 0 — a worker exposes
-    the engine gauges, the gateway the shed counter; the poller merges."""
+    the engine gauges, the gateway the shed counter.  With SLO
+    objectives configured (PR 13) the gateway also exposes burn gauges,
+    surfaced as ``slo_burn_rate`` (key present only then): the WORST
+    series across objectives and windows, because an autoscaler reacting
+    to any burning window beats one averaging a fast burn away."""
     out = {"pending_depth": 0.0, "batch_occupancy": 0.0, "shed_total": 0.0}
     for name, val in _GAUGE_RE.findall(metrics_text):
         out[name] = float(val)
     m = _SHED_RE.search(metrics_text)
     if m:
         out["shed_total"] = float(m.group(1))
+    burns = [float(v) for v in _BURN_RE.findall(metrics_text)]
+    if burns:
+        out["slo_burn_rate"] = max(burns)
     return out
 
 

@@ -94,6 +94,7 @@ FAULT_SITES: dict[str, str] = {
     "kv.serve": "donor side, before a KvFetchRequest is served",
     "gossip.send": "before a gateway replica pushes an anti-entropy frame",
     "gossip.recv": "before an inbound gossip frame is merged",
+    "obs.scrape": "before the gateway fetches one worker's metric snapshot",
 }
 
 

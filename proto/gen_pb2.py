@@ -167,6 +167,24 @@ def apply_schema_edits(fdp: descriptor_pb2.FileDescriptorProto) -> None:
     _ensure_field(tsp, _field("error", 5, STR))
     _ensure_message(fdp, tsp)
 
+    # PR 13: swarm observatory (docs/OBSERVABILITY.md).  The gateway fans a
+    # MetricsFetch out to every worker over the same authenticated stream
+    # plane as TraceFetch; each answers with its full Prometheus exposition
+    # text, re-exported under a worker label at GET /metrics/cluster.
+    mfr = descriptor_pb2.DescriptorProto(name="MetricsFetch")
+    _ensure_field(mfr, _field("families", 1, STR, REP))
+    _ensure_message(fdp, mfr)
+
+    # MetricsSnapshot: one node's scrape.  ``payload`` is the node's own
+    # /metrics exposition text (UTF-8); ``found`` distinguishes "obs plane
+    # disabled here" from an empty exposition.
+    msn = descriptor_pb2.DescriptorProto(name="MetricsSnapshot")
+    _ensure_field(msn, _field("node", 1, STR))
+    _ensure_field(msn, _field("payload", 2, BYTES))
+    _ensure_field(msn, _field("found", 3, BOOL))
+    _ensure_field(msn, _field("error", 4, STR))
+    _ensure_message(fdp, msn)
+
     (base,) = [m for m in fdp.message_type if m.name == "BaseMessage"]
     _ensure_field(base, _field("kv_fetch_request", 7, MSG,
                                type_name=".llama.v1.KvFetchRequest",
@@ -185,6 +203,12 @@ def apply_schema_edits(fdp: descriptor_pb2.FileDescriptorProto) -> None:
                                oneof_index=0))
     _ensure_field(base, _field("trace_spans", 12, MSG,
                                type_name=".llama.v1.TraceSpans",
+                               oneof_index=0))
+    _ensure_field(base, _field("metrics_fetch", 13, MSG,
+                               type_name=".llama.v1.MetricsFetch",
+                               oneof_index=0))
+    _ensure_field(base, _field("metrics_snapshot", 14, MSG,
+                               type_name=".llama.v1.MetricsSnapshot",
                                oneof_index=0))
 
 

@@ -153,8 +153,11 @@ async def test_gateway_and_worker_metrics_lint():
     consumer = Peer(Ed25519PrivateKey.generate(), _cfg(bootstrap),
                     engine=FakeEngine(models=[]), worker_mode=False)
     await consumer.start()
+    # SLO objectives on so the crowdllama_slo_* families render and get
+    # linted (disabled objectives expose nothing by design).
     gateway = Gateway(consumer, port=0, host="127.0.0.1",
-                      metrics_exemplars=True)
+                      metrics_exemplars=True,
+                      slo_ttft_ms=500.0, slo_decode_ms=200.0)
     await gateway.start()
     gw_port = gateway._runner.addresses[0][1]
 
@@ -184,9 +187,17 @@ async def test_gateway_and_worker_metrics_lint():
                              f"/metrics") as resp:
                 assert resp.status == 200
                 wk_text = await resp.text()
+            # The third scrape surface (PR 13): the cluster fan-in must
+            # be lint-clean too — merged worker families keep one TYPE
+            # per family and gain a worker label, exemplars stripped.
+            async with s.get(f"http://127.0.0.1:{gw_port}"
+                             f"/metrics/cluster") as resp:
+                assert resp.status == 200
+                cl_text = await resp.text()
 
         gw_types = _lint(gw_text)
         wk_types = _lint(wk_text)
+        cl_types = _lint(cl_text)
         # Completeness, closing the loop with swarmlint's static family
         # collector (crowdllama_tpu/analysis/contracts.py): every
         # crowdllama_* family named anywhere in code must be DECLARED on
@@ -196,10 +207,10 @@ async def test_gateway_and_worker_metrics_lint():
         from crowdllama_tpu.analysis.contracts import collect_metric_families
 
         exact, _ = collect_metric_families(repo_root())
-        declared = set(gw_types) | set(wk_types)
+        declared = set(gw_types) | set(wk_types) | set(cl_types)
         missing = sorted(f for f in exact if f not in declared)
         assert not missing, (
-            f"families named in code but declared on neither /metrics "
+            f"families named in code but declared on no scrape "
             f"surface: {missing}")
         # The swarm-uniform families exist on BOTH scrape surfaces, with
         # the engine/scheduler gauges next to them.
@@ -268,6 +279,45 @@ async def test_gateway_and_worker_metrics_lint():
             for fam in ("crowdllama_device_memory_bytes_in_use",
                         "crowdllama_device_memory_bytes_limit"):
                 assert types.get(fam) == "gauge", f"{fam} missing"
+            # Swarm observatory (PR 13): dial-ladder attempts, the
+            # host-gap histogram and the per-dispatch-class duty cycle
+            # are swarm-uniform (zeros on nodes that never dialed a
+            # ladder rung or dispatched that class).
+            assert types.get(
+                "crowdllama_dial_ladder_attempts_total") == "counter"
+            assert types.get("crowdllama_host_gap_seconds") == "histogram"
+            assert types.get("crowdllama_engine_duty_cycle") == "gauge"
+        # All eight (rung, outcome) ladder series pre-render at zero.
+        for text in (gw_text, wk_text):
+            for rung in ("direct", "reverse", "punch", "splice"):
+                for outcome in ("ok", "fail"):
+                    assert (f'crowdllama_dial_ladder_attempts_total{{'
+                            f'rung="{rung}",outcome="{outcome}"}}') in text
+        # Duty cycle: one labeled child per dispatch class.
+        for cls in ("plain", "megastep", "ragged", "spec"):
+            assert (f'crowdllama_engine_duty_cycle{{dispatch="{cls}"}}'
+                    in gw_text)
+        # SLO burn-rate plane (gateway-only; objectives were configured).
+        for fam, kind in (("crowdllama_slo_objective_ms", "gauge"),
+                          ("crowdllama_slo_requests_total", "counter"),
+                          ("crowdllama_slo_burn_rate", "gauge"),
+                          ("crowdllama_slo_fast_burn", "gauge"),
+                          ("crowdllama_slo_fast_burn_episodes_total",
+                           "counter")):
+            assert gw_types.get(fam) == kind, f"{fam} missing"
+        # Cluster rollups on the fan-in surface.
+        for fam, kind in (("crowdllama_cluster_workers_total", "gauge"),
+                          ("crowdllama_cluster_workers_scraped", "gauge"),
+                          ("crowdllama_cluster_scrapes_total", "counter"),
+                          ("crowdllama_cluster_scrape_misses_total",
+                           "counter"),
+                          ("crowdllama_cluster_tokens_per_second",
+                           "gauge"),
+                          ("crowdllama_cluster_batch_occupancy", "gauge"),
+                          ("crowdllama_cluster_kv_cache_utilization",
+                           "gauge"),
+                          ("crowdllama_cluster_inflight", "gauge")):
+            assert cl_types.get(fam) == kind, f"{fam} missing"
         # Gateway-side routing counters for the KV-ship plane.
         for fam in ("crowdllama_gateway_affinity_evicted_total",
                     "crowdllama_gateway_affinity_repointed_total",
