@@ -5,7 +5,7 @@ PY ?= python
 PYTEST = env JAX_PLATFORMS=cpu $(PY) -m pytest -p no:cacheprovider
 
 .PHONY: test tier1 lint chaos chaos-multi-gateway distill-smoke bench-kv \
-	bench-mixed bench-megastep trace-demo obs-demo
+	bench-mixed bench-megastep bench-fused trace-demo obs-demo
 
 # Full suite (slow soaks included).  Runs lint + the chaos matrix FIRST:
 # swarmlint finishes in seconds and the fault-injection scenarios are the
@@ -79,4 +79,13 @@ bench-mixed:
 # per-step dispatch+readback control.
 bench-megastep:
 	env JAX_PLATFORMS=cpu CROWDLLAMA_BENCH_PHASES=decode_megastep \
+		$(PY) bench.py
+
+# Fused ragged megastep (docs/MEGASTEP.md "Fused ragged megastep"): the
+# mixed-batch phase's fused-vs-gated arms (decode-step p95 during a long
+# prefill, tokens per dispatch, host-gap share) plus the megastep K
+# sweep — the two phases that price megastep x ragged fusion.
+bench-fused:
+	env JAX_PLATFORMS=cpu \
+		CROWDLLAMA_BENCH_PHASES=mixed_batch,decode_megastep \
 		$(PY) bench.py

@@ -145,12 +145,19 @@ _TPU_ONLY_PHASES = frozenset(
 # are then already resident for ctx4k).
 _TPU_WINDOW_PRIORITY = {"kernel": -1, "decode8b": 0, "decode8b_paged": 1,
                         "decode8b_ctx4k": 2, "decode_kv8": 3,
-                        "decode8b_int4": 4}
+                        "decode8b_int4": 4, "decode_megastep": 5,
+                        "mixed_batch": 6}
 # CPU-fallback executions of these phases are re-run when the tunnel
 # returns (their CPU numbers are tiny-model stand-ins); swarm is a
-# control-plane metric and CPU by design.
+# control-plane metric and CPU by design.  mixed_batch and
+# decode_megastep joined the list with the fused ragged megastep: their
+# CPU numbers price the ref path's additive chunk flops, and the claim
+# that the chunk rides in the decode step's idle compute (and that K
+# dispatches amortize over the tunnel's ~70 ms round trip) is only
+# provable on-chip.
 _RERUN_ON_TPU = frozenset({"kernel", "decode", "decode_paged",
-                           "decode_spec", "ttft"})
+                           "decode_spec", "ttft", "mixed_batch",
+                           "decode_megastep"})
 
 # Honor JAX_PLATFORMS even though the image's sitecustomize pre-imports jax
 # pinned to the axon (TPU tunnel) platform — env vars alone are read too
@@ -754,11 +761,16 @@ def _mixed_batch_phase() -> dict:
     512-token chunk on top of its step; WITH it the ragged step carries
     the decode tokens and the chunk in ONE dispatch, and
     ``step_token_budget`` bounds the chunk — the knob trading prefill
-    completion time for decode-step smoothness.  Swept over budgets;
-    headline = unified decode-step p95 / decode-only p95 at the tightest
-    budget (on the memory-bound TPU the chunk rides in the decode step's
-    idle compute; on the CPU fallback the chunk's flops are additive, so
-    only the tight budgets approach decode-only latency)."""
+    completion time for decode-step smoothness.  Each budget also runs
+    the FUSED arm (docs/MEGASTEP.md): ragged_megastep folds K=4 unified
+    steps into ONE host dispatch with on-device sampling, so the
+    per-step dispatch+readback the gated arm pays per token amortizes
+    K×.  Swept over budgets; headline = FUSED decode-step p95 /
+    decode-only p95 at the tightest budget, with the gated (per-dispatch)
+    ratio alongside as the control (on the memory-bound TPU the chunk
+    rides in the decode step's idle compute; on the CPU fallback the
+    chunk's flops are additive, so only the tight budgets approach
+    decode-only latency)."""
     import jax
     import numpy as np
 
@@ -813,22 +825,65 @@ def _mixed_batch_phase() -> dict:
 
         unified: list[float] = []
         totals: list[float] = []
+        g_busy = g_gap = 0.0
+        g_disp = 0
         for rnd in range(rounds):  # round 0 is the compile warmup
             p = rng.integers(1, cfg.vocab_size, size=long_len).tolist()
             job = runner.ragged_begin(p, long_slot, state)
             t_r = time.monotonic()
+            prev_end = t_r
             while not job.finished:
                 t0 = time.monotonic()
                 toks, state = runner.ragged_step(state, job, 1)
                 np.asarray(toks)
+                t1 = time.monotonic()
                 if rnd:
-                    unified.append(time.monotonic() - t0)
+                    unified.append(t1 - t0)
+                    g_busy += t1 - t0
+                    g_gap += t0 - prev_end
+                    g_disp += 1
+                prev_end = t1
             if rnd:
                 totals.append(time.monotonic() - t_r)
             key, sub = jax.random.split(key)
             _, state = runner.ragged_finish(state, job, 0.7, 0.95, sub)
             state = runner.release(state, long_slot)
 
+        # FUSED arm: ragged_megastep(state, job, K) — K unified steps
+        # per host dispatch, ONE device_get of the packed [K, B] block +
+        # done-flags per flight.  host_gap_share = time the device sat
+        # idle between dispatches / total; decode_tokens_per_dispatch is
+        # what the crowdllama_engine_tokens_per_dispatch gauge shows
+        # during a fused admission (K × live decode slots).
+        fused_k = 4
+        fsteps: list[float] = []
+        ftotals: list[float] = []
+        f_busy = f_gap = 0.0
+        f_disp = 0
+        for rnd in range(rounds):  # round 0 compiles the fused program
+            p = rng.integers(1, cfg.vocab_size, size=long_len).tolist()
+            job = runner.ragged_begin(p, long_slot, state)
+            t_r = time.monotonic()
+            prev_end = t_r
+            while not job.finished:
+                t0 = time.monotonic()
+                tokens, done, state = runner.ragged_megastep(
+                    state, job, fused_k)
+                jax.device_get((tokens, done))
+                t1 = time.monotonic()
+                if rnd:
+                    fsteps.append((t1 - t0) / fused_k)
+                    f_busy += t1 - t0
+                    f_gap += t0 - prev_end
+                    f_disp += 1
+                prev_end = t1
+            if rnd:
+                ftotals.append(time.monotonic() - t_r)
+            key, sub = jax.random.split(key)
+            _, state = runner.ragged_finish(state, job, 0.7, 0.95, sub)
+            state = runner.release(state, long_slot)
+
+        base_p95 = float(np.percentile(np.asarray(base), 95))
         entry = {
             "ragged_chunk": runner.ragged_chunk,
             "step_token_budget": runner.step_token_budget,
@@ -836,8 +891,24 @@ def _mixed_batch_phase() -> dict:
             "unified_step": _latency_stats(unified),
             "p95_vs_decode_only": round(
                 float(np.percentile(np.asarray(unified), 95))
-                / float(np.percentile(np.asarray(base), 95)), 3),
+                / base_p95, 3),
             "long_prefill_complete_s": round(float(np.mean(totals)), 3),
+            "decode_tokens_per_dispatch": slots - 1,
+            "host_gap_share": round(g_gap / max(g_gap + g_busy, 1e-9), 4),
+            "fused": {
+                "megastep_k": fused_k,
+                "unified_step": _latency_stats(fsteps),
+                "p95_vs_decode_only": round(
+                    float(np.percentile(np.asarray(fsteps), 95))
+                    / base_p95, 3),
+                "long_prefill_complete_s": round(
+                    float(np.mean(ftotals)), 3),
+                "decode_tokens_per_dispatch": fused_k * (slots - 1),
+                "host_dispatches_vs_gated": round(
+                    g_disp / max(f_disp, 1), 2),
+                "host_gap_share": round(
+                    f_gap / max(f_gap + f_busy, 1e-9), 4),
+            },
         }
         sweep[f"chunk{runner.ragged_chunk}"] = entry
         headline = entry  # tightest budget last in the sweep
@@ -869,19 +940,23 @@ def _mixed_batch_phase() -> dict:
 
     return {
         "metric": f"{model} mixed-batch decode-step p95 "
-                  f"(unified ragged vs decode-only)",
-        "value": headline["p95_vs_decode_only"],
+                  f"(fused ragged megastep vs decode-only)",
+        "value": headline["fused"]["p95_vs_decode_only"],
         "unit": "x decode-only p95",
         "vs_baseline": None,
         "extra": {
             "platform": platform, "slots": slots, "ctx": ctx,
             "long_prompt_tokens": long_len, "page_size": page,
+            "gated_p95_vs_decode_only": headline["p95_vs_decode_only"],
             "budget_sweep": sweep,
             "without_unification": legacy,
             "reading": "1.0 = a decode stream cannot tell a long prefill "
-                       "is sharing its batch; without_unification is the "
-                       "retired alternating loop, where every decode "
-                       "token during the prefill waits a full chunk",
+                       "is sharing its batch; the fused arm folds K "
+                       "unified steps into one dispatch (one readback "
+                       "per flight), the gated arm is the per-dispatch "
+                       "control, without_unification is the retired "
+                       "alternating loop, where every decode token "
+                       "during the prefill waits a full chunk",
         },
     }
 

@@ -30,7 +30,8 @@ argument of ``pl.pallas_call``) — and flags, inside the traced bodies:
 ``host-sync-in-decode-loop``
     A ``for``/``while`` loop that both dispatches decode work
     (``decode_steps_device`` / ``decode_megastep`` / ``ragged_step`` /
-    ``decode_steps``) and materializes device values on the host
+    ``ragged_megastep`` / ``decode_steps``) and materializes device
+    values on the host
     (``np.asarray``/``np.array`` — called directly or handed to
     ``run_in_executor`` — or ``.item()``/``.tolist()``).  A per-step
     readback inside the dispatch loop serializes host and device and is
@@ -66,9 +67,12 @@ _IMPURE_PREFIXES = ("time.", "random.", "np.random.", "numpy.random.",
 
 # host-sync-in-decode-loop: decode dispatch entry points (the device-side
 # flights the scheduler's loop launches) and the host-materializing calls
-# that must not share a loop body with them.
+# that must not share a loop body with them.  ragged_megastep is the
+# fused ragged flight (K unified steps per dispatch) — a per-flight sync
+# creep there forfeits exactly the dispatches the fusion reclaimed.
 _DISPATCH_CALLS = frozenset({
-    "decode_steps_device", "decode_megastep", "ragged_step", "decode_steps",
+    "decode_steps_device", "decode_megastep", "ragged_step",
+    "ragged_megastep", "decode_steps",
 })
 _LOOP_SYNC_NAMES = frozenset({
     "np.asarray", "np.array", "numpy.asarray", "numpy.array",

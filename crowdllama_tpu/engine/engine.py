@@ -119,7 +119,7 @@ class Engine:
         # Duty-cycle gauges (PR 13): labeled children, one per dispatch
         # class, zero on engines without a scheduler for the same
         # absent()-alert reason.
-        for cls in ("plain", "megastep", "ragged", "spec"):
+        for cls in ("plain", "megastep", "ragged", "ragged_mega", "spec"):
             g[f"duty_cycle|dispatch={cls}"] = 0.0
         return g
 

@@ -56,7 +56,8 @@ from crowdllama_tpu.engine.sampling import (
 from crowdllama_tpu.models import transformer as T
 from crowdllama_tpu.obs.metrics import ENGINE_TELEMETRY
 from crowdllama_tpu.ops.attention import decode_attention, decode_attention_q
-from crowdllama_tpu.ops.pallas.megastep import run_decode_megastep
+from crowdllama_tpu.ops.pallas.megastep import (run_decode_megastep,
+                                                run_ragged_megastep)
 from crowdllama_tpu.ops.pallas.paged import (
     flash_paged_decode_attention,
     flash_paged_decode_attention_tp,
@@ -216,6 +217,9 @@ class PagedModelRunner(ModelRunner):
         self._ragged_step_fn = jax.jit(self._ragged_step_impl,
                                        donate_argnums=(1,),
                                        static_argnums=(7,))
+        self._ragged_mega_fn = jax.jit(self._ragged_mega_impl,
+                                       donate_argnums=(1,),
+                                       static_argnums=(9,))
 
     # ------------------------------------------------------------ allocator
 
@@ -720,29 +724,29 @@ class PagedModelRunner(ModelRunner):
         return run_decode_megastep(self._paged_step_body(params, page_table),
                                    state, eos_ids, budgets, num_steps)
 
-    def _ragged_step_impl(self, params, state: PagedDecodeState, page_table,
-                          chunk_tokens, ctx_arr, total_len, chunk_slot,
-                          num_steps: int):
-        """The unified ragged batch step (docs/RAGGED_BATCH.md).
+    def _ragged_step_body(self, params, page_table, total_len, chunk_slot,
+                          c: int):
+        """One unified ragged step (docs/RAGGED_BATCH.md) as a ``lax.scan``
+        body closure — shared verbatim by the per-dispatch program
+        (``_ragged_step_impl``) and the fused ragged megastep
+        (``_ragged_mega_impl``), the same single-body contract that keeps
+        ``_paged_step_body``'s two consumers from drifting (byte-identity,
+        docs/MEGASTEP.md).
 
-        Each of ``num_steps`` scan iterations runs ONE jitted forward over
-        B+C query rows: one decode token per active slot (rows 0..B-1,
-        exactly the plain decode step's math) plus one prefill chunk of up
-        to C tokens for ``chunk_slot`` (rows B.., exactly the monolithic
-        chunk's math with the slot's pages as cached context).  KV for all
-        rows scatters into the shared pool in the same layer pass, and
-        attention runs through :func:`ragged_paged_attention` with
-        per-sequence (q_len, kv_len) metadata.
-
-        chunk_tokens: [K, C] prompt tokens per step (0-padded);
-        ctx_arr: [K] tokens already prefilled before each step;
-        total_len: prompt length; chunk_slot: the reserved slot.
-        Returns (decode tokens [K, B], last prompt-token logits [V], state).
+        One call of the returned ``step(state, (ctx_i, ctoks))`` runs ONE
+        jitted forward over B+C query rows: one decode token per active
+        slot (rows 0..B-1, exactly the plain decode step's math) plus one
+        prefill chunk of up to C tokens for ``chunk_slot`` (rows B..,
+        exactly the monolithic chunk's math with the slot's pages as
+        cached context).  KV for all rows scatters into the shared pool in
+        the same layer pass, and attention runs through
+        :func:`ragged_paged_attention` with per-sequence (q_len, kv_len)
+        metadata.  Returns ``(new_state, (decode tokens [B], chunk logits
+        [V], has_chunk))``.
         """
         cfg = self.cfg
         pg = self.page_size
         b = self.max_slots
-        c = chunk_tokens.shape[1]
         dh = cfg.resolved_head_dim()
         hkv = cfg.num_kv_heads
         scale = T.attn_scale(cfg)
@@ -852,12 +856,42 @@ class PagedModelRunner(ModelRunner):
             )
             return new_state, (next_tokens, chunk_logits, valid > 0)
 
+        return step
+
+    def _ragged_step_impl(self, params, state: PagedDecodeState, page_table,
+                          chunk_tokens, ctx_arr, total_len, chunk_slot,
+                          num_steps: int):
+        """``num_steps`` unified ragged steps as a ``lax.scan`` over
+        :meth:`_ragged_step_body`.
+
+        chunk_tokens: [K, C] prompt tokens per step (0-padded);
+        ctx_arr: [K] tokens already prefilled before each step;
+        total_len: prompt length; chunk_slot: the reserved slot.
+        Returns (decode tokens [K, B], last prompt-token logits [V], state).
+        """
+        step = self._ragged_step_body(params, page_table, total_len,
+                                      chunk_slot, chunk_tokens.shape[1])
         new_state, (tokens, chunk_logits, flags) = jax.lax.scan(
             step, state, (ctx_arr, chunk_tokens))
         # Logits of the final prompt token = the last step that had valid
         # chunk rows (later steps past the prompt end leave it untouched).
         ridx = (num_steps - 1) - jnp.argmax(flags[::-1])
         return tokens, chunk_logits[ridx], new_state
+
+    def _ragged_mega_impl(self, params, state: PagedDecodeState, page_table,
+                          chunk_tokens, ctx_arr, total_len, chunk_slot,
+                          eos_ids, budgets, num_steps: int):
+        """Fused ragged megastep: ``num_steps`` unified steps in ONE
+        device-resident while_loop with on-device sampling and per-slot
+        done-flags for the decode rows (docs/MEGASTEP.md, "Fused ragged
+        megastep").  The loop body is the SAME closure the scan path
+        uses, so the two programs cannot drift.  Returns (tokens [K, B],
+        done [K, B] bool, last prompt-token logits [V], state)."""
+        step = self._ragged_step_body(params, page_table, total_len,
+                                      chunk_slot, chunk_tokens.shape[1])
+        return run_ragged_megastep(step, state, eos_ids, budgets,
+                                   ctx_arr, chunk_tokens, total_len,
+                                   num_steps, vocab=self.cfg.vocab_size)
 
     # ------------------------------------------------------------------ API
 
@@ -1132,16 +1166,35 @@ class PagedModelRunner(ModelRunner):
         self._ragged_slot = slot
         return job
 
-    def ragged_step(self, state: PagedDecodeState, job: "RaggedPrefillJob",
-                    num_steps: int = 1):
-        """Dispatch ``num_steps`` unified steps: every active decode slot
-        advances one token per step AND the job prefills up to
-        ``ragged_chunk`` prompt tokens per step.  Returns (decode tokens
-        [num_steps, B] device array, new state) — the same contract as
-        decode_steps_device, so the scheduler's double-buffered retire
-        path consumes it unchanged.  Raises PagesExhausted when the pool
-        cannot cover the job's next pages (the scheduler fails the
-        request and aborts the job)."""
+    def _ragged_window(self) -> int:
+        """Page-table width (in pages) this dispatch actually needs:
+        max pages held by any slot AFTER provisioning, rounded up to a
+        power of two (bounded compile count) and floored at 4 pages.
+
+        Passing ``page_table[:, :wp]`` instead of the full table makes
+        the reference path's gathered KV views ``wp * page`` wide, so
+        unified-step cost is proportional to the densest live sequence
+        rather than to ``max_seq`` (the "additive chunk-flops" the v2
+        layout removes).  Bitwise-invisible to the streams: columns past
+        a row's ``kv_len`` mask to ``NEG_INF`` (finite), whose ``exp``
+        underflows to exactly 0.0, and every live row keeps >= 1 valid
+        column — trailing exact zeros don't perturb the reductions."""
+        need = 4
+        for pages in self._slot_pages.values():
+            need = max(need, len(pages))
+        wp = 4
+        while wp < need:
+            wp *= 2
+        return min(wp, self.max_pages_per_slot)
+
+    def _ragged_provision(self, job: "RaggedPrefillJob", num_steps: int):
+        """Dispatch-time host bookkeeping shared by :meth:`ragged_step`
+        and :meth:`ragged_megastep`: grow the chunk slot's pages to the
+        dispatch end (so ``done_tokens == exportable KV`` holds even
+        while the flight is still running on device), grow every
+        decoding slot for ``num_steps`` tokens, and build the [K, C]
+        chunk-token block + per-step context array.  Returns
+        ``(chunk_tokens, ctx_arr, end, wp)``."""
         c = self.ragged_chunk
         pg = self.page_size
         slot = job.slot
@@ -1163,24 +1216,79 @@ class PagedModelRunner(ModelRunner):
         flat = job.prompt_ids[ctx0:end]
         chunk_tokens.reshape(-1)[:len(flat)] = flat
         ctx_arr = ctx0 + np.arange(num_steps, dtype=np.int32) * c
-        sig = f"{num_steps}x{c}"
         ENGINE_TELEMETRY.padding_inc(useful=end - ctx0,
                                      waste=num_steps * c - (end - ctx0))
-        t_c = ENGINE_TELEMETRY.compile_begin("ragged_step", sig)
-        tokens, last, new_state = self._ragged_step_fn(
-            self.params, state, jnp.asarray(self.page_table),
-            jnp.asarray(chunk_tokens), jnp.asarray(ctx_arr),
-            jnp.int32(total), jnp.int32(slot), num_steps)
-        ENGINE_TELEMETRY.compile_end("ragged_step", sig, t_c)
+        return chunk_tokens, ctx_arr, end, self._ragged_window()
+
+    def _ragged_commit(self, job: "RaggedPrefillJob", end: int,
+                       num_steps: int, last) -> None:
+        """Post-dispatch host bookkeeping shared by both unified entry
+        points: bank the dispatch-end progress and the final prompt
+        token's logits, advance every slot's host sequence mirror, and
+        prefix-index the job's freshly completed pages."""
         job.done_tokens = end
         job.last_logits = last
-        self._host_seq[slot] = end
+        self._host_seq[job.slot] = end
         for s in self._slot_pages:
-            if s != slot:
+            if s != job.slot:
                 self._host_seq[s] = min(self._host_seq[s] + num_steps,
                                         self.max_seq)
         self._ragged_index(job)
+
+    def ragged_step(self, state: PagedDecodeState, job: "RaggedPrefillJob",
+                    num_steps: int = 1):
+        """Dispatch ``num_steps`` unified steps: every active decode slot
+        advances one token per step AND the job prefills up to
+        ``ragged_chunk`` prompt tokens per step.  Returns (decode tokens
+        [num_steps, B] device array, new state) — the same contract as
+        decode_steps_device, so the scheduler's double-buffered retire
+        path consumes it unchanged.  Raises PagesExhausted when the pool
+        cannot cover the job's next pages (the scheduler fails the
+        request and aborts the job)."""
+        c = self.ragged_chunk
+        chunk_tokens, ctx_arr, end, wp = self._ragged_provision(job,
+                                                                num_steps)
+        sig = f"{num_steps}x{c}w{wp}"
+        t_c = ENGINE_TELEMETRY.compile_begin("ragged_step", sig)
+        tokens, last, new_state = self._ragged_step_fn(
+            self.params, state, jnp.asarray(self.page_table[:, :wp]),
+            jnp.asarray(chunk_tokens), jnp.asarray(ctx_arr),
+            jnp.int32(len(job.prompt_ids)), jnp.int32(job.slot), num_steps)
+        ENGINE_TELEMETRY.compile_end("ragged_step", sig, t_c)
+        self._ragged_commit(job, end, num_steps, last)
         return tokens, new_state
+
+    def ragged_megastep(self, state: PagedDecodeState,
+                        job: "RaggedPrefillJob", num_steps: int = 1,
+                        eos_ids=None, budgets=None):
+        """Fused ragged megastep (docs/MEGASTEP.md): ``num_steps`` unified
+        steps in ONE host dispatch — every decode slot advances one token
+        per step with ON-DEVICE sampling and per-slot done-flags, AND the
+        job prefills up to ``ragged_chunk`` prompt tokens per step, chunk
+        KV scattering to its pool pages each iteration.
+
+        Decode-side contract matches :meth:`decode_megastep` (tokens +
+        flags stay on device, one transfer per flight; early exit only
+        once every live slot fired AND the chunk is complete).  Prefill-
+        side contract matches :meth:`ragged_step` (``done_tokens``
+        advances to the dispatch end, ``last_logits`` banked, pages
+        pre-provisioned at dispatch so ``done_tokens == exportable KV``
+        even mid-flight).  Returns (tokens [K, B], done [K, B] bool, new
+        state)."""
+        c = self.ragged_chunk
+        eos_ids, budgets = self._mega_limits_dev(eos_ids, budgets)
+        chunk_tokens, ctx_arr, end, wp = self._ragged_provision(job,
+                                                                num_steps)
+        sig = f"{num_steps}x{c}w{wp}"
+        t_c = ENGINE_TELEMETRY.compile_begin("ragged_megastep", sig)
+        tokens, done, last, new_state = self._ragged_mega_fn(
+            self.params, state, jnp.asarray(self.page_table[:, :wp]),
+            jnp.asarray(chunk_tokens), jnp.asarray(ctx_arr),
+            jnp.int32(len(job.prompt_ids)), jnp.int32(job.slot),
+            eos_ids, budgets, num_steps)
+        ENGINE_TELEMETRY.compile_end("ragged_megastep", sig, t_c)
+        self._ragged_commit(job, end, num_steps, last)
+        return tokens, done, new_state
 
     def _ragged_index(self, job: "RaggedPrefillJob") -> None:
         """Prefix-index the job's freshly completed full pages.

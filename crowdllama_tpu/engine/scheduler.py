@@ -448,7 +448,7 @@ class Scheduler:
         # for classes this engine never dispatched) so dashboards can
         # compare megastep (high duty) vs per-step (low duty) directly.
         duty = getattr(self, "_duty", {})
-        for cls in ("plain", "megastep", "ragged", "spec"):
+        for cls in ("plain", "megastep", "ragged", "ragged_mega", "spec"):
             g[f"duty_cycle|dispatch={cls}"] = float(duty.get(cls, 0.0))
         if hasattr(r, "draft_len"):
             # Speculation acceptance on BOTH /metrics surfaces (gateway
@@ -806,18 +806,27 @@ class Scheduler:
         if (rjob is not None
                 or any(isinstance(s, _SlotInfo) for s in self.slots)):
             k = self._chunk_size()
-            # Megastep upgrade (docs/MEGASTEP.md): only full-size plain
-            # decode chunks become megasteps — size-1 dispatches
-            # (admittable request waiting, spec probes) keep their latency
-            # purpose, a ragged job's unified step has its own program,
+            # Megastep upgrade (docs/MEGASTEP.md): only full-size decode
+            # chunks become megasteps — size-1 dispatches (admittable
+            # request waiting, spec probes) keep their latency purpose,
             # and a draft-speculating runner already packs K verify steps
             # per dispatch (verify chunk = K is the megastep of that
-            # path).  Deciding BEFORE pre_decode_check sizes page growth
-            # for the real step count.
+            # path).  An in-flight ragged prefill no longer demotes the
+            # batch: full-size unified chunks upgrade to the FUSED ragged
+            # megastep (K unified steps per dispatch with on-device
+            # decode sampling + done-flags, the prompt chunk advancing
+            # inside the device loop — docs/MEGASTEP.md "Fused ragged
+            # megastep") whenever the runner provides it; the unified
+            # step body is draft-independent (drafting pauses during a
+            # ragged prefill), so no draft_len gate.  Deciding BEFORE
+            # pre_decode_check sizes page growth for the real step count.
             use_mega = (self._megastep and rjob is None
                         and k == self.decode_chunk
                         and getattr(self.runner, "draft_len", 0) == 0)
-            if use_mega:
+            use_ragged_mega = (self._megastep and rjob is not None
+                               and k == self.decode_chunk
+                               and hasattr(self.runner, "ragged_megastep"))
+            if use_mega or use_ragged_mega:
                 k = self.megastep_k
             # Paged-KV runners grow page tables before the chunk; slots an
             # overcommitted pool cannot grow finish with "length" (their
@@ -873,9 +882,19 @@ class Scheduler:
                     else:
                         loop.create_task(self.migrate())
                 try:
-                    tokens_dev, self.state = await loop.run_in_executor(
-                        self._exec, functools.partial(
-                            self.runner.ragged_step, self.state, job, k))
+                    if use_ragged_mega:
+                        eos_ids, budgets = self._mega_limits()
+                        tokens_dev, rdone_dev, self.state = (
+                            await loop.run_in_executor(
+                                self._exec, functools.partial(
+                                    self.runner.ragged_megastep,
+                                    self.state, job, k, eos_ids=eos_ids,
+                                    budgets=budgets)))
+                    else:
+                        rdone_dev = None
+                        tokens_dev, self.state = await loop.run_in_executor(
+                            self._exec, functools.partial(
+                                self.runner.ragged_step, self.state, job, k))
                 except ValueError as e:
                     # Pool cannot cover the job's next chunk pages
                     # (PagesExhausted is a ValueError): fail THIS request,
@@ -898,7 +917,7 @@ class Scheduler:
                     dispatched = _InFlightChunk(
                         tokens_dev=tokens_dev, snapshot=list(self.slots),
                         dispatched_at=time.monotonic(),
-                        ragged_steps=n_chunks)
+                        ragged_steps=n_chunks, done_dev=rdone_dev)
                     if job.finished:
                         # Whole prompt is in the pool: sample the first
                         # token and activate the slot (the ragged
@@ -1122,7 +1141,8 @@ class Scheduler:
         # the device_get above is the one sync this loop already pays.
         gap = (max(0.0, fl.dispatched_at - self._last_retire_at)
                if self._last_retire_at else 0.0)
-        cls = ("megastep" if fl.done_dev is not None
+        cls = ("ragged_mega" if fl.done_dev is not None and fl.ragged_steps
+               else "megastep" if fl.done_dev is not None
                else "ragged" if fl.ragged_steps
                else "spec" if tokens.ndim == 3 else "plain")
         ENGINE_TELEMETRY.host_gap_seconds.labels(cls).observe(gap)
@@ -1152,6 +1172,10 @@ class Scheduler:
                                   for s in fl.snapshot], bool)
             if live_cols.any() and d[:, live_cols].any(axis=0).all():
                 steps_run = int(d[:, live_cols].argmax(axis=0).max()) + 1
+                if fl.ragged_steps:
+                    # Fused ragged flight: the chunk pins the loop open
+                    # past all-fired, so every token-carrying step ran.
+                    steps_run = max(steps_run, fl.ragged_steps)
         ENGINE_TELEMETRY.padding_inc(useful=live * steps_run,
                                      waste=max(0, batch - live) * steps_run)
         emitted = 0

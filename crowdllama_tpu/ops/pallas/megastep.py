@@ -37,6 +37,13 @@ Byte-identity contract (vs. the per-step path):
 Done-flags are advisory acceleration for the host (and the early-exit
 trigger on device); the scheduler's ``_emit`` bookkeeping remains the
 authority on retirement, which is what makes byte-identity checkable.
+
+:func:`run_ragged_megastep` extends the same harness to the unified
+ragged batch (docs/RAGGED_BATCH.md): each iteration runs the runner's
+unified step (all decode slots + one advancing prefill chunk, chunk KV
+scattering to pool pages on device) instead of the plain decode step,
+so a long prefill no longer forces decode back to one dispatch per
+token (docs/MEGASTEP.md "Fused ragged megastep").
 """
 
 from __future__ import annotations
@@ -97,3 +104,70 @@ def run_decode_megastep(step_fn, state, eos_ids, budgets, num_steps):
             jnp.zeros((num_steps, b), bool))
     new_state, _, _, _, tokens, done = jax.lax.while_loop(cond, body, init)
     return tokens, done, new_state
+
+
+def run_ragged_megastep(step_fn, state, eos_ids, budgets,
+                        ctx_arr, chunk_tokens, total_len, num_steps,
+                        vocab: int):
+    """Run ``num_steps`` UNIFIED ragged steps (decode rows + one prefill
+    chunk, docs/RAGGED_BATCH.md) in one device-resident loop.
+
+    ``step_fn(state, (ctx_i, ctoks)) -> (new_state, (tokens[B],
+    chunk_logits[V], has_chunk))`` is the runner's unified step closure —
+    the exact body its per-dispatch ``lax.scan`` uses
+    (``PagedModelRunner._ragged_step_body``), so fused and per-step
+    paths share one program body and cannot drift.
+
+    The harness is :func:`run_decode_megastep`'s while_loop with two
+    ragged extensions:
+
+    - **The chunk pins the loop open.**  The exit condition is
+      ``alive.any() | (ctx_arr[i] < total_len)``: early exit (all decode
+      slots fired) must never skip a step that still carries prompt
+      tokens, because the host already committed ``done_tokens =
+      min(ctx0 + K*chunk, total)`` at dispatch — the invariant that
+      ``done_tokens`` of progress equals ``done_tokens`` of exportable
+      KV (migration, prefix index) survives on-device chunk advancement
+      only if every token-carrying step actually runs.
+    - **Last-chunk logits ride the carry.**  Each step with valid chunk
+      rows overwrites the carried ``[V]`` logits row; after the loop it
+      holds the final prompt token's logits — the same value the scan
+      path selects by index — so ``ragged_finish`` samples the first
+      token with unchanged math.
+
+    Returns ``(tokens [K, B], done [K, B] bool, last_logits [V],
+    new_state)``.
+    """
+    eos_ids = jnp.asarray(eos_ids, jnp.int32)
+    budgets = jnp.asarray(budgets, jnp.int32)
+    alive0 = state.active & (budgets > 0)
+    token_dtype = state.tokens.dtype
+    b = eos_ids.shape[0]
+
+    def cond(carry):
+        _, alive, _, i, _, _, _ = carry
+        i_c = jnp.minimum(i, num_steps - 1)
+        chunk_pending = ctx_arr[i_c] < total_len
+        return (i < num_steps) & (alive.any() | chunk_pending)
+
+    def body(carry):
+        st, alive, emitted, i, toks_buf, done_buf, last = carry
+        ctx_i = jax.lax.dynamic_index_in_dim(ctx_arr, i, keepdims=False)
+        ctoks = jax.lax.dynamic_index_in_dim(chunk_tokens, i, keepdims=False)
+        new_st, (toks, chunk_logits, has_chunk) = step_fn(st, (ctx_i, ctoks))
+        emitted = emitted + 1
+        done_now = (toks.astype(jnp.int32) == eos_ids) | (emitted >= budgets)
+        fired = alive & done_now
+        toks_buf = jax.lax.dynamic_update_index_in_dim(toks_buf, toks, i, 0)
+        done_buf = jax.lax.dynamic_update_index_in_dim(done_buf, fired, i, 0)
+        last = jnp.where(has_chunk, chunk_logits, last)
+        return (new_st, alive & ~done_now, emitted, i + 1,
+                toks_buf, done_buf, last)
+
+    init = (state, alive0, jnp.zeros((b,), jnp.int32), jnp.int32(0),
+            jnp.zeros((num_steps, b), token_dtype),
+            jnp.zeros((num_steps, b), bool),
+            jnp.zeros((vocab,), jnp.float32))
+    new_state, _, _, _, tokens, done, last = jax.lax.while_loop(
+        cond, body, init)
+    return tokens, done, last, new_state

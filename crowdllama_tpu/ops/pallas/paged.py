@@ -22,6 +22,17 @@ V scales folded into the probabilities — mirroring the contiguous
 ``decode_attention_q`` math (ops/attention.py), so paged + int8 KV compose
 (VERDICT r2 weak #2: the features must stop being pairwise exclusive).
 
+The unified ragged batch (docs/RAGGED_BATCH.md) gets the v2 layout
+(:func:`flash_ragged_paged_attention`): ONE kernel whose grid rows are
+uniform head-packed [Hkv, QB, G, Dh] query blocks — B decode rows and
+ceil(C/QB) prefill-chunk blocks differ only in their scalar-prefetched
+(q_start, kv_len, q_valid) metadata and page-table row, the sequential
+kv walk stops at each block's causal/validity bound (density-
+proportional cost), and the page-gather DMA is the double-buffered
+BlockSpec pipeline itself.  The v1 additive pair (decode kernel +
+chunk kernel, two launches) remains as the plain decode path and the
+TP building block.
+
 The reference has no kernels at all (compute is delegated to Ollama,
 /root/reference/pkg/crowdllama/api.go:108-160).
 """
@@ -281,12 +292,13 @@ def ragged_pallas_supported(page_size: int, head_dim: int,
                             num_kv_heads: int = 0,
                             itemsize: int = 2,
                             quant: bool = False) -> bool:
-    """Gate for the fused ragged (decode + prefill-chunk) kernel pair.
+    """Gate for the fused ragged (decode + prefill-chunk) kernel.
 
-    The unified step runs the decode rows through the existing paged
-    decode kernel and the chunk rows through the chunk kernel below, so
-    the constraints are the decode gate plus the chunk kernel's VMEM
-    footprint (QB*G query rows instead of G per kv head)."""
+    The unified step runs the whole mixed batch through the v2 kernel
+    (:func:`flash_ragged_paged_attention`), whose blocks are uniform
+    [Hkv, QB, G, Dh] query tiles, so the constraints are the decode gate
+    plus the chunk-sized VMEM footprint (QB*G query rows instead of G
+    per kv head) — identical bounds to the v1 kernel pair."""
     if not paged_pallas_supported(page_size, head_dim, n_shards,
                                   num_kv_heads, itemsize, quant):
         return False
@@ -572,6 +584,239 @@ def ragged_paged_attention_ref(
     return jnp.concatenate([out_dec, out_chunk], axis=0)
 
 
+def _ragged_v2_kernel(
+    # scalar prefetch
+    table_ref,    # [NB, NP] int32 — page-table row per query block
+    info_ref,     # [NB, 3] int32 — (q_start, kv_len, q_valid) per block
+    window_ref,   # [1] int32 — sliding window (<=0 disables)
+    # operands: q, then PAIRS x (k, v), then PAIRS x (ks, vs) if quant
+    q_ref,        # [Hkv, QB, G, Dh] — one head-packed query block
+    *refs,
+    scale: float,
+    softcap: float,
+    page: int,
+    pairs: int,
+    quant: bool,
+):
+    """Ragged-paged attention v2: ONE kernel for the whole mixed batch.
+
+    Every grid row is a uniform head-packed [Hkv, QB, G, Dh] query
+    block; what makes it a decode row or a prefill-chunk block is pure
+    scalar metadata.  Block n attends kv positions ``< kv_len[n]`` with
+    the causal bound ``kpos <= q_start[n] + row_query`` per row, and only
+    its first ``q_valid[n]`` queries are real:
+
+    - a DECODE block has ``q_start = kv_len - 1, q_valid = 1`` (0 when
+      the slot is inactive — the block skips entirely), so row 0 sees
+      exactly the decode kernel's ``kpos < seq_len`` window;
+    - a CHUNK block j has ``q_start = ctx + j*QB`` and ``q_valid =
+      clip(chunk_len - j*QB, 0, QB)`` — exactly the chunk kernel's
+      causal prefill over the slot's pages.
+
+    Cost is density-proportional by construction: the sequential kv grid
+    walks ``table_ref[n]`` only up to ``min(kv_len, q_start + q_valid)``
+    (later pages compute-skip), and the page-gather DMA is the BlockSpec
+    pipeline itself — the index map reads the scalar-prefetched table,
+    and Pallas double-buffers the [Hkv, page, Dh] tiles so page p+1
+    streams in while p computes.
+    """
+    kv = refs[: 2 * pairs]
+    scs = refs[2 * pairs: 4 * pairs] if quant else ()
+    o_ref, acc_ref, m_ref, l_ref = refs[-4:]
+
+    n = pl.program_id(0)
+    p = pl.program_id(1)
+    num_steps = pl.num_programs(1)
+    q_start = info_ref[n, 0]
+    kv_len = info_ref[n, 1]
+    q_valid = info_ref[n, 2]
+    window = window_ref[0]
+    hkv, qbw, g, dh = q_ref.shape
+    rows = qbw * g
+
+    @pl.when(p == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # Keys this block can see: validity bound AND the causal bound of its
+    # last REAL row — later pages are compute-skipped entirely, which is
+    # what keeps an idle decode row (q_valid 0) and a short sequence from
+    # paying for the pool's widest resident.
+    block_bound = jnp.minimum(kv_len, q_start + q_valid)
+    row_iota = jax.lax.broadcasted_iota(jnp.int32, (1, rows, 1), 1)
+    qpos = q_start + row_iota // g
+    row_ok = row_iota // g < q_valid
+
+    def _tile(j):
+        k_ref, v_ref = kv[2 * j], kv[2 * j + 1]
+        base = (p * pairs + j) * page
+
+        @pl.when((base < block_bound) & (q_valid > 0))
+        def _body():
+            q = q_ref[...].astype(jnp.float32).reshape(hkv, rows, dh)
+            k_tile = k_ref[...].astype(jnp.float32)  # [Hkv, page, Dh]
+            v_tile = v_ref[...].astype(jnp.float32)
+            kpos = base + jax.lax.broadcasted_iota(jnp.int32, (1, 1, page), 2)
+
+            logits = jax.lax.dot_general(
+                q, k_tile, (((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            ) * scale
+            if quant:
+                logits = logits * scs[2 * j][...].astype(jnp.float32)
+            logits = _softcap(logits, softcap)
+
+            mask = row_ok & (kpos < kv_len) & (kpos <= qpos)
+            mask &= (window <= 0) | (kpos > qpos - window)
+            logits = jnp.where(mask, logits, NEG_INF)
+
+            m_prev = m_ref[:, :, :1]
+            l_prev = l_ref[:, :, :1]
+            m_new = jnp.maximum(m_prev,
+                                jnp.max(logits, axis=-1, keepdims=True))
+            alpha = jnp.exp(m_prev - m_new)
+            pr = jnp.exp(logits - m_new) * mask.astype(jnp.float32)
+            l_new = l_prev * alpha + jnp.sum(pr, axis=-1, keepdims=True)
+            if quant:
+                pr = pr * scs[2 * j + 1][...].astype(jnp.float32)
+            pv = jax.lax.dot_general(
+                pr, v_tile, (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            )
+            acc_ref[...] = acc_ref[...] * alpha + pv
+            m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+            l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    for j in range(pairs):
+        _tile(j)
+
+    @pl.when(p == num_steps - 1)
+    def _finalize():
+        l = l_ref[:, :, :1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        out = (acc_ref[...] / l).astype(o_ref.dtype)
+        o_ref[...] = out.reshape(hkv, qbw, g, dh)
+
+
+def flash_ragged_paged_attention(
+    q: jnp.ndarray,            # [B + C, H, Dh] — decode rows then chunk rows
+    pool_k: jnp.ndarray,       # [P, Hkv, page, Dh]
+    pool_v: jnp.ndarray,
+    page_table: jnp.ndarray,   # [B, NP] int32
+    q_lens: jnp.ndarray,       # [B + 1] int32
+    kv_lens: jnp.ndarray,      # [B + 1] int32
+    chunk_slot: jnp.ndarray,   # scalar int32
+    scale: float,
+    softcap: float = 0.0,
+    sliding_window: int | jnp.ndarray = 0,
+    k_scale: jnp.ndarray | None = None,
+    v_scale: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Ragged-paged attention v2 layout: the whole mixed batch — B decode
+    sequences + one prefill chunk — in a SINGLE pallas_call.
+
+    v1 ran the additive kernel pair (decode kernel + chunk kernel, two
+    launches, two grids).  v2 packs both into one grid of ``B +
+    ceil(C/QB)`` uniform head-packed query blocks whose behavior is
+    driven entirely by a scalar-prefetched ``(q_start, kv_len, q_valid)``
+    row and a per-block page-table row (decode block n gets slot n's
+    row; every chunk block gets ``chunk_slot``'s).  The chunk's fresh KV
+    must already be scattered into the pool.  Output [B + C, H, Dh]."""
+    bc, h, dh = q.shape
+    _, hkv, page, _ = pool_k.shape
+    g = h // hkv
+    b = page_table.shape[0]
+    c = bc - b
+    np_ = page_table.shape[1]
+    quant = k_scale is not None
+
+    qb = _CHUNK_QB
+    jblocks = -(-c // qb)
+    nb = b + jblocks
+    # Decode rows ride in block row 0 (rows 1.. are dead weight a decode
+    # block's q_valid=1 masks off — uniform blocks are what let one
+    # program serve both populations); chunk rows pack [Hkv, C, G, Dh]
+    # kv-head-major then split into QB-row blocks.
+    qd = q[:b].reshape(b, hkv, g, dh)[:, :, None]          # [B,Hkv,1,G,Dh]
+    qd = jnp.pad(qd, ((0, 0), (0, 0), (0, qb - 1), (0, 0), (0, 0)))
+    qc = q[b:].reshape(c, hkv, g, dh).transpose(1, 0, 2, 3)
+    if jblocks * qb != c:
+        qc = jnp.pad(qc, ((0, 0), (0, jblocks * qb - c), (0, 0), (0, 0)))
+    qc = qc.reshape(hkv, jblocks, qb, g, dh).transpose(1, 0, 2, 3, 4)
+    qx = jnp.concatenate([qd, qc], axis=0)                 # [NB,Hkv,QB,G,Dh]
+
+    table = page_table.astype(jnp.int32)
+    ctx = (kv_lens[b] - q_lens[b]).astype(jnp.int32)
+    j_idx = jnp.arange(jblocks, dtype=jnp.int32)
+    blk_table = jnp.concatenate([
+        table, jnp.broadcast_to(table[chunk_slot][None], (jblocks, np_))])
+    q_start = jnp.concatenate([kv_lens[:b] - 1, ctx + j_idx * qb])
+    kv_len_blk = jnp.concatenate([
+        kv_lens[:b], jnp.broadcast_to(kv_lens[b], (jblocks,))])
+    q_valid = jnp.concatenate([
+        q_lens[:b], jnp.clip(q_lens[b] - j_idx * qb, 0, qb)])
+    blk_info = jnp.stack(
+        [q_start, kv_len_blk, q_valid], axis=1).astype(jnp.int32)
+    window = jnp.asarray(sliding_window, jnp.int32).reshape(1)
+
+    itemsize = pool_k.dtype.itemsize
+    pairs = 2 if (np_ >= 2 and 4 * _pairs_bytes(hkv, page, dh, itemsize)
+                  <= _VMEM_TILE_BUDGET) else 1
+    steps = -(-np_ // pairs)
+
+    def q_map(ni, pi, tr, ir, wr):
+        return (ni, 0, 0, 0, 0)
+
+    def kv_map_at(j):
+        def kv_map(ni, pi, tr, ir, wr):
+            idx = jnp.minimum(pi * pairs + j, np_ - 1)
+            return (tr[ni, idx], 0, 0, 0)
+        return kv_map
+
+    in_specs = [pl.BlockSpec((None, hkv, qb, g, dh), q_map)]
+    operands = [qx]
+    for j in range(pairs):
+        in_specs += [pl.BlockSpec((None, hkv, page, dh), kv_map_at(j))] * 2
+        operands += [pool_k, pool_v]
+    if quant:
+        ks4 = k_scale.reshape(*k_scale.shape[:2], 1, page)
+        vs4 = v_scale.reshape(*v_scale.shape[:2], 1, page)
+        for j in range(pairs):
+            in_specs += [pl.BlockSpec((None, hkv, 1, page),
+                                      kv_map_at(j))] * 2
+            operands += [ks4, vs4]
+
+    kernel = functools.partial(
+        _ragged_v2_kernel,
+        scale=scale, softcap=float(softcap or 0.0), page=page,
+        pairs=pairs, quant=quant,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(nb, steps),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((None, hkv, qb, g, dh), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((hkv, qb * g, dh), jnp.float32),
+            pltpu.VMEM((hkv, qb * g, _LANES), jnp.float32),
+            pltpu.VMEM((hkv, qb * g, _LANES), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((nb, hkv, qb, g, dh), q.dtype),
+        interpret=_interpret(),
+    )(blk_table, blk_info, window, *operands)
+    out_dec = out[:b, :, 0].reshape(b, h, dh)
+    out_chunk = out[b:].transpose(1, 0, 2, 3, 4).reshape(
+        hkv, jblocks * qb, g, dh)[:, :c].transpose(1, 0, 2, 3).reshape(
+        c, h, dh)
+    return jnp.concatenate([out_dec, out_chunk], axis=0)
+
+
 def ragged_paged_attention(
     q: jnp.ndarray,            # [B + C, H, Dh]
     chunk_k: jnp.ndarray,      # [1, Hkv, C, Dh]
@@ -592,27 +837,24 @@ def ragged_paged_attention(
     """Unified ragged batch attention over the paged pool.
 
     ``use_pallas`` (a static flag the runner resolves via
-    :func:`ragged_pallas_supported`) routes the decode rows through the
-    fused paged decode kernel and the chunk rows through the chunk
-    kernel; otherwise the pure-JAX reference runs (tier-1 / CPU).  Both
-    require the chunk's fresh KV to already be scattered into the pool;
-    the ref additionally takes it as ``chunk_k``/``chunk_v`` operands so
-    its self block matches the monolithic prefill bitwise."""
+    :func:`ragged_pallas_supported`) routes the whole mixed batch
+    through the single v2 kernel (:func:`flash_ragged_paged_attention`);
+    otherwise the pure-JAX reference runs (tier-1 / CPU).  Both require
+    the chunk's fresh KV to already be scattered into the pool; the ref
+    additionally takes it as ``chunk_k``/``chunk_v`` operands so its
+    self block matches the monolithic prefill bitwise.  The v1 additive
+    pair (:func:`flash_paged_decode_attention` +
+    :func:`flash_ragged_chunk_attention`) remains for the plain decode
+    path / TP wrapper and as the per-population building blocks."""
     if not use_pallas:
         return ragged_paged_attention_ref(
             q, chunk_k, chunk_v, pool_k, pool_v, page_table, q_lens,
             kv_lens, chunk_slot, scale, softcap=softcap,
             sliding_window=sliding_window, k_scale=k_scale, v_scale=v_scale)
-    b = page_table.shape[0]
-    out_dec = flash_paged_decode_attention(
-        q[:b], pool_k, pool_v, page_table, kv_lens[:b], scale,
-        softcap=softcap, sliding_window=sliding_window,
+    return flash_ragged_paged_attention(
+        q, pool_k, pool_v, page_table, q_lens, kv_lens, chunk_slot,
+        scale, softcap=softcap, sliding_window=sliding_window,
         k_scale=k_scale, v_scale=v_scale)
-    out_chunk = flash_ragged_chunk_attention(
-        q[b:], pool_k, pool_v, page_table[chunk_slot],
-        kv_lens[b] - q_lens[b], kv_lens[b], scale, softcap=softcap,
-        sliding_window=sliding_window, k_scale=k_scale, v_scale=v_scale)
-    return jnp.concatenate([out_dec, out_chunk], axis=0)
 
 
 def flash_paged_decode_attention_tp(

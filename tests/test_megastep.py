@@ -166,6 +166,80 @@ def test_megastep_compile_buckets_per_k(runner_pair):
         after[(program, "5")]
 
 
+# ---------------------------------------------------- fused ragged runner
+
+# Params for the fused ragged-megastep units: a 512-token context fits a
+# 300-token prompt that CANNOT finish chunking inside K <= 8 steps of the
+# 32-token ragged chunk below, so the per-step control never has to call
+# ragged_step on a finished job.  bf16 pools: every assertion is
+# array_equal (see tests/test_ragged.py).
+_RAGGED = {}
+
+
+def _ragged_pair():
+    if "cfg" not in _RAGGED:
+        _RAGGED["cfg"] = get_config("tiny-test", max_context_length=512)
+        _RAGGED["params"] = T.init_params(_RAGGED["cfg"], KEY,
+                                          dtype=jnp.bfloat16)
+    mk = lambda: PagedModelRunner(
+        _RAGGED["cfg"], params=_RAGGED["params"], max_slots=4, max_seq=512,
+        page_size=32, mesh_spec="1", step_token_budget=36,
+        prefix_cache=False)
+    return mk(), mk()
+
+
+@pytest.mark.parametrize("k", [1, 4, 8])
+def test_ragged_megastep_matches_per_step_runner(k):
+    """ragged_megastep(state, job, K) emits the exact [K, B] token block
+    K chained single-step ragged_step dispatches emit while a prefill
+    chunk is advancing in the same flights — even though the fused
+    dispatch provisions all K chunks up front and therefore runs at a
+    WIDER density-proportional page-table window than the control's
+    early dispatches (the window is bitwise-invisible by design), and
+    the chunk-slot bookkeeping (done_tokens, last_logits) lands
+    identically."""
+    ctrl, mega = _ragged_pair()
+    c = ctrl.ragged_chunk
+    assert c == 32
+    vocab = _RAGGED["cfg"].vocab_size
+    prompt = [int(x) % vocab for x in range(17, 17 + 300)]
+    short = [[3, 1, 4, 1, 5, 9, 2, 6], [2, 7, 1, 8, 2, 8]]
+
+    cs, ms = ctrl.init_state(), mega.init_state()
+    for slot, p in enumerate(short):
+        fc, cs = _insert(ctrl, cs, slot, p)
+        fm, ms = _insert(mega, ms, slot, p)
+        assert fc == fm
+    cjob = ctrl.ragged_begin(prompt, 2, state=cs)
+    mjob = mega.ragged_begin(prompt, 2, state=ms)
+
+    crows = []
+    for _ in range(k):
+        toks, cs = ctrl.ragged_step(cs, cjob, num_steps=1)
+        crows.append(np.asarray(toks))
+    mtoks, done, ms = mega.ragged_megastep(ms, mjob, k)
+    np.testing.assert_array_equal(np.asarray(mtoks),
+                                  np.concatenate(crows, axis=0))
+    # NO_BUDGET / no-EOS defaults: nothing fires, and the in-flight
+    # chunk pins the loop open — all K rows carry real decode tokens.
+    assert not np.asarray(done).any()
+    assert mjob.done_tokens == cjob.done_tokens == k * c
+
+    # Both paths finish the prompt (fused keeps using the fused entry)
+    # and hand the SAME stream on: first sampled token and the next
+    # decode block match byte for byte.
+    while not cjob.finished:
+        _, cs = ctrl.ragged_step(cs, cjob, num_steps=1)
+    while not mjob.finished:
+        _, _, ms = mega.ragged_megastep(ms, mjob, 1)
+    fc, cs = ctrl.ragged_finish(cs, cjob, 0.0, 1.0, KEY)
+    fm, ms = mega.ragged_finish(ms, mjob, 0.0, 1.0, KEY)
+    assert fc == fm
+    ctoks, _ = ctrl.decode_steps(cs, 4)
+    mtoks, done, _ = mega.decode_megastep(ms, 4)
+    np.testing.assert_array_equal(np.asarray(mtoks), np.asarray(ctoks))
+
+
 # ------------------------------------------------------- scheduler streams
 
 
@@ -340,6 +414,56 @@ async def test_megastep_spec_adaptive_retune_streams_identical():
     assert mega == base, (mega, base)
 
 
+async def test_ragged_megastep_spec_retune_streams_identical():
+    """The fused ragged gate has NO draft-len condition (the unified
+    step is draft-independent; drafting pauses during a ragged prefill),
+    so a spec runner mid acceptance-adaptive retune must take the fused
+    path for the chunked admission and still emit the legacy streams —
+    with the same retune count — while the ragged_mega duty-cycle series
+    proves the fused class actually dispatched."""
+    from crowdllama_tpu.engine.scheduler import GenRequest, Scheduler
+    from crowdllama_tpu.engine.spec import SpecPagedModelRunner
+
+    cfg = get_config("tiny-test", max_context_length=256)
+    params = T.init_params(cfg, KEY, dtype=jnp.bfloat16)
+    runner = SpecPagedModelRunner(cfg, params=params, max_slots=4,
+                                  max_seq=256, page_size=32, mesh_spec="1",
+                                  draft_len=3, step_token_budget=96,
+                                  prefix_cache=False)
+
+    def reqs():
+        # Non-repetitive short prompts collapse draft acceptance (the
+        # controller retunes mid-stream) while the 150-token prompt
+        # forces a multi-chunk ragged admission into the same flights.
+        return [GenRequest(prompt_ids=[3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5],
+                           max_tokens=20, seed=7),
+                GenRequest(prompt_ids=list(range(11, 11 + 150)),
+                           max_tokens=12, seed=9),
+                GenRequest(prompt_ids=[5, 9] * 8, max_tokens=16, seed=5)]
+
+    async def run(megastep_k):
+        runner.set_draft_len(3)
+        sched = Scheduler(runner, decode_chunk=4, ragged=True,
+                          spec_draft_max=4, megastep_k=megastep_k)
+        assert sched._spec_adaptive
+        sched.start()
+        try:
+            outs = await _drain_streams(sched, reqs())
+            return (outs, sched.spec_retunes, sched.ragged_chunks,
+                    sched.telemetry_gauges())
+        finally:
+            await sched.stop()
+
+    base, base_retunes, base_chunks, _ = await run(0)
+    mega, mega_retunes, mega_chunks, gauges = await run(4)
+    assert base_chunks >= 2, base_chunks  # the long prompt really chunked
+    assert mega_chunks >= 2, mega_chunks
+    assert base_retunes > 0, "controller never retuned — test is vacuous"
+    assert mega_retunes == base_retunes
+    assert mega == base, (mega, base)
+    assert gauges["duty_cycle|dispatch=ragged_mega"] > 0.0
+
+
 # --------------------------------------------- chaos: drain at a boundary
 
 
@@ -414,5 +538,101 @@ async def test_megastep_drain_at_boundary_migrates_without_replay():
             assert donor_eng._runner.kv_pages_exported > 0
             assert succ_eng.obs.metrics.replayed_prefill_tokens == 0
             assert gateway.obs.metrics.migrated_streams == 1
+    finally:
+        await teardown()
+
+
+@pytest.mark.chaos
+async def test_ragged_megastep_drain_at_fused_boundary_resumes():
+    """A drain landing at a FUSED-flight boundary: with megastep_k=4 the
+    "scheduler.ragged_chunk" chaos site fires once per fused dispatch —
+    which IS the fused safe point — so the drain must migrate the
+    mid-prefill request exactly like the per-chunk ragged path does:
+    pages the donor's completed fused flights built move to the
+    successor, replayed_prefill_tokens counts ONLY the unshipped tail,
+    and the client's stream is byte-identical to a clean rerun even
+    though whole [K, B] fused blocks were in flight around the drain."""
+    import aiohttp
+
+    from test_drain import RAGGED_CONTENT, _chat_body, _content, \
+        _ndjson_lines, _topology
+    from crowdllama_tpu.engine.engine import JaxEngine
+    from crowdllama_tpu.testing import faults
+    from crowdllama_tpu.testing.faults import FaultPlan, FaultRule
+
+    MODEL = "tiny-test"
+    # step_token_budget 32 on 16-token pages → 16-token ragged chunks;
+    # megastep_k 4 → 64 prompt tokens per FUSED dispatch, so the
+    # ~190-token prompt needs ~3 fused dispatches and the after=1 drain
+    # fires with most of the prompt still unbuilt.
+    kv_cfg = dict(model=MODEL, kv_layout="paged", kv_page_size=16,
+                  kv_ship=True, kv_ship_min_tokens=16, kv_ship_timeout=2.0,
+                  step_token_budget=32, decode_chunk=4, megastep_k=4)
+    workers, engines, _obs, consumer, gateway, gw_port, teardown = \
+        await _topology(
+            lambda cfg: JaxEngine(cfg, max_context_length=256,
+                                  warmup=False),
+            cfg_kw=kv_cfg, kv_ship=True)
+    try:
+        by_id = {w.peer_id: (w, e) for w, e in zip(workers, engines)}
+        url = f"http://127.0.0.1:{gw_port}/api/chat"
+        body = _chat_body(RAGGED_CONTENT, num_predict=16)
+        # The delay rules park the scheduler loop between the later fused
+        # dispatches so the drain task reaches its migrate safe point
+        # while the job is still mid-prefill (same choreography as the
+        # per-chunk drain test, one site pass per FUSED flight).
+        plan = FaultPlan(seed=13, rules=[
+            FaultRule(site="scheduler.ragged_chunk", action="delay",
+                      delay_s=0.3, after=2, times=2),
+            FaultRule(site="scheduler.ragged_chunk", action="drain",
+                      after=1, times=1)])
+        async with aiohttp.ClientSession() as s:
+            with faults.installed(plan):
+                async with s.post(url, json=body) as resp:
+                    assert resp.status == 200
+                    lines = _ndjson_lines(await resp.text())
+            # The drain fired at a fused boundary mid-prefill.
+            assert plan.log and plan.log[0][2] == "drain"
+            attrs = plan.log[0][1]
+            assert 0 < attrs["done"] < attrs["total"], attrs
+
+            donor_id = next(w.peer_id for w in workers
+                            if w.obs.metrics.drain["initiated"])
+            _, donor_eng = by_id[donor_id]
+            succ_id = next(p for p in by_id if p != donor_id)
+            _, succ_eng = by_id[succ_id]
+            # Both sides ran the megastep scheduler, and the donor
+            # retired at least one FUSED ragged flight before handing
+            # off (the duty-cycle series is the fused class's witness).
+            assert donor_eng.scheduler._megastep
+            assert succ_eng.scheduler._megastep
+            donor_gauges = donor_eng.scheduler.telemetry_gauges()
+            assert donor_gauges["duty_cycle|dispatch=ragged_mega"] > 0.0
+
+            # Clean completion on the successor, one uninterrupted
+            # stream for the client.
+            assert lines[-1]["done"] is True
+            assert lines[-1].get("done_reason") in ("stop", "length")
+            assert lines[-1]["worker_id"] == succ_id
+            migrated_text = _content(lines)
+            assert migrated_text
+
+            # Partial handoff: fused-flight pages moved, the replay
+            # counter holds only the unshipped tail.
+            assert donor_eng._runner.kv_pages_exported > 0
+            assert succ_eng._runner.kv_pages_imported > 0
+            replayed = succ_eng.obs.metrics.replayed_prefill_tokens
+            assert 0 < replayed < attrs["total"], (replayed, attrs)
+            assert donor_eng.scheduler.ragged_chunks > 0
+            assert succ_eng.scheduler.ragged_chunks > 0
+            assert gateway.obs.metrics.migrated_streams == 1
+
+            # Byte-identity: a clean rerun on the surviving worker is
+            # the reference — no token from an in-flight fused block
+            # was double-delivered or dropped across the boundary.
+            async with s.post(url, json=body) as resp:
+                assert resp.status == 200
+                reference = _content(_ndjson_lines(await resp.text()))
+            assert migrated_text == reference
     finally:
         await teardown()

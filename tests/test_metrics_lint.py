@@ -293,8 +293,10 @@ async def test_gateway_and_worker_metrics_lint():
                 for outcome in ("ok", "fail"):
                     assert (f'crowdllama_dial_ladder_attempts_total{{'
                             f'rung="{rung}",outcome="{outcome}"}}') in text
-        # Duty cycle: one labeled child per dispatch class.
-        for cls in ("plain", "megastep", "ragged", "spec"):
+        # Duty cycle: one labeled child per dispatch class, including
+        # the fused ragged-megastep class (pre-rendered at zero from
+        # boot so dashboards see the series before the first flight).
+        for cls in ("plain", "megastep", "ragged", "ragged_mega", "spec"):
             assert (f'crowdllama_engine_duty_cycle{{dispatch="{cls}"}}'
                     in gw_text)
         # SLO burn-rate plane (gateway-only; objectives were configured).

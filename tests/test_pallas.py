@@ -124,6 +124,64 @@ def test_decode_inactive_slot_is_finite_free():
     assert np.isfinite(np.asarray(out)).all()
 
 
+@pytest.mark.parametrize("softcap,window", [(0.0, 0), (30.0, 0), (0.0, 9)])
+def test_ragged_v2_matches_reference(softcap, window):
+    """Ragged-paged attention v2 (ONE kernel, head-packed query blocks,
+    scalar-driven decode/chunk behavior) vs the pure-JAX unified ref:
+    decode rows at mixed lengths — including an inactive q_len=0 slot,
+    which must not contaminate its neighbors — plus a prefill chunk
+    spanning a partial second query block."""
+    from crowdllama_tpu.ops.pallas.paged import (
+        flash_ragged_paged_attention,
+        ragged_paged_attention_ref,
+    )
+
+    b, h, hkv, dh, page, np_ = 3, 4, 2, 16, 32, 4
+    g = h // hkv
+    c, ctx, chunk_len = 40, 16, 40  # 2 q blocks; second holds 8 valid rows
+    chunk_slot = 2
+    pool_pages = 16
+    key = jax.random.PRNGKey(6)
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    q = jax.random.normal(k1, (b + c, h, dh))
+    pool_k = jax.random.normal(k2, (pool_pages, hkv, page, dh))
+    pool_v = jax.random.normal(k3, (pool_pages, hkv, page, dh))
+    # Distinct pages per slot; the chunk slot owns rows ctx..ctx+c-1.
+    page_table = jnp.asarray([[1, 2, 3, 4], [5, 6, 7, 8], [9, 10, 11, 12]],
+                             jnp.int32)
+    q_lens = jnp.asarray([1, 0, 1, chunk_len], jnp.int32)  # slot 1 inactive
+    kv_lens = jnp.asarray([33, 0, 1, ctx + chunk_len], jnp.int32)
+    # The contract: the chunk's fresh KV is ALREADY scattered into the
+    # pool (the engine writes it in the same layer pass).  The ref reads
+    # the self block from explicit operands; carve them back out of the
+    # pool so both paths see identical bytes.
+    cpages = page_table[chunk_slot]
+    cpos = ctx + jnp.arange(c)
+    chunk_k = pool_k[cpages[cpos // page], :, cpos % page].transpose(
+        1, 0, 2)[None]
+    chunk_v = pool_v[cpages[cpos // page], :, cpos % page].transpose(
+        1, 0, 2)[None]
+    del k4
+    scale = dh ** -0.5
+
+    ref = ragged_paged_attention_ref(
+        q, chunk_k, chunk_v, pool_k, pool_v, page_table, q_lens, kv_lens,
+        jnp.int32(chunk_slot), scale, softcap=softcap,
+        sliding_window=window)
+    got = flash_ragged_paged_attention(
+        q, pool_k, pool_v, page_table, q_lens, kv_lens,
+        jnp.int32(chunk_slot), scale, softcap=softcap,
+        sliding_window=window)
+    # Compare rows that carry real queries: active decode rows + the
+    # chunk's valid rows (the runner discards everything else).
+    live = [0, 2] + [b + i for i in range(chunk_len)]
+    np.testing.assert_allclose(np.asarray(got)[live], np.asarray(ref)[live],
+                               rtol=2e-5, atol=2e-5)
+    # The kernel's dead rows are zeros, not NaN (q_valid=0 skips compute).
+    assert np.isfinite(np.asarray(got)).all()
+    np.testing.assert_array_equal(np.asarray(got)[1], 0.0)
+
+
 def test_decode_bf16():
     b, s, h, hkv, dh = 2, 64, 4, 4, 32
     key = jax.random.PRNGKey(5)

@@ -282,10 +282,23 @@ _LOOP_SYNC_FIXTURE = """
                 tokens = await loop.run_in_executor(
                     self._exec, np.asarray, tokens_dev)
 
+        async def loop_bad_fused(self, loop, state, job):
+            while not job.finished:
+                tokens_dev, done_dev, state = self.runner.ragged_megastep(
+                    state, job, 8)
+                done = np.asarray(done_dev)
+
         async def loop_ok(self, loop, state):
             while True:
                 tokens_dev, done_dev, state = self.runner.decode_megastep(
                     state, 8)
+                tokens, done = await loop.run_in_executor(
+                    self._exec, jax.device_get, (tokens_dev, done_dev))
+
+        async def loop_ok_fused(self, loop, state, job):
+            while not job.finished:
+                tokens_dev, done_dev, state = self.runner.ragged_megastep(
+                    state, job, 8)
                 tokens, done = await loop.run_in_executor(
                     self._exec, jax.device_get, (tokens_dev, done_dev))
 
@@ -301,9 +314,12 @@ def test_host_sync_in_decode_loop_seeded(tmp_path):
                       {"crowdllama_tpu/engine/fx.py": _LOOP_SYNC_FIXTURE})
     hits = {(f.code, f.symbol) for f in check_jax_purity(root, ("engine",))}
     # Direct per-step readback AND the executor-wrapped form (np.asarray
-    # handed to run_in_executor) are both the seeded bug class.
+    # handed to run_in_executor) are both the seeded bug class, and the
+    # fused ragged flight (ragged_megastep) is covered the same way — a
+    # per-flight sync there forfeits the dispatches the fusion reclaimed.
     assert ("host-sync-in-decode-loop", "loop_bad") in hits
     assert ("host-sync-in-decode-loop", "loop_bad_executor") in hits
+    assert ("host-sync-in-decode-loop", "loop_bad_fused") in hits
 
 
 def test_host_sync_in_decode_loop_true_negatives(tmp_path):
@@ -312,8 +328,10 @@ def test_host_sync_in_decode_loop_true_negatives(tmp_path):
     loop_hits = {f.symbol for f in check_jax_purity(root, ("engine",))
                  if f.code == "host-sync-in-decode-loop"}
     # The sanctioned megastep pattern (one jax.device_get of the packed
-    # block per flight) and a dispatch-free emit loop stay clean.
+    # block per flight) — plain or fused ragged — and a dispatch-free
+    # emit loop stay clean.
     assert "loop_ok" not in loop_hits
+    assert "loop_ok_fused" not in loop_hits
     assert "retire_ok" not in loop_hits
 
 
