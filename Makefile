@@ -5,7 +5,8 @@ PY ?= python
 PYTEST = env JAX_PLATFORMS=cpu $(PY) -m pytest -p no:cacheprovider
 
 .PHONY: test tier1 lint chaos chaos-multi-gateway distill-smoke bench-kv \
-	bench-mixed bench-megastep bench-fused trace-demo obs-demo
+	bench-mixed bench-megastep bench-fused bench-autopilot trace-demo \
+	obs-demo
 
 # Full suite (slow soaks included).  Runs lint + the chaos matrix FIRST:
 # swarmlint finishes in seconds and the fault-injection scenarios are the
@@ -88,4 +89,12 @@ bench-megastep:
 bench-fused:
 	env JAX_PLATFORMS=cpu \
 		CROWDLLAMA_BENCH_PHASES=mixed_batch,decode_megastep \
+		$(PY) bench.py
+
+# Closed-loop performance autopilot (docs/AUTOTUNE.md): three scenario
+# shapes under grid-search-best static dials vs the autotuner walking
+# from defaults — steps/sec ratio, moves-to-converge, dial trajectory
+# (artifact: benchmarks/results/AUTOTUNE_cpu_*.json).
+bench-autopilot:
+	env JAX_PLATFORMS=cpu CROWDLLAMA_BENCH_PHASES=autopilot \
 		$(PY) bench.py

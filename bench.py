@@ -57,6 +57,10 @@ Phases (CROWDLLAMA_BENCH_PHASES to select, comma-separated):
             steps per host dispatch with on-device sampling, swept over
             K in {1,2,4,8} against a per-step dispatch+readback control —
             decode steps/sec and host dispatches per token
+  autopilot  closed-loop dial autopilot (docs/AUTOTUNE.md): three
+            scenario shapes, each under grid-search-best static dials vs
+            the autotuner from defaults — steps/sec ratio, moves to
+            converge, and the dial trajectory (subprocess, CPU)
 
 The reference publishes no measured numbers (SURVEY §6); the only
 throughput figure in its tree is the hardcoded 150 tokens/sec a worker
@@ -130,8 +134,8 @@ _ALL_PHASES = ("kernel", "decode", "decode_paged", "decode8b",
                "decode8b_paged", "decode8b_ctx4k", "ttft", "swarm",
                "ep_dispatch", "kv_transfer", "mini_swarm", "multi_gateway",
                "capacity", "mixed_batch", "ctx32k", "decode_megastep",
-               "obs_overhead", "decode_spec", "decode_spec_draft",
-               "decode_kv8", "decode8b_int4")
+               "obs_overhead", "autopilot", "decode_spec",
+               "decode_spec_draft", "decode_kv8", "decode8b_int4")
 
 # Phases meaningless on the CPU fallback (real-size or quantized decode).
 _TPU_ONLY_PHASES = frozenset(
@@ -1446,6 +1450,12 @@ def _mini_swarm_phase() -> dict:
     return _subprocess_phase("mini_swarm.py", {"JAX_PLATFORMS": "cpu"})
 
 
+def _autopilot_phase() -> dict:
+    # Closed-loop autopilot vs offline grid search (docs/AUTOTUNE.md):
+    # a control-plane ratio like swarm/mini_swarm, CPU by design.
+    return _subprocess_phase("autopilot.py", {"JAX_PLATFORMS": "cpu"})
+
+
 def _multi_gateway_phase() -> dict:
     # Replicated gateway plane (ISSUE 7): req/s scaling across in-process
     # replicas, cross-replica affinity hit-rate via gossip, and tenant
@@ -1568,6 +1578,7 @@ def main() -> None:
         "ctx32k": _ctx32k_phase,
         "decode_megastep": _decode_megastep_phase,
         "obs_overhead": _obs_overhead_phase,
+        "autopilot": _autopilot_phase,
     }
 
     remaining = [p for p in phases if p in runners]

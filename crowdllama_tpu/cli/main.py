@@ -503,6 +503,13 @@ def render_top(text: str) -> str:
         elif name == "crowdllama_engine_duty_cycle":
             # highest-duty dispatch class is the one that matters
             row["duty"] = max(row.get("duty", 0.0), value)
+        elif name == "crowdllama_autotune_dial":
+            # autopilot dial positions (docs/AUTOTUNE.md) render as one
+            # compact K/k/B/C column: megastep K, spec draft cap k,
+            # step-token budget B, prefill chunk C.
+            row.setdefault("dials", {})[labels.get("dial", "")] = value
+        elif name == "crowdllama_autotune_moves_total":
+            row["moves"] = value
     lines = [
         f"workers {rollups.get('workers_total', 0):g} "
         f"(scraped {rollups.get('workers_scraped', 0):g})   "
@@ -511,16 +518,25 @@ def render_top(text: str) -> str:
         f"kv {rollups.get('kv_cache_utilization', 0):.2f}   "
         f"inflight {rollups.get('inflight', 0):g}",
         f"{'WORKER':<18}{'OK':>3}{'LOAD':>7}{'TOK/S':>8}{'ACT':>5}"
-        f"{'PEND':>6}{'OCC':>6}{'KV':>6}{'DUTY':>6}",
+        f"{'PEND':>6}{'OCC':>6}{'KV':>6}{'DUTY':>6}  {'DIALS':<20}",
     ]
     for wid in sorted(rows):
         r = rows[wid]
+        dials = r.get("dials") or {}
+        if dials:
+            dial_col = (f"K{dials.get('megastep_k', 0):g}"
+                        f"/k{dials.get('draft_k', 0):g}"
+                        f"/B{dials.get('step_token_budget', 0):g}"
+                        f"/C{dials.get('prefill_chunk', 0):g}"
+                        f" m{r.get('moves', 0):g}")
+        else:
+            dial_col = "-"
         lines.append(
             f"{wid:<18}{'y' if r.get('ok', 0) else 'n':>3}"
             f"{r.get('load', 0.0):>7.2f}{r.get('tok/s', 0.0):>8.1f}"
             f"{r.get('act', 0.0):>5.0f}{r.get('pend', 0.0):>6.0f}"
             f"{r.get('occ', 0.0):>6.2f}{r.get('kv', 0.0):>6.2f}"
-            f"{r.get('duty', 0.0):>6.2f}")
+            f"{r.get('duty', 0.0):>6.2f}  {dial_col:<20}")
     if not rows:
         lines.append("(no workers visible)")
     return "\n".join(lines)
@@ -742,11 +758,24 @@ async def run_node(cfg: Configuration, worker_mode: bool) -> None:
             gossip.metrics = gateway.obs.metrics
             await gossip.start()
         await gateway.start()
-    elif cfg.worker_metrics_port:
-        from crowdllama_tpu.obs.http import ObsServer
-        obs_server = ObsServer(peer, host=cfg.listen_host,
-                               port=cfg.worker_metrics_port)
-        await obs_server.start()
+    else:
+        if cfg.autotune and cfg.gateway_peers:
+            # Autopilot warm-start plane (docs/AUTOTUNE.md): the worker
+            # joins the gossip plane directly — peer.py dispatches
+            # gossip_frame on every node — so its tuner reads/writes the
+            # tune/<model> keys the gateways replicate.  The join sync
+            # pulls the swarm's current operating points immediately.
+            from crowdllama_tpu.swarm.gossip import GossipNode
+
+            gossip = GossipNode(peer, peers=cfg.gateway_peers,
+                                interval=cfg.gossip_interval)
+            await gossip.start()
+            engine.set_gossip(gossip)
+        if cfg.worker_metrics_port:
+            from crowdllama_tpu.obs.http import ObsServer
+            obs_server = ObsServer(peer, host=cfg.listen_host,
+                                   port=cfg.worker_metrics_port)
+            await obs_server.start()
 
     ipc = None
     if cfg.ipc_socket:

@@ -1,0 +1,489 @@
+"""Closed-loop performance autopilot (ISSUE 17, docs/AUTOTUNE.md).
+
+The engine carries four hand-set performance dials — megastep K
+(docs/MEGASTEP.md), the adaptive-spec draft-length cap
+(docs/SPECULATIVE.md), the unified ragged batch's ``step_token_budget``
+(docs/RAGGED_BATCH.md) and the prefill chunk — and PR 13 built exactly
+the sensors an online controller needs: per-dispatch-class duty-cycle
+EWMAs, tokens-per-dispatch, and burn-rate math (obs/slo.py).  This
+module closes the loop so the observability plane stops being read-only.
+
+:class:`AutoTuner` runs coordinate descent over the dials.  At a slow
+cadence (one measurement phase per ``interval`` retire windows, so one
+dial move per ~2×interval windows) it
+
+1. measures a **baseline** phase on the current operating point,
+2. perturbs ONE dial one grid step and measures a **trial** phase,
+3. keeps the move when the trial score beats baseline by ``min_gain``,
+   else reverts — reverting is free, because the prior dial value's
+   compile signature is already cached (EngineTelemetry's
+   ``crowdllama_xla_compile_cache_hits_total`` witness proves it), and
+4. hard-backs-off to the last-known-good point on a fast-burn edge of
+   its worker-local latency burn tracker (:class:`~crowdllama_tpu.obs.
+   slo.WindowBurn`), minting a process-wide backoff event the gateway's
+   flight recorder captures with reason ``autotune_backoff``.
+
+The score is the composite the ISSUE names::
+
+    score = duty_cycle(active dispatch class)
+            x tokens_per_dispatch
+            x 1 / (1 + burn)          # SLO burn penalty
+
+Byte-identity is structural, not asserted per move: every dial changes
+how MANY tokens ride one device dispatch or how a prompt is chunked,
+never WHICH tokens are sampled (greedy exactness — the same invariant
+PR 4's acceptance-adaptive controller proved for draft_len).  The
+scheduler hosts the tuner at its existing between-dispatch safe point
+(the retire path, exactly where ``_spec_retune`` runs), so a move never
+touches an in-flight program.
+
+Learned operating points publish through the PR 7 gossip CRDT map under
+``tune/<model>`` keys (swarm/gossip.py), so a fresh worker warm-starts
+from the swarm's converged point instead of cold-searching.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+
+from crowdllama_tpu.obs.slo import WindowBurn
+
+log = logging.getLogger("crowdllama.autotune")
+
+# The coordinate order.  Gauge children keep this naming on every scrape
+# surface (``crowdllama_autotune_dial{dial="..."}``).
+DIALS = ("megastep_k", "draft_k", "step_token_budget", "prefill_chunk")
+
+# Exposition families this module feeds (docs/OBSERVABILITY.md).  The
+# gauge keys below render through obs/metrics.engine_gauge_lines, which
+# strips the ``engine_`` infix for the ``autotune_`` plane.
+METRIC_FAMILIES = (
+    "crowdllama_autotune_dial",
+    "crowdllama_autotune_score",
+    "crowdllama_autotune_moves_total",
+    "crowdllama_autotune_reverts_total",
+    "crowdllama_autotune_backoffs_total",
+)
+
+# Default dial ceilings (config.py --autotune-* flags override).
+DEFAULT_BOUNDS = {
+    "megastep_k": 16,
+    "draft_k": 8,
+    "step_token_budget": 4096,
+    "prefill_chunk": 1024,
+}
+
+# Keep a move only when the trial phase beats baseline by this margin —
+# phase scores are noisy, and a churning dial costs compile cache churn.
+MIN_GAIN = 0.02
+# When no --slo-decode-ms objective is configured, the tuner derives a
+# worker-local one from its first baseline phase: this multiple of the
+# observed mean per-token latency.  Generous on purpose — the backoff
+# exists for moves that made things badly worse, not for noise.
+AUTO_OBJECTIVE_MULT = 5.0
+# Phases to sit still after a backoff before probing again.
+COOLDOWN_PHASES = 2
+
+
+class _BackoffLog:
+    """Process-wide autotune backoff registry (the ENGINE_TELEMETRY
+    pattern): the scheduler's loop records, the gateway's flight-recorder
+    edge check reads — no wiring through the engine seam needed, and the
+    numbers are real on the node that actually tunes."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.total = 0
+        self.last: dict | None = None
+
+    def record(self, event: dict) -> None:
+        with self._lock:
+            self.total += 1
+            self.last = dict(event)
+
+    def snapshot(self) -> tuple[int, dict | None]:
+        with self._lock:
+            return self.total, dict(self.last) if self.last else None
+
+
+BACKOFF_LOG = _BackoffLog()
+
+
+class AutoTuner:
+    """Coordinate-descent tuner over one scheduler's dials.
+
+    Single-threaded by construction: every entry point
+    (:meth:`on_window`, :meth:`set_gossip`) runs on the scheduler's event
+    loop, and dial writes land between device dispatches (the caller is
+    the retire path).  ``clock`` is injectable for unit tests.
+    """
+
+    def __init__(self, scheduler, model_id: str = "",
+                 interval: int = 32, bounds: dict | None = None,
+                 decode_ms: float = 0.0, gossip=None,
+                 min_gain: float = MIN_GAIN,
+                 burn_short: int = 8, burn_long: int = 32,
+                 clock=time.monotonic) -> None:
+        self.sched = scheduler
+        self.model_id = model_id or "default"
+        self.interval = max(1, int(interval))
+        self.bounds = dict(DEFAULT_BOUNDS)
+        self.bounds.update(bounds or {})
+        self.min_gain = float(min_gain)
+        self.gossip = gossip
+        self._clock = clock
+        # Worker-local burn signal: per-token latency of each retired
+        # window against the decode objective (configured, or derived
+        # after the first baseline phase).
+        self.burn = WindowBurn(objective_ms=decode_ms,
+                               short=burn_short, long=burn_long)
+        self._in_episode = False
+        # Dial grids: name -> (ascending candidate tuple, current index).
+        self._grids: dict[str, tuple[tuple, int]] = {}
+        self._dir: dict[str, int] = {}
+        self._build_grids()
+        self._order = [d for d in DIALS if d in self._grids]
+        self._next_dial = 0
+        # The starting point is known-good by definition.
+        self._last_good = self._snapshot()
+        # Phase accumulator.
+        self._n = 0
+        self._duty_sum = 0.0
+        self._tokens_sum = 0.0
+        self._ms_sum = 0.0
+        # Pending trial move: {"dial", "frm", "to"} or None (baseline).
+        self._pending: dict | None = None
+        self._cooldown = 0
+        self._best_score = 0.0
+        # Telemetry.
+        self.score = 0.0
+        self.moves = 0
+        self.reverts = 0
+        self.backoffs = 0
+        self.warm_starts = 0
+        self._warm_pending = gossip is not None
+        log.info("autotune up: model=%s dials=%s interval=%d windows",
+                 self.model_id, self._order, self.interval)
+
+    # ------------------------------------------------------------- dials
+
+    def _build_grids(self) -> None:
+        """One ascending candidate grid per dial this runner supports.
+        A disabled dial (runner without the capability) simply has no
+        grid — the coordinate loop skips it and its gauge reads 0."""
+        sched, r = self.sched, self.sched.runner
+        if getattr(r, "supports_megastep", False):
+            vals = sorted({k for k in (0, 1, 2, 4, 8, 16, 32)
+                           if k <= self.bounds["megastep_k"]}
+                          | {max(0, sched.megastep_k)})
+            self._grids["megastep_k"] = (
+                tuple(vals), vals.index(max(0, sched.megastep_k)))
+        if getattr(sched, "_spec_adaptive", False):
+            hi = max(1, int(self.bounds["draft_k"]))
+            vals = tuple(range(1, hi + 1))
+            cur = min(max(1, sched.spec_draft_max), hi)
+            self._grids["draft_k"] = (vals, vals.index(cur))
+        page = int(getattr(r, "page_size", 0) or 0)
+        if (page > 0 and getattr(r, "supports_ragged", False)
+                and getattr(r, "step_token_budget", 0)):
+            lo = r.max_slots + page
+            hi = max(lo, int(self.bounds["step_token_budget"]))
+            vals = sorted(set(range(lo, hi + 1, 2 * page))
+                          | {int(r.step_token_budget)})
+            self._grids["step_token_budget"] = (
+                tuple(vals), vals.index(int(r.step_token_budget)))
+        chunk = int(getattr(r, "prefill_chunk", 0) or 0)
+        if chunk > 0:  # pp/sp meshes pin prefill_chunk 0: dial disabled
+            vals = sorted({c for c in (64, 128, 256, 512, 1024, 2048)
+                           if c <= self.bounds["prefill_chunk"]} | {chunk})
+            self._grids["prefill_chunk"] = (tuple(vals), vals.index(chunk))
+        for name in self._grids:
+            self._dir[name] = 1
+
+    def _read(self, name: str) -> int:
+        sched, r = self.sched, self.sched.runner
+        if name == "megastep_k":
+            return int(sched.megastep_k)
+        if name == "draft_k":
+            return int(sched.spec_draft_max)
+        if name == "step_token_budget":
+            return int(getattr(r, "step_token_budget", 0) or 0)
+        if name == "prefill_chunk":
+            return int(getattr(r, "prefill_chunk", 0) or 0)
+        return 0
+
+    def _recompute_ragged(self, r) -> None:
+        """Re-derive the page-aligned ragged chunk from the current
+        (step_token_budget, prefill_chunk) pair — the same math the paged
+        runner runs at construction (engine/paged.py), so a retuned dial
+        produces exactly the geometry a fresh boot with that flag would."""
+        page = int(getattr(r, "page_size", 0) or 0)
+        if page <= 0 or not hasattr(r, "ragged_chunk"):
+            return
+        budget = int(r.step_token_budget)
+        c = min(int(r.prefill_chunk), max(budget - r.max_slots, page))
+        r.ragged_chunk = max(page, (c // page) * page)
+
+    def _apply(self, name: str, value: int) -> None:
+        """Write one dial.  Called only from the scheduler's retire path
+        (between device dispatches): the in-flight program keeps its
+        shape, the NEXT dispatch picks up the new one — the same safe
+        point _spec_retune uses, so byte-identity is preserved by
+        construction (dials change dispatch shape, never token choice)."""
+        sched, r = self.sched, self.sched.runner
+        if name == "megastep_k":
+            sched.megastep_k = max(0, int(value))
+            sched._megastep = (sched.megastep_k > 0
+                               and getattr(r, "supports_megastep", False))
+        elif name == "draft_k":
+            sched.spec_draft_max = max(1, int(value))
+            if getattr(r, "draft_len", 0) > sched.spec_draft_max:
+                # Clamp the live draft under the new cap; the adaptive
+                # controller keeps retuning inside [0, cap] from here.
+                r.set_draft_len(sched.spec_draft_max)
+        elif name == "step_token_budget":
+            r.step_token_budget = int(value)
+            self._recompute_ragged(r)
+        elif name == "prefill_chunk":
+            r.prefill_chunk = int(value)
+            if getattr(r, "step_token_budget", 0):
+                self._recompute_ragged(r)
+
+    def _snapshot(self) -> dict:
+        return {name: self._read(name) for name in self._grids}
+
+    def _restore(self, point: dict) -> None:
+        for name, value in point.items():
+            if name not in self._grids:
+                continue
+            vals, _ = self._grids[name]
+            if value in vals:
+                self._grids[name] = (vals, vals.index(value))
+            self._apply(name, value)
+
+    # ------------------------------------------------------------ gossip
+
+    def set_gossip(self, gossip) -> None:
+        """Late gossip wiring (the CLI starts the node's GossipNode after
+        the engine): warm-start from the swarm's ``tune/<model>`` point at
+        the next safe point, unless local moves already happened."""
+        self.gossip = gossip
+        if gossip is not None and self.moves == 0:
+            self._warm_pending = True
+
+    def _apply_warm(self) -> None:
+        self._warm_pending = False
+        if self.gossip is None or self.moves:
+            return
+        try:
+            point = self.gossip.lookup_operating_point(self.model_id)
+        except Exception as e:  # pragma: no cover - defensive
+            log.debug("autotune warm-start lookup failed: %s", e)
+            return
+        if not point:
+            return
+        # Clamp each gossiped value onto this runner's grid (a donor with
+        # a different page size or bound must not wedge the coordinate
+        # walk off-grid).
+        warmed = {}
+        for name, value in point.items():
+            if name not in self._grids:
+                continue
+            vals, _ = self._grids[name]
+            nearest = min(vals, key=lambda v: abs(v - int(value)))
+            warmed[name] = nearest
+        if not warmed or warmed == self._snapshot():
+            return
+        self._restore(warmed)
+        self._last_good = self._snapshot()
+        self.warm_starts += 1
+        self._reset_phase()
+        log.info("autotune warm start for %s from gossip: %s",
+                 self.model_id, warmed)
+
+    def _publish(self) -> None:
+        if self.gossip is None:
+            return
+        try:
+            self.gossip.record_operating_point(self.model_id,
+                                               self._last_good)
+        except Exception as e:  # pragma: no cover - defensive
+            log.debug("autotune publish failed: %s", e)
+
+    # ------------------------------------------------------------- loop
+
+    def on_window(self, cls: str, duty: float, emitted: int,
+                  dt: float) -> None:
+        """Fold one retired flight into the current phase.  Called by
+        Scheduler._retire_inflight for every token-emitting window —
+        i.e. at the between-dispatch safe point, which is why move
+        application can happen inline here."""
+        if self._warm_pending:
+            self._apply_warm()
+        ms = dt * 1000.0 / max(1, emitted)
+        self.burn.observe(ms)
+        if self._check_backoff():
+            return
+        self._n += 1
+        self._duty_sum += float(duty)
+        self._tokens_sum += float(emitted)
+        self._ms_sum += ms
+        if self._n >= self.interval:
+            self._phase_end()
+
+    def _reset_phase(self) -> None:
+        self._n = 0
+        self._duty_sum = 0.0
+        self._tokens_sum = 0.0
+        self._ms_sum = 0.0
+
+    def _phase_score(self) -> float:
+        n = max(1, self._n)
+        penalty = 1.0 / (1.0 + self.burn.burn())
+        return (self._duty_sum / n) * (self._tokens_sum / n) * penalty
+
+    def _phase_end(self) -> None:
+        score = self._phase_score()
+        mean_ms = self._ms_sum / max(1, self._n)
+        self.score = score
+        self._reset_phase()
+        if self.burn.objective_ms <= 0.0 and mean_ms > 0.0:
+            # No configured decode objective: derive the worker-local one
+            # from the first measured phase, before any move is proposed.
+            self.burn.objective_ms = AUTO_OBJECTIVE_MULT * mean_ms
+            log.info("autotune derived decode objective: %.2f ms/token",
+                     self.burn.objective_ms)
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            self._best_score = max(self._best_score, score)
+            return
+        if self._pending is None:
+            # Baseline phase on the current point: refresh the reference
+            # score, then propose the next coordinate move.
+            self._best_score = score
+            self._propose()
+            return
+        move = self._pending
+        self._pending = None
+        if score >= self._best_score * (1.0 + self.min_gain):
+            self._best_score = score
+            self._last_good = self._snapshot()
+            self._publish()
+            log.info("autotune keep: %s %d -> %d (score %.3f)",
+                     move["dial"], move["frm"], move["to"], score)
+        else:
+            # Revert is free: the (program, shape) signature of the prior
+            # value is still in the XLA cache — compile_begin returns the
+            # cached-hit witness instead of claiming a new signature.
+            name = move["dial"]
+            vals, _ = self._grids[name]
+            self._grids[name] = (vals, vals.index(move["frm"]))
+            self._apply(name, move["frm"])
+            self._dir[name] = -self._dir[name]
+            self.reverts += 1
+            log.info("autotune revert: %s %d -> %d (score %.3f < %.3f)",
+                     name, move["to"], move["frm"], score,
+                     self._best_score)
+
+    def _propose(self) -> None:
+        """Pick the next movable dial round-robin and step it one grid
+        position in its remembered direction (flipped at edges and after
+        a revert — plain coordinate hill-climbing)."""
+        for _ in range(len(self._order) or 1):
+            if not self._order:
+                return
+            name = self._order[self._next_dial % len(self._order)]
+            self._next_dial += 1
+            vals, idx = self._grids[name]
+            if len(vals) < 2:
+                continue
+            d = self._dir[name]
+            if not 0 <= idx + d < len(vals):
+                d = -d
+                self._dir[name] = d
+            if not 0 <= idx + d < len(vals):
+                continue
+            frm, to = vals[idx], vals[idx + d]
+            self._grids[name] = (vals, idx + d)
+            self._apply(name, to)
+            self._pending = {"dial": name, "frm": frm, "to": to}
+            self.moves += 1
+            log.info("autotune move: %s %d -> %d", name, frm, to)
+            return
+
+    def _check_backoff(self) -> bool:
+        """Fast-burn edge -> hard revert to the last-known-good point.
+        Level-triggered episodes back off once (the SloEngine edge
+        idiom); the cooldown keeps the tuner from re-probing into the
+        same incident."""
+        burning = self.burn.in_fast_burn()
+        edge = burning and not self._in_episode
+        self._in_episode = burning
+        if not edge:
+            return False
+        move = self._pending or {"dial": "", "frm": 0, "to": 0}
+        self._pending = None
+        self._restore(self._last_good)
+        self.backoffs += 1
+        self._cooldown = COOLDOWN_PHASES
+        self._reset_phase()
+        event = {"model": self.model_id, "dial": move["dial"],
+                 "frm": move["frm"], "to": move["to"],
+                 "restored": dict(self._last_good),
+                 "burn": round(self.burn.burn(), 3)}
+        BACKOFF_LOG.record(event)
+        log.warning("autotune fast-burn backoff: %s", event)
+        return True
+
+    # -------------------------------------------------------- telemetry
+
+    def gauges(self) -> dict:
+        """Merged into Scheduler.telemetry_gauges(): the exposition layer
+        renders ``autotune_*`` keys as ``crowdllama_autotune_*`` families
+        on /metrics, /metrics/cluster and `crowdllama-tpu top`."""
+        g = {
+            "autotune_score": float(self.score),
+            "autotune_moves_total": float(self.moves),
+            "autotune_reverts_total": float(self.reverts),
+            "autotune_backoffs_total": float(self.backoffs),
+        }
+        for name in DIALS:
+            g[f"autotune_dial|dial={name}"] = float(self._read(name))
+        return g
+
+    def describe(self) -> dict:
+        return {
+            "dials": self._snapshot(),
+            "score": round(self.score, 4),
+            "moves": self.moves,
+            "reverts": self.reverts,
+            "backoffs": self.backoffs,
+            "warm_starts": self.warm_starts,
+            "objective_ms": round(self.burn.objective_ms, 3),
+        }
+
+
+def encode_point(point: dict) -> str:
+    """Gossip value for a ``tune/<model>`` key: canonical JSON."""
+    return json.dumps({k: int(v) for k, v in sorted(point.items())},
+                      separators=(",", ":"))
+
+
+def decode_point(value: str) -> dict:
+    try:
+        raw = json.loads(value or "")
+    except (ValueError, TypeError):
+        return {}
+    if not isinstance(raw, dict):
+        return {}
+    out = {}
+    for k, v in raw.items():
+        if k in DIALS:
+            try:
+                out[k] = int(v)
+            except (TypeError, ValueError):
+                continue
+    return out

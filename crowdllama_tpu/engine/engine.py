@@ -121,7 +121,21 @@ class Engine:
         # absent()-alert reason.
         for cls in ("plain", "megastep", "ragged", "ragged_mega", "spec"):
             g[f"duty_cycle|dispatch={cls}"] = 0.0
+        # Autopilot plane (ISSUE 17, docs/AUTOTUNE.md): the
+        # crowdllama_autotune_* families exist on every worker, zeros on
+        # engines that do not tune.
+        g.update({"autotune_score": 0.0, "autotune_moves_total": 0.0,
+                  "autotune_reverts_total": 0.0,
+                  "autotune_backoffs_total": 0.0})
+        for dial in ("megastep_k", "draft_k", "step_token_budget",
+                     "prefill_chunk"):
+            g[f"autotune_dial|dial={dial}"] = 0.0
         return g
+
+    def set_gossip(self, gossip) -> None:
+        """Hand the node's GossipNode to the engine (CLI wiring) so the
+        autopilot can warm-start from / publish to the ``tune/<model>``
+        CRDT keys (docs/AUTOTUNE.md).  No-op on engines that don't tune."""
 
     async def drain(self, timeout: float = 30.0) -> bool:
         """Finish in-flight work before shutdown; True when drained."""
@@ -399,9 +413,21 @@ class JaxEngine(Engine):
         self._runner = None
         self._peer = None  # set by attach_peer (KV fetch dials through it)
         self._kv_streams = None  # pooled donor streams (lazy StreamPool)
+        # Closed-loop autopilot (docs/AUTOTUNE.md): built in start() when
+        # config.autotune is set; gossip may be wired before OR after.
+        self.autotuner = None
+        self._gossip = None
 
     def attach_peer(self, peer) -> None:
         self._peer = peer
+
+    def set_gossip(self, gossip) -> None:
+        """CLI wiring for the autopilot's warm-start/publish plane.  The
+        GossipNode starts after the engine, so this may land either side
+        of start(): stash for construction AND forward to a live tuner."""
+        self._gossip = gossip
+        if self.autotuner is not None:
+            self.autotuner.set_gossip(gossip)
 
     async def start(self) -> None:
         """Build tokenizer/params/runner (compiles on first use)."""
@@ -457,6 +483,22 @@ class JaxEngine(Engine):
             ragged=self.config.ragged_prefill,
             megastep_k=self.config.megastep_k)
         self.scheduler.drain_requested_cb = self._chaos_drain
+        if self.config.autotune:
+            from crowdllama_tpu.engine.autotune import AutoTuner
+
+            self.autotuner = AutoTuner(
+                self.scheduler,
+                model_id=self.config.model,
+                interval=self.config.autotune_interval,
+                bounds={
+                    "megastep_k": self.config.autotune_megastep_max,
+                    "draft_k": self.config.autotune_draft_max,
+                    "step_token_budget": self.config.autotune_budget_max,
+                    "prefill_chunk": self.config.autotune_prefill_max,
+                },
+                decode_ms=self.config.slo_decode_ms,
+                gossip=self._gossip)
+            self.scheduler.attach_autotuner(self.autotuner)
         self.scheduler.start()
         log.info(
             "engine up: model=%s mesh=%s slots=%d max_seq=%d",
@@ -849,6 +891,11 @@ class JaxEngine(Engine):
                     "retunes": self.scheduler.spec_retunes,
                     "probes": self.scheduler.spec_probes,
                 }
+        if self.autotuner is not None:
+            # Autopilot snapshot (docs/AUTOTUNE.md): the live operating
+            # point + move accounting, next to the spec controller it
+            # generalizes.
+            d["autotune"] = self.autotuner.describe()
         return d
 
     async def capture_profile(self, seconds: float = 3.0) -> str:

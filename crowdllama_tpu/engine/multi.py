@@ -105,6 +105,13 @@ class MultiEngine(Engine):
         for eng in self._engines.values():
             eng.attach_peer(peer)
 
+    def set_gossip(self, gossip) -> None:
+        """Autopilot warm-start plane (docs/AUTOTUNE.md): every child
+        tunes its own model, so each one gets the node's GossipNode."""
+        self._gossip = gossip
+        for eng in self._engines.values():
+            eng.set_gossip(gossip)
+
     def model_dir(self, model: str) -> str | None:
         eng = self._engines.get(model)
         return eng.model_dir(model) if eng is not None else None
@@ -132,15 +139,20 @@ class MultiEngine(Engine):
     # totals) sums.
     _GAUGE_MAX = frozenset(
         {"batch_occupancy", "kv_cache_utilization", "spec_draft_len",
-         "step_token_budget_used", "tokens_per_dispatch"})
+         "step_token_budget_used", "tokens_per_dispatch",
+         "autotune_score"})
 
     def obs_gauges(self) -> dict:
         out: dict = {}
         for eng in self._engines.values():
             for k, v in eng.obs_gauges().items():
                 # duty_cycle|dispatch=... is a ratio, not a depth: max,
-                # like the other point-in-time gauges.
-                if k in self._GAUGE_MAX or k.startswith("duty_cycle"):
+                # like the other point-in-time gauges.  Autotune dial
+                # positions are point-in-time too (a summed K would read
+                # as a dial value no child actually runs); the autotune
+                # move/revert/backoff counters sum like any counter.
+                if (k in self._GAUGE_MAX or k.startswith("duty_cycle")
+                        or k.startswith("autotune_dial")):
                     out[k] = max(out.get(k, 0.0), v)
                 else:
                     out[k] = out.get(k, 0.0) + v

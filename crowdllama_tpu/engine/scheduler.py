@@ -236,8 +236,20 @@ class Scheduler:
         # (the engine points it at the peer's drain, like the
         # "engine.stream_chunk" site does for mid-stream drains).
         self.drain_requested_cb = None
+        # Closed-loop autopilot (ISSUE 17, engine/autotune.py): the
+        # scheduler HOSTS the tuner because the retire path is the
+        # between-dispatch safe point — the same boundary drain/migrate
+        # and _spec_retune already use, so every dial move lands with no
+        # program in flight.  None = autotune off (the default).
+        self._autotune = None
 
     # ---------------------------------------------------------------- public
+
+    def attach_autotuner(self, tuner) -> None:
+        """Wire the performance autopilot (engine/autotune.py).  The
+        retire path feeds it one sample per token-emitting flight and
+        lets it move dials inline — i.e. between device dispatches."""
+        self._autotune = tuner
 
     def start(self) -> None:
         self._draining = False
@@ -450,6 +462,20 @@ class Scheduler:
         duty = getattr(self, "_duty", {})
         for cls in ("plain", "megastep", "ragged", "ragged_mega", "spec"):
             g[f"duty_cycle|dispatch={cls}"] = float(duty.get(cls, 0.0))
+        # Autopilot plane (ISSUE 17, docs/AUTOTUNE.md): always present —
+        # zeros with the tuner off, live dials/score/counters with it on
+        # — so the crowdllama_autotune_* families render on every worker
+        # (the absent()-alert invariant the other gauges keep).
+        tuner = getattr(self, "_autotune", None)
+        if tuner is not None:
+            g.update(tuner.gauges())
+        else:
+            g.update({"autotune_score": 0.0, "autotune_moves_total": 0.0,
+                      "autotune_reverts_total": 0.0,
+                      "autotune_backoffs_total": 0.0})
+            for dial in ("megastep_k", "draft_k", "step_token_budget",
+                         "prefill_chunk"):
+                g[f"autotune_dial|dial={dial}"] = 0.0
         if hasattr(r, "draft_len"):
             # Speculation acceptance on BOTH /metrics surfaces (gateway
             # aggregates worker gauges): emitted/steps is the live
@@ -1247,6 +1273,13 @@ class Scheduler:
                 self.spec_probes += 1
                 self.runner.set_draft_len(1)
         self._tokens_per_dispatch = float(emitted)
+        if self._autotune is not None and emitted:
+            # Autopilot sample + (maybe) a dial move, HERE because retire
+            # runs strictly between device dispatches — the same safe
+            # point _spec_retune writes draft_len from.  Overshoot-only
+            # windows are skipped for the same reason the EMA skips them.
+            self._autotune.on_window(cls, self._duty.get(cls, 0.0),
+                                     emitted, dt)
         await self._flush_releases(loop)
         if emitted == 0:
             # Pure-overshoot chunk (dispatched before its slots' EOS was

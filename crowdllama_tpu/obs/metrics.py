@@ -355,7 +355,12 @@ def engine_gauge_lines(gauges: dict) -> list[str]:
         except (TypeError, ValueError):
             continue
         base, _, label = key.partition("|")
-        name = f"crowdllama_engine_{base}"
+        # Autopilot keys are their own exposition plane (ISSUE 17,
+        # docs/AUTOTUNE.md): crowdllama_autotune_* rather than an
+        # engine_-prefixed family, because the dials belong to the
+        # control loop, not the batch-shape gauges dashboards rate().
+        name = (f"crowdllama_{base}" if base.startswith("autotune_")
+                else f"crowdllama_engine_{base}")
         kind = "counter" if base.endswith("_total") else "gauge"
         if name not in typed:
             typed.add(name)
@@ -393,6 +398,12 @@ class EngineTelemetry:
         self.bucket_guard = LabelGuard(max_values=256)
         self._compiles: dict[tuple[str, str], int] = {}
         self._seen: set[tuple[str, str]] = set()
+        # Cached-hit witness (ISSUE 17 satellite): dispatches whose
+        # (program, bucket) signature was already claimed — the proof
+        # that flipping a dial BACK is free (no recompile).  Keyed by
+        # program only: the interesting fact is "this entry point reused
+        # a signature", not which bucket did.
+        self._cache_hits: dict[str, int] = {}
         self._padding = {"waste": 0, "useful": 0}
         # Unified ragged batch (docs/RAGGED_BATCH.md): wall time per
         # prefill chunk carried inside a decode dispatch.  Engine-plane
@@ -421,6 +432,8 @@ class EngineTelemetry:
         key = self._key(program, bucket)
         with self._lock:
             if key in self._seen:
+                self._cache_hits[key[0]] = \
+                    self._cache_hits.get(key[0], 0) + 1
                 return 0.0
             self._seen.add(key)
         return time.perf_counter()
@@ -447,6 +460,12 @@ class EngineTelemetry:
         with self._lock:
             return dict(self._compiles)
 
+    def snapshot_cache_hits(self) -> dict[str, int]:
+        """program -> cached-signature dispatch count; the retune test
+        diffs two snapshots to prove a dial revert recompiled nothing."""
+        with self._lock:
+            return dict(self._cache_hits)
+
     def padding_snapshot(self) -> dict[str, int]:
         with self._lock:
             return dict(self._padding)
@@ -458,6 +477,7 @@ class EngineTelemetry:
         with self._lock:
             compiles = sorted(self._compiles.items())
             padding = dict(self._padding)
+            cache_hits = sorted(self._cache_hits.items())
         out.append("# TYPE crowdllama_xla_compiles_total counter")
         if not compiles:
             out.append('crowdllama_xla_compiles_total{program="none",'
@@ -465,6 +485,16 @@ class EngineTelemetry:
         for (program, bucket), n in compiles:
             out.append(f'crowdllama_xla_compiles_total{{'
                        f'program="{program}",bucket="{bucket}"}} {n}')
+        # Cached-hit witness (docs/AUTOTUNE.md): signature reuse per jit
+        # entry point — a dial revert shows up here instead of as a new
+        # crowdllama_xla_compiles_total child.
+        out.append("# TYPE crowdllama_xla_compile_cache_hits_total counter")
+        if not cache_hits:
+            out.append('crowdllama_xla_compile_cache_hits_total{'
+                       'program="none"} 0')
+        for program, n in cache_hits:
+            out.append(f'crowdllama_xla_compile_cache_hits_total{{'
+                       f'program="{program}"}} {n}')
         out.append("# TYPE crowdllama_padding_waste_tokens_total counter")
         out.append(f"crowdllama_padding_waste_tokens_total "
                    f"{padding['waste']}")
