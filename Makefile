@@ -4,15 +4,15 @@
 PY ?= python
 PYTEST = env JAX_PLATFORMS=cpu $(PY) -m pytest -p no:cacheprovider
 
-.PHONY: test tier1 lint chaos chaos-multi-gateway distill-smoke bench-kv \
-	bench-mixed bench-megastep bench-fused bench-autopilot trace-demo \
-	obs-demo
+.PHONY: test tier1 lint chaos chaos-multi-gateway chaos-soak \
+	distill-smoke bench-kv bench-mixed bench-megastep bench-fused \
+	bench-autopilot trace-demo obs-demo
 
 # Full suite (slow soaks included).  Runs lint + the chaos matrix FIRST:
 # swarmlint finishes in seconds and the fault-injection scenarios are the
 # cheapest way to catch a request-plane regression, so they gate the
 # long tail instead of trailing it.
-test: lint chaos
+test: lint chaos chaos-soak
 	$(PYTEST) tests/ -q -m 'not chaos'
 
 # The tier-1 gate: what CI (and ROADMAP.md) holds the repo to.
@@ -41,6 +41,17 @@ chaos: chaos-multi-gateway
 chaos-multi-gateway:
 	$(PYTEST) tests/test_gossip.py -q \
 		-k 'two_gateways or converges_under or tenant_quota_sheds'
+
+# Seeded chaos soak (docs/ROBUSTNESS.md "Gray failures"): 200 streams
+# against a 5-worker loopback swarm under a mixed kill/stall/slow/
+# hedge-delay/drain/partition schedule; every stream must come back
+# byte-identical to its fault-free control with exactly one clean
+# terminal, stalled streams must recover within the stall budget +
+# failover slack, and hedge_launched == hedge_won + hedge_cancelled.
+# Deterministic schedule, < 120 s; artifact under benchmarks/results/.
+chaos-soak:
+	env JAX_PLATFORMS=cpu $(PY) -m crowdllama_tpu.testing.soak \
+		--seed 42 --streams 200
 
 # Draft-distillation training tests (docs/SPECULATIVE.md): 30-step CPU
 # distillation smoke + native-checkpoint round-trip + the trained-draft

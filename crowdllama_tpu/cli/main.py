@@ -753,6 +753,8 @@ async def run_node(cfg: Configuration, worker_mode: bool) -> None:
                           metrics_exemplars=cfg.metrics_exemplars,
                           slo_ttft_ms=cfg.slo_ttft_ms,
                           slo_decode_ms=cfg.slo_decode_ms,
+                          stream_stall_ms=cfg.stream_stall_ms,
+                          hedge_ttft_ms=cfg.hedge_ttft_ms,
                           profile_dir=cfg.profile_dir)
         if gossip is not None:
             gossip.metrics = gateway.obs.metrics

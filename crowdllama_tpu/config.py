@@ -242,6 +242,21 @@ class Configuration:
     # decode-step gap.  0 disables the tracker and its gauges.
     slo_ttft_ms: float = 0.0
     slo_decode_ms: float = 0.0
+    # Gray-failure immunity (docs/ROBUSTNESS.md): the gateway's
+    # per-stream progress watchdog — maximum token inter-arrival gap in
+    # ms (applied to TTFT and decode separately; the live SLO objective
+    # raises it when higher) before a stalled stream is torn down and
+    # failed over with the worker quarantined as "wedged".  0 = off.
+    stream_stall_ms: float = 0.0
+    # Hedged first-token dispatch: when a stream's first frame is slower
+    # than this (or the live TTFT p95 once the histogram has data), the
+    # gateway races the second-best worker and delivers exactly one
+    # stream.  0 = off.
+    hedge_ttft_ms: float = 0.0
+    # Worker-side dispatch self-watchdog (engine/scheduler.py): a flight
+    # older than this multiple of its dispatch-class flight-duration EWMA
+    # marks the engine wedged and self-drains.  0 = off.
+    wedge_multiplier: float = 0.0
 
     # Multi-worker sharded serving (BASELINE configs 4-5): a node with
     # shard_count > 1 serves one shard of an N-way split; shard_group names
@@ -394,6 +409,12 @@ class Configuration:
             "CROWDLLAMA_TPU_SLO_TTFT_MS", cfg.slo_ttft_ms))
         cfg.slo_decode_ms = float(env.get(
             "CROWDLLAMA_TPU_SLO_DECODE_MS", cfg.slo_decode_ms))
+        cfg.stream_stall_ms = float(env.get(
+            "CROWDLLAMA_TPU_STREAM_STALL_MS", cfg.stream_stall_ms))
+        cfg.hedge_ttft_ms = float(env.get(
+            "CROWDLLAMA_TPU_HEDGE_TTFT_MS", cfg.hedge_ttft_ms))
+        cfg.wedge_multiplier = float(env.get(
+            "CROWDLLAMA_TPU_WEDGE_MULTIPLIER", cfg.wedge_multiplier))
         if env.get("CROWDLLAMA_TPU_WARMUP"):
             cfg.warmup = env["CROWDLLAMA_TPU_WARMUP"] in ("1", "true")
         for k, v in overrides.items():
@@ -480,6 +501,15 @@ class Configuration:
         if cfg.slo_decode_ms < 0:
             raise ValueError(f"slo_decode_ms must be >= 0, "
                              f"got {cfg.slo_decode_ms}")
+        if cfg.stream_stall_ms < 0:
+            raise ValueError(f"stream_stall_ms must be >= 0, "
+                             f"got {cfg.stream_stall_ms}")
+        if cfg.hedge_ttft_ms < 0:
+            raise ValueError(f"hedge_ttft_ms must be >= 0, "
+                             f"got {cfg.hedge_ttft_ms}")
+        if cfg.wedge_multiplier < 0:
+            raise ValueError(f"wedge_multiplier must be >= 0, "
+                             f"got {cfg.wedge_multiplier}")
         cfg.relay_mode = (cfg.relay_mode or "auto").strip().lower()
         if cfg.relay_mode not in ("auto", "always", "off"):
             raise ValueError(f"unknown relay_mode {cfg.relay_mode!r} "
@@ -654,6 +684,25 @@ class Configuration:
                             type=float,
                             help="per decode-step objective in ms for the "
                                  "SLO burn-rate plane (0 = disabled)")
+        parser.add_argument("--stream-stall-ms", dest="stream_stall_ms",
+                            type=float,
+                            help="gateway per-stream progress watchdog: max "
+                                 "token inter-arrival gap in ms before the "
+                                 "stream is declared stalled and failed over "
+                                 "with the worker quarantined as wedged "
+                                 "(0 = off; live SLO objectives raise it)")
+        parser.add_argument("--hedge-ttft-ms", dest="hedge_ttft_ms",
+                            type=float,
+                            help="race the second-best worker when the first "
+                                 "token is slower than this many ms (or the "
+                                 "live TTFT p95 once known); exactly one "
+                                 "stream is delivered (0 = off)")
+        parser.add_argument("--wedge-multiplier", dest="wedge_multiplier",
+                            type=float,
+                            help="worker self-watchdog: declare the engine "
+                                 "wedged when a dispatch flight exceeds this "
+                                 "multiple of its class EWMA and self-drain "
+                                 "(0 = off)")
         parser.add_argument("--request-timeout", dest="request_timeout",
                             type=float,
                             help="per-request wall-clock budget in seconds, "
@@ -723,6 +772,7 @@ class Configuration:
                 "profile_dir", "trace_buffer", "worker_metrics_port",
                 "flight_recorder", "trace_ttl", "metrics_exemplars",
                 "slo_ttft_ms", "slo_decode_ms",
+                "stream_stall_ms", "hedge_ttft_ms", "wedge_multiplier",
                 "request_timeout", "admission_max_inflight",
                 "admission_pending_max", "retry_after_s",
                 "kv_ship", "kv_ship_min_tokens", "kv_ship_timeout",

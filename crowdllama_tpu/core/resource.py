@@ -84,6 +84,12 @@ class Resource:
     # parsers drop the field as unknown JSON, old advertisements default
     # to False here.
     draining: bool = False
+    # WHY the quarantine happened: "drain" for an announced graceful
+    # handoff, "wedged" when the gateway's per-stream progress watchdog
+    # (or the worker's own dispatch self-watchdog) caught a gray failure
+    # — a worker that still answers probes but stopped making progress.
+    # "" until the first mark_draining (docs/ROBUSTNESS.md).
+    draining_reason: str = ""
     shard_group: ShardGroup | None = None
 
     def touch(self) -> None:

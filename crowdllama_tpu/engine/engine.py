@@ -481,7 +481,8 @@ class JaxEngine(Engine):
             admission_pending_max=self.config.admission_pending_max,
             spec_draft_max=self.config.spec_draft_max,
             ragged=self.config.ragged_prefill,
-            megastep_k=self.config.megastep_k)
+            megastep_k=self.config.megastep_k,
+            wedge_multiplier=self.config.wedge_multiplier)
         self.scheduler.drain_requested_cb = self._chaos_drain
         if self.config.autotune:
             from crowdllama_tpu.engine.autotune import AutoTuner
@@ -956,7 +957,11 @@ class JaxEngine(Engine):
         kv_trace: str = "",
         migrate: bool = False,
     ) -> AsyncIterator[Chunk]:
-        from crowdllama_tpu.engine.scheduler import DONE, GenRequest
+        from crowdllama_tpu.engine.scheduler import (
+            DONE,
+            GenRequest,
+            WedgedError,
+        )
 
         if self.scheduler is None:
             raise RuntimeError("engine not started")
@@ -1002,6 +1007,12 @@ class JaxEngine(Engine):
                 token, reason = await req.out.get()
                 if token is DONE:
                     finished = True
+                    if reason.startswith("error: wedged"):
+                        # Typed: the dispatch self-watchdog failed this
+                        # request (docs/ROBUSTNESS.md) — callers and the
+                        # serve loop can tell a wedge from a generic
+                        # engine failure.
+                        raise WedgedError(reason[len("error: "):])
                     if reason.startswith("error"):
                         raise RuntimeError(reason)
                     q_ns, p_ns = _trace_split()

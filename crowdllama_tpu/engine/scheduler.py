@@ -58,6 +58,15 @@ class OverloadedError(RuntimeError):
     """
 
 
+class WedgedError(RuntimeError):
+    """The dispatch self-watchdog declared the engine wedged: a flight
+    stayed in device_get far past its dispatch-class EWMA (gray failure —
+    the device hung, not crashed).  Requests failed under this carry a
+    reason starting with ``"error: wedged"`` so the engine seam can
+    re-raise the typed error instead of a generic RuntimeError
+    (docs/ROBUSTNESS.md gray-failure section)."""
+
+
 @dataclass(eq=False)  # identity semantics (slot/queue tracking, WeakSet)
 class GenRequest:
     prompt_ids: list[int]
@@ -87,6 +96,27 @@ class GenRequest:
     # Any import failure falls back to plain prefill — never fails the
     # request.
     kv_import: dict | None = None
+    # Claim-or-skip terminal delivery (docs/ROBUSTNESS.md): set by the
+    # FIRST path to deliver this request's terminal frame.  The retire
+    # path (_emit on EOS/budget) and the migrate safe point both reach
+    # completing streams — without the claim a drain landing on a stream's
+    # final chunk could deliver BOTH a "stop" and a "migrate" terminal,
+    # and the consumer/gateway would see a phantom second completion.
+    finished: bool = False
+
+    def finish(self, reason: str) -> bool:
+        """Atomically claim this request's terminal: exactly one
+        ``(_DONE, reason)`` is ever queued, whichever of the racing
+        paths (retire/EOS, migrate safe point, loop recovery, admit
+        failure, wedge watchdog) gets here first wins.  Returns False
+        when another path already claimed it — callers skip their own
+        accounting (a migrate must not count an already-served stream
+        as moved)."""
+        if self.finished:
+            return False
+        self.finished = True
+        self.out.put_nowait((_DONE, reason))
+        return True
 
 
 @dataclass
@@ -117,7 +147,8 @@ class Scheduler:
     def __init__(self, runner: ModelRunner, max_queue: int = 256,
                  decode_chunk: int = 8, admission_pending_max: int = 0,
                  spec_draft_max: int = 0, ragged: bool = True,
-                 megastep_k: int = 0):
+                 megastep_k: int = 0, wedge_multiplier: float = 0.0,
+                 clock=time.monotonic):
         self.runner = runner
         self.decode_chunk = max(1, decode_chunk)
         # Kernel-looped megastep (docs/MEGASTEP.md): K full decode steps
@@ -236,6 +267,26 @@ class Scheduler:
         # (the engine points it at the peer's drain, like the
         # "engine.stream_chunk" site does for mid-stream drains).
         self.drain_requested_cb = None
+        # Dispatch self-watchdog (docs/ROBUSTNESS.md gray-failure
+        # section): a flight whose age exceeds wedge_multiplier × its
+        # dispatch-class flight-duration EWMA marks the ENGINE wedged —
+        # the device hung inside a transfer/program, a failure the decode
+        # loop cannot observe about itself because it is parked on that
+        # very executor await.  A separate watchdog task runs
+        # check_wedged() on the injected clock (unit-testable without
+        # waiting out real thresholds).  0 = watchdog off.
+        self.wedge_multiplier = max(0.0, float(wedge_multiplier))
+        self._clock = clock
+        # Absolute floor under the multiplied EWMA: sub-second EWMAs must
+        # not let scheduler jitter (GC pause, CPU contention) read as a
+        # wedge — a real device hang is seconds, not milliseconds.
+        self.wedge_floor_s = 5.0
+        self.wedge_check_interval_s = 0.25
+        self._flight_ewma: dict[str, float] = {}  # cls -> flight seconds
+        self.wedged = False
+        self.wedged_events = 0
+        self._wedge_drain_fired = False
+        self._watchdog_task: asyncio.Task | None = None
         # Closed-loop autopilot (ISSUE 17, engine/autotune.py): the
         # scheduler HOSTS the tuner because the retire path is the
         # between-dispatch safe point — the same boundary drain/migrate
@@ -253,13 +304,25 @@ class Scheduler:
 
     def start(self) -> None:
         self._draining = False
+        self.wedged = False
+        self._wedge_drain_fired = False
         if self._exec is None:  # restarted after stop(): fresh dispatcher
             self._exec = ThreadPoolExecutor(max_workers=1,
                                             thread_name_prefix="jax-dispatch")
         if self._task is None:
             self._task = asyncio.create_task(self._loop(), name="decode-loop")
+        if self.wedge_multiplier > 0 and self._watchdog_task is None:
+            self._watchdog_task = asyncio.create_task(
+                self._watchdog_loop(), name="wedge-watchdog")
 
     async def stop(self) -> None:
+        if self._watchdog_task is not None:
+            self._watchdog_task.cancel()
+            try:
+                await self._watchdog_task
+            except asyncio.CancelledError:
+                pass
+            self._watchdog_task = None
         if self._task is not None:
             self._task.cancel()
             try:
@@ -358,6 +421,12 @@ class Scheduler:
         the successor as a KV donor until the drain deadline.
         """
         self._draining = True
+        if self.wedged:
+            # The decode loop is stuck inside a device transfer — its safe
+            # point may never run, and touching the runner here could block
+            # on the same hung device.  _declare_wedged already failed
+            # every request with the typed reason; nothing left to move.
+            return 0
         if self._task is None:
             # Loop not running (unit tests drive the runner directly):
             # nothing can be in flight, process immediately.
@@ -379,22 +448,124 @@ class Scheduler:
             abort = self._abort_fn(job)
             if abort is not None:
                 abort(job)
-            req.out.put_nowait((_DONE, "migrate"))
-            moved += 1
+            if req.finish("migrate"):
+                moved += 1
         for i, info in enumerate(self.slots):
             if isinstance(info, _SlotInfo):
                 self.slots[i] = None
                 self.state = self.runner.release(self.state, i)
                 self.requests_served += 1
-                info.req.out.put_nowait((_DONE, "migrate"))
-                moved += 1
+                if info.req.finish("migrate"):
+                    moved += 1
         while self._deferred:
-            self._deferred.popleft().out.put_nowait((_DONE, "migrate"))
-            moved += 1
+            if self._deferred.popleft().finish("migrate"):
+                moved += 1
         while not self.pending.empty():
-            self.pending.get_nowait().out.put_nowait((_DONE, "migrate"))
-            moved += 1
+            if self.pending.get_nowait().finish("migrate"):
+                moved += 1
         return moved
+
+    # --------------------------------------------- dispatch self-watchdog
+
+    @staticmethod
+    def _flight_class(fl: _InFlightChunk) -> str:
+        """Dispatch class of an in-flight chunk, from host-side metadata
+        only (the watchdog must never touch the device — tokens_dev may
+        belong to a hung transfer).  Same classification _retire_inflight
+        applies after readback: a jax device array reports the same ndim
+        before and after device_get."""
+        if fl.done_dev is not None:
+            return "ragged_mega" if fl.ragged_steps else "megastep"
+        if fl.ragged_steps:
+            return "ragged"
+        return "spec" if getattr(fl.tokens_dev, "ndim", 2) == 3 else "plain"
+
+    def check_wedged(self, now: float | None = None) -> bool:
+        """One watchdog probe: is the current flight stuck past its
+        dispatch-class threshold?  Pure host math on the injected clock —
+        callable from a unit test with a fake clock, and from the
+        watchdog task.  Idempotent once tripped.
+
+        The threshold is ``wedge_multiplier × flight-duration EWMA`` for
+        the flight's dispatch class (floored at wedge_floor_s), so a
+        megastep flight that legitimately runs 50× longer than a plain
+        chunk is judged against megastep history, not a global constant.
+        A class with NO retired flight yet is never judged: its first
+        flight may legitimately include XLA compilation."""
+        if self.wedged:
+            return True
+        fl = self._inflight
+        if self.wedge_multiplier <= 0 or fl is None:
+            return False
+        cls = self._flight_class(fl)
+        ewma = self._flight_ewma.get(cls)
+        if ewma is None:
+            return False
+        if now is None:
+            now = self._clock()
+        age = now - fl.dispatched_at
+        threshold = max(self.wedge_floor_s, self.wedge_multiplier * ewma)
+        if age <= threshold:
+            return False
+        self._declare_wedged(cls, age, threshold)
+        return True
+
+    def _declare_wedged(self, cls: str, age: float,
+                        threshold: float) -> None:
+        """The engine is wedged: fail every request a terminal can still
+        reach with the typed ``error: wedged`` reason (the engine seam
+        raises WedgedError from it), then trigger self-drain ONCE so the
+        gateway learns through the drain plane — a typed draining reject
+        within one probe interval — instead of burning its full request
+        budget against a silent worker.
+
+        Deliberately does NOT touch device state (release/init_state):
+        the dispatch executor is stuck inside the hung transfer, and any
+        runner call here could block the watchdog on the same device.
+        Slots stay occupied and _draining rejects new submissions, so no
+        new request can land on the wedged engine."""
+        self.wedged = True
+        self.wedged_events += 1
+        self._draining = True
+        reason = (f"error: wedged: {cls} flight stuck for {age:.1f}s "
+                  f"(threshold {threshold:.1f}s = "
+                  f"{self.wedge_multiplier:g}x class EWMA)")
+        log.error("dispatch self-watchdog: %s — failing in-flight "
+                  "requests and self-draining", reason[len("error: "):])
+        if self._chunking is not None:
+            self._chunking[0].finish(reason)
+        for info in self.slots:
+            if isinstance(info, _SlotInfo):
+                info.req.finish(reason)
+        while self._deferred:
+            self._deferred.popleft().finish(reason)
+        while not self.pending.empty():
+            self.pending.get_nowait().finish(reason)
+        if self._migrating is not None:
+            # A migrate() racing the wedge must not hang on a safe point
+            # the stuck loop will never reach.
+            fut, self._migrating = self._migrating, None
+            if not fut.cancelled():
+                fut.set_result(0)
+        if self.drain_requested_cb is not None \
+                and not self._wedge_drain_fired:
+            self._wedge_drain_fired = True
+            try:
+                self.drain_requested_cb()
+            except Exception:
+                log.exception("wedge self-drain callback failed")
+
+    async def _watchdog_loop(self) -> None:
+        """A task SEPARATE from the decode loop on purpose: a wedged
+        flight parks the decode loop inside its executor await, so the
+        loop cannot self-check — only an independent task still gets
+        scheduled while the device hangs."""
+        while not self.wedged:
+            await asyncio.sleep(self.wedge_check_interval_s)
+            try:
+                self.check_wedged()
+            except Exception:
+                log.exception("wedge watchdog probe failed")
 
     async def run_exclusive(self, fn):
         """Run ``fn(state) -> result`` on the dispatch executor at the
@@ -462,6 +633,11 @@ class Scheduler:
         duty = getattr(self, "_duty", {})
         for cls in ("plain", "megastep", "ragged", "ragged_mega", "spec"):
             g[f"duty_cycle|dispatch={cls}"] = float(duty.get(cls, 0.0))
+        # Dispatch self-watchdog (docs/ROBUSTNESS.md): level gauge (1 =
+        # this engine declared itself wedged and self-drained) + the
+        # monotonic trip counter, always present so absent()-alerts work.
+        g["wedged"] = 1.0 if getattr(self, "wedged", False) else 0.0
+        g["wedged_events_total"] = float(getattr(self, "wedged_events", 0))
         # Autopilot plane (ISSUE 17, docs/AUTOTUNE.md): always present —
         # zeros with the tuner off, live dials/score/counters with it on
         # — so the crowdllama_autotune_* families render on every worker
@@ -601,7 +777,7 @@ class Scheduler:
         out_of_context = info.prompt_len + info.generated >= self.runner.max_seq - 1
         if token == req.eos_id or info.generated >= req.max_tokens or out_of_context:
             reason = "stop" if token == req.eos_id else "length"
-            req.out.put_nowait((_DONE, reason))
+            req.finish(reason)
             slot = self.slots.index(info)
             self.slots[slot] = None
             if getattr(self.runner, "defer_release", False):
@@ -704,17 +880,15 @@ class Scheduler:
                     creq, _, _ = self._chunking
                     self._chunking = None
                     self._admitting -= 1
-                    creq.out.put_nowait((_DONE, "error: engine failure"))
+                    creq.finish("error: engine failure")
                 for i, info in enumerate(self.slots):
                     if isinstance(info, _SlotInfo):
-                        info.req.out.put_nowait((_DONE, "error: engine failure"))
+                        info.req.finish("error: engine failure")
                     self.slots[i] = None
                 while self._deferred:
-                    self._deferred.popleft().out.put_nowait(
-                        (_DONE, "error: engine failure"))
+                    self._deferred.popleft().finish("error: engine failure")
                 while not self.pending.empty():
-                    self.pending.get_nowait().out.put_nowait(
-                        (_DONE, "error: engine failure"))
+                    self.pending.get_nowait().finish("error: engine failure")
                 if self._migrating is not None:
                     # A pending migrate() must not hang on engine failure;
                     # everything above was failed, nothing left to move.
@@ -763,22 +937,25 @@ class Scheduler:
                 abort = self._abort_fn(job)
                 if abort is not None:
                     await loop_.run_in_executor(self._exec, abort, job)
-                req.out.put_nowait((_DONE, "migrate"))
-                moved += 1
+                if req.finish("migrate"):
+                    moved += 1
             for i, info in enumerate(self.slots):
                 if isinstance(info, _SlotInfo):
                     self.slots[i] = None
                     self.state = await loop_.run_in_executor(
                         self._exec, self.runner.release, self.state, i)
                     self.requests_served += 1
-                    info.req.out.put_nowait((_DONE, "migrate"))
-                    moved += 1
+                    # Claim-or-skip: a stream whose final chunk retired
+                    # between migrate() and this safe point already holds
+                    # its "stop" terminal — it was SERVED, not moved.
+                    if info.req.finish("migrate"):
+                        moved += 1
             while self._deferred:
-                self._deferred.popleft().out.put_nowait((_DONE, "migrate"))
-                moved += 1
+                if self._deferred.popleft().finish("migrate"):
+                    moved += 1
             while not self.pending.empty():
-                self.pending.get_nowait().out.put_nowait((_DONE, "migrate"))
-                moved += 1
+                if self.pending.get_nowait().finish("migrate"):
+                    moved += 1
             if not fut.cancelled():
                 fut.set_result(moved)
 
@@ -878,7 +1055,7 @@ class Scheduler:
                     if isinstance(info, _SlotInfo):
                         log.warning(
                             "kv pool exhausted: finishing slot %d early", slot)
-                        info.req.out.put_nowait((_DONE, "length"))
+                        info.req.finish("length")
                         self.slots[slot] = None
                         self.requests_served += 1
                     self.state = await loop.run_in_executor(
@@ -932,7 +1109,7 @@ class Scheduler:
                     if abort is not None:
                         await loop.run_in_executor(self._exec, abort, job)
                     log.warning("ragged admit failed: %s", e)
-                    req.out.put_nowait((_DONE, f"error: {e}"))
+                    req.finish(f"error: {e}")
                 else:
                     # On BaseException _chunking stays set: _loop's
                     # recovery fails the request and resets state.
@@ -962,8 +1139,7 @@ class Scheduler:
                                     repeat_penalty=req.repeat_penalty))
                         except BaseException:
                             self.slots[slot] = None
-                            req.out.put_nowait(
-                                (_DONE, "error: engine failure"))
+                            req.finish("error: engine failure")
                             raise
                         info = _SlotInfo(req=req,
                                          prompt_len=len(req.prompt_ids))
@@ -1029,11 +1205,11 @@ class Scheduler:
                 self._chunking = None
                 self.slots[slot] = None
                 log.warning("chunked admit failed: %s", e)
-                req.out.put_nowait((_DONE, f"error: {e}"))
+                req.finish(f"error: {e}")
             except BaseException:
                 self._chunking = None
                 self.slots[slot] = None
-                req.out.put_nowait((_DONE, "error: engine failure"))
+                req.finish("error: engine failure")
                 raise
             finally:
                 if self._chunking is None:
@@ -1107,14 +1283,14 @@ class Scheduler:
                                 state=self.state))
                 except ValueError as e:
                     log.warning("admit failed: %s", e)
-                    req.out.put_nowait((_DONE, f"error: {e}"))
+                    req.finish(f"error: {e}")
                     continue
                 except BaseException:
                     # Engine failure in prefill_begin (e.g. the prefix-seed
                     # gather): the popped request is in neither slots nor
                     # pending — fail it before the loop's recovery resets
                     # state, or its client waits forever.
-                    req.out.put_nowait((_DONE, "error: engine failure"))
+                    req.finish("error: engine failure")
                     raise
                 self._admitting += 1
                 self._chunking = (req, slot, job)
@@ -1125,13 +1301,13 @@ class Scheduler:
                 await self._admit_one(req, slot)
             except ValueError as e:  # bad request (too long, etc.)
                 log.warning("admit failed: %s", e)
-                req.out.put_nowait((_DONE, f"error: {e}"))
+                req.finish(f"error: {e}")
                 continue
             except BaseException:
                 # Engine failure mid-admission: the popped request is in
                 # neither slots nor pending, so _loop's recovery would miss
                 # it — fail it here, then let the recovery reset state.
-                req.out.put_nowait((_DONE, "error: engine failure"))
+                req.finish("error: engine failure")
                 raise  # the dispatched chunk is dropped; recovery resets state
             finally:
                 self._admitting -= 1
@@ -1167,14 +1343,18 @@ class Scheduler:
         # the device_get above is the one sync this loop already pays.
         gap = (max(0.0, fl.dispatched_at - self._last_retire_at)
                if self._last_retire_at else 0.0)
-        cls = ("ragged_mega" if fl.done_dev is not None and fl.ragged_steps
-               else "megastep" if fl.done_dev is not None
-               else "ragged" if fl.ragged_steps
-               else "spec" if tokens.ndim == 3 else "plain")
+        cls = self._flight_class(fl)
         ENGINE_TELEMETRY.host_gap_seconds.labels(cls).observe(gap)
         duty = dt / max(dt + gap, 1e-9)
         prev = self._duty.get(cls)
         self._duty[cls] = duty if prev is None else 0.9 * prev + 0.1 * duty
+        # Flight-duration EWMA per dispatch class: the self-watchdog's
+        # baseline.  dt is the wall time attributed to waiting on THIS
+        # flight, so a healthy class's EWMA tracks its real cadence and
+        # wedge thresholds scale with megastep K / chunk size instead of
+        # being a global constant.
+        e = self._flight_ewma.get(cls)
+        self._flight_ewma[cls] = dt if e is None else 0.9 * e + 0.1 * dt
         self._last_retire_at = now
         if fl.ragged_steps:
             # Per-chunk prefill latency inside the unified dispatch (the

@@ -96,6 +96,21 @@ class _WorkerDraining(Exception):
         self.delivered_tokens = delivered_tokens
 
 
+class _StreamStalled(Exception):
+    """The worker stopped making token progress past the stall budget
+    while holding the transport OPEN: the gray failure.  There is no EOF
+    and no error frame to react to — only the per-stream progress
+    watchdog (``--stream-stall-ms``, docs/ROBUSTNESS.md) notices.
+    _route tears the stream down, quarantines the worker as ``wedged``
+    (it may still answer health probes) and fails the stream over."""
+
+    def __init__(self, worker_id: str, phase: str):
+        super().__init__(
+            f"worker {worker_id[:8]} stalled (no {phase} progress)")
+        self.worker_id = worker_id
+        self.phase = phase  # "ttft" | "decode"
+
+
 class _StreamCtx:
     """Client-side state of ONE streamed response, surviving failover.
 
@@ -104,7 +119,7 @@ class _StreamCtx:
     the OpenAI envelope state (rid/created/chunk ordinal) stays stable so
     a failover does not re-send the role delta or change the stream id."""
 
-    __slots__ = ("out", "sent_text", "rid", "created", "nth")
+    __slots__ = ("out", "sent_text", "rid", "created", "nth", "winner")
 
     def __init__(self, shape: str):
         self.out: web.StreamResponse | None = None
@@ -113,6 +128,9 @@ class _StreamCtx:
             + os.urandom(12).hex()
         self.created = int(time.time())
         self.nth = 0
+        # Hedged dispatch: the worker that actually served the stream
+        # (may differ from the one _route picked when the hedge won).
+        self.winner = ""
 
 
 class Gateway:
@@ -123,6 +141,7 @@ class Gateway:
                  gossip=None, tenant_quotas=None, flight_recorder: int = 32,
                  trace_ttl: float = 0.0, metrics_exemplars: bool = False,
                  slo_ttft_ms: float = 0.0, slo_decode_ms: float = 0.0,
+                 stream_stall_ms: float = 0.0, hedge_ttft_ms: float = 0.0,
                  profile_dir: str = ""):
         self.peer = peer
         self.port = port
@@ -280,7 +299,22 @@ class Gateway:
         # admission cap + worker "overloaded" rejections), and wall-clock
         # budget exhaustions.
         self._robust = {"failovers": 0, "replayed_chunks": 0, "shed": 0,
-                        "budget_exhausted": 0}
+                        "budget_exhausted": 0,
+                        # Gray-failure immunity (docs/ROBUSTNESS.md):
+                        # streams torn down by the progress watchdog,
+                        # workers quarantined as wedged for it, and the
+                        # hedged-dispatch exactly-once ledger (launched ==
+                        # won + cancelled, asserted by the chaos soak).
+                        "stalled_streams": 0, "wedge_quarantines": 0,
+                        "hedge_launched": 0, "hedge_won": 0,
+                        "hedge_cancelled": 0}
+        # Per-stream progress watchdog + hedged first-token dispatch
+        # (docs/ROBUSTNESS.md): both default OFF; the live SLO objectives
+        # raise the stall budget, the live TTFT p95 raises the hedge
+        # threshold, so neither knob can fire tighter than the swarm's
+        # actual promised/observed latency.
+        self.stream_stall_ms = max(0.0, float(stream_stall_ms))
+        self.hedge_ttft_ms = max(0.0, float(hedge_ttft_ms))
         # Prefix-affinity routing: multi-turn chats replay their history
         # verbatim, so turn N shares its leading tokens with turn 1 — the
         # engine's automatic prefix cache only pays if the continuation
@@ -980,6 +1014,30 @@ class Gateway:
         lines.append(
             f"crowdllama_gateway_pool_evicted_dead_total "
             f"{self._stream_pool.evicted_dead}")
+        # Gray-failure immunity plane (docs/ROBUSTNESS.md): stalled-stream
+        # watchdog teardowns, wedged-worker quarantines, and the hedged
+        # first-token dispatch ledger (launched == won + cancelled is the
+        # exactly-once conservation law the chaos soak asserts).
+        lines.append(
+            "# TYPE crowdllama_stall_aborted_streams_total counter")
+        lines.append(
+            f"crowdllama_stall_aborted_streams_total "
+            f"{self._robust['stalled_streams']}")
+        lines.append("# TYPE crowdllama_wedge_quarantines_total counter")
+        lines.append(
+            f"crowdllama_wedge_quarantines_total "
+            f"{self._robust['wedge_quarantines']}")
+        lines.append("# TYPE crowdllama_hedge_launched_total counter")
+        lines.append(
+            f"crowdllama_hedge_launched_total "
+            f"{self._robust['hedge_launched']}")
+        lines.append("# TYPE crowdllama_hedge_won_total counter")
+        lines.append(
+            f"crowdllama_hedge_won_total {self._robust['hedge_won']}")
+        lines.append("# TYPE crowdllama_hedge_cancelled_total counter")
+        lines.append(
+            f"crowdllama_hedge_cancelled_total "
+            f"{self._robust['hedge_cancelled']}")
         # Request hot-path CPU attribution (ISSUE 1 tentpole d): cumulative
         # microseconds per phase; rate(phase)/rate(requests) is the
         # per-request cost.  aead_us is process-wide (net/secure.py).
@@ -1625,19 +1683,24 @@ class Gateway:
                     resp = await self._forward(request, worker.peer_id, msg,
                                                stream, shape, t0, acc=acc,
                                                ctx=sctx, deadline=deadline)
-                    self._affinity_put(akey, worker.peer_id)
-                    if drained_worker and drained_worker != worker.peer_id:
+                    # Hedged dispatch may have delivered the stream from a
+                    # different worker than the one routing picked — pin
+                    # the affinity (and attribute the trace) to whoever
+                    # actually produced the tokens.
+                    winner_id = sctx.winner or worker.peer_id
+                    self._affinity_put(akey, winner_id)
+                    if drained_worker and drained_worker != winner_id:
                         # Every conversation pinned to the drained worker
                         # re-points to the successor that absorbed the
                         # handoff (satellite: affinity hygiene).
                         self._affinity_drop_worker(drained_worker,
-                                                   successor=worker.peer_id)
-                    if used_affinity:
+                                                   successor=winner_id)
+                    if used_affinity and winner_id == worker.peer_id:
                         # Counted only when the pinned route actually
                         # served: a failed forward falls back to scoring
                         # and must not inflate the hit counter.
                         self._affinity_hits += 1
-                    served_by = worker.peer_id
+                    served_by = winner_id
                     status = resp.status
                     return resp
                 except _StreamStarted as e:
@@ -1646,12 +1709,13 @@ class Gateway:
                     # response — nobody is listening.  The prefill still
                     # populated this worker's prefix cache, so the
                     # affinity record stays useful.
-                    self._affinity_put(akey, worker.peer_id)
-                    if used_affinity:
+                    winner_id = sctx.winner or worker.peer_id
+                    self._affinity_put(akey, winner_id)
+                    if used_affinity and winner_id == worker.peer_id:
                         self._affinity_hits += 1
                     log.warning("stream to client aborted mid-flight: %s",
                                 e.cause)
-                    served_by = worker.peer_id
+                    served_by = winner_id
                     status = e.response.status
                     return e.response
                 except _BudgetExhausted as e:
@@ -1687,6 +1751,30 @@ class Gateway:
                         "worker %s draining; re-routing with KV handoff "
                         "(mid_stream=%s, delivered_tokens=%d)",
                         e.worker_id[:8], e.migrated, e.delivered_tokens)
+                except _StreamStalled as e:
+                    # GRAY FAILURE: the worker holds the transport open
+                    # but stopped producing frames past the stall budget.
+                    # Unlike a crash there is no EOF — the watchdog turns
+                    # silence into an actionable death: quarantine the
+                    # worker as WEDGED (it may still answer health
+                    # probes, so an ordinary probe would never evict it)
+                    # and fail the stream over like any worker death.
+                    last_err = str(e)
+                    self._robust["stalled_streams"] += 1
+                    pm = self.peer.peer_manager
+                    mark = getattr(pm, "mark_draining", None)
+                    if mark is not None and mark(e.worker_id,
+                                                 reason="wedged"):
+                        self._robust["wedge_quarantines"] += 1
+                    self.obs.trace.record(
+                        tid, "wedged", 0, parent=GATEWAY_ROOT_SPAN,
+                        worker=e.worker_id[:8], phase=e.phase)
+                    prev_worker = e.worker_id
+                    died_at = time.monotonic()
+                    log.warning(
+                        "worker %s stalled (%s phase); quarantined as "
+                        "wedged, failing stream over", e.worker_id[:8],
+                        e.phase)
                 except Exception as e:
                     # Worker-side failure (pre- OR mid-stream): eligible
                     # for retry/failover on the next-best worker.
@@ -1797,6 +1885,12 @@ class Gateway:
                 reasons.append("failover")
             if "migrate" in names:
                 reasons.append("migrate")
+            if "wedged" in names:
+                # A gray failure the progress watchdog converted into a
+                # failover: the stitched trace shows WHERE the stream
+                # stalled (ttft vs decode) and which worker was
+                # quarantined (docs/ROBUSTNESS.md).
+                reasons.append("wedged")
             if "kv_hint" in names:
                 # Candidate only: kept iff the stitched worker fragment
                 # shows the donor fetch actually fell back.
@@ -1884,6 +1978,221 @@ class Gateway:
             pass
         return out
 
+    # ------------------------------------- gray-failure immunity plane
+
+    def _stall_budget(self, phase: str) -> float:
+        """Seconds of token-progress silence tolerated in ``phase``
+        ("ttft" | "decode") before the stream is declared stalled
+        (0.0 = watchdog off).  The live SLO objective raises the floor:
+        a stall deadline must never be tighter than the latency the
+        operator promised clients for the same phase."""
+        if self.stream_stall_ms <= 0:
+            return 0.0
+        ms = self.stream_stall_ms
+        tr = self.slo.trackers.get(phase)
+        if tr is not None and tr.objective_ms > ms:
+            ms = tr.objective_ms
+        return ms / 1000.0
+
+    def _hedge_threshold(self) -> float:
+        """Seconds of first-token silence before a hedge launches
+        (0.0 = hedging off).  The LIVE TTFT p95 raises the configured
+        floor once the histogram has enough mass (same observation floor
+        the flight recorder uses), falling back to the SLO TTFT
+        objective — so "slow" always means slow RELATIVE TO THE SWARM,
+        and a uniformly slow model does not trigger a hedge storm."""
+        if self.hedge_ttft_ms <= 0:
+            return 0.0
+        thr = self.hedge_ttft_ms / 1000.0
+        hist = self.obs.metrics.ttft_seconds
+        if hist.count >= self._flight_min_count:
+            thr = max(thr, hist.quantile(0.95))
+        else:
+            tr = self.slo.trackers.get("ttft")
+            if tr is not None:
+                thr = max(thr, tr.objective_ms / 1000.0)
+        return thr
+
+    def _classify_frame(self, raw, worker_id: str):
+        """Decode one inference-stream frame, surfacing drain/handoff
+        frames as _WorkerDraining so _route re-routes with the drained
+        worker attached as KV donor (checked BEFORE the generate
+        extraction: a MigrateFrame is a different oneof arm)."""
+        if raw.WhichOneof("message") == "migrate_frame":
+            mf = raw.migrate_frame
+            raise _WorkerDraining(worker_id, migrated=True,
+                                  delivered_tokens=mf.delivered_tokens)
+        resp = extract_generate_response(raw)
+        if resp.done and resp.done_reason == "draining":
+            raise _WorkerDraining(worker_id)
+        return resp
+
+    async def _open_stream(self, worker_id: str, msg, frame: bytes,
+                           deadline: float | None, stall_ttft: float,
+                           acc: dict, use_pool: bool = True):
+        """Open an inference stream to ``worker_id``, send the encoded
+        ``frame`` and read the FIRST response frame; returns
+        ``(stream, first_resp)`` with the caller owning the stream.
+
+        Pooled stream first (a stale one — worker idled it out or
+        restarted — gets ONE fresh redial), fresh dial otherwise.  Every
+        receive is clamped to ``stall_ttft`` when the progress watchdog
+        is armed: a worker that accepted the request and went silent
+        surfaces as _StreamStalled rather than a redial — a second dial
+        would burn another full stall budget on the same wedged worker.
+        Cancellation (hedge race lost) closes the stream before any of
+        its frames can reach a client."""
+        def remaining() -> float:
+            return (deadline - time.monotonic()) if deadline is not None \
+                else 600.0
+
+        def _recv_timeout() -> float:
+            t = max(0.05, min(600.0, remaining()))
+            return min(t, stall_ttft) if stall_ttft > 0 else t
+
+        s = self._pool_get(worker_id) if use_pool else None
+        if s is not None:
+            try:
+                await self._send_frame(s, frame, acc=acc)
+                return s, self._classify_frame(
+                    await self._recv_pb(s, timeout=_recv_timeout(),
+                                        acc=acc), worker_id)
+            except (asyncio.CancelledError, _WorkerDraining):
+                # A draining reject is a DELIBERATE answer, not a stale
+                # pooled stream: no redial (it would get the same
+                # reject).  A cancel means the hedge race was lost.
+                s.close()
+                raise
+            except asyncio.TimeoutError as e:
+                s.close()
+                if remaining() <= 0:
+                    raise _BudgetExhausted(
+                        "budget exhausted on pooled attempt") from e
+                if stall_ttft > 0:
+                    raise _StreamStalled(worker_id, "ttft") from e
+                raise
+            except Exception as e:
+                s.close()
+                if remaining() <= 0:
+                    raise _BudgetExhausted(
+                        "budget exhausted on pooled attempt") from e
+                log.debug("pooled stream to %s stale (%s); redialing",
+                          worker_id[:8], e)
+        s = await self._dial(worker_id, acc=acc,
+                             timeout=(remaining()
+                                      if deadline is not None else None),
+                             trace_id=msg.trace_id)
+        try:
+            await self._send_frame(s, frame, acc=acc)
+            return s, self._classify_frame(
+                await self._recv_pb(s, timeout=_recv_timeout(), acc=acc),
+                worker_id)
+        except BaseException as e:
+            s.close()
+            if (isinstance(e, (asyncio.TimeoutError, OSError))
+                    and remaining() <= 0):
+                raise _BudgetExhausted(
+                    "budget exhausted during dial/first frame") from e
+            if isinstance(e, asyncio.TimeoutError) and stall_ttft > 0:
+                raise _StreamStalled(worker_id, "ttft") from e
+            raise
+
+    async def _hedge_race(self, primary_id: str, msg, frame: bytes,
+                          deadline: float | None, stall_ttft: float,
+                          acc: dict, hedge_thr: float):
+        """Hedged first-token dispatch (docs/ROBUSTNESS.md): give the
+        primary worker ``hedge_thr`` seconds to produce a first frame;
+        past it, speculatively dispatch the SAME request to the
+        second-best worker and deliver whichever stream wins the race.
+
+        EXACTLY-ONCE: _open_stream returns at the first frame — nothing
+        reaches the client until a single winner is chosen, and every
+        loser is cancelled/closed before its first byte could be
+        written.  Counter conservation (asserted by the chaos soak):
+        hedge_launched == hedge_won + hedge_cancelled.
+
+        Returns ``(stream, first_resp, winner_worker_id)``."""
+        tid = msg.trace_id
+        p_task = asyncio.ensure_future(self._open_stream(
+            primary_id, msg, frame, deadline, stall_ttft, acc))
+        tasks: dict[asyncio.Task, str] = {p_task: primary_id}
+        launched = False
+        try:
+            done, _ = await asyncio.wait({p_task}, timeout=hedge_thr)
+            if not done:
+                # First token is late relative to the swarm: launch the
+                # hedge on the next-best worker.  Never pooled — the
+                # pool hands out per-worker streams, but this request
+                # may be abandoned mid-frame by a cancel, which poisons
+                # a reusable transport.
+                alt = self._find_worker(msg.generate_request.model,
+                                        exclude={primary_id}, acc=acc)
+                if alt is not None:
+                    launched = True
+                    self._robust["hedge_launched"] += 1
+                    self.obs.trace.record(
+                        tid, "hedge", 0, parent=GATEWAY_ROOT_SPAN,
+                        primary=primary_id[:8], hedge=alt.peer_id[:8])
+                    tasks[asyncio.ensure_future(self._open_stream(
+                        alt.peer_id, msg, frame, deadline, stall_ttft,
+                        acc, use_pool=False))] = alt.peer_id
+            winner = None
+            primary_err: BaseException | None = None
+            while tasks and winner is None:
+                done, _ = await asyncio.wait(
+                    set(tasks), return_when=asyncio.FIRST_COMPLETED)
+                for t in done:
+                    wid = tasks.pop(t)
+                    err = t.exception()
+                    if err is None:
+                        if winner is None:
+                            winner = (await t, wid)
+                        else:
+                            # Two first frames landed in the same wait
+                            # round: the second is a loser like any
+                            # other — close before any byte escapes.
+                            (await t)[0].close()
+                        continue
+                    if isinstance(err, _WorkerDraining):
+                        # A loser's drain announcement must still
+                        # quarantine it — the observation is real even
+                        # though the race discards the attempt.
+                        pm = self.peer.peer_manager
+                        mark = getattr(pm, "mark_draining", None)
+                        if mark is not None:
+                            mark(err.worker_id)
+                    if wid == primary_id:
+                        primary_err = err
+            if winner is None:
+                # Both sides failed: the hedge did not win, account it
+                # as cancelled (conservation) and surface the PRIMARY's
+                # error so _route's ladder sees the same failure mode an
+                # unhedged attempt would have produced.
+                if launched:
+                    self._robust["hedge_cancelled"] += 1
+                if primary_err is not None:
+                    raise primary_err
+                raise RuntimeError("hedged dispatch failed on every leg")
+            (s, first_resp), wid = winner
+            if launched:
+                if wid == primary_id:
+                    self._robust["hedge_cancelled"] += 1
+                else:
+                    self._robust["hedge_won"] += 1
+            return s, first_resp, wid
+        finally:
+            # Tear down every leg still racing — the loser BEFORE its
+            # first byte reaches the client — and reap a straggler that
+            # completed between the winner landing and the cancel.
+            for t in tasks:
+                t.cancel()
+            if tasks:
+                reaped = await asyncio.gather(*tasks,
+                                              return_exceptions=True)
+                for r in reaped:
+                    if isinstance(r, tuple):
+                        r[0].close()
+
     async def _forward(self, request, worker_id: str, msg, stream: bool,
                        shape: str, t0: float,
                        acc: dict | None = None,
@@ -1913,8 +2222,9 @@ class Gateway:
             return (deadline - time.monotonic()) if deadline is not None \
                 else 600.0
 
-        def _recv_timeout() -> float:
-            return max(0.05, min(600.0, remaining()))
+        def _recv_timeout(stall: float = 0.0) -> float:
+            t = max(0.05, min(600.0, remaining()))
+            return min(t, stall) if stall > 0 else t
 
         def render(resp, final: bool) -> dict:
             if openai:
@@ -1925,18 +2235,9 @@ class Gateway:
             return self._ollama_json(resp, shape == "chat", final=final)
 
         def classify(raw):
-            """Decode one inference-stream frame, surfacing drain/handoff
-            frames as _WorkerDraining so _route re-routes with the drained
-            worker attached as KV donor (checked BEFORE the generate
-            extraction: a MigrateFrame is a different oneof arm)."""
-            if raw.WhichOneof("message") == "migrate_frame":
-                mf = raw.migrate_frame
-                raise _WorkerDraining(worker_id, migrated=True,
-                                      delivered_tokens=mf.delivered_tokens)
-            resp = extract_generate_response(raw)
-            if resp.done and resp.done_reason == "draining":
-                raise _WorkerDraining(worker_id)
-            return resp
+            # Late-bound worker_id on purpose: a hedge win reassigns it
+            # to the worker actually serving the decode loop.
+            return self._classify_frame(raw, worker_id)
 
         if not stream:
             resp = classify(await self._roundtrip(
@@ -1946,49 +2247,31 @@ class Gateway:
             return web.json_response(render(resp, final=True))
 
         # Streamed: one NDJSON line (Ollama) or SSE data event (OpenAI)
-        # per chunk.  Read the FIRST frame before sending headers, so a
-        # worker that dies immediately is still retryable by _route — and
-        # so a STALE pooled stream is detected while a fresh redial is
-        # still possible.
+        # per chunk.  The FIRST frame is read before sending headers
+        # (_open_stream), so a worker that dies immediately is still
+        # retryable by _route — and a STALE pooled stream is detected
+        # while a fresh redial is still possible.  When the per-stream
+        # progress watchdog is armed, every receive below is clamped to
+        # the phase's stall budget: a worker holding the transport open
+        # without producing frames surfaces as _StreamStalled instead of
+        # hanging until the request budget dies (docs/ROBUSTNESS.md).
+        stall_ttft = self._stall_budget("ttft")
+        stall_decode = self._stall_budget("decode")
         if remaining() <= 0:
             raise _BudgetExhausted("budget exhausted before dial")
         frame = self._encode_frame(msg, acc=acc)
-        s = self._pool_get(worker_id)
-        first = None
-        if s is not None:
-            try:
-                await self._send_frame(s, frame, acc=acc)
-                first = classify(
-                    await self._recv_pb(s, timeout=_recv_timeout(), acc=acc))
-            except (asyncio.CancelledError, _WorkerDraining):
-                # A draining reject is a DELIBERATE answer, not a stale
-                # pooled stream: no redial (it would get the same reject).
-                s.close()
-                raise
-            except Exception as e:
-                s.close()
-                s = None
-                if remaining() <= 0:
-                    raise _BudgetExhausted(
-                        "budget exhausted on pooled attempt") from e
-                log.debug("pooled stream to %s stale (%s); redialing",
-                          worker_id[:8], e)
-        if s is None:
-            s = await self._dial(worker_id, acc=acc,
-                                 timeout=(remaining()
-                                          if deadline is not None else None),
-                                 trace_id=msg.trace_id)
-            try:
-                await self._send_frame(s, frame, acc=acc)
-                first = classify(
-                    await self._recv_pb(s, timeout=_recv_timeout(), acc=acc))
-            except BaseException as e:
-                s.close()
-                if (isinstance(e, (asyncio.TimeoutError, OSError))
-                        and remaining() <= 0):
-                    raise _BudgetExhausted(
-                        "budget exhausted during dial/first frame") from e
-                raise
+        # Hedged first-token dispatch: only on the FIRST attempt of a
+        # stream — a failover replay already has client bytes out, and
+        # failover itself covers that tail.
+        hedge_thr = self._hedge_threshold() if ctx.out is None else 0.0
+        if hedge_thr > 0:
+            s, first, worker_id = await self._hedge_race(
+                worker_id, msg, frame, deadline, stall_ttft, acc,
+                hedge_thr)
+        else:
+            s, first = await self._open_stream(
+                worker_id, msg, frame, deadline, stall_ttft, acc)
+        ctx.winner = worker_id
         # Pool the stream back only after the worker's terminal frame was
         # READ (a mid-response abort leaves frames in flight — closing is
         # the only safe disposal).
@@ -2067,12 +2350,19 @@ class Gateway:
                     raise _BudgetExhausted("budget exhausted mid-stream")
                 try:
                     resp = classify(
-                        await self._recv_pb(s, timeout=_recv_timeout(),
-                                            acc=acc))
+                        await self._recv_pb(
+                            s, timeout=_recv_timeout(stall_decode),
+                            acc=acc))
                 except asyncio.TimeoutError as e:
                     if remaining() <= 0:
                         raise _BudgetExhausted(
                             "budget exhausted mid-stream") from e
+                    if stall_decode > 0:
+                        # Mid-decode stall: frames stopped arriving past
+                        # the watchdog budget with the transport still
+                        # open — tear down and fail over (the replay
+                        # trim resumes the client byte-identically).
+                        raise _StreamStalled(worker_id, "decode") from e
                     raise
                 t_now = time.perf_counter_ns()
                 self.obs.metrics.decode_step_seconds.observe(

@@ -465,13 +465,20 @@ class Peer:
 
         Idempotent (SIGTERM and POST /drain may race).  Order matters:
 
-        1. advertised metadata flips to ``draining: true`` — gateways that
-           re-probe quarantine us from routing snapshots;
-        2. the publish/advertise loops stop and ONE forced metadata
-           provide goes out, so the swarm learns about the drain now
-           rather than at the next reprovide tick;
-        3. the engine migrates every in-flight request — each stream gets
-           a MigrateFrame and the gateway re-routes it with this worker
+        1. the engine's migration is REQUESTED first — the scheduler
+           flips to draining and claims every in-flight stream at its
+           next safe point (within one decode dispatch).  Requesting it
+           after the network advertising below raced stream completion:
+           the forced DHT provide can take seconds, long enough for a
+           short stream to finish with ``"stop"`` on this worker instead
+           of migrating (the drain-vs-completion race the claim-or-skip
+           safe point closes from the scheduler side);
+        2. advertised metadata flips to ``draining: true`` and ONE forced
+           metadata provide goes out while the migration completes, so
+           gateways that re-probe quarantine us now rather than at the
+           next reprovide tick;
+        3. the migration result is awaited — each claimed stream got a
+           MigrateFrame and the gateway re-routes it with this worker
            attached as KV donor.
 
         New GenerateRequests are rejected with a ``draining`` terminal
@@ -487,6 +494,7 @@ class Peer:
         if self.obs is not None:
             self.obs.metrics.drain_inc("initiated")
         t0 = time.perf_counter_ns()
+        migrating = asyncio.ensure_future(self.engine.migrate())
         await self.stop_advertising()
         if self.dht is not None and self.host is not None:
             try:
@@ -499,7 +507,7 @@ class Peer:
                                      min_interval=0), timeout=5.0)
             except Exception as e:
                 log.warning("drain metadata publish failed: %s", e)
-        migrated = await self.engine.migrate()
+        migrated = await migrating
         if self.obs is not None:
             self.obs.trace.record(
                 f"drain-{self.peer_id[:8]}", "drain",
@@ -710,8 +718,23 @@ class Peer:
                         parent=msg.parent_span)
             return True
         except Exception as e:
-            from crowdllama_tpu.testing.faults import KillStream
+            from crowdllama_tpu.testing.faults import KillStream, StallStream
 
+            if isinstance(e, StallStream):
+                # Injected gray failure (testing/faults.py): the transport
+                # stays OPEN but nothing is ever written again — no EOF, no
+                # error frame.  From the gateway this is a worker that
+                # wedged mid-stream; only its per-stream progress watchdog
+                # (--stream-stall-ms) can notice.  Park until the gateway
+                # gives up and closes its end (reader EOF), then drop out.
+                log.warning("fault injection stalled inference stream: %s", e)
+                try:
+                    await asyncio.wait_for(stream.reader.read(),
+                                           timeout=600.0)
+                except Exception:
+                    pass
+                stream.close()
+                return False
             if isinstance(e, KillStream):
                 # Injected worker death (testing/faults.py): drop the
                 # transport with NO error frame — from the gateway this is

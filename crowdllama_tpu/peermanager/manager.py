@@ -196,7 +196,7 @@ class PeerManager:
         if info is not None:
             info.last_seen = time.monotonic()
 
-    def mark_draining(self, peer_id: str) -> bool:
+    def mark_draining(self, peer_id: str, reason: str = "drain") -> bool:
         """Quarantine ``peer_id`` from routing IMMEDIATELY (epoch bump).
 
         Called by the gateway the moment it sees a MigrateFrame or a
@@ -204,11 +204,19 @@ class PeerManager:
         final publish + our next health probe) confirms it within an
         interval, but new requests must stop landing on the worker NOW,
         not a probe later.  The peer stays in the table (healthy, still a
-        KV donor); only the routing snapshot excludes it."""
+        KV donor); only the routing snapshot excludes it.
+
+        ``reason`` records WHY the quarantine happened: ``"drain"`` for
+        an announced graceful handoff, ``"wedged"`` when the gateway's
+        per-stream progress watchdog caught a gray failure — a worker
+        that still answers health probes but stopped making token
+        progress, which the ordinary probe plane would never evict
+        (docs/ROBUSTNESS.md)."""
         info = self.peers.get(peer_id)
         if info is None or getattr(info.resource, "draining", False):
             return False
         info.resource.draining = True
+        info.resource.draining_reason = reason
         self._bump_routing_epoch()
         if self.on_draining is not None:
             try:

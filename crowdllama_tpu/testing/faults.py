@@ -57,6 +57,15 @@ Actions:
   arrived mid-stream, or mid-chunked-prefill) and the request continues
   until the scheduler hands it off with a MigrateFrame — the chaos
   trigger for live request migration (docs/ROBUSTNESS.md).
+- ``"stall_stream"`` — raise :class:`StallStream`.  The worker's serve
+  loop holds the transport OPEN but never writes another frame: the gray
+  failure.  Unlike ``kill_stream`` there is no EOF to react to — only the
+  gateway's per-stream progress watchdog (``--stream-stall-ms``,
+  docs/ROBUSTNESS.md) notices, tears the stream down, and fails over.
+- ``"slow_stream"`` — ``asyncio.sleep(delay_s + seeded jitter)`` then
+  continue, like ``delay`` but intended with ``times=0`` on a stream
+  site: every chunk is paced, modeling a worker decoding at a fraction
+  of its normal speed (the second gray-failure shape).
 
 Usage::
 
@@ -113,6 +122,12 @@ class DrainRequested(FaultError):
     keeps streaming until the scheduler migrates the request."""
 
 
+class StallStream(FaultError):
+    """Injected gray failure: the serving side must hold the transport
+    open but never write another frame — no EOF, no error, just silence.
+    Only a progress watchdog on the consuming side can detect it."""
+
+
 @dataclass
 class FaultRule:
     """One deterministic trigger: fires at pass index >= ``after`` through
@@ -120,7 +135,9 @@ class FaultRule:
     most ``times`` times (0 = unlimited)."""
 
     site: str
-    action: str = "error"  # "error" | "kill_stream" | "delay" | "drain"
+    # "error" | "kill_stream" | "delay" | "drain" | "stall_stream"
+    # | "slow_stream"
+    action: str = "error"
     match: dict = field(default_factory=dict)
     after: int = 0
     times: int = 1
@@ -137,7 +154,8 @@ class FaultRule:
                 f"unknown fault site {self.site!r} — registered sites: "
                 f"{', '.join(sorted(FAULT_SITES))} (see FAULT_SITES in "
                 "testing/faults.py; a typo here would silently never fire)")
-        if self.action not in ("error", "kill_stream", "delay", "drain"):
+        if self.action not in ("error", "kill_stream", "delay", "drain",
+                               "stall_stream", "slow_stream"):
             raise ValueError(f"unknown fault action {self.action!r}")
 
 
@@ -176,7 +194,7 @@ class FaultPlan:
                 continue
             rule.fired += 1
             self.log.append((site, dict(attrs), rule.action))
-            if rule.action == "delay":
+            if rule.action in ("delay", "slow_stream"):
                 jitter = (self._rng.uniform(0, rule.jitter_s)
                           if rule.jitter_s else 0.0)
                 await asyncio.sleep(rule.delay_s + jitter)
@@ -184,6 +202,8 @@ class FaultPlan:
                 raise KillStream(f"{rule.message} @ {site}")
             elif rule.action == "drain":
                 raise DrainRequested(f"{rule.message} @ {site}")
+            elif rule.action == "stall_stream":
+                raise StallStream(f"{rule.message} @ {site}")
             else:
                 raise FaultError(f"{rule.message} @ {site}")
 

@@ -161,14 +161,20 @@ async def test_midstream_worker_kill_failover_byte_identical():
         await teardown()
 
 
-async def test_midstream_kill_replays_deterministically():
-    """The same seeded plan, reset and re-run, kills at the same chunk and
-    heals the same way — chaos scenarios are replayable, not flaky."""
-    workers, consumer, gateway, gw_port, teardown = await _topology(2)
+async def test_midstream_stall_replays_deterministically():
+    """The same seeded plan, reset and re-run, STALLS at the same chunk
+    and heals the same way — gray-failure chaos scenarios are replayable,
+    not flaky.  Unlike kill_stream there is no EOF: only the gateway's
+    per-stream progress watchdog (--stream-stall-ms) notices the silence,
+    tears the stream down and fails it over.  Three workers because each
+    run quarantines the stalled one as wedged — run two must still have a
+    failover target left."""
+    workers, consumer, gateway, gw_port, teardown = await _topology(
+        3, stream_stall_ms=400)
     try:
         url = f"http://127.0.0.1:{gw_port}/api/chat"
         plan = FaultPlan(seed=7, rules=[
-            FaultRule(site="engine.stream_chunk", action="kill_stream",
+            FaultRule(site="engine.stream_chunk", action="stall_stream",
                       after=2, times=1)])
         texts, logs = [], []
         async with aiohttp.ClientSession() as s:
@@ -183,8 +189,10 @@ async def test_midstream_kill_replays_deterministically():
                              for site, a, action in plan.log])
         assert texts[0] == texts[1]
         assert logs[0] == logs[1] == [("engine.stream_chunk", 2,
-                                       "kill_stream")]
+                                       "stall_stream")]
         assert gateway._robust["failovers"] == 2
+        assert gateway._robust["stalled_streams"] == 2
+        assert gateway._robust["wedge_quarantines"] == 2
     finally:
         await teardown()
 

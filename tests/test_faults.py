@@ -109,6 +109,43 @@ async def test_drain_action_raises_drain_requested():
     assert issubclass(faults.DrainRequested, FaultError)
 
 
+async def test_stall_stream_action_raises_stall_stream():
+    """The "stall_stream" action (gray-failure chaos trigger) raises the
+    typed StallStream — the serving side catches it BY TYPE and holds the
+    transport open without writing another frame, so the only detector
+    is the consuming side's progress watchdog (docs/ROBUSTNESS.md)."""
+    plan = FaultPlan(rules=[
+        FaultRule(site="engine.stream_chunk", action="stall_stream",
+                  after=2, times=1)])
+    await plan.inject("engine.stream_chunk", index=0)
+    await plan.inject("engine.stream_chunk", index=1)
+    with pytest.raises(faults.StallStream):
+        await plan.inject("engine.stream_chunk", index=2)
+    # times=1: spent — the failover replay streams through undisturbed.
+    await plan.inject("engine.stream_chunk", index=3)
+    assert [(s, a) for (s, _, a) in plan.log] == [
+        ("engine.stream_chunk", "stall_stream")]
+    assert issubclass(faults.StallStream, FaultError)
+
+
+async def test_slow_stream_action_paces_every_chunk():
+    """"slow_stream" with times=0 paces EVERY pass through the site
+    (seeded jitter on top of delay_s) and never raises — the second
+    gray-failure shape: a worker decoding at a fraction of its speed."""
+    plan = FaultPlan(seed=5, rules=[
+        FaultRule(site="engine.stream_chunk", action="slow_stream",
+                  delay_s=0.0, jitter_s=0.005, times=0)])
+    for i in range(3):
+        await plan.inject("engine.stream_chunk", index=i)
+    assert [a for (_, _, a) in plan.log] == ["slow_stream"] * 3
+    # Seeded: a reset plan draws the same jitter sequence.
+    rng_draws = [plan._rng.random() for _ in range(2)]
+    plan.reset()
+    for i in range(3):
+        await plan.inject("engine.stream_chunk", index=i)
+    assert [plan._rng.random() for _ in range(2)] == rng_draws
+
+
 async def test_unknown_site_rejected_at_plan_build():
     """FAULT_SITES is the registry of instrumented choke points; a typo'd
     site in a chaos test must fail at FaultRule construction — not
