@@ -6,7 +6,7 @@ PYTEST = env JAX_PLATFORMS=cpu $(PY) -m pytest -p no:cacheprovider
 
 .PHONY: test tier1 lint chaos chaos-multi-gateway chaos-soak \
 	distill-smoke bench-kv bench-mixed bench-megastep bench-fused \
-	bench-autopilot trace-demo obs-demo
+	bench-autopilot bench-swarm trace-demo obs-demo
 
 # Full suite (slow soaks included).  Runs lint + the chaos matrix FIRST:
 # swarmlint finishes in seconds and the fault-injection scenarios are the
@@ -106,6 +106,13 @@ bench-fused:
 # shapes under grid-search-best static dials vs the autotuner walking
 # from defaults — steps/sec ratio, moves-to-converge, dial trajectory
 # (artifact: benchmarks/results/AUTOTUNE_cpu_*.json).
+# Native data-plane arms (docs/NATIVE.md): the swarm_scaling phase run
+# twice — native fast path vs CROWDLLAMA_NO_NATIVE=1 — one subprocess
+# per arm; writes benchmarks/results/SWARM_SCALING_cpu_<date>.json with
+# req/s, cpu_us_per_request, loop lag, and the serde+aead share per arm.
+bench-swarm:
+	env JAX_PLATFORMS=cpu $(PY) benchmarks/swarm_scaling.py --arms
+
 bench-autopilot:
 	env JAX_PLATFORMS=cpu CROWDLLAMA_BENCH_PHASES=autopilot \
 		$(PY) bench.py

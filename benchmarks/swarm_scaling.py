@@ -248,19 +248,148 @@ async def run() -> dict:
         (t for t in reversed(gateway.obs.trace.snapshot()["traces"])
          if t["done"]), None)
 
+    from crowdllama_tpu import native
+
     return {
         "metric": f"swarm scaling 1->{sizes[-1]} workers, gateway requests/sec",
         "value": curve[-1]["requests_per_sec"],
         "unit": "requests/sec",
         "vs_baseline": None,  # reference publishes no scaling numbers
         "extra": {"curve": curve, "concurrency": concurrency,
+                  "native_enabled": native.native_enabled(),
+                  "native_fallbacks": dict(native.stats()["fallbacks"]),
                   "trace_sample": trace_sample},
     }
+
+
+def _arm_summary(result: dict) -> dict:
+    """Per-arm digest for the artifact: curve-wide medians plus the
+    serde+aead share the native plane is meant to collapse.
+
+    Medians across swarm sizes, not the single-replica point: on the
+    1-core bench host the per-size numbers jitter by +/-50% (discovery
+    timing, scheduler noise), and the per-request phase costs are roughly
+    size-independent, so the median is the stable estimator.
+
+    ``cpu_us_per_request`` is *process-wide* CPU (the bench runs the
+    gateway, all workers, the boot host AND the load generator in one
+    process), so the gateway replica's own data-plane cost is reported
+    separately as ``gateway_dataplane_us_per_request`` (route+serde+aead
+    from the hot-path attribution) together with the single-replica
+    capacity it implies.
+    """
+    import statistics
+
+    curve = result["extra"]["curve"]
+    med = lambda k: round(statistics.median(p[k] for p in curve), 1)  # noqa: E731
+    dataplane = round(med("route_us") + med("serde_us") + med("aead_us"), 1)
+    return {
+        "native_enabled": result["extra"]["native_enabled"],
+        "requests_per_sec_single_replica": curve[0]["requests_per_sec"],
+        "peak_requests_per_sec": max(p["requests_per_sec"] for p in curve),
+        "cpu_us_per_request_median": med("cpu_us_per_request"),
+        "gateway_dataplane_us_per_request": dataplane,
+        "implied_replica_capacity_req_s": (
+            round(1e6 / dataplane) if dataplane else None),
+        "serde_us": med("serde_us"),
+        "aead_us": med("aead_us"),
+        "route_us": med("route_us"),
+        "loop_lag_max_ms": max(p["loop_lag"]["max_ms"] for p in curve),
+        "request_hist_p95_ms": med("request_hist_p95_ms"),
+        "curve": curve,
+    }
+
+
+def run_arms() -> dict:
+    """Native-vs-CROWDLLAMA_NO_NATIVE=1 arm pair (one subprocess each, so
+    every arm gets a clean library state) -> SWARM_SCALING_cpu_<date>.json."""
+    import subprocess
+
+    from crowdllama_tpu import native
+
+    native.ensure_built()  # native arm must not pay the g++ run mid-bench
+    script = str(Path(__file__).resolve())
+    arms: dict[str, dict] = {}
+    for arm in ("native", "no_native"):
+        env = dict(os.environ)
+        env.setdefault("CROWDLLAMA_TPU_TEST_MODE", "1")
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        if arm == "no_native":
+            env["CROWDLLAMA_NO_NATIVE"] = "1"
+        else:
+            env.pop("CROWDLLAMA_NO_NATIVE", None)
+        print(f"# arm={arm}", file=sys.stderr)
+        proc = subprocess.run(
+            [sys.executable, script], env=env, capture_output=True,
+            text=True, timeout=float(
+                os.environ.get("CROWDLLAMA_BENCH_SUBPROC_TIMEOUT", "900")))
+        sys.stderr.write(proc.stderr)
+        line = next(
+            (ln for ln in reversed(proc.stdout.splitlines())
+             if ln.strip().startswith("{")), None)
+        if line is None:
+            raise RuntimeError(
+                f"arm {arm}: rc={proc.returncode}, no JSON line "
+                f"(stdout tail: {proc.stdout[-300:]!r})")
+        arms[arm] = _arm_summary(json.loads(line))
+
+    nat, py = arms["native"], arms["no_native"]
+    serde_aead_native = round(nat["serde_us"] + nat["aead_us"], 1)
+    serde_aead_python = round(py["serde_us"] + py["aead_us"], 1)
+    artifact = {
+        "metric": "swarm scaling, native vs CROWDLLAMA_NO_NATIVE=1 arms",
+        "unit": "requests/sec",
+        "date": time.strftime("%Y-%m-%d"),
+        "host": {"cpus": os.cpu_count()},
+        "config": {
+            "sizes": os.environ.get("CROWDLLAMA_BENCH_SIZES", "1,2,4,8,16"),
+            "requests_per_size": int(os.environ.get(
+                "CROWDLLAMA_BENCH_REQUESTS", "150")),
+            "concurrency": int(os.environ.get(
+                "CROWDLLAMA_BENCH_CONCURRENCY", "8")),
+        },
+        "note": (
+            "chat-shaped traffic (payloads < wire.NATIVE_ENVELOPE_MIN_BYTES)"
+            " intentionally converges between arms: the size-aware dispatch"
+            " routes tiny envelopes through upb in both, so arm deltas here"
+            " bound host noise; the native wins live on >=4KB payloads"
+            " (KV shipping, long responses) and in the AEAD frame path"),
+        "arms": arms,
+        "comparison": {
+            "serde_aead_us_native": serde_aead_native,
+            "serde_aead_us_python": serde_aead_python,
+            "serde_aead_collapse_x": (
+                round(serde_aead_python / serde_aead_native, 2)
+                if serde_aead_native else None),
+            "dataplane_us_native":
+                nat["gateway_dataplane_us_per_request"],
+            "dataplane_us_python":
+                py["gateway_dataplane_us_per_request"],
+        },
+        "acceptance": {
+            "gateway_dataplane_us_per_request_lt_200":
+                nat["gateway_dataplane_us_per_request"] < 200,
+            "implied_replica_capacity_ge_5k":
+                (nat["implied_replica_capacity_req_s"] or 0) >= 5000,
+        },
+    }
+    out_dir = Path(__file__).resolve().parent / "results"
+    out_dir.mkdir(parents=True, exist_ok=True)
+    out = out_dir / f"SWARM_SCALING_cpu_{artifact['date']}.json"
+    out.write_text(json.dumps(artifact, indent=2) + "\n")
+    print(f"# wrote {out}", file=sys.stderr)
+    return artifact
 
 
 def main() -> None:
     os.environ.setdefault("CROWDLLAMA_TPU_TEST_MODE", "1")
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if "--arms" in sys.argv[1:]:
+        print(json.dumps(run_arms()))
+        return
+    if not os.environ.get("CROWDLLAMA_NO_NATIVE"):
+        from crowdllama_tpu import native
+        native.ensure_built()  # pay the g++ run before the loop starts
     result = asyncio.run(run())
     print(json.dumps(result))
 

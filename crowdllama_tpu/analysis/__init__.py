@@ -13,6 +13,10 @@ Nine PRs of hand-maintained invariants, enforced mechanically:
   the FAULT_SITES registry vs instrumented ``faults.inject`` sites,
   ``crowdllama_*`` metric families vs docs, CLI-flag/env parity in
   config.py.
+- :mod:`.ffi_contract`   — the native C ABI seam: every ``extern "C"``
+  export in ``native/_src`` has a matching ctypes restype/argtypes
+  declaration (and vice versa), with arity and return-type agreement.
+  Zero waivers by policy.
 
 Findings resolve against ``analysis/baseline.toml`` (each waiver carries a
 one-line justification); anything NOT waived fails ``make lint`` and the
@@ -37,12 +41,14 @@ def all_checkers():
     ``import crowdllama_tpu.analysis`` stays cheap."""
     from crowdllama_tpu.analysis.async_hotpath import check_async_hotpath
     from crowdllama_tpu.analysis.contracts import check_contracts
+    from crowdllama_tpu.analysis.ffi_contract import check_ffi_contract
     from crowdllama_tpu.analysis.jax_purity import check_jax_purity
 
     return {
         "async-hotpath": check_async_hotpath,
         "jax-purity": check_jax_purity,
         "contracts": check_contracts,
+        "ffi-contract": check_ffi_contract,
     }
 
 

@@ -56,7 +56,11 @@ CONFIG_ENV_EXEMPT = frozenset({
 
 _FAMILY_RE = re.compile(r"crowdllama_[a-z0-9_]+")
 # Tokens that look like families but are package/protocol identifiers.
-_FAMILY_JUNK_PREFIXES = ("crowdllama_tpu", "crowdllama_native")
+# `crowdllama_native` alone is the shared-library name; the REAL
+# crowdllama_native_* metric families (obs/http.py native_metric_lines)
+# are longer and must stay doc-checked.
+_FAMILY_JUNK_PREFIXES = ("crowdllama_tpu",)
+_FAMILY_JUNK_EXACT = frozenset({"crowdllama_native"})
 
 
 def _read(root: str, rel: str) -> str:
@@ -213,7 +217,8 @@ def collect_metric_families(root: str) -> tuple[set[str], set[str]]:
     prefixes: set[str] = set()
 
     def _add(token: str, dynamic_tail: bool) -> None:
-        if token.startswith(_FAMILY_JUNK_PREFIXES):
+        if token.startswith(_FAMILY_JUNK_PREFIXES) \
+                or token in _FAMILY_JUNK_EXACT:
             return
         # A trailing-underscore token is a family-prefix fragment whether
         # it came from an f-string (f"crowdllama_engine_{key}") or a
@@ -275,7 +280,8 @@ def check_metrics_docs(root: str) -> list[Finding]:
                 f"no `{pref}...` family appears in docs/OBSERVABILITY.md"))
     # Families documented but gone from code: stale docs mislead oncall.
     for tok in sorted(doc_tokens):
-        if tok.startswith(_FAMILY_JUNK_PREFIXES) or tok.endswith("_"):
+        if tok.startswith(_FAMILY_JUNK_PREFIXES) \
+                or tok in _FAMILY_JUNK_EXACT or tok.endswith("_"):
             continue
         base = tok
         for suffix in ("_bucket", "_sum", "_count"):

@@ -1,0 +1,212 @@
+"""ffi-contract checker: the C ABI seam between the native plane and ctypes.
+
+The native data plane (crowdllama_tpu/native/) is a hand-maintained FFI
+contract: every ``extern "C"`` function in ``_src/*.cpp`` must have a
+matching ``lib.<symbol>.restype`` / ``lib.<symbol>.argtypes`` declaration
+in ``native/__init__.py``'s ``_declare``, and every ctypes declaration
+must name a symbol the C++ source actually exports.  ctypes is the one
+place the interpreter will happily smash the stack for you — an argtypes
+list one entry short, or a ``c_int`` restype for a pointer-returning
+function, corrupts memory instead of raising.  This checker makes the two
+sides of the seam fail lint the moment they drift:
+
+``ffi-undeclared``
+    An ``extern "C"`` export with no (or only half of a) restype/argtypes
+    declaration.  Undeclared functions default to ``int`` restype —
+    pointer truncation on 64-bit.
+
+``ffi-unknown-symbol``
+    A ctypes declaration for a symbol the C++ source does not export —
+    either a typo (the call would raise AttributeError at runtime) or a
+    declaration left behind after the C function was removed.
+
+``ffi-arity``
+    ``len(argtypes)`` differs from the C parameter count — the classic
+    silent-stack-garbage bug.
+
+``ffi-restype``
+    The declared restype disagrees with the C return type (void / void* /
+    integer widths), the pointer-truncation bug class.
+
+Zero waivers by policy: there is no legitimate reason for the two sides
+of an ABI to disagree.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from crowdllama_tpu.analysis.base import Finding, dotted_name
+
+CHECKER = "ffi-contract"
+
+CPP_DIR = "crowdllama_tpu/native/_src"
+PY_DECL = "crowdllama_tpu/native/__init__.py"
+
+# C return type -> acceptable ctypes restype tails (None = literal None).
+# Only types actually usable at this seam are mapped; an unmapped C return
+# type is itself a finding (the contract must stay expressible in ctypes).
+_RETURN_MAP: dict[str, tuple[str | None, ...]] = {
+    "void": (None,),
+    "void*": ("c_void_p",),
+    "long": ("c_long",),
+    "int": ("c_int",),
+    "int32_t": ("c_int32",),
+    "int64_t": ("c_int64", "c_longlong"),
+    "uint32_t": ("c_uint32",),
+    "uint64_t": ("c_uint64", "c_ulonglong"),
+    "size_t": ("c_size_t",),
+    "double": ("c_double",),
+    "float": ("c_float",),
+}
+
+# A function definition at extern-"C" block scope:  ret name(params) {
+_FUNC_RE = re.compile(
+    r"^[ \t]*([A-Za-z_][A-Za-z0-9_]*(?:\s*\*)?)\s+"   # return type
+    r"(cl_[a-z0-9_]+)\s*\(([^)]*)\)\s*\{",            # name(params) {
+    re.MULTILINE | re.DOTALL)
+
+
+def _extern_c_blocks(text: str) -> list[tuple[int, str]]:
+    """(start line, body) of every ``extern "C" { ... }`` block, by brace
+    matching — the C++ side of the contract is whatever these export."""
+    out: list[tuple[int, str]] = []
+    for m in re.finditer(r'extern\s+"C"\s*\{', text):
+        depth = 1
+        i = m.end()
+        while i < len(text) and depth:
+            if text[i] == "{":
+                depth += 1
+            elif text[i] == "}":
+                depth -= 1
+            i += 1
+        out.append((text.count("\n", 0, m.start()) + 1, text[m.end():i - 1]))
+    return out
+
+
+def _param_count(params: str) -> int:
+    flat = " ".join(params.split())
+    if not flat or flat == "void":
+        return 0
+    # No function-pointer params at this seam, so top-level commas are
+    # exactly the separators.
+    return flat.count(",") + 1
+
+
+def c_exports(root: str) -> dict[str, tuple[str, int, str, int]]:
+    """symbol -> (return type, param count, rel path, line) for every
+    extern "C" function across the native C++ sources."""
+    out: dict[str, tuple[str, int, str, int]] = {}
+    d = Path(root) / CPP_DIR
+    for f in sorted(d.glob("*.cpp")) if d.is_dir() else []:
+        text = f.read_text(encoding="utf-8")
+        rel = f.relative_to(root).as_posix()
+        for start_line, body in _extern_c_blocks(text):
+            for m in _FUNC_RE.finditer(body):
+                ret = "".join(m.group(1).split())  # "void *" -> "void*"
+                line = start_line + body.count("\n", 0, m.start())
+                out[m.group(2)] = (ret, _param_count(m.group(3)), rel, line)
+    return out
+
+
+def py_declarations(root: str) -> dict[str, dict]:
+    """symbol -> {"restype": tail|None|"<missing>", "argc": int|None,
+    "line": int} from the ``lib.<sym>.restype/.argtypes = ...``
+    assignments inside ``_declare``."""
+    path = Path(root) / PY_DECL
+    if not path.is_file():
+        return {}
+    tree = ast.parse(path.read_text(encoding="utf-8"))
+    decl_fn = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name == "_declare":
+            decl_fn = node
+            break
+    if decl_fn is None:
+        return {}
+    decls: dict[str, dict] = {}
+
+    def _entry(sym: str, line: int) -> dict:
+        return decls.setdefault(
+            sym, {"restype": "<missing>", "argc": None, "line": line})
+
+    for node in ast.walk(decl_fn):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if not (isinstance(tgt, ast.Attribute)
+                and tgt.attr in ("restype", "argtypes")
+                and isinstance(tgt.value, ast.Attribute)):
+            continue
+        sym = tgt.value.attr
+        e = _entry(sym, node.lineno)
+        if tgt.attr == "restype":
+            if isinstance(node.value, ast.Constant) \
+                    and node.value.value is None:
+                e["restype"] = None
+            else:
+                name = dotted_name(node.value)
+                e["restype"] = name.rsplit(".", 1)[-1] if name else "<expr>"
+        else:
+            if isinstance(node.value, (ast.List, ast.Tuple)):
+                e["argc"] = len(node.value.elts)
+    return decls
+
+
+def check_ffi_contract(root: str) -> list[Finding]:
+    out: list[Finding] = []
+    exports = c_exports(root)
+    decls = py_declarations(root)
+    for sym, (ret, argc, cpath, cline) in sorted(exports.items()):
+        d = decls.get(sym)
+        if d is None:
+            out.append(Finding(
+                CHECKER, "ffi-undeclared", PY_DECL, 0, sym,
+                f"extern \"C\" `{sym}` ({cpath}:{cline}) has no ctypes "
+                "restype/argtypes in _declare — undeclared foreign "
+                "functions default to int restype (pointer truncation)"))
+            continue
+        if d["restype"] == "<missing>" or d["argc"] is None:
+            half = "restype" if d["restype"] == "<missing>" else "argtypes"
+            out.append(Finding(
+                CHECKER, "ffi-undeclared", PY_DECL, d["line"], sym,
+                f"`{sym}` is missing its {half} declaration in _declare — "
+                "declare both halves of the signature"))
+            continue
+        if d["argc"] != argc:
+            out.append(Finding(
+                CHECKER, "ffi-arity", PY_DECL, d["line"], sym,
+                f"argtypes declares {d['argc']} parameters but "
+                f"`{sym}` ({cpath}:{cline}) takes {argc} — mismatched "
+                "arity silently passes stack garbage"))
+        expected = _RETURN_MAP.get(ret)
+        if expected is None:
+            out.append(Finding(
+                CHECKER, "ffi-restype", cpath, cline, sym,
+                f"`{sym}` returns `{ret}`, which has no known ctypes "
+                "mapping — use a type from analysis/ffi_contract.py's "
+                "_RETURN_MAP or extend it"))
+        elif d["restype"] == "<expr>":
+            out.append(Finding(
+                CHECKER, "ffi-restype", PY_DECL, d["line"], sym,
+                f"`{sym}` restype is a computed expression — declare a "
+                "literal ctypes type so the contract stays checkable"))
+        elif d["restype"] not in expected:
+            want = " or ".join("None" if e is None else f"ctypes.{e}"
+                               for e in expected)
+            got = "None" if d["restype"] is None else d["restype"]
+            out.append(Finding(
+                CHECKER, "ffi-restype", PY_DECL, d["line"], sym,
+                f"`{sym}` returns `{ret}` in C but restype is {got} — "
+                f"expected {want} (wrong restype truncates or fabricates "
+                "the return value)"))
+    for sym, d in sorted(decls.items()):
+        if sym not in exports:
+            out.append(Finding(
+                CHECKER, "ffi-unknown-symbol", PY_DECL, d["line"], sym,
+                f"_declare configures `{sym}` but no extern \"C\" "
+                "function of that name exists in the native sources — "
+                "typo or stale declaration"))
+    return out

@@ -10,9 +10,13 @@ simple clients).
 from __future__ import annotations
 
 import asyncio
+import ctypes
+import math
 import socket
 import struct
+import threading
 
+from crowdllama_tpu import native
 from crowdllama_tpu.core import llama_v1_pb2 as pb
 
 # Reference caps frames at 10 MB (pbwire.go:53).
@@ -100,6 +104,365 @@ def _recvexact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
+# ------------------------------------------------------- envelope fast path
+#
+# Native scalar→frame encoders and a frame→view decoder for the two
+# per-request arms (GenerateRequest out, GenerateResponse both ways).  The
+# encoders only pay off when the frame is built straight from Python
+# scalars — upb's own SerializeToString is already C, so going through a
+# pb object first would be slower, not faster.  Every wrapper returns
+# None (or a pb fallback) whenever native is unavailable or the shape is
+# unusual, and the caller's Python path produces byte-identical frames —
+# asserted by tests/test_native_dataplane.py.
+
+# Dispatch threshold for the envelope encoders: below this payload size
+# upb's C serializer beats the ctypes marshalling floor (~3µs of struct
+# setattrs per call), above it the one-pass native encode wins — 2.7x at
+# 64KB, measured crossover ~4-8KB on the bench host.  Call sites consult
+# this; the encoders themselves stay unconditional so parity tests can
+# drive both paths at every size.
+NATIVE_ENVELOPE_MIN_BYTES = 4096
+
+_scratch = threading.local()
+
+
+def _enc_buf(need: int) -> ctypes.Array:
+    buf = getattr(_scratch, "buf", None)
+    if buf is None or len(buf) < need:
+        buf = ctypes.create_string_buffer(max(need, 1 << 16))
+        _scratch.buf = buf
+    return buf
+
+
+def _set_str(fields, name: str, value: str) -> None:
+    b = value.encode("utf-8")
+    setattr(fields, name, b)
+    setattr(fields, name + "_len", len(b))
+
+
+def encode_genresp_frame(
+    model: str,
+    response: str,
+    worker_id: str = "",
+    done: bool = True,
+    done_reason: str = "stop",
+    total_duration_ns: int = 0,
+    prompt_tokens: int = 0,
+    completion_tokens: int = 0,
+    created_ns: int = 0,
+    trace_id: str = "",
+    parent_span: str = "",
+) -> bytes | None:
+    """Encode a BaseMessage{generate_response} wire frame from scalars.
+
+    Returns None when the native library is unavailable — the caller falls
+    back to ``messages.create_generate_response`` + ``encode_frame``.
+    ``done_reason`` is cleared when not done, matching the Python builder.
+    """
+    lib = native.load()
+    if lib is None:
+        native.record_fallback("envelope")
+        return None
+    f = native.ClGenRespFields()
+    _set_str(f, "model", model)
+    _set_str(f, "response", response)
+    _set_str(f, "done_reason", done_reason if done else "")
+    _set_str(f, "worker_id", worker_id)
+    _set_str(f, "trace_id", trace_id)
+    _set_str(f, "parent_span", parent_span)
+    f.created_seconds = created_ns // 1_000_000_000
+    f.created_nanos = created_ns % 1_000_000_000
+    f.has_created = 1
+    f.done = 1 if done else 0
+    f.total_duration = total_duration_ns
+    f.prompt_tokens = prompt_tokens
+    f.completion_tokens = completion_tokens
+    need = (4 + 64 + f.model_len + f.response_len + f.done_reason_len
+            + f.worker_id_len + f.trace_id_len + f.parent_span_len)
+    buf = _enc_buf(need)
+    n = lib.cl_env_encode_genresp(ctypes.byref(f), buf, len(buf))
+    if n < 0:
+        raise WireError("native encode capacity error")
+    if n - 4 > MAX_MESSAGE_SIZE:
+        raise WireError(
+            f"message size {n - 4} exceeds maximum {MAX_MESSAGE_SIZE}")
+    # string_at copies exactly n bytes; .raw[:n] would memcpy the whole
+    # scratch buffer (64KB+) first.
+    return ctypes.string_at(buf, n)
+
+
+def encode_genreq_frame(
+    model: str,
+    prompt: str = "",
+    stream: bool = False,
+    messages: tuple = (),
+    max_tokens: int = 0,
+    temperature: float = 0.0,
+    top_p: float = 0.0,
+    seed: int = 0,
+    stop: tuple = (),
+    top_k: int = 0,
+    repeat_penalty: float = 0.0,
+    kv_donor: str = "",
+    migrate: bool = False,
+    trace_id: str = "",
+    parent_span: str = "",
+) -> bytes | None:
+    """Encode a BaseMessage{generate_request} wire frame from scalars.
+
+    Returns None when native is unavailable or a value hits a proto3
+    serialization ambiguity the C encoder does not model (negative zero
+    floats, out-of-range ints) — callers fall back to the pb builder.
+    """
+    lib = native.load()
+    if lib is None:
+        native.record_fallback("envelope")
+        return None
+    # Bail to the pb path on any value whose proto3 serialization is
+    # ambiguous or that the pb builder would treat differently: negative
+    # zero floats (skip-if-default implementations disagree on the bit
+    # test), out-of-range ints, non-string chat fields (the pb builder
+    # raises a TypeError the caller may rely on).
+    try:
+        if not (0 <= seed < 2**64) or not (-2**31 <= max_tokens < 2**31) \
+                or not (-2**31 <= top_k < 2**31):
+            return None
+        for v in (temperature, top_p, repeat_penalty):
+            if v == 0.0 and math.copysign(1.0, v) < 0:
+                return None
+        for m in messages:
+            if not isinstance(m.get("role", "user"), str) \
+                    or not isinstance(m.get("content", ""), str):
+                return None
+    except (TypeError, AttributeError):
+        return None
+    f = native.ClGenReqFields()
+    _set_str(f, "model", model)
+    _set_str(f, "prompt", prompt)
+    _set_str(f, "kv_donor", kv_donor)
+    _set_str(f, "trace_id", trace_id)
+    _set_str(f, "parent_span", parent_span)
+    msgs = list(messages)
+    roles = [str(m.get("role", "user")).encode("utf-8") for m in msgs]
+    conts = [str(m.get("content", "")).encode("utf-8") for m in msgs]
+    stops = [str(s).encode("utf-8") for s in stop]
+    if msgs:
+        f.msg_roles = (ctypes.c_char_p * len(roles))(*roles)
+        f.msg_role_lens = (ctypes.c_size_t * len(roles))(*map(len, roles))
+        f.msg_contents = (ctypes.c_char_p * len(conts))(*conts)
+        f.msg_content_lens = (ctypes.c_size_t * len(conts))(*map(len, conts))
+    if stops:
+        f.stops = (ctypes.c_char_p * len(stops))(*stops)
+        f.stop_lens = (ctypes.c_size_t * len(stops))(*map(len, stops))
+    f.n_msgs = len(msgs)
+    f.n_stop = len(stops)
+    f.stream = 1 if stream else 0
+    f.max_tokens = max_tokens
+    f.temperature = temperature
+    f.top_p = top_p
+    f.repeat_penalty = repeat_penalty
+    f.top_k = top_k
+    f.seed = seed
+    f.migrate = 1 if migrate else 0
+    need = (4 + 96 + f.model_len + f.prompt_len + f.kv_donor_len
+            + f.trace_id_len + f.parent_span_len
+            + sum(len(r) + len(c) + 8 for r, c in zip(roles, conts))
+            + sum(len(s) + 4 for s in stops))
+    buf = _enc_buf(need)
+    n = lib.cl_env_encode_genreq(ctypes.byref(f), buf, len(buf))
+    if n < 0:
+        raise WireError("native encode capacity error")
+    if n - 4 > MAX_MESSAGE_SIZE:
+        raise WireError(
+            f"message size {n - 4} exceeds maximum {MAX_MESSAGE_SIZE}")
+    return ctypes.string_at(buf, n)
+
+
+class FastTimestamp:
+    """Plain mutable mirror of google.protobuf.Timestamp's read surface."""
+
+    __slots__ = ("seconds", "nanos")
+
+    def __init__(self, seconds: int = 0, nanos: int = 0):
+        self.seconds = seconds
+        self.nanos = nanos
+
+    def ToNanoseconds(self) -> int:
+        return self.seconds * 1_000_000_000 + self.nanos
+
+
+class FastGenerateResponse:
+    """Plain mutable mirror of the GenerateResponse fields the hot path
+    reads (and the replay trim mutates)."""
+
+    __slots__ = ("model", "created_at", "response", "done", "done_reason",
+                 "worker_id", "total_duration", "prompt_tokens",
+                 "completion_tokens")
+
+    def __init__(self) -> None:
+        self.model = ""
+        self.created_at = FastTimestamp()
+        self.response = ""
+        self.done = False
+        self.done_reason = ""
+        self.worker_id = ""
+        self.total_duration = 0
+        self.prompt_tokens = 0
+        self.completion_tokens = 0
+
+
+class FastBaseMessage:
+    """Decode-view of a BaseMessage whose arm is generate_response.
+
+    Exposes exactly the surface the gateway hot path touches:
+    ``WhichOneof``, ``generate_response``, ``trace_id``, ``parent_span``.
+    Anything else lives only on the real pb class — ``decode_payload_fast``
+    returns a real pb.BaseMessage whenever the frame is not a plain
+    GenerateResponse envelope.
+    """
+
+    __slots__ = ("generate_response", "trace_id", "parent_span")
+
+    def __init__(self) -> None:
+        self.generate_response = FastGenerateResponse()
+        self.trace_id = ""
+        self.parent_span = ""
+
+    def WhichOneof(self, name: str) -> str | None:
+        if name != "message":
+            raise ValueError(f"unknown oneof {name!r}")
+        return "generate_response"
+
+
+def decode_payload_fast(payload: bytes) -> "pb.BaseMessage | FastBaseMessage":
+    """Decode a frame payload, using the native strict decoder for the
+    GenerateResponse arm and the real parser for everything else.
+
+    The native decoder refuses (returns 0 for) any shape it is not sure
+    about — unknown fields, other arms, non-canonical ordering — so the
+    fast object is only ever produced for frames the Python path would
+    decode to exactly the same values.
+    """
+    # Same size-aware dispatch as the encoders: upb parses tiny payloads
+    # faster than the view-extraction floor; both paths yield equal values.
+    if len(payload) < NATIVE_ENVELOPE_MIN_BYTES:
+        return decode_payload(payload)
+    lib = native.load()
+    if lib is None:
+        return decode_payload(payload)
+    view = getattr(_scratch, "view", None)
+    if view is None:
+        view = _scratch.view = native.ClGenRespView()
+    if lib.cl_env_decode_genresp(payload, len(payload), ctypes.byref(view)) != 1:
+        return decode_payload(payload)
+    try:
+        msg = FastBaseMessage()
+        resp = msg.generate_response
+        resp.model = payload[view.model_off:view.model_off + view.model_len].decode("utf-8")
+        resp.response = payload[view.response_off:view.response_off + view.response_len].decode("utf-8")
+        resp.done_reason = payload[view.done_reason_off:view.done_reason_off + view.done_reason_len].decode("utf-8")
+        resp.worker_id = payload[view.worker_id_off:view.worker_id_off + view.worker_id_len].decode("utf-8")
+        msg.trace_id = payload[view.trace_id_off:view.trace_id_off + view.trace_id_len].decode("utf-8")
+        msg.parent_span = payload[view.parent_span_off:view.parent_span_off + view.parent_span_len].decode("utf-8")
+    except UnicodeDecodeError:
+        # upb validates UTF-8 on parse; delegate so the error is identical.
+        return decode_payload(payload)
+    resp.done = bool(view.done)
+    resp.total_duration = view.total_duration
+    resp.prompt_tokens = view.prompt_tokens
+    resp.completion_tokens = view.completion_tokens
+    resp.created_at.seconds = view.created_seconds
+    resp.created_at.nanos = view.created_nanos
+    return msg
+
+
+# --------------------------------------------------------- frame batching
+
+
+class FrameBatcher:
+    """Coalesces frame writes issued within one event-loop tick into a
+    single underlying ``write``.
+
+    Sits ABOVE the AEAD seam: when the underlying writer is a
+    SecureWriter, a batch of N small plaintext frames becomes ONE sealed
+    wire frame (up to the 256K chunk size) instead of N — collapsing both
+    the per-frame AEAD cost and the per-frame transport writes.  The flush
+    runs via ``loop.call_soon``, i.e. as soon as the producing coroutine
+    actually suspends, so steady-state SSE cadence is unchanged.
+
+    The stream's FIRST frame flushes inline instead: the TTFT bound must
+    not depend on the producer ever suspending.  A burst generator (a
+    failover replay, a fast test engine) can emit a whole stream without
+    yielding to the loop — ``StreamWriter.drain()`` on an unpaused
+    transport returns without suspending — so the scheduled tick would
+    never run before the stream ends or dies, turning TTFT into
+    end-to-end latency and making a mid-burst worker death look like
+    zero progress from the gateway.  One early write per stream buys a
+    hard TTFT guarantee; everything after it coalesces per tick.
+
+    ``drain()`` does NOT force a flush — it only propagates a captured
+    write error and applies the underlying writer's backpressure.  Pending
+    bytes are bounded by ``max_pending`` (an oversized batch flushes
+    inline).  Call ``aclose()`` (or ``flush()``) before closing the
+    stream.
+    """
+
+    def __init__(self, writer, max_pending: int = 64 * 1024):
+        self._w = writer
+        self._max_pending = max_pending
+        self._pending = bytearray()
+        self._scheduled = False
+        self._first = True
+        self._error: BaseException | None = None
+        self.batched_writes = 0   # frames accepted
+        self.flushes = 0          # underlying write calls
+
+    def write(self, frame: bytes) -> None:
+        if self._error:
+            return  # surfaced on the next drain()/flush()
+        self._pending += frame
+        self.batched_writes += 1
+        if self._first:
+            self._first = False
+            self._flush_now()
+        elif len(self._pending) >= self._max_pending:
+            self._flush_now()
+        elif not self._scheduled:
+            self._scheduled = True
+            asyncio.get_running_loop().call_soon(self._tick)
+
+    def _tick(self) -> None:
+        self._scheduled = False
+        self._flush_now()
+
+    def _flush_now(self) -> None:
+        if not self._pending or self._error:
+            return
+        data = bytes(self._pending)
+        self._pending.clear()
+        try:
+            self._w.write(data)
+            self.flushes += 1
+        except Exception as e:  # surfaced on the next drain()/flush()
+            self._error = e
+
+    def _raise_pending_error(self) -> None:
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+
+    async def drain(self) -> None:
+        self._raise_pending_error()
+        await self._w.drain()
+        self._raise_pending_error()
+
+    async def flush(self) -> None:
+        """Force out anything still pending (end of stream, before EOF)."""
+        self._flush_now()
+        self._raise_pending_error()
+        await self._w.drain()
+
+
 # ----------------------------------------------------------- batch scanning
 
 def scan_frames(buf: bytes | bytearray | memoryview) -> tuple[list[bytes], int]:
@@ -111,12 +474,8 @@ def scan_frames(buf: bytes | bytearray | memoryview) -> tuple[list[bytes], int]:
     Uses the C++ scanner (native/_src/crowdllama_native.cpp) when available.
     """
     data = bytes(buf)
-    from crowdllama_tpu import native as _native
-
-    lib = _native.load()
+    lib = native.load()
     if lib is not None:
-        import ctypes
-
         max_frames = max(1, len(data) // 4)
         offs = (ctypes.c_uint32 * max_frames)()
         sizes = (ctypes.c_uint32 * max_frames)()
@@ -128,6 +487,7 @@ def scan_frames(buf: bytes | bytearray | memoryview) -> tuple[list[bytes], int]:
         return ([data[offs[i]:offs[i] + sizes[i]] for i in range(n)],
                 consumed.value)
 
+    native.record_fallback("frame_scan")
     payloads: list[bytes] = []
     pos = 0
     while pos + _LEN.size <= len(data):

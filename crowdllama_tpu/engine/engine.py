@@ -18,12 +18,14 @@ from typing import AsyncIterator
 
 from crowdllama_tpu.config import Configuration
 from crowdllama_tpu.core import pb
+from crowdllama_tpu.core import wire
 from crowdllama_tpu.core.messages import (
     create_embed_response,
     create_generate_response,
     extract_embed_request,
     extract_generate_request,
     flatten_chat,
+    genresp_frame_bytes,
     migrate_frame_msg,
 )
 from crowdllama_tpu.testing import faults
@@ -284,7 +286,22 @@ class Engine:
     ) -> AsyncIterator[pb.BaseMessage]:
         """Streaming superset: one GenerateResponse frame per chunk, done
         marked on the last (SURVEY §7 hard part 5 — the reference carries a
-        stream flag but never streams)."""
+        stream flag but never streams).
+
+        Decode-wrapper over ``handle_streaming_frames`` — the wire hot
+        path yields encoded frames directly; this keeps the pb-object
+        surface for tests and non-wire consumers.
+        """
+        async for frame in self.handle_streaming_frames(msg, worker_id=worker_id):
+            yield wire.decode_payload(frame[4:])
+
+    async def handle_streaming_frames(
+        self, msg: pb.BaseMessage, worker_id: str = ""
+    ) -> AsyncIterator[bytes]:
+        """Streaming hot path: yields complete encoded wire frames
+        ([4B BE len][BaseMessage]) — one per chunk, trace_id embedded —
+        built straight from engine scalars with zero intermediate pb
+        objects when the native encoder is loaded."""
         req = extract_generate_request(msg)
         t0 = time.monotonic_ns()
         first_ns = 0
@@ -318,7 +335,7 @@ class Engine:
                 self._obs_generate(msg, req.model, t0, first_ns,
                                    time.monotonic_ns(), chunk)
                 hashes, page_size = self._migrate_export_meta(req)
-                yield migrate_frame_msg(
+                mig = migrate_frame_msg(
                     model=req.model,
                     worker_id=worker_id,
                     delivered_tokens=chunk.completion_tokens,
@@ -327,12 +344,15 @@ class Engine:
                     page_size=page_size,
                     reason="drain",
                 )
+                if msg.trace_id:
+                    mig.trace_id = msg.trace_id
+                yield wire.encode_frame(mig)
                 return
             if chunk.done:
                 final = chunk
                 self._obs_generate(msg, req.model, t0, first_ns,
                                    time.monotonic_ns(), final)
-            yield create_generate_response(
+            yield genresp_frame_bytes(
                 model=req.model,
                 response=chunk.text,
                 worker_id=worker_id,
@@ -341,6 +361,7 @@ class Engine:
                 total_duration_ns=(time.monotonic_ns() - t0) if chunk.done else 0,
                 prompt_tokens=chunk.prompt_tokens if chunk.done else 0,
                 completion_tokens=chunk.completion_tokens if chunk.done else 0,
+                trace_id=msg.trace_id,
             )
 
     def _format_chat(self, messages: list[dict], model: str = "") -> str:

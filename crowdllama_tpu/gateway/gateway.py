@@ -36,7 +36,7 @@ from crowdllama_tpu.core.messages import (
 )
 from crowdllama_tpu.core.protocol import INFERENCE_PROTOCOL
 from crowdllama_tpu.obs import GATEWAY_ROOT_SPAN, NodeObs, new_trace_id
-from crowdllama_tpu.obs.http import host_stat_lines
+from crowdllama_tpu.obs.http import host_stat_lines, native_metric_lines
 from crowdllama_tpu.obs.metrics import (
     ENGINE_TELEMETRY,
     LabelGuard,
@@ -510,7 +510,10 @@ class Gateway:
         t0 = time.perf_counter_ns()
         payload = await wire.read_frame_payload(s.reader, timeout=timeout)
         t1 = time.perf_counter_ns()
-        reply = wire.decode_payload(payload)
+        # Fast path: the native strict decoder handles the GenerateResponse
+        # arm (the per-chunk hot case); anything else falls back to the
+        # real parser inside decode_payload_fast with identical semantics.
+        reply = wire.decode_payload_fast(payload)
         t2 = time.perf_counter_ns()
         self._perf["io_wait_ns"] += t1 - t0
         self._perf["serde_ns"] += t2 - t1
@@ -807,14 +810,17 @@ class Gateway:
             self._finish_trace(tid, acc, model, t0, status, served_by)
 
     async def _roundtrip(self, worker_id: str, msg, timeout: float = 600,
-                         acc: dict | None = None):
+                         acc: dict | None = None,
+                         frame: bytes | None = None):
         """Request/reply over a pooled (or fresh) inference stream.
 
         A pooled stream can be stale (worker idled it out or restarted):
         generation/embedding requests are stateless, so the failed attempt
         retries once on a fresh dial — reusing the ALREADY-ENCODED frame
-        bytes — before surfacing the error."""
-        frame = self._encode_frame(msg, acc=acc)
+        bytes — before surfacing the error.  ``frame`` lets _route pass
+        natively pre-encoded request bytes (zero pb serialization here)."""
+        if frame is None:
+            frame = self._encode_frame(msg, acc=acc)
         s = self._pool_get(worker_id)
         if s is not None:
             try:
@@ -1074,6 +1080,7 @@ class Gateway:
         lines.extend(ENGINE_TELEMETRY.expose())
         lines.extend(device_memory_lines())
         lines.extend(host_stat_lines(self.peer.host))
+        lines.extend(native_metric_lines())
         # SLO burn-rate plane (PR 13): objective/burn-rate/fast-burn
         # gauges — the series swarm/autoscale.py parse_gauges consumes.
         lines.extend(self.slo.expose())
@@ -1555,7 +1562,7 @@ class Gateway:
     async def _route_admitted(self, request, model, stream, options,
                               messages=None, prompt="",
                               shape="chat") -> web.StreamResponse:
-        msg = create_generate_request(
+        req_kwargs = dict(
             model=model,
             prompt=prompt,
             stream=stream,
@@ -1579,6 +1586,12 @@ class Gateway:
             repeat_penalty=max(0.0, float(
                 options.get("repeat_penalty", 1.0) or 1.0)),
         )
+        # pb-object construction is serde work: time it into serde_ns so
+        # the native arm (scalar->frame, no pb build on the frame path)
+        # and the pb arm attribute the same phase identically.
+        t_build = time.perf_counter_ns()
+        msg = create_generate_request(**req_kwargs)
+        self._perf["serde_ns"] += time.perf_counter_ns() - t_build
         from crowdllama_tpu.net import secure
 
         # Mint the trace id here — the admission point every hop downstream
@@ -1586,6 +1599,34 @@ class Gateway:
         tid = new_trace_id()
         msg.trace_id = tid
         msg.parent_span = GATEWAY_ROOT_SPAN
+
+        # Size-aware dispatch (see wire.NATIVE_ENVELOPE_MIN_BYTES): short
+        # prompts serialize faster through upb than through the ctypes
+        # marshalling floor; both paths emit identical bytes.
+        _req_payload_len = len(prompt) + sum(
+            len(str(m.get("content", ""))) for m in (messages or ()))
+
+        def _native_req_frame(kv_donor: str = "",
+                              migrate: bool = False) -> bytes | None:
+            """Pre-encode the request wire frame from the admission scalars
+            (native fast path; byte-identical to _encode_frame(msg)).
+            None → the per-attempt send falls back to pb serialization."""
+            if _req_payload_len < wire.NATIVE_ENVELOPE_MIN_BYTES:
+                return None
+            t_enc = time.perf_counter_ns()
+            try:
+                f = wire.encode_genreq_frame(
+                    **req_kwargs, kv_donor=kv_donor, migrate=migrate,
+                    trace_id=tid, parent_span=GATEWAY_ROOT_SPAN)
+            except wire.WireError:
+                # Oversize raises at the same boundary on the pb path —
+                # let _encode_frame produce the identical error there.
+                return None
+            dt = time.perf_counter_ns() - t_enc
+            if f is not None:
+                self._perf["serde_ns"] += dt
+                acc["serde_ns"] = acc.get("serde_ns", 0) + dt
+            return f
         t0 = time.monotonic()  # TTFB measures from ADMISSION, retries included
         # Total wall-clock budget, charged across every retry/failover this
         # request pays (docs/ROBUSTNESS.md): routing, dials, handshakes and
@@ -1594,6 +1635,10 @@ class Gateway:
         deadline = t0 + budget
         self._perf["requests"] += 1
         acc: dict = {}
+        # Encode the request frame once at admission (native path); the
+        # common attempt (no donor, no migrate) reuses it verbatim and
+        # skips per-attempt pb serialization entirely.
+        base_frame = _native_req_frame()
         self.obs.trace.begin(tid, node="gateway", model=model,
                              path=request.path, stream=stream)
         aead0 = secure.aead_stats()[0]
@@ -1662,6 +1707,9 @@ class Gateway:
                         self.obs.trace.record(
                             tid, "kv_hint", 0, parent=GATEWAY_ROOT_SPAN,
                             donor=donor[:8], worker=worker.peer_id[:8])
+                gr = msg.generate_request
+                req_frame = (base_frame if not gr.kv_donor and not gr.migrate
+                             else _native_req_frame(gr.kv_donor, gr.migrate))
                 if sctx.out is not None:
                     # MID-STREAM FAILOVER: headers (and sent_text chars)
                     # already reached the client from a worker that then
@@ -1682,7 +1730,8 @@ class Gateway:
                 try:
                     resp = await self._forward(request, worker.peer_id, msg,
                                                stream, shape, t0, acc=acc,
-                                               ctx=sctx, deadline=deadline)
+                                               ctx=sctx, deadline=deadline,
+                                               req_frame=req_frame)
                     # Hedged dispatch may have delivered the stream from a
                     # different worker than the one routing picked — pin
                     # the affinity (and attribute the trace) to whoever
@@ -2197,7 +2246,8 @@ class Gateway:
                        shape: str, t0: float,
                        acc: dict | None = None,
                        ctx: _StreamCtx | None = None,
-                       deadline: float | None = None) -> web.StreamResponse:
+                       deadline: float | None = None,
+                       req_frame: bytes | None = None) -> web.StreamResponse:
         """Open an inference stream to the worker and relay the reply
         (gateway.go:243-298).  ``shape`` picks the client dialect:
         Ollama NDJSON ("chat"/"generate") or OpenAI SSE ("openai-*").
@@ -2241,7 +2291,8 @@ class Gateway:
 
         if not stream:
             resp = classify(await self._roundtrip(
-                worker_id, msg, timeout=_recv_timeout(), acc=acc))
+                worker_id, msg, timeout=_recv_timeout(), acc=acc,
+                frame=req_frame))
             if resp.done_reason == "error":
                 raise RuntimeError(resp.response)
             return web.json_response(render(resp, final=True))
@@ -2259,7 +2310,8 @@ class Gateway:
         stall_decode = self._stall_budget("decode")
         if remaining() <= 0:
             raise _BudgetExhausted("budget exhausted before dial")
-        frame = self._encode_frame(msg, acc=acc)
+        frame = req_frame if req_frame is not None \
+            else self._encode_frame(msg, acc=acc)
         # Hedged first-token dispatch: only on the FIRST attempt of a
         # stream — a failover replay already has client bytes out, and
         # failover itself covers that tail.

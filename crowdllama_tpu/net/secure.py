@@ -25,7 +25,9 @@ from __future__ import annotations
 import asyncio
 import time
 
+from crowdllama_tpu import native
 from crowdllama_tpu.utils.crypto_compat import (
+    HAVE_CRYPTOGRAPHY,
     HKDF,
     SHA256,
     ChaCha20Poly1305,
@@ -81,16 +83,52 @@ def ecdh(private: X25519PrivateKey, peer_public_raw: bytes) -> bytes:
     return private.exchange(X25519PublicKey.from_public_bytes(peer_public_raw))
 
 
+# The native AEAD context must match the cipher the Python path would use:
+# real ChaCha20-Poly1305 when the ``cryptography`` package is installed,
+# otherwise the compat encrypt-then-MAC scheme.  Wire bytes are identical
+# either way — asserted by tests/test_native_dataplane.py's golden corpus.
+_NATIVE_FLAVOR = native.FLAVOR_CHACHA if HAVE_CRYPTOGRAPHY else native.FLAVOR_COMPAT
+
+
+def _native_session(key: bytes) -> "native.AeadSession | None":
+    lib = native.load()
+    if lib is None:
+        native.record_fallback("aead")
+        return None
+    try:
+        return native.AeadSession(lib, key, _NATIVE_FLAVOR)
+    except Exception:
+        native.record_fallback("aead")
+        return None
+
+
 class SecureWriter:
     """Encrypting adapter over an asyncio StreamWriter."""
 
     def __init__(self, writer: asyncio.StreamWriter, key: bytes):
         self._w = writer
-        self._aead = ChaCha20Poly1305(key)
+        self._native = _native_session(key)
+        self._aead = None if self._native is not None else ChaCha20Poly1305(key)
         self._ctr = 0
 
+    @property
+    def counter(self) -> int:
+        """Frames sealed so far (native or Python path)."""
+        return self._native.counter if self._native is not None else self._ctr
+
     def _frame(self, chunk: bytes) -> None:
+        """Seal exactly one frame (empty chunk = authenticated close)."""
         global _aead_ns, _aead_ops
+        if self._native is not None:
+            t0 = time.perf_counter_ns()
+            if chunk:
+                frame = self._native.seal_frames(bytes(chunk), len(chunk))
+            else:
+                frame = self._native.seal_frames(b"", CHUNK, with_eof=True)
+            _aead_ns += time.perf_counter_ns() - t0
+            _aead_ops += 1
+            self._w.write(frame)
+            return
         nonce = self._ctr.to_bytes(12, "big")
         self._ctr += 1
         t0 = time.perf_counter_ns()
@@ -100,6 +138,17 @@ class SecureWriter:
         self._w.write(len(ct).to_bytes(4, "big") + ct)
 
     def write(self, data: bytes) -> None:
+        global _aead_ns, _aead_ops
+        if self._native is not None:
+            if not data:
+                return
+            t0 = time.perf_counter_ns()
+            before = self._native.counter
+            frames = self._native.seal_frames(bytes(data), CHUNK)
+            _aead_ns += time.perf_counter_ns() - t0
+            _aead_ops += self._native.counter - before
+            self._w.write(frames)
+            return
         data = bytes(data)
         for off in range(0, len(data), CHUNK):
             self._frame(data[off:off + CHUNK])
@@ -136,11 +185,17 @@ class SecureReader:
 
     def __init__(self, reader: asyncio.StreamReader, key: bytes):
         self._r = reader
-        self._aead = ChaCha20Poly1305(key)
+        self._native = _native_session(key)
+        self._aead = None if self._native is not None else ChaCha20Poly1305(key)
         self._ctr = 0
         self._buf = bytearray()
         self._eof = False
         self._authenticated_eof = False  # saw the empty close frame
+
+    @property
+    def counter(self) -> int:
+        """Frames consumed so far (native or Python path)."""
+        return self._native.counter if self._native is not None else self._ctr
 
     async def _fill(self) -> None:
         """Read and decrypt one frame into the plaintext buffer."""
@@ -159,16 +214,26 @@ class SecureReader:
         except asyncio.IncompleteReadError as e:
             raise TamperError("stream cut mid-frame") from e
         global _aead_ns, _aead_ops
-        nonce = self._ctr.to_bytes(12, "big")
-        self._ctr += 1
-        t0 = time.perf_counter_ns()
-        try:
-            pt = self._aead.decrypt(nonce, ct, None)
-        except InvalidTag as e:
-            raise TamperError("frame failed authentication") from e
-        finally:
+        if self._native is not None:
+            # The native context advances its counter on success AND on tag
+            # failure, matching the ``finally`` of the Python path below.
+            t0 = time.perf_counter_ns()
+            pt = self._native.open(ct)
             _aead_ns += time.perf_counter_ns() - t0
             _aead_ops += 1
+            if pt is None:
+                raise TamperError("frame failed authentication")
+        else:
+            nonce = self._ctr.to_bytes(12, "big")
+            self._ctr += 1
+            t0 = time.perf_counter_ns()
+            try:
+                pt = self._aead.decrypt(nonce, ct, None)
+            except InvalidTag as e:
+                raise TamperError("frame failed authentication") from e
+            finally:
+                _aead_ns += time.perf_counter_ns() - t0
+                _aead_ops += 1
         if not pt:  # authenticated close marker (SecureWriter.write_eof)
             self._eof = True
             self._authenticated_eof = True

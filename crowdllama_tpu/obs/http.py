@@ -27,6 +27,31 @@ from crowdllama_tpu.obs.metrics import (
 log = logging.getLogger("crowdllama.obs")
 
 
+def native_metric_lines() -> list[str]:
+    """Native data-plane health (docs/NATIVE.md): a gauge for whether the
+    C++ fast path is active in this process, plus a per-component counter
+    of every degradation to the pure-Python path.  A fleet where
+    ``crowdllama_native_enabled`` is 0 (or fallbacks are climbing) is
+    silently paying ~an order of magnitude more CPU per request — these
+    series make that visible instead of a mystery regression."""
+    from crowdllama_tpu import native
+
+    st = native.stats()
+    lines = [
+        "# TYPE crowdllama_native_enabled gauge",
+        f"crowdllama_native_enabled {1 if st['enabled'] else 0}",
+        "# TYPE crowdllama_native_fallbacks_total counter",
+    ]
+    # Always-present component labels so dashboards can rate() without
+    # sparse-series gaps; extra components recorded at runtime still show.
+    components = {"aead": 0, "envelope": 0, "frame_scan": 0}
+    components.update(st["fallbacks"])
+    for comp, v in sorted(components.items()):
+        lines.append(
+            f'crowdllama_native_fallbacks_total{{component="{comp}"}} {v}')
+    return lines
+
+
 def host_stat_lines(host) -> list[str]:
     """Host stream-path counters, identical series on gateway and worker."""
     lines = ["# TYPE crowdllama_host_streams_total counter"]
@@ -74,6 +99,7 @@ def node_metric_lines(peer) -> list[str]:
     lines.extend(ENGINE_TELEMETRY.expose())
     lines.extend(device_memory_lines())
     lines.extend(host_stat_lines(peer.host))
+    lines.extend(native_metric_lines())
     return lines
 
 

@@ -685,25 +685,33 @@ class Peer:
                 # burning its failover budget on us — but the stream stays
                 # open: we keep serving KvFetchRequests as a migration
                 # donor until drain_timeout.
-                from crowdllama_tpu.core.messages import (
-                    create_generate_response,
-                )
+                from crowdllama_tpu.core.messages import genresp_frame_bytes
 
                 if self.obs is not None:
                     self.obs.metrics.drain_inc("rejected_requests")
-                reject = create_generate_response(
+                reject = genresp_frame_bytes(
                     model=req.model, response="", worker_id=self.peer_id,
-                    done=True, done_reason="draining")
-                reject.trace_id = tid
-                await wire.write_length_prefixed_pb(stream.writer, reject)
+                    done=True, done_reason="draining", trace_id=tid)
+                await wire.write_frame_bytes(stream.writer, reject)
                 return True
             if req.stream:
+                # Frames-first hot path: the engine yields encoded wire
+                # frames (trace_id embedded); the batcher sends the first
+                # frame inline (hard TTFT bound even for burst producers)
+                # and coalesces every later frame produced within one
+                # event-loop tick into a single sealed write
+                # (wire.FrameBatcher — flushes via call_soon).
                 flush_ns = 0
-                async for frame in self.engine.handle_streaming(msg, worker_id=self.peer_id):
-                    frame.trace_id = tid
+                batcher = wire.FrameBatcher(stream.writer)
+                async for frame in self.engine.handle_streaming_frames(
+                        msg, worker_id=self.peer_id):
                     t0 = time.perf_counter_ns()
-                    await wire.write_length_prefixed_pb(stream.writer, frame)
+                    batcher.write(frame)
+                    await batcher.drain()
                     flush_ns += time.perf_counter_ns() - t0
+                t0 = time.perf_counter_ns()
+                await batcher.flush()
+                flush_ns += time.perf_counter_ns() - t0
                 if tid:
                     self.obs.trace.record(tid, "stream_flush", flush_ns,
                                           parent=msg.parent_span)
@@ -747,7 +755,7 @@ class Peer:
             log.warning("inference failed: %s", e)
             from crowdllama_tpu.core.messages import (
                 create_embed_response,
-                create_generate_response,
+                genresp_frame_bytes,
             )
 
             if msg.WhichOneof("message") == "embed_request":
@@ -765,17 +773,19 @@ class Peer:
                     model=msg.embed_request.model, embeddings=[],
                     worker_id=self.peer_id, error=prefix + detail,
                 )
+                err.trace_id = tid
+                err_frame = wire.encode_frame(err)
             else:
-                err = create_generate_response(
+                err_frame = genresp_frame_bytes(
                     model=msg.generate_request.model if msg.generate_request else "",
                     response=f"error: {e}",
                     worker_id=self.peer_id,
                     done=True,
                     done_reason="error",
+                    trace_id=tid,
                 )
-            err.trace_id = tid
             try:
-                await wire.write_length_prefixed_pb(stream.writer, err)
+                await wire.write_frame_bytes(stream.writer, err_frame)
             except Exception:
                 return False  # writer dead: end the stream's serve loop
             return True  # error frame delivered; the exchange is complete

@@ -324,26 +324,38 @@ except ImportError:  # stdlib-only fallback
                 raise ValueError("key must be 32 bytes")
             self._enc_key = key
             self._mac_key = hashlib.sha256(b"compat-aead-mac" + key).digest()
+            # Per-session pooled hash states: the key-dependent prefix of
+            # the XOF absorb and the HMAC inner/outer pads are computed
+            # once here; per-frame cost is a copy() + the variable suffix.
+            # Output is byte-identical to rebuilding from scratch.
+            self._shake_base = hashlib.shake_256(
+                b"compat-aead-stream" + key)
+            self._hmac_base = _hmac.new(self._mac_key, b"", hashlib.sha256)
 
         def _keystream(self, nonce: bytes, n: int) -> bytes:
-            return hashlib.shake_256(
-                b"compat-aead-stream" + self._enc_key + nonce).digest(n)
+            shake = self._shake_base.copy()
+            shake.update(nonce)
+            return shake.digest(n)
+
+        def _tag(self, nonce: bytes, aad: bytes | None, ct: bytes) -> bytes:
+            mac = self._hmac_base.copy()
+            mac.update(nonce)
+            if aad:
+                mac.update(aad)
+            mac.update(ct)
+            return mac.digest()[:self._TAG]
 
         def encrypt(self, nonce: bytes, data: bytes, aad: bytes | None) -> bytes:
             ks = self._keystream(nonce, len(data))
             ct = (int.from_bytes(data, "big") ^ int.from_bytes(ks, "big")
                   ).to_bytes(len(data), "big") if data else b""
-            mac = _hmac.new(self._mac_key, nonce + (aad or b"") + ct,
-                            hashlib.sha256).digest()[:self._TAG]
-            return ct + mac
+            return ct + self._tag(nonce, aad, ct)
 
         def decrypt(self, nonce: bytes, data: bytes, aad: bytes | None) -> bytes:
             if len(data) < self._TAG:
                 raise InvalidTag("ciphertext shorter than tag")
             ct, mac = data[:-self._TAG], data[-self._TAG:]
-            want = _hmac.new(self._mac_key, nonce + (aad or b"") + ct,
-                             hashlib.sha256).digest()[:self._TAG]
-            if not _hmac.compare_digest(mac, want):
+            if not _hmac.compare_digest(mac, self._tag(nonce, aad, ct)):
                 raise InvalidTag("tag mismatch")
             ks = self._keystream(nonce, len(ct))
             return (int.from_bytes(ct, "big") ^ int.from_bytes(ks, "big")

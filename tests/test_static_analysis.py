@@ -474,6 +474,110 @@ def test_collected_families_look_sane():
     assert not any(f.startswith("crowdllama_tpu") for f in exact)
 
 
+# ------------------------------------------------ ffi-contract seeds
+
+
+_FFI_CPP_FIXTURE = """
+    #include <cstdint>
+    #include <cstddef>
+
+    // cl_-named but NOT exported: internal linkage, outside extern "C" —
+    // must not demand a ctypes declaration (true negative).
+    static long cl_fx_internal(int a) { return a; }
+
+    extern "C" {
+
+    void* cl_fx_ok(const uint8_t* key, int flavor) { (void)key; return 0; }
+
+    void cl_fx_void(void* h) { (void)h; }
+
+    long cl_fx_arity(void* h, const uint8_t* buf, size_t len) { return 0; }
+
+    long cl_fx_restype(void* h) { return 0; }
+
+    long cl_fx_undeclared(void* h) { return 0; }
+
+    long cl_fx_half(void* h) { return 0; }
+
+    }  // extern "C"
+"""
+
+_FFI_PY_FIXTURE = """
+    import ctypes
+
+
+    def _declare(lib):
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.cl_fx_ok.restype = ctypes.c_void_p
+        lib.cl_fx_ok.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.cl_fx_void.restype = None
+        lib.cl_fx_void.argtypes = [ctypes.c_void_p]
+        # Seeded: one argtypes entry short of the three C parameters.
+        lib.cl_fx_arity.restype = ctypes.c_long
+        lib.cl_fx_arity.argtypes = [ctypes.c_void_p, u8p]
+        # Seeded: C returns long, declared c_int (truncation).
+        lib.cl_fx_restype.restype = ctypes.c_int
+        lib.cl_fx_restype.argtypes = [ctypes.c_void_p]
+        # Seeded: argtypes half missing.
+        lib.cl_fx_half.restype = ctypes.c_long
+        # Seeded: no such extern "C" symbol.
+        lib.cl_fx_ghost.restype = ctypes.c_long
+        lib.cl_fx_ghost.argtypes = [ctypes.c_void_p]
+        return lib
+"""
+
+
+def _ffi_fixture_root(tmp_path):
+    return _fake_repo(tmp_path, {
+        "crowdllama_tpu/native/_src/fx.cpp": _FFI_CPP_FIXTURE,
+        "crowdllama_tpu/native/__init__.py": _FFI_PY_FIXTURE,
+    })
+
+
+def test_ffi_contract_catches_seeded_violations(tmp_path):
+    from crowdllama_tpu.analysis.ffi_contract import check_ffi_contract
+
+    hits = {(f.code, f.symbol)
+            for f in check_ffi_contract(_ffi_fixture_root(tmp_path))}
+    assert ("ffi-undeclared", "cl_fx_undeclared") in hits
+    assert ("ffi-undeclared", "cl_fx_half") in hits
+    assert ("ffi-arity", "cl_fx_arity") in hits
+    assert ("ffi-restype", "cl_fx_restype") in hits
+    assert ("ffi-unknown-symbol", "cl_fx_ghost") in hits
+
+
+def test_ffi_contract_true_negatives(tmp_path):
+    from crowdllama_tpu.analysis.ffi_contract import check_ffi_contract
+
+    symbols = {f.symbol
+               for f in check_ffi_contract(_ffi_fixture_root(tmp_path))}
+    # Fully-declared functions (incl. restype None for void) are clean;
+    # a static cl_-named helper outside extern "C" is not part of the ABI.
+    assert "cl_fx_ok" not in symbols
+    assert "cl_fx_void" not in symbols
+    assert "cl_fx_internal" not in symbols
+
+
+def test_ffi_contract_repo_has_zero_waivers():
+    """ISSUE 19 policy: the ABI seam is never waived — both repo baseline
+    hygiene and the checker being clean on the real tree."""
+    from crowdllama_tpu.analysis.ffi_contract import (
+        c_exports,
+        check_ffi_contract,
+        py_declarations,
+    )
+
+    assert not any(e.get("checker") == "ffi-contract"
+                   for e in load_baseline().entries)
+    root = repo_root()
+    findings = check_ffi_contract(root)
+    assert not findings, "\n".join(f.render() for f in findings)
+    # The contract is non-trivially exercised: every native symbol the
+    # data plane uses is visible to both sides of the seam.
+    exports, decls = c_exports(root), py_declarations(root)
+    assert len(exports) >= 15 and set(exports) == set(decls)
+
+
 # ------------------------------------------------------------ the CLI
 
 
@@ -484,7 +588,8 @@ def test_cli_json_format_is_clean_on_repo(capsys):
     data = json.loads(capsys.readouterr().out)
     assert rc == 0
     assert data["findings"] == []
-    assert data["checkers"] == ["async-hotpath", "contracts", "jax-purity"]
+    assert data["checkers"] == ["async-hotpath", "contracts",
+                                "ffi-contract", "jax-purity"]
     assert data["elapsed_s"] < 30.0
 
 
