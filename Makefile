@@ -6,7 +6,7 @@ PYTEST = env JAX_PLATFORMS=cpu $(PY) -m pytest -p no:cacheprovider
 
 .PHONY: test tier1 lint chaos chaos-multi-gateway chaos-soak \
 	distill-smoke bench-kv bench-mixed bench-megastep bench-fused \
-	bench-autopilot bench-swarm trace-demo obs-demo
+	bench-autopilot bench-swarm bench-spec-rtt trace-demo obs-demo
 
 # Full suite (slow soaks included).  Runs lint + the chaos matrix FIRST:
 # swarmlint finishes in seconds and the fault-injection scenarios are the
@@ -77,6 +77,11 @@ obs-demo:
 # under benchmarks/results/.
 bench-kv:
 	env JAX_PLATFORMS=cpu CROWDLLAMA_BENCH_PHASES=kv_transfer $(PY) bench.py
+
+# Gateway-drafted speculative pipeline vs worker-paced stop-and-wait vs
+# plain streaming across injected swarm RTT (docs/SPECULATIVE.md).
+bench-spec-rtt:
+	env JAX_PLATFORMS=cpu CROWDLLAMA_BENCH_PHASES=spec_rtt $(PY) bench.py
 
 # Unified-ragged-batch benchmark (docs/RAGGED_BATCH.md): decode-step p95
 # while a long prefill chunks through the same jitted step (swept over

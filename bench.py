@@ -37,6 +37,9 @@ Phases (CROWDLLAMA_BENCH_PHASES to select, comma-separated):
   kv_transfer  swarm KV shipping: prefix-page fetch vs prefill recompute
             TTFT across injected RTT, with the break-even prefix length
             (benchmarks/kv_transfer.py as a subprocess, CPU)
+  spec_rtt  gateway-drafted speculative pipeline vs worker-paced
+            stop-and-wait vs plain streaming across injected RTT
+            (benchmarks/spec_rtt.py as a subprocess, CPU)
   mini_swarm  REAL tiny engines behind the gateway on CPU — end-to-end
             tok/s + TTFT under concurrent load, with a FakeEngine
             control curve (VERDICT #5; subprocess, CPU)
@@ -134,7 +137,7 @@ _ALL_PHASES = ("kernel", "decode", "decode_paged", "decode8b",
                "decode8b_paged", "decode8b_ctx4k", "ttft", "swarm",
                "ep_dispatch", "kv_transfer", "mini_swarm", "multi_gateway",
                "capacity", "mixed_batch", "ctx32k", "decode_megastep",
-               "obs_overhead", "autopilot", "decode_spec",
+               "obs_overhead", "autopilot", "spec_rtt", "decode_spec",
                "decode_spec_draft", "decode_kv8", "decode8b_int4")
 
 # Phases meaningless on the CPU fallback (real-size or quantized decode).
@@ -1450,6 +1453,12 @@ def _mini_swarm_phase() -> dict:
     return _subprocess_phase("mini_swarm.py", {"JAX_PLATFORMS": "cpu"})
 
 
+def _spec_rtt_phase() -> dict:
+    # Gateway-drafted speculative pipeline across injected RTT (ISSUE 20):
+    # a control-plane ratio like ep_dispatch/kv_transfer, CPU by design.
+    return _subprocess_phase("spec_rtt.py", {"JAX_PLATFORMS": "cpu"})
+
+
 def _autopilot_phase() -> dict:
     # Closed-loop autopilot vs offline grid search (docs/AUTOTUNE.md):
     # a control-plane ratio like swarm/mini_swarm, CPU by design.
@@ -1579,6 +1588,7 @@ def main() -> None:
         "decode_megastep": _decode_megastep_phase,
         "obs_overhead": _obs_overhead_phase,
         "autopilot": _autopilot_phase,
+        "spec_rtt": _spec_rtt_phase,
     }
 
     remaining = [p for p in phases if p in runners]

@@ -33,95 +33,15 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent))
 import _common  # noqa: F401,E402 - repo path + JAX platform bootstrap
 
-import asyncio
+import asyncio  # noqa: F401 - used by the bench body below
 import json
 import os
 import time
 from dataclasses import replace
 
-
-class DelayProxy:
-    """Transparent TCP relay that delivers every chunk ``delay_s`` after it
-    was read, per direction (injected RTT = 2 * delay_s per round trip).
-
-    Delivery is timestamp-scheduled (reader task enqueues, writer task
-    sleeps until due), so reads never stall behind the sleep: a multi-chunk
-    message pays the delay ONCE, not once per chunk."""
-
-    def __init__(self, target_port: int, delay_s: float):
-        self._target = target_port
-        self._delay = delay_s
-        self._server: asyncio.base_events.Server | None = None
-        self._tasks: set[asyncio.Task] = set()
-
-    async def start(self) -> int:
-        self._server = await asyncio.start_server(
-            self._on_conn, "127.0.0.1", 0)
-        return self._server.sockets[0].getsockname()[1]
-
-    async def close(self) -> None:
-        if self._server is not None:
-            self._server.close()
-            await self._server.wait_closed()
-        for t in list(self._tasks):
-            t.cancel()
-        await asyncio.gather(*self._tasks, return_exceptions=True)
-
-    def _track(self, coro) -> None:
-        t = asyncio.create_task(coro)
-        self._tasks.add(t)
-        t.add_done_callback(self._tasks.discard)
-
-    async def _on_conn(self, reader, writer):
-        try:
-            up_r, up_w = await asyncio.open_connection(
-                "127.0.0.1", self._target)
-        except OSError:
-            writer.close()
-            return
-        self._track(self._pump(reader, up_w))
-        self._track(self._pump(up_r, writer))
-
-    async def _pump(self, reader, writer):
-        loop = asyncio.get_running_loop()
-        q: asyncio.Queue = asyncio.Queue()
-
-        async def drain_delayed():
-            while True:
-                item = await q.get()
-                if item is None:
-                    break
-                due, data = item
-                dt = due - loop.time()
-                if dt > 0:
-                    await asyncio.sleep(dt)
-                try:
-                    writer.write(data)
-                    await writer.drain()
-                except (ConnectionError, OSError):
-                    return
-            try:
-                if writer.can_write_eof():
-                    writer.write_eof()  # propagate half-close
-            except (ConnectionError, OSError):
-                pass
-
-        w = asyncio.create_task(drain_delayed())
-        try:
-            while True:
-                chunk = await reader.read(65536)
-                if not chunk:
-                    break
-                q.put_nowait((loop.time() + self._delay, chunk))
-        except (ConnectionError, OSError):
-            pass
-        finally:
-            q.put_nowait(None)
-            try:
-                await w
-            except asyncio.CancelledError:
-                w.cancel()
-                raise
+# Shared injected-latency relay (factored out of this file once the
+# spec-pipeline bench became its third consumer).
+from crowdllama_tpu.testing.netem import DelayProxy  # noqa: E402,F401
 
 
 async def run() -> dict:

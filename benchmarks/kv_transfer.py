@@ -48,7 +48,7 @@ import statistics
 import time
 from dataclasses import replace
 
-from ep_dispatch import DelayProxy  # noqa: E402 - shared delay relay
+from crowdllama_tpu.testing.netem import DelayProxy  # noqa: E402
 
 # tiny-test-gemma is the DEEPEST test-scale model (4 layers): prefill
 # compute per token is the thing a fetch avoids, and the 2-layer toys

@@ -46,7 +46,7 @@ CHECKER = "contracts"
 # which is exactly the forcing function we want.
 RESPONSE_ARMS = frozenset({
     "generate_response", "embed_response", "kv_pages", "migrate_frame",
-    "trace_spans", "metrics_snapshot",
+    "trace_spans", "metrics_snapshot", "verify_result",
 })
 
 # Configuration fields intentionally without a CROWDLLAMA_TPU_* env read.
@@ -58,9 +58,12 @@ _FAMILY_RE = re.compile(r"crowdllama_[a-z0-9_]+")
 # Tokens that look like families but are package/protocol identifiers.
 # `crowdllama_native` alone is the shared-library name; the REAL
 # crowdllama_native_* metric families (obs/http.py native_metric_lines)
-# are longer and must stay doc-checked.
+# are longer and must stay doc-checked.  `crowdllama_manifest` is the
+# checkpoint-cache integrity dotfile (net/model_share.py MANIFEST_NAME),
+# not an exposition family.
 _FAMILY_JUNK_PREFIXES = ("crowdllama_tpu",)
-_FAMILY_JUNK_EXACT = frozenset({"crowdllama_native"})
+_FAMILY_JUNK_EXACT = frozenset({"crowdllama_native",
+                                "crowdllama_manifest"})
 
 
 def _read(root: str, rel: str) -> str:

@@ -147,6 +147,9 @@ class Configuration:
     autotune_draft_max: int = 8
     autotune_budget_max: int = 4096
     autotune_prefill_max: int = 1024
+    # Ceiling for the remote-draft pipeline-depth dial (the depth_hint
+    # advertised to gateways, docs/SPECULATIVE.md).
+    autotune_depth_max: int = 32
     warmup: bool = True  # compile prefill/decode at engine start
     quantize: str = ""  # "" (bf16) | "int8" | "int4" weight-only (ops/quant.py)
     # KV cache layout: "paged" (engine/paged.py, the default: page pool +
@@ -174,6 +177,12 @@ class Configuration:
     # [0, spec_draft_max], pausing speculation entirely (k=0, plain-decode
     # cost) when drafts mostly miss.  0 = fixed spec_draft (seed behavior).
     spec_draft_max: int = 0
+    # Gateway-drafted speculative pipeline (docs/SPECULATIVE.md):
+    # "off" | "gateway" (draft locally at the gateway from
+    # spec_draft_path, stream DraftChunk frames ahead of the worker) |
+    # "worker" (pure ack credits: worker-paced remote speculation, the
+    # RTT-linear baseline).  Streamed requests only.
+    gateway_spec_pipeline: str = "off"
     drain_timeout: float = 30.0  # graceful-shutdown grace for in-flight reqs
     # Robustness plane (docs/ROBUSTNESS.md): per-request wall-clock budget
     # in seconds, charged across retries and mid-stream failovers; clients
@@ -332,6 +341,8 @@ class Configuration:
         cfg.autotune_prefill_max = int(env.get(
             "CROWDLLAMA_TPU_AUTOTUNE_PREFILL_MAX",
             cfg.autotune_prefill_max))
+        cfg.autotune_depth_max = int(env.get(
+            "CROWDLLAMA_TPU_AUTOTUNE_DEPTH_MAX", cfg.autotune_depth_max))
         cfg.shard_group = env.get("CROWDLLAMA_TPU_SHARD_GROUP", cfg.shard_group)
         cfg.shard_index = int(env.get("CROWDLLAMA_TPU_SHARD_INDEX", cfg.shard_index))
         cfg.shard_count = int(env.get("CROWDLLAMA_TPU_SHARD_COUNT", cfg.shard_count))
@@ -363,6 +374,9 @@ class Configuration:
                                       cfg.spec_draft_path)
         cfg.spec_draft_max = int(env.get("CROWDLLAMA_TPU_SPEC_DRAFT_MAX",
                                          cfg.spec_draft_max))
+        cfg.gateway_spec_pipeline = env.get(
+            "CROWDLLAMA_TPU_GATEWAY_SPEC_PIPELINE",
+            cfg.gateway_spec_pipeline)
         cfg.drain_timeout = float(env.get("CROWDLLAMA_TPU_DRAIN_TIMEOUT",
                                           cfg.drain_timeout))
         cfg.request_timeout = float(env.get(
@@ -495,6 +509,9 @@ class Configuration:
         if cfg.autotune_prefill_max < 64:
             raise ValueError(f"autotune_prefill_max must be >= 64, "
                              f"got {cfg.autotune_prefill_max}")
+        if cfg.autotune_depth_max < 1:
+            raise ValueError(f"autotune_depth_max must be >= 1, "
+                             f"got {cfg.autotune_depth_max}")
         if cfg.slo_ttft_ms < 0:
             raise ValueError(f"slo_ttft_ms must be >= 0, "
                              f"got {cfg.slo_ttft_ms}")
@@ -518,6 +535,13 @@ class Configuration:
         if cfg.spec_decode not in ("", "ngram", "draft"):
             raise ValueError(f"unknown spec_decode {cfg.spec_decode!r} "
                              "(want '', 'ngram' or 'draft')")
+        cfg.gateway_spec_pipeline = (
+            cfg.gateway_spec_pipeline or "off").strip().lower()
+        if cfg.gateway_spec_pipeline not in ("off", "gateway", "worker"):
+            raise ValueError(
+                f"unknown gateway_spec_pipeline "
+                f"{cfg.gateway_spec_pipeline!r} "
+                "(want 'off', 'gateway' or 'worker')")
         if cfg.spec_decode:
             # Spec composes with BOTH layouts (VERDICT r3 #4): paged runs
             # SpecPagedModelRunner (bf16 or int8 pools); contiguous still
@@ -616,6 +640,14 @@ class Configuration:
                             help="enable acceptance-adaptive draft length: "
                                  "retune k in [0, max] between dispatches "
                                  "(0 = fixed --spec-draft)")
+        parser.add_argument("--gateway-spec-pipeline",
+                            dest="gateway_spec_pipeline",
+                            choices=("off", "gateway", "worker"),
+                            help="gateway-drafted speculative pipeline: "
+                                 "draft at the gateway (--spec-draft-path) "
+                                 "and batch-verify at the worker; 'worker' "
+                                 "sends pure ack credits (RTT-linear "
+                                 "baseline)")
         parser.add_argument("--step-token-budget", dest="step_token_budget",
                             type=int,
                             help="unified ragged batch: per-step token "
@@ -765,6 +797,7 @@ class Configuration:
                 "quantize", "kv_layout", "kv_page_size", "kv_pool_tokens",
                 "kv_dtype", "relay_mode", "spec_decode", "spec_draft",
                 "spec_draft_model", "spec_draft_path", "spec_draft_max",
+                "gateway_spec_pipeline",
                 "step_token_budget", "ragged_prefill", "megastep_k",
                 "autotune", "autotune_interval", "autotune_megastep_max",
                 "autotune_draft_max", "autotune_budget_max",

@@ -311,6 +311,44 @@ def extract_metrics_snapshot(msg: pb.BaseMessage) -> pb.MetricsSnapshot:
     return msg.metrics_snapshot
 
 
+def draft_chunk_msg(model: str = "", chunk_id: int = 0, position: int = 0,
+                    tokens: Iterable[int] = ()) -> pb.BaseMessage:
+    """Client → worker (docs/SPECULATIVE.md): one chunk of gateway-drafted
+    tokens starting at absolute ``position``; an empty tokens list is a
+    pure pipeline credit (worker-draft pacing mode)."""
+    dc = pb.DraftChunk(model=model, chunk_id=int(chunk_id),
+                       position=int(position))
+    dc.tokens.extend(int(t) for t in tokens)
+    return pb.BaseMessage(draft_chunk=dc)
+
+
+def extract_draft_chunk(msg: pb.BaseMessage) -> pb.DraftChunk:
+    if msg.WhichOneof("message") != "draft_chunk":
+        raise ValueError("message does not contain a DraftChunk")
+    return msg.draft_chunk
+
+
+def verify_result_msg(chunk_id: int = 0, position: int = 0,
+                      accepted: int = 0, tokens: Iterable[int] = (),
+                      done: bool = False, draft_k: int = 0,
+                      depth_hint: int = 0,
+                      prompt_ids: Iterable[int] = ()) -> pb.BaseMessage:
+    """Worker → client: one verify round's outcome (chunk_id 0 = the
+    stream handshake carrying prompt_ids + the first emitted token)."""
+    vr = pb.VerifyResult(chunk_id=int(chunk_id), position=int(position),
+                         accepted=int(accepted), done=bool(done),
+                         draft_k=int(draft_k), depth_hint=int(depth_hint))
+    vr.tokens.extend(int(t) for t in tokens)
+    vr.prompt_ids.extend(int(t) for t in prompt_ids)
+    return pb.BaseMessage(verify_result=vr)
+
+
+def extract_verify_result(msg: pb.BaseMessage) -> pb.VerifyResult:
+    if msg.WhichOneof("message") != "verify_result":
+        raise ValueError("message does not contain a VerifyResult")
+    return msg.verify_result
+
+
 def flatten_chat(messages: Iterable[Mapping[str, str]]) -> str:
     """Flatten Ollama-style chat messages into a single prompt string.
 

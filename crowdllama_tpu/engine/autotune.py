@@ -55,7 +55,8 @@ log = logging.getLogger("crowdllama.autotune")
 
 # The coordinate order.  Gauge children keep this naming on every scrape
 # surface (``crowdllama_autotune_dial{dial="..."}``).
-DIALS = ("megastep_k", "draft_k", "step_token_budget", "prefill_chunk")
+DIALS = ("megastep_k", "draft_k", "step_token_budget", "prefill_chunk",
+         "pipeline_depth")
 
 # Exposition families this module feeds (docs/OBSERVABILITY.md).  The
 # gauge keys below render through obs/metrics.engine_gauge_lines, which
@@ -74,6 +75,7 @@ DEFAULT_BOUNDS = {
     "draft_k": 8,
     "step_token_budget": 4096,
     "prefill_chunk": 1024,
+    "pipeline_depth": 32,
 }
 
 # Keep a move only when the trial phase beats baseline by this margin —
@@ -200,6 +202,19 @@ class AutoTuner:
             vals = sorted({c for c in (64, 128, 256, 512, 1024, 2048)
                            if c <= self.bounds["prefill_chunk"]} | {chunk})
             self._grids["prefill_chunk"] = (tuple(vals), vals.index(chunk))
+        if (getattr(r, "supports_remote_draft", False)
+                and hasattr(sched, "spec_pipeline_depth")):
+            # Remote-draft pipeline depth (docs/SPECULATIVE.md): the cap
+            # advertised to gateways via VerifyResult.depth_hint.  The
+            # gateway's RTT-aware controller takes the min of its own
+            # estimate and this hint, so the dial bounds worker-side
+            # credit backlog rather than picking the depth outright.
+            cur = max(1, int(sched.spec_pipeline_depth))
+            hi = max(1, int(self.bounds["pipeline_depth"]))
+            vals = sorted({d for d in (1, 2, 4, 8, 16, 32)
+                           if d <= hi} | {min(cur, hi)})
+            self._grids["pipeline_depth"] = (
+                tuple(vals), vals.index(min(cur, hi)))
         for name in self._grids:
             self._dir[name] = 1
 
@@ -213,6 +228,8 @@ class AutoTuner:
             return int(getattr(r, "step_token_budget", 0) or 0)
         if name == "prefill_chunk":
             return int(getattr(r, "prefill_chunk", 0) or 0)
+        if name == "pipeline_depth":
+            return int(getattr(sched, "spec_pipeline_depth", 0) or 0)
         return 0
 
     def _recompute_ragged(self, r) -> None:
@@ -251,6 +268,10 @@ class AutoTuner:
             r.prefill_chunk = int(value)
             if getattr(r, "step_token_budget", 0):
                 self._recompute_ragged(r)
+        elif name == "pipeline_depth":
+            # Advertised on the NEXT VerifyResult frame each stream emits;
+            # gateways converge on it within one pipeline round trip.
+            sched.spec_pipeline_depth = max(1, int(value))
 
     def _snapshot(self) -> dict:
         return {name: self._read(name) for name in self._grids}

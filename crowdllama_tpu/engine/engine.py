@@ -27,6 +27,7 @@ from crowdllama_tpu.core.messages import (
     flatten_chat,
     genresp_frame_bytes,
     migrate_frame_msg,
+    verify_result_msg,
 )
 from crowdllama_tpu.testing import faults
 
@@ -53,6 +54,12 @@ class Chunk:
     # empty payload) and prefill ran plain — the flight recorder's
     # kv_ship_fallback trigger confirms against this span meta post-stitch.
     kv_fallback: bool = False
+    # Remote-draft control plane (docs/SPECULATIVE.md): when set, this
+    # chunk answers one consumed DraftChunk credit and handle_streaming
+    # emits a VerifyResult frame for it (keys: chunk_id/position/accepted/
+    # tokens, optionally prompt_ids on the chunk_id=0 handshake).  A pure
+    # verify chunk carries no text and no done flag.
+    verify: dict | None = None
 
 
 class StopMatcher:
@@ -103,6 +110,11 @@ class Engine:
     # hint is dropped silently everywhere else so the wire field is always
     # safe to set.
     supports_kv_donor = False
+    # Engines that can batch-verify gateway-drafted tokens (a runner with
+    # the hosted spec verify program, docs/SPECULATIVE.md) opt in; on every
+    # other engine GenerateRequest.remote_draft streams run unpaced and the
+    # peer nacks DraftChunk credits so the gateway degrades to plain mode.
+    supports_remote_draft = False
 
     async def start(self) -> None: ...
     async def stop(self) -> None: ...
@@ -130,9 +142,16 @@ class Engine:
                   "autotune_reverts_total": 0.0,
                   "autotune_backoffs_total": 0.0})
         for dial in ("megastep_k", "draft_k", "step_token_budget",
-                     "prefill_chunk"):
+                     "prefill_chunk", "pipeline_depth"):
             g[f"autotune_dial|dial={dial}"] = 0.0
         return g
+
+    def _verify_frame_fields(self) -> tuple[int, int]:
+        """(draft_k, depth_hint) advertised on every VerifyResult frame —
+        the worker's live draft length (gateway clamps its chunk size to
+        it; 0 = drafting paused, send pure acks) and the pipeline depth
+        the worker is willing to absorb."""
+        return 0, 1
 
     def set_gossip(self, gossip) -> None:
         """Hand the node's GossipNode to the engine (CLI wiring) so the
@@ -282,7 +301,8 @@ class Engine:
         )
 
     async def handle_streaming(
-        self, msg: pb.BaseMessage, worker_id: str = ""
+        self, msg: pb.BaseMessage, worker_id: str = "",
+        draft_feed=None,
     ) -> AsyncIterator[pb.BaseMessage]:
         """Streaming superset: one GenerateResponse frame per chunk, done
         marked on the last (SURVEY §7 hard part 5 — the reference carries a
@@ -292,11 +312,13 @@ class Engine:
         path yields encoded frames directly; this keeps the pb-object
         surface for tests and non-wire consumers.
         """
-        async for frame in self.handle_streaming_frames(msg, worker_id=worker_id):
+        async for frame in self.handle_streaming_frames(
+                msg, worker_id=worker_id, draft_feed=draft_feed):
             yield wire.decode_payload(frame[4:])
 
     async def handle_streaming_frames(
-        self, msg: pb.BaseMessage, worker_id: str = ""
+        self, msg: pb.BaseMessage, worker_id: str = "",
+        draft_feed=None,
     ) -> AsyncIterator[bytes]:
         """Streaming hot path: yields complete encoded wire frames
         ([4B BE len][BaseMessage]) — one per chunk, trace_id embedded —
@@ -307,9 +329,34 @@ class Engine:
         first_ns = 0
         n_chunk = 0
         final: Chunk | None = None
-        async for chunk in self._gen_from_request(req, trace_id=msg.trace_id):
+        async for chunk in self._gen_from_request(req, trace_id=msg.trace_id,
+                                                  draft_feed=draft_feed):
             if not first_ns:
                 first_ns = time.monotonic_ns()
+            if chunk.verify is not None:
+                # Remote-draft control plane: answer a consumed DraftChunk
+                # credit with a VerifyResult frame, interleaved with (and
+                # invisible to) the client's GenerateResponse stream.
+                v = chunk.verify
+                await faults.inject("spec.verify", worker=worker_id,
+                                    model=req.model,
+                                    chunk_id=int(v.get("chunk_id", 0)))
+                dk, dh = self._verify_frame_fields()
+                vmsg = verify_result_msg(
+                    chunk_id=int(v.get("chunk_id", 0)),
+                    position=int(v.get("position", 0)),
+                    accepted=int(v.get("accepted", 0)),
+                    tokens=[int(t) for t in v.get("tokens", [])],
+                    done=False,
+                    draft_k=int(v.get("draft_k", dk)),
+                    depth_hint=int(v.get("depth_hint", dh)),
+                    prompt_ids=[int(t) for t in v.get("prompt_ids", [])],
+                )
+                if msg.trace_id:
+                    vmsg.trace_id = msg.trace_id
+                yield wire.encode_frame(vmsg)
+                if not chunk.text and not chunk.done:
+                    continue  # pure control chunk: no client frame
             try:
                 await faults.inject("engine.stream_chunk", worker=worker_id,
                                     model=req.model, index=n_chunk)
@@ -388,9 +435,18 @@ class Engine:
         return [], 0
 
     def _gen_from_request(self, req: pb.GenerateRequest,
-                          trace_id: str = "") -> AsyncIterator[Chunk]:
+                          trace_id: str = "",
+                          draft_feed=None) -> AsyncIterator[Chunk]:
         prompt = self._prompt_of(req)
         kwargs = {}
+        if (draft_feed is not None and getattr(req, "remote_draft", False)
+                and self.supports_remote_draft):
+            # Same opt-in shape as kv_donor below: only engines that can
+            # pace on DraftChunk credits see the kwargs, so third-party
+            # generate() signatures keep working and the stream silently
+            # runs unpaced elsewhere (the peer nacks the credits).
+            kwargs["remote_draft"] = True
+            kwargs["draft_feed"] = draft_feed
         donor = getattr(req, "kv_donor", "")
         if donor and self.supports_kv_donor:
             # Only engines that opted in receive the kwargs — third-party
@@ -441,6 +497,17 @@ class JaxEngine(Engine):
 
     def attach_peer(self, peer) -> None:
         self._peer = peer
+
+    @property
+    def supports_remote_draft(self) -> bool:
+        """True once the runner carries the hosted spec verify program
+        (SpecPagedModelRunner) — known only after start() builds it."""
+        return bool(getattr(self._runner, "supports_remote_draft", False))
+
+    def _verify_frame_fields(self) -> tuple[int, int]:
+        r, s = self._runner, self.scheduler
+        return (int(getattr(r, "draft_len", 0)),
+                int(getattr(s, "spec_pipeline_depth", 1)))
 
     def set_gossip(self, gossip) -> None:
         """CLI wiring for the autopilot's warm-start/publish plane.  The
@@ -517,6 +584,7 @@ class JaxEngine(Engine):
                     "draft_k": self.config.autotune_draft_max,
                     "step_token_budget": self.config.autotune_budget_max,
                     "prefill_chunk": self.config.autotune_prefill_max,
+                    "pipeline_depth": self.config.autotune_depth_max,
                 },
                 decode_ms=self.config.slo_decode_ms,
                 gossip=self._gossip)
@@ -977,9 +1045,12 @@ class JaxEngine(Engine):
         kv_donor: str = "",
         kv_trace: str = "",
         migrate: bool = False,
+        remote_draft: bool = False,
+        draft_feed=None,
     ) -> AsyncIterator[Chunk]:
         from crowdllama_tpu.engine.scheduler import (
             DONE,
+            VERIFY,
             GenRequest,
             WedgedError,
         )
@@ -1007,6 +1078,9 @@ class JaxEngine(Engine):
             seed=seed,
             kv_import=kv_import,
         )
+        if remote_draft and draft_feed is not None:
+            req.remote_draft = True
+            req.feed = draft_feed
         await self.scheduler.submit(req)
         decoder = self.tokenizer.stream_decoder()
         matcher = StopMatcher(stop)
@@ -1026,6 +1100,12 @@ class JaxEngine(Engine):
         try:
             while True:
                 token, reason = await req.out.get()
+                if token is VERIFY:
+                    # Remote-draft control plane: the scheduler answers
+                    # each consumed DraftChunk credit with a verify payload
+                    # — pure control chunk, no client-visible text.
+                    yield Chunk(text="", verify=reason)
+                    continue
                 if token is DONE:
                     finished = True
                     if reason.startswith("error: wedged"):
@@ -1046,6 +1126,15 @@ class JaxEngine(Engine):
                     )
                     return
                 completion += 1
+                if completion == 1 and req.remote_draft:
+                    # Handshake (chunk_id 0, never a real credit): gives
+                    # the gateway's drafter the tokenized prompt and the
+                    # model's first token so it can seed its own KV before
+                    # the first text frame even decodes.
+                    yield Chunk(text="", verify={
+                        "chunk_id": 0, "position": 1, "accepted": 0,
+                        "tokens": [int(token)],
+                        "prompt_ids": [int(t) for t in prompt_ids]})
                 if token == req.eos_id:
                     continue  # silent; DONE follows
                 text = decoder.feed(token)

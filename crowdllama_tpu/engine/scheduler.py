@@ -43,6 +43,10 @@ from crowdllama_tpu.testing import faults
 log = logging.getLogger("crowdllama.engine.scheduler")
 
 _DONE = object()
+# Remote-draft verify payload marker on a request's out queue (ISSUE 20,
+# docs/SPECULATIVE.md): the paired value is a dict the engine turns into a
+# VerifyResult wire frame interleaved with the stream's text frames.
+_VERIFY = object()
 # Slot sentinel: reserved for an in-progress chunked admission — occupied
 # (skipped by _free_slot) but carrying no request yet.
 _RESERVED = object()
@@ -103,6 +107,13 @@ class GenRequest:
     # final chunk could deliver BOTH a "stop" and a "migrate" terminal,
     # and the consumer/gateway would see a phantom second completion.
     finished: bool = False
+    # Gateway-drafted speculation (ISSUE 20, docs/SPECULATIVE.md): the
+    # request rides a paced remote-draft stream, and ``feed`` is its
+    # DraftFeed (core/spec_pipeline.py, duck-typed here) — one credit
+    # consumed per verify round, one _VERIFY payload pushed back per
+    # credit.  None = ordinary stream.
+    remote_draft: bool = False
+    feed: object | None = None
 
     def finish(self, reason: str) -> bool:
         """Atomically claim this request's terminal: exactly one
@@ -141,6 +152,10 @@ class _InFlightChunk:
     # done-flags [K, B], read back in the same transfer as the tokens.
     # None for legacy per-step-chunk dispatches.
     done_dev: object = None
+    # Remote-draft pacing (docs/SPECULATIVE.md): the (slot, chunk_id)
+    # credits this flight consumed — retire answers each with a _VERIFY
+    # payload carrying the tokens that slot emitted in the flight.
+    verify_meta: list | None = None
 
 
 class Scheduler:
@@ -241,6 +256,17 @@ class Scheduler:
         self._accept_off = 0     # window: draft tokens offered
         self._plain_since_probe = 0
         self._spec_probing = False
+        # Gateway-drafted pipeline (ISSUE 20, docs/SPECULATIVE.md): slots
+        # whose request carries a DraftFeed advance one verify round per
+        # wire credit.  spec_pipeline_depth is the depth hint advertised
+        # back on every VerifyResult (the AutoTuner's fifth dial); the
+        # stall budget releases a creditless stream to full speed
+        # (free_run) so a dead gateway pump can never park a batch.
+        self.spec_pipeline_depth = 8
+        self.spec_pipeline_stall_s = 2.0
+        self.spec_verifies = 0         # hosted/ack verify rounds answered
+        self.spec_stale_chunks = 0     # draft chunks nacked unverified
+        self.spec_pipeline_freeruns = 0  # paced streams released
         # Unified ragged batch (ISSUE 9, docs/RAGGED_BATCH.md): when the
         # runner supports it, long prompts prefill INSIDE the decode
         # dispatch (fixed-token chunks riding the per-step token budget)
@@ -353,6 +379,10 @@ class Scheduler:
                 raise OverloadedError(
                     f"overloaded: {depth} requests pending (admission "
                     f"threshold {self.admission_pending_max})")
+        if req.feed is not None:
+            # Credits pushed by the peer's chunk reader must wake a parked
+            # dispatch loop (same event loop: a plain callback suffices).
+            req.feed._waker = self._wake.set
         await self.pending.put(req)
         self._track(req)
         self._wake.set()
@@ -650,8 +680,20 @@ class Scheduler:
                       "autotune_reverts_total": 0.0,
                       "autotune_backoffs_total": 0.0})
             for dial in ("megastep_k", "draft_k", "step_token_budget",
-                         "prefill_chunk"):
+                         "prefill_chunk", "pipeline_depth"):
                 g[f"autotune_dial|dial={dial}"] = 0.0
+        # Remote-draft pipeline plane (ISSUE 20, docs/SPECULATIVE.md):
+        # always present so the crowdllama_spec_pipeline_* families exist
+        # on every worker (absent()-alert invariant) — zeros until a
+        # gateway opens a paced stream.
+        g["spec_pipeline_depth"] = float(
+            getattr(self, "spec_pipeline_depth", 0))
+        g["spec_pipeline_verifies"] = float(
+            getattr(self, "spec_verifies", 0))
+        g["spec_pipeline_stale"] = float(
+            getattr(self, "spec_stale_chunks", 0))
+        g["spec_pipeline_freeruns"] = float(
+            getattr(self, "spec_pipeline_freeruns", 0))
         if hasattr(r, "draft_len"):
             # Speculation acceptance on BOTH /metrics surfaces (gateway
             # aggregates worker gauges): emitted/steps is the live
@@ -862,6 +904,144 @@ class Scheduler:
             log.info("spec retune: draft_len %d -> %d (window rate %.2f)",
                      k, new_k, rate)
 
+    # ------------------------------------ gateway-drafted pipeline pacing
+
+    def _paced_slots(self, rjob) -> list:
+        """Live slots pacing their decode on remote-draft credits, after
+        the release rules: a closed-and-drained feed, a mixed batch
+        (unpaced live slots share the fixed-shape dispatch), or an active
+        ragged prefill flips its stream to free_run.  Pacing is exact
+        only when every live slot is paced — the remote-draft serving
+        regime; anything else degrades to best-effort full speed."""
+        paced = []
+        live = 0
+        for i, info in enumerate(self.slots):
+            if not isinstance(info, _SlotInfo):
+                continue
+            live += 1
+            feed = getattr(info.req, "feed", None)
+            if feed is None or feed.free_run:
+                continue
+            if feed.closed and not feed.chunks:
+                feed.free_run = True  # gateway hung up: finish at speed
+                continue
+            paced.append((i, info))
+        if paced and (rjob is not None or len(paced) != live):
+            for _i, info in paced:
+                info.req.feed.free_run = True
+                self.spec_pipeline_freeruns += 1
+            return []
+        return paced
+
+    async def _dispatch_paced(self, loop, paced):
+        """One pipeline round over paced slots: consume one credit per
+        feed (flushing stale draft chunks with an immediate nack), then
+        dispatch ONE verify round — the hosted program over the gateway's
+        drafts when any credit carried tokens, the worker's own spec/plain
+        step for pure-ack credits.  Creditless feeds park the loop on the
+        wake event until credit arrives or the stall budget releases the
+        stream to free_run.  Returns the in-flight chunk, or None when no
+        dispatch happened this iteration."""
+        import functools
+
+        if self._inflight is not None:
+            # The previous round has not retired, so per-slot generated
+            # counts are pre-retire — validating a pipelined credit here
+            # (positioned assuming that round fully accepts) would flush
+            # it as stale.  Skip; the loop retires the flight right after
+            # this and the next iteration consumes credits against
+            # current counts.  Paced rounds thus give up the dispatch/
+            # readback overlap: the credit pipeline hides swarm RTT,
+            # which dwarfs the readback latency the overlap hides.
+            return None
+
+        now = time.monotonic()
+        ready = True
+        park = self.spec_pipeline_stall_s
+        for _i, info in paced:
+            feed = info.req.feed
+            if feed.chunks:
+                feed.stalled_at = 0.0
+                continue
+            if not feed.stalled_at:
+                feed.stalled_at = now
+            waited = now - feed.stalled_at
+            if waited >= self.spec_pipeline_stall_s:
+                feed.free_run = True
+                self.spec_pipeline_freeruns += 1
+                log.warning("spec pipeline stall: releasing paced stream "
+                            "to full speed after %.1fs without credit",
+                            waited)
+            else:
+                ready = False
+                park = min(park, self.spec_pipeline_stall_s - waited)
+        if any(info.req.feed.free_run for _i, info in paced):
+            return None  # released: the next iteration dispatches normally
+        if not ready:
+            # Park only when nothing else needs the loop (an undrained
+            # flight, pending admissions, cancels and exclusive fns all
+            # take priority and re-enter here next iteration).
+            if (self._inflight is None and self.pending.empty()
+                    and not self._deferred and not self._exclusive
+                    and self._migrating is None and self._chunking is None):
+                self._wake.clear()
+                try:
+                    await asyncio.wait_for(self._wake.wait(),
+                                           timeout=max(0.01, park))
+                except asyncio.TimeoutError:
+                    pass
+            return None
+        kmax = int(getattr(self.runner, "draft_len", 0))
+        meta: list[tuple[int, int]] = []
+        token_chunks: dict[int, list[int]] = {}
+        for i, info in paced:
+            feed = info.req.feed
+            credit = None
+            while feed.chunks:
+                cid, pos, toks = feed.chunks.popleft()
+                if toks and (kmax <= 0 or pos != info.generated):
+                    # Stale (drafted from a superseded prefix — an earlier
+                    # partial acceptance corrected past its base) or the
+                    # runner paused drafting since the advertise: nack
+                    # immediately so the gateway's window keeps moving
+                    # without a wasted verify forward.
+                    self.spec_stale_chunks += 1
+                    self.spec_verifies += 1
+                    info.req.out.put_nowait((_VERIFY, {
+                        "chunk_id": cid, "position": info.generated,
+                        "accepted": 0, "tokens": []}))
+                    continue
+                credit = (cid, pos, toks)
+                break
+            if credit is None:
+                continue  # the stale flush ate every queued credit
+            cid, _pos, toks = credit
+            meta.append((i, cid))
+            if toks:
+                token_chunks[i] = toks
+        if not meta:
+            return None
+        if token_chunks:
+            kk = min(max(len(t) for t in token_chunks.values()), kmax)
+            drafts = np.full((len(self.slots), kk), -1, np.int32)
+            for i, toks in token_chunks.items():
+                t = toks[:kk]
+                drafts[i, :len(t)] = t
+            tokens_dev, self.state = await loop.run_in_executor(
+                self._exec, functools.partial(
+                    self.runner.decode_steps_hosted, self.state, drafts))
+        else:
+            # Pure ack credits (worker-draft pacing): one round of the
+            # worker's OWN program — a packed spec verify step while
+            # drafting is on, a plain step while paused.
+            tokens_dev, self.state = await loop.run_in_executor(
+                self._exec, self.runner.decode_steps_device, self.state, 1)
+        self._step_budget_used = float(len(meta))
+        self.host_dispatches += 1
+        return _InFlightChunk(
+            tokens_dev=tokens_dev, snapshot=list(self.slots),
+            dispatched_at=time.monotonic(), verify_meta=meta)
+
     async def _loop(self) -> None:
         while True:
             try:
@@ -1008,7 +1188,15 @@ class Scheduler:
                 await loop.run_in_executor(self._exec, abort, job)
         if (rjob is not None
                 or any(isinstance(s, _SlotInfo) for s in self.slots)):
-            k = self._chunk_size()
+            # Gateway-drafted pacing (ISSUE 20, docs/SPECULATIVE.md):
+            # when EVERY live slot rides a remote-draft stream, decode
+            # advances one verify round per wire credit instead of free-
+            # running — the gateway's outstanding-chunk window becomes
+            # the dispatch clock.  Mixed batches and ragged prefills
+            # release paced streams to full speed (pacing is perf-only;
+            # the token stream is byte-identical either way).
+            paced = self._paced_slots(rjob)
+            k = 1 if paced else self._chunk_size()
             # Megastep upgrade (docs/MEGASTEP.md): only full-size decode
             # chunks become megasteps — size-1 dispatches (admittable
             # request waiting, spec probes) keep their latency purpose,
@@ -1023,7 +1211,7 @@ class Scheduler:
             # step body is draft-independent (drafting pauses during a
             # ragged prefill), so no draft_len gate.  Deciding BEFORE
             # pre_decode_check sizes page growth for the real step count.
-            use_mega = (self._megastep and rjob is None
+            use_mega = (self._megastep and rjob is None and not paced
                         and k == self.decode_chunk
                         and getattr(self.runner, "draft_len", 0) == 0)
             use_ragged_mega = (self._megastep and rjob is not None
@@ -1147,6 +1335,8 @@ class Scheduler:
                         req.first_token_at = time.monotonic()
                         self._emit(req, first, info)
                         await self._flush_releases(loop)
+            elif paced:
+                dispatched = await self._dispatch_paced(loop, paced)
             elif live:
                 done_dev = None
                 if use_mega:
@@ -1387,6 +1577,9 @@ class Scheduler:
         emitted = 0
         chunk_acc = 0  # draft tokens accepted in this chunk (live slots)
         chunk_off = 0  # draft tokens offered in this chunk (live slots)
+        # Paced flights answer each consumed DraftChunk credit with ONE
+        # VerifyResult carrying the tokens this round actually emitted.
+        verify_tok: dict[int, list[int]] = {}
         # k at DISPATCH time, recovered from the packed layout [K, 3+k, B]
         # — the live draft_len may already have been retuned since.
         k_dispatch = tokens.shape[1] - 3 if tokens.ndim == 3 else 0
@@ -1406,8 +1599,10 @@ class Scheduler:
                     for jj in range(int(tokens[step, 0, i])):
                         if self.slots[i] is not info:  # retired mid-step
                             break
-                        self._emit(info.req, int(tokens[step, 1 + jj, i]),
-                                   info)
+                        tok = int(tokens[step, 1 + jj, i])
+                        self._emit(info.req, tok, info)
+                        if fl.verify_meta is not None:
+                            verify_tok.setdefault(i, []).append(tok)
                         emitted += 1
                         step_emitted += 1
                     # Split by source, counting only tokens actually
@@ -1424,7 +1619,10 @@ class Scheduler:
                         chunk_acc += step_emitted - 1
                         chunk_off += k_dispatch
                 else:
-                    self._emit(info.req, int(tokens[step, i]), info)
+                    tok = int(tokens[step, i])
+                    self._emit(info.req, tok, info)
+                    if fl.verify_meta is not None:
+                        verify_tok.setdefault(i, []).append(tok)
                     emitted += 1
         if tokens.ndim == 3:
             # Acceptance telemetry: emitted / (verify steps × live slots)
@@ -1460,6 +1658,21 @@ class Scheduler:
             # windows are skipped for the same reason the EMA skips them.
             self._autotune.on_window(cls, self._duty.get(cls, 0.0),
                                      emitted, dt)
+        if fl.verify_meta:
+            # One VerifyResult per consumed credit: position is the slot's
+            # post-round generated count, accepted = emitted - 1 (the last
+            # emit is always the model-chosen continuation, never a draft).
+            # A slot retired mid-round still answers its credit (possibly
+            # with done already queued) so the gateway's window drains.
+            for slot_idx, chunk_id in fl.verify_meta:
+                info = fl.snapshot[slot_idx]
+                if not isinstance(info, _SlotInfo):
+                    continue
+                toks = verify_tok.get(slot_idx, [])
+                self.spec_verifies += 1
+                info.req.out.put_nowait((_VERIFY, {
+                    "chunk_id": chunk_id, "position": info.generated,
+                    "accepted": max(0, len(toks) - 1), "tokens": toks}))
         await self._flush_releases(loop)
         if emitted == 0:
             # Pure-overshoot chunk (dispatched before its slots' EOS was
@@ -1473,3 +1686,4 @@ class Scheduler:
 
 
 DONE = _DONE
+VERIFY = _VERIFY

@@ -316,6 +316,12 @@ class SpecPagedModelRunner(_AdaptiveDraftLen, PagedModelRunner):
     little earlier).
     """
 
+    # Gateway-drafted speculation (docs/SPECULATIVE.md): this runner can
+    # batch-verify draft chunks proposed by a REMOTE drafter — the packed
+    # verify program is proposal-agnostic, so a wire-delivered chunk slots
+    # in exactly where the local proposer's drafts would.
+    supports_remote_draft = True
+
     def __init__(self, cfg, *args, draft_len: int = 4, **kwargs):
         super().__init__(cfg, *args, **kwargs)
         self.draft_len = max(1, draft_len)
@@ -323,6 +329,9 @@ class SpecPagedModelRunner(_AdaptiveDraftLen, PagedModelRunner):
         self._spec_decode = jax.jit(self._spec_decode_impl,
                                     donate_argnums=(1,),
                                     static_argnums=(4, 5))
+        self._hosted_verify = jax.jit(self._hosted_verify_impl,
+                                      donate_argnums=(1,),
+                                      static_argnums=(4,))
         self._set_hist = jax.jit(self._set_hist_impl, donate_argnums=(0,))
 
     # ------------------------------------------------------------------ state
@@ -356,10 +365,18 @@ class SpecPagedModelRunner(_AdaptiveDraftLen, PagedModelRunner):
 
     # ---------------------------------------------------------------- decode
 
-    def _spec_decode_impl(self, params, state, page_table, prompt_lens,
-                          num_steps: int, draft_len: int):
-        """``num_steps`` verify steps; returns (packed [K, 2+J, B], state).
-        ``draft_len`` is static (see the contiguous runner's docstring)."""
+    def _verify_step_body(self, params, st, page_table, seq_drafts,
+                          match_drafts, from_prompt, draft_k, draft_v,
+                          draft_len: int):
+        """One traced verify step over explicit drafts — the layout half
+        shared by the local scan (:meth:`_spec_decode_impl`) and the
+        hosted remote-draft entry (:meth:`_hosted_verify_impl`).
+
+        ``seq_drafts`` feed the forward (must be valid token ids);
+        ``match_drafts`` feed the acceptance compare — the hosted path
+        clamps -1 "no draft" sentinels for the embedding lookup while
+        matching the RAW ids so a sentinel can never be accepted.
+        Returns ``(new_state, packed [2+J, B])``."""
         cfg = self.cfg
         b = self.max_slots
         j = 1 + draft_len
@@ -371,80 +388,104 @@ class SpecPagedModelRunner(_AdaptiveDraftLen, PagedModelRunner):
         bidx = jnp.arange(b)
         quant = self.kv_dtype == "int8"
 
+        seq_tok = jnp.concatenate([st.tokens[:, None], seq_drafts], 1)
+        positions = jnp.minimum(st.seq_lens[:, None] + jnp.arange(j),
+                                s_max - 1)                  # [B, J]
+
+        # Context: the dequantized virtual-contiguous view of every
+        # slot's pages (what the jnp paged decode fallback attends
+        # over); garbage beyond seq_lens is masked by ctx_valid.
+        ck = st.pool_k[:, page_table]     # [L, B, NP, Hkv, pg, Dh]
+        cv = st.pool_v[:, page_table]
+        if quant:
+            ck = (ck.astype(jnp.float32)
+                  * st.k_scale[:, page_table][..., None]
+                  .astype(jnp.float32))
+            cv = (cv.astype(jnp.float32)
+                  * st.v_scale[:, page_table][..., None]
+                  .astype(jnp.float32))
+        ck = ck.transpose(0, 1, 3, 2, 4, 5).reshape(
+            l, b, hkv, view, dh).astype(self.dtype)
+        cv = cv.transpose(0, 1, 3, 2, 4, 5).reshape(
+            l, b, hkv, view, dh).astype(self.dtype)
+        ctx_valid = jnp.arange(view)[None, :] < st.seq_lens[:, None]
+
+        logits, ks, vs = T.prefill(
+            params, cfg, seq_tok, positions,
+            ctx_k=ck, ctx_v=cv, ctx_valid=ctx_valid,
+        )  # logits [B, J, V]; ks/vs [L, B, Hkv, J, Dh]
+
+        # Scatter the J new KV entries into pages (dump page for
+        # inactive slots — their table rows may alias live pages).
+        pages_bj = jnp.where(
+            st.active[:, None],
+            page_table[bidx[:, None], positions // pg],
+            self.total_pages)                               # [B, J]
+        off = positions % pg
+        k_scale, v_scale = st.k_scale, st.v_scale
+        if quant:
+            from crowdllama_tpu.ops.quant import quantize_kv
+
+            ks, k_sc = quantize_kv(ks, scale_dtype=k_scale.dtype)
+            vs, v_sc = quantize_kv(vs, scale_dtype=v_scale.dtype)
+            k_scale = k_scale.at[:, pages_bj, :, off].set(
+                k_sc.transpose(1, 3, 0, 2))
+            v_scale = v_scale.at[:, pages_bj, :, off].set(
+                v_sc.transpose(1, 3, 0, 2))
+        pool_k = st.pool_k.at[:, pages_bj, :, off].set(
+            ks.transpose(1, 3, 0, 2, 4).astype(st.pool_k.dtype))
+        pool_v = st.pool_v.at[:, pages_bj, :, off].set(
+            vs.transpose(1, 3, 0, 2, 4).astype(st.pool_v.dtype))
+
+        counts, emit, pending, hist, carry = _verify_accept_emit(
+            st, logits, match_drafts, j, s_max)
+
+        new_state = PagedDecodeState(
+            pool_k=pool_k, pool_v=pool_v,
+            k_scale=k_scale, v_scale=v_scale,
+            seq_lens=st.seq_lens + counts,
+            tokens=jnp.where(st.active, pending, st.tokens),
+            active=st.active,
+            temperature=st.temperature, top_p=st.top_p,
+            top_k=st.top_k, repeat_penalty=st.repeat_penalty,
+            recent=st.recent, keys=carry, hist=hist,
+            draft_k=draft_k, draft_v=draft_v,
+        )
+        src = jnp.where(counts > 1,
+                        jnp.where(from_prompt, 1, 2), 0)    # [B]
+        packed = jnp.concatenate(
+            [counts[None, :], emit.T, src[None, :]], axis=0)  # [2+J, B]
+        return new_state, packed
+
+    def _spec_decode_impl(self, params, state, page_table, prompt_lens,
+                          num_steps: int, draft_len: int):
+        """``num_steps`` verify steps; returns (packed [K, 2+J, B], state).
+        ``draft_len`` is static (see the contiguous runner's docstring)."""
+
         def step(st, _):
             drafts, from_prompt, draft_k, draft_v = self._propose_in_step(
                 st, prompt_lens, draft_len)
-            seq_tok = jnp.concatenate([st.tokens[:, None], drafts], 1)
-            positions = jnp.minimum(st.seq_lens[:, None] + jnp.arange(j),
-                                    s_max - 1)                  # [B, J]
-
-            # Context: the dequantized virtual-contiguous view of every
-            # slot's pages (what the jnp paged decode fallback attends
-            # over); garbage beyond seq_lens is masked by ctx_valid.
-            ck = st.pool_k[:, page_table]     # [L, B, NP, Hkv, pg, Dh]
-            cv = st.pool_v[:, page_table]
-            if quant:
-                ck = (ck.astype(jnp.float32)
-                      * st.k_scale[:, page_table][..., None]
-                      .astype(jnp.float32))
-                cv = (cv.astype(jnp.float32)
-                      * st.v_scale[:, page_table][..., None]
-                      .astype(jnp.float32))
-            ck = ck.transpose(0, 1, 3, 2, 4, 5).reshape(
-                l, b, hkv, view, dh).astype(self.dtype)
-            cv = cv.transpose(0, 1, 3, 2, 4, 5).reshape(
-                l, b, hkv, view, dh).astype(self.dtype)
-            ctx_valid = jnp.arange(view)[None, :] < st.seq_lens[:, None]
-
-            logits, ks, vs = T.prefill(
-                params, cfg, seq_tok, positions,
-                ctx_k=ck, ctx_v=cv, ctx_valid=ctx_valid,
-            )  # logits [B, J, V]; ks/vs [L, B, Hkv, J, Dh]
-
-            # Scatter the J new KV entries into pages (dump page for
-            # inactive slots — their table rows may alias live pages).
-            pages_bj = jnp.where(
-                st.active[:, None],
-                page_table[bidx[:, None], positions // pg],
-                self.total_pages)                               # [B, J]
-            off = positions % pg
-            k_scale, v_scale = st.k_scale, st.v_scale
-            if quant:
-                from crowdllama_tpu.ops.quant import quantize_kv
-
-                ks, k_sc = quantize_kv(ks, scale_dtype=k_scale.dtype)
-                vs, v_sc = quantize_kv(vs, scale_dtype=v_scale.dtype)
-                k_scale = k_scale.at[:, pages_bj, :, off].set(
-                    k_sc.transpose(1, 3, 0, 2))
-                v_scale = v_scale.at[:, pages_bj, :, off].set(
-                    v_sc.transpose(1, 3, 0, 2))
-            pool_k = st.pool_k.at[:, pages_bj, :, off].set(
-                ks.transpose(1, 3, 0, 2, 4).astype(st.pool_k.dtype))
-            pool_v = st.pool_v.at[:, pages_bj, :, off].set(
-                vs.transpose(1, 3, 0, 2, 4).astype(st.pool_v.dtype))
-
-            counts, emit, pending, hist, carry = _verify_accept_emit(
-                st, logits, drafts, j, s_max)
-
-            new_state = PagedDecodeState(
-                pool_k=pool_k, pool_v=pool_v,
-                k_scale=k_scale, v_scale=v_scale,
-                seq_lens=st.seq_lens + counts,
-                tokens=jnp.where(st.active, pending, st.tokens),
-                active=st.active,
-                temperature=st.temperature, top_p=st.top_p,
-                top_k=st.top_k, repeat_penalty=st.repeat_penalty,
-                recent=st.recent, keys=carry, hist=hist,
-                draft_k=draft_k, draft_v=draft_v,
-            )
-            src = jnp.where(counts > 1,
-                            jnp.where(from_prompt, 1, 2), 0)    # [B]
-            packed = jnp.concatenate(
-                [counts[None, :], emit.T, src[None, :]], axis=0)  # [2+J, B]
-            return new_state, packed
+            return self._verify_step_body(
+                params, st, page_table, drafts, drafts, from_prompt,
+                draft_k, draft_v, draft_len)
 
         new_state, packed = jax.lax.scan(step, state, length=num_steps)
         return packed, new_state  # packed [K, 2+J, B]
+
+    def _hosted_verify_impl(self, params, state, page_table, drafts,
+                            draft_len: int):
+        """One verify step over REMOTELY-proposed drafts ([B, draft_len]
+        int32, -1 = "no draft for this slot").  Sentinels are clamped for
+        the forward only; the acceptance compare sees the raw ids, so a
+        slot with no draft degrades to exact plain greedy (one
+        model-chosen token emits).  Local draft caches pass through
+        untouched — the remote drafter owns proposal state."""
+        safe = jnp.maximum(drafts, 0)
+        from_prompt = jnp.zeros((self.max_slots,), bool)
+        new_state, packed = self._verify_step_body(
+            params, state, page_table, safe, drafts, from_prompt,
+            state.draft_k, state.draft_v, draft_len)
+        return packed[None], new_state  # [1, 2+J, B]
 
     def _propose_in_step(self, st, prompt_lens, draft_len: int):
         """Traced draft proposal for one verify step: returns
@@ -482,6 +523,32 @@ class SpecPagedModelRunner(_AdaptiveDraftLen, PagedModelRunner):
             if slot == self._ragged_slot:
                 continue
             self._host_seq[slot] = min(self._host_seq[slot] + num_steps * j,
+                                       self.max_seq)
+        return packed, new_state
+
+    def decode_steps_hosted(self, state, drafts_np):
+        """One verify step over gateway-supplied drafts (the remote-draft
+        pipeline, docs/SPECULATIVE.md): ``drafts_np`` is [B, k] int32 with
+        -1 marking slots that have no remote draft this round.  Returns
+        the same packed [1, 2+J, B] block one local spec step produces,
+        so the scheduler's retire path is layout-identical.  ``k`` is
+        bounded by ``self.draft_len`` (the gateway clamps chunks to the
+        advertised k), keeping ``pre_decode_check(1)``'s capacity reserve
+        valid."""
+        k = int(drafts_np.shape[1])
+        assert 1 <= k <= self.draft_len, (
+            f"hosted chunk k={k} outside [1, {self.draft_len}]")
+        self._ensure_capacity(1 + k)
+        sig = f"hosted_1x{k}"
+        t_c = ENGINE_TELEMETRY.compile_begin("spec_verify_hosted", sig)
+        packed, new_state = self._hosted_verify(
+            self.params, state, jnp.asarray(self.page_table),
+            jnp.asarray(np.asarray(drafts_np, dtype=np.int32)), k)
+        ENGINE_TELEMETRY.compile_end("spec_verify_hosted", sig, t_c)
+        for slot in self._slot_pages:
+            if slot == self._ragged_slot:
+                continue
+            self._host_seq[slot] = min(self._host_seq[slot] + 1 + k,
                                        self.max_seq)
         return packed, new_state
 

@@ -1,0 +1,311 @@
+"""Gateway-side speculative drafting (docs/SPECULATIVE.md).
+
+PR 4 put the draft model next to the verifier; this module puts it next
+to the CLIENT.  The gateway runs the distilled draft checkpoint locally,
+streams draft-token chunks ahead of the worker over the authenticated
+inference stream (``DraftChunk`` frames), and the worker batch-verifies
+each chunk with the hosted spec program — so the swarm RTT is paid once
+per pipeline window instead of once per token.
+
+Three pieces, all single-stream-scoped:
+
+- :class:`GatewayDrafter` — the loaded draft model (params + jitted
+  prefill/step), shared across streams; one per gateway process.
+- :class:`DraftSession` — per-stream drafting state: the committed
+  sequence, the outstanding speculative rollout, and a contiguous KV
+  cache kept in lockstep (rejected-tail KV is masked by position and
+  overwritten, the same contract the worker's draft cache uses).
+- :class:`SpecPipelinePump` — per-stream flow control: keeps
+  ``min(controller depth, worker depth_hint)`` chunks in flight, feeds
+  the RTT/step/acceptance estimators from VerifyResult arrivals, and
+  degrades to pure-ack credits (worker-draft pacing) when there is no
+  drafter or the acceptance controller pauses.
+
+Correctness never depends on any of this: the worker's verify is exact
+(the client stream is byte-identical to plain greedy decode), drafts
+only decide how many tokens each round emits.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from crowdllama_tpu.core import wire
+from crowdllama_tpu.core.messages import draft_chunk_msg
+from crowdllama_tpu.core.spec_pipeline import PipelineDepthController
+
+log = logging.getLogger("crowdllama.gateway.draft")
+
+
+def _bucket(n: int, lo: int = 16) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+class GatewayDrafter:
+    """The gateway's local draft model: one native checkpoint, jitted
+    prefill + greedy decode step, shared by every stream's session."""
+
+    def __init__(self, params, cfg, max_seq: int = 2048):
+        import jax
+
+        self.params = params
+        self.cfg = cfg
+        self.max_seq = int(max_seq)
+        self._prefill = jax.jit(self._prefill_impl)
+        self._step = jax.jit(self._step_impl, donate_argnums=(2, 3))
+
+    @classmethod
+    def from_checkpoint(cls, path: str, max_seq: int = 2048,
+                        seed: int = 0) -> "GatewayDrafter":
+        """Load a draft checkpoint dir (native layout from train/distill,
+        or HF safetensors) exactly the way the worker engine would."""
+        from crowdllama_tpu.engine.weights import (
+            config_from_hf_dir,
+            is_native_checkpoint,
+            load_or_init_params,
+            native_config_from_dir,
+        )
+
+        if is_native_checkpoint(path):
+            cfg = native_config_from_dir(path)
+        else:
+            cfg = config_from_hf_dir(path)
+        params = load_or_init_params(cfg, path, seed=seed)
+        return cls(params, cfg, max_seq=max_seq)
+
+    def _prefill_impl(self, tokens, plen):
+        """tokens [1, T] zero-padded; returns (next token predicted after
+        position plen-1, KV cache [L, 1, Hkv, max_seq, Dh])."""
+        import jax
+        import jax.numpy as jnp
+
+        from crowdllama_tpu.models import transformer as T
+
+        t = tokens.shape[1]
+        positions = jnp.minimum(jnp.arange(t)[None, :], plen - 1)
+        kv_valid = (jnp.arange(t) < plen)[None, :]
+        logits, ks, vs = T.prefill(self.params, self.cfg, tokens,
+                                   positions, kv_valid=kv_valid)
+        nxt = jnp.argmax(logits[0, plen - 1], axis=-1).astype(jnp.int32)
+        num_l, _, num_h, _, dh = ks.shape
+        k = jnp.zeros((num_l, 1, num_h, self.max_seq, dh), ks.dtype)
+        v = jnp.zeros_like(k)
+        k = jax.lax.dynamic_update_slice(k, ks, (0, 0, 0, 0, 0))
+        v = jax.lax.dynamic_update_slice(v, vs, (0, 0, 0, 0, 0))
+        return nxt, k, v
+
+    def _step_impl(self, tok, pos, k, v):
+        """Ingest ``tok`` at position ``pos``; returns the greedy next
+        token and the extended cache."""
+        import jax.numpy as jnp
+
+        from crowdllama_tpu.models import transformer as T
+
+        logits, k, v = T.decode_step(
+            self.params, self.cfg, tok[None], pos[None], k, v,
+            (pos + 1)[None])
+        return jnp.argmax(logits[0], axis=-1).astype(jnp.int32), k, v
+
+    def session(self, prompt_ids, first_token: int) -> "DraftSession":
+        return DraftSession(self, prompt_ids, first_token)
+
+
+class DraftSession:
+    """Per-stream draft state.
+
+    ``seq`` is the committed sequence (prompt + every token the worker
+    has verified), ``spec`` the outstanding greedy rollout beyond it, and
+    ``sent`` how far into ``spec`` chunks have already been shipped.
+    Chunk i+1 is positioned assuming chunk i fully accepts: the worker's
+    generative emit after a full accept is the rollout's next token, so
+    the pointer skips one drafted token per shipped chunk.  A partial
+    accept invalidates the rollout (``observe`` drops it and rewinds the
+    KV watermark); the in-flight tail comes back as stale nacks and the
+    pump re-drafts from the corrected prefix.
+    """
+
+    def __init__(self, drafter: GatewayDrafter, prompt_ids,
+                 first_token: int):
+        self.d = drafter
+        self.prompt_len = len(prompt_ids)
+        self.seq = [int(t) for t in prompt_ids] + [int(first_token)]
+        self.spec: list[int] = []
+        self.sent = 0
+        self.kv = None  # (k, v) device arrays, allocated on first draft
+        self.ingested = 0  # tokens whose KV is in the cache
+        self._next = None  # predicted token after position ingested-1
+
+    def observe(self, tokens) -> None:
+        """Fold one verify round's emitted tokens into the state."""
+        import numpy as _np  # noqa: F401  (kept jax-free on this path)
+
+        for t in tokens:
+            t = int(t)
+            if self.spec and self.spec[0] == t:
+                self.spec.pop(0)
+                self.sent = max(0, self.sent - 1)
+            else:
+                # Rollout diverged from the model: everything speculative
+                # is garbage, including its KV tail (masked by position,
+                # overwritten on the next catch-up).
+                self.spec = []
+                self.sent = 0
+                self.ingested = min(self.ingested, len(self.seq))
+                self._next = None
+            self.seq.append(t)
+
+    def _extend(self, n: int) -> None:
+        import jax.numpy as jnp
+        import numpy as np
+
+        toks = self.seq + self.spec
+        room = self.d.max_seq - len(toks) - 1
+        n = min(n, room)
+        if n <= 0:
+            return
+        if self.kv is None:
+            b = _bucket(len(toks))
+            padded = np.zeros((1, b), np.int32)
+            padded[0, :len(toks)] = toks
+            self._next, k, v = self.d._prefill(jnp.asarray(padded),
+                                               jnp.int32(len(toks)))
+            self.kv = (k, v)
+            self.ingested = len(toks)
+        while self.ingested < len(toks):
+            self._next, k, v = self.d._step(
+                jnp.int32(toks[self.ingested]), jnp.int32(self.ingested),
+                *self.kv)
+            self.kv = (k, v)
+            self.ingested += 1
+        for _ in range(n):
+            t = int(self._next)
+            self.spec.append(t)
+            self._next, k, v = self.d._step(
+                jnp.int32(t), jnp.int32(self.ingested), *self.kv)
+            self.kv = (k, v)
+            self.ingested += 1
+
+    def next_chunk(self, k: int) -> tuple[int, list[int]]:
+        """(position, tokens) for the next chunk of up to ``k`` drafts.
+        Position is the worker's expected generated-count at consumption
+        (pipelined: assumes every in-flight chunk fully accepts).  Out of
+        context room → empty tokens (the chunk degrades to an ack)."""
+        want = self.sent + int(k)
+        if len(self.spec) < want + 1:
+            # +1: the predicted generative token the pointer skips.
+            self._extend(want + 1 - len(self.spec))
+        toks = list(self.spec[self.sent:want])
+        position = (len(self.seq) - self.prompt_len) + self.sent
+        if toks:
+            self.sent += len(toks) + 1
+        return position, toks
+
+
+class SpecPipelinePump:
+    """Flow control for one remote-draft stream.
+
+    The gateway's recv loop calls :meth:`on_verify` for every
+    VerifyResult frame; the pump folds the observation into the depth
+    controller and tops the outstanding window back up.  ``send`` is the
+    async whole-frame writer for the worker stream.  With no drafter
+    (worker-draft mode, or the checkpoint failed to load) every chunk is
+    a pure ack credit — worker-paced speculation over the same wire.
+    """
+
+    def __init__(self, model: str, send, drafter: GatewayDrafter | None,
+                 controller: PipelineDepthController | None = None):
+        self.model = model
+        self._send = send
+        self.drafter = drafter
+        self.session: DraftSession | None = None
+        self.ctrl = controller or PipelineDepthController()
+        self._inflight: dict[int, tuple[float, int]] = {}
+        self._next_id = 1
+        self._last_verify_at = 0.0
+        self.worker_k = 0
+        self.worker_depth = 1
+        # Telemetry (gateway /metrics: crowdllama_draft_chunk_* families).
+        self.chunks_sent = 0
+        self.acks_sent = 0
+        self.nacks = 0
+        self.tokens_accepted = 0
+        self.tokens_offered = 0
+
+    async def fill(self) -> None:
+        depth = min(self.ctrl.depth(), max(1, self.worker_depth))
+        if self.session is None:
+            # No drafter: a pure-ack credit predicts nothing, so there is
+            # nothing useful to keep in flight — stay at the stop-and-wait
+            # baseline (one verify round per RTT, exactly the cost the
+            # gateway-draft pipeline exists to hide).
+            depth = 1
+        while len(self._inflight) < depth:
+            k = 0
+            if self.session is not None:
+                k = self.ctrl.draft_k(self.worker_k)
+            pos, toks = (self.session.next_chunk(k)
+                         if (self.session is not None and k > 0)
+                         else (0, []))
+            cid = self._next_id
+            self._next_id += 1
+            self._inflight[cid] = (time.monotonic(), len(toks))
+            if toks:
+                self.chunks_sent += 1
+                self.tokens_offered += len(toks)
+            else:
+                self.acks_sent += 1
+            await self._send(wire.encode_frame(draft_chunk_msg(
+                model=self.model, chunk_id=cid, position=pos,
+                tokens=toks)))
+
+    async def on_verify(self, vr) -> None:
+        now = time.monotonic()
+        self.worker_k = max(0, int(vr.draft_k))
+        self.worker_depth = max(1, int(vr.depth_hint))
+        if int(vr.chunk_id) == 0:
+            # Handshake (never a real credit): prompt ids + first token
+            # seed the drafter's session before the first text frame.
+            if self.drafter is not None and vr.prompt_ids and vr.tokens:
+                try:
+                    self.session = self.drafter.session(
+                        list(vr.prompt_ids), int(vr.tokens[0]))
+                except Exception as e:
+                    log.warning("draft session init failed (%s); "
+                                "degrading to ack pacing", e)
+                    self.session = None
+            await self.fill()
+            return
+        meta = self._inflight.pop(int(vr.chunk_id), None)
+        if self._last_verify_at and self._inflight:
+            # Pipe still busy: verify arrivals are spaced one worker
+            # round apart — the step-time estimator's natural sample.
+            self.ctrl.observe_step(now - self._last_verify_at)
+        self._last_verify_at = now
+        if meta is not None:
+            sent_at, offered = meta
+            elapsed = now - sent_at
+            if self.ctrl.step_ewma > 0.0:
+                # Queued rounds ahead of this chunk are step time, not
+                # wire time — subtract them out of the RTT sample.
+                q = len(self._inflight) * self.ctrl.step_ewma
+                self.ctrl.observe_rtt(max(0.0, elapsed - q))
+            else:
+                # Cold start (stop-and-wait): elapsed is rtt + one step,
+                # unsplittable yet — halve it so neither estimate stays
+                # zero and the window can start growing; later busy-pipe
+                # samples correct both.
+                self.ctrl.observe_step(elapsed / 2.0)
+                self.ctrl.observe_rtt(elapsed / 2.0)
+            if offered:
+                acc = max(0, int(vr.accepted))
+                self.ctrl.observe_accept(acc, offered)
+                self.tokens_accepted += min(acc, offered)
+                if not vr.tokens:
+                    self.nacks += 1  # stale chunk flushed unverified
+        if self.session is not None and vr.tokens:
+            self.session.observe(list(vr.tokens))
+        await self.fill()

@@ -142,7 +142,8 @@ class Gateway:
                  trace_ttl: float = 0.0, metrics_exemplars: bool = False,
                  slo_ttft_ms: float = 0.0, slo_decode_ms: float = 0.0,
                  stream_stall_ms: float = 0.0, hedge_ttft_ms: float = 0.0,
-                 profile_dir: str = ""):
+                 profile_dir: str = "", spec_pipeline: str = "off",
+                 spec_draft_path: str = ""):
         self.peer = peer
         self.port = port
         self.host = host
@@ -337,6 +338,29 @@ class Gateway:
         self._gossip_affinity_hits = 0
         # Per-tenant inflight (weighted-fair admission): tenant -> count.
         self._tenant_inflight: dict[str, int] = {}
+        # Gateway-drafted speculative pipeline (docs/SPECULATIVE.md):
+        # "off" routes plain streams; "gateway" drafts locally from
+        # spec_draft_path and streams DraftChunk frames ahead of the
+        # worker; "worker" sends pure ack credits (worker-paced remote
+        # speculation — the RTT-linear baseline the bench compares
+        # against).  The drafter loads lazily on first use so a gateway
+        # that never sees a remote-draft stream never touches jax.
+        if spec_pipeline not in ("off", "gateway", "worker"):
+            raise ValueError(
+                f"spec_pipeline must be off|gateway|worker, "
+                f"got {spec_pipeline!r}")
+        self.spec_pipeline = spec_pipeline
+        self.spec_draft_path = str(spec_draft_path or "")
+        self._spec_drafter = None
+        self._spec_drafter_tried = False
+        # crowdllama_draft_chunk_* counter family (handle_metrics).
+        self._spec_stats = {"chunks": 0, "acks": 0, "nacks": 0,
+                            "accepted": 0, "offered": 0}
+        # Warm-start cache for the depth controller: RTT and worker round
+        # time are properties of the WIRE to a worker, not of one stream,
+        # but the pump is per-stream — without this every short chat
+        # spends its first RTTs re-learning the window from stop-and-wait.
+        self._spec_wire: dict[str, tuple[float, float]] = {}
 
     # ----------------------------------------------------------- lifecycle
 
@@ -1044,6 +1068,29 @@ class Gateway:
         lines.append(
             f"crowdllama_hedge_cancelled_total "
             f"{self._robust['hedge_cancelled']}")
+        # Gateway-drafted speculative pipeline (docs/SPECULATIVE.md):
+        # chunks/acks/nacks over the DraftChunk sub-protocol, plus the
+        # offered-vs-accepted draft-token ledger (acceptance rate is
+        # rate(accepted)/rate(offered)).
+        lines.append("# TYPE crowdllama_draft_chunk_sent_total counter")
+        lines.append(
+            f"crowdllama_draft_chunk_sent_total "
+            f"{self._spec_stats['chunks']}")
+        lines.append("# TYPE crowdllama_draft_chunk_acks_total counter")
+        lines.append(
+            f"crowdllama_draft_chunk_acks_total "
+            f"{self._spec_stats['acks']}")
+        lines.append("# TYPE crowdllama_draft_chunk_nacked_total counter")
+        lines.append(
+            f"crowdllama_draft_chunk_nacked_total "
+            f"{self._spec_stats['nacks']}")
+        lines.append(
+            "# TYPE crowdllama_draft_chunk_tokens_total counter")
+        for outcome, key in (("offered", "offered"),
+                             ("accepted", "accepted")):
+            lines.append(
+                f'crowdllama_draft_chunk_tokens_total{{outcome='
+                f'"{outcome}"}} {self._spec_stats[key]}')
         # Request hot-path CPU attribution (ISSUE 1 tentpole d): cumulative
         # microseconds per phase; rate(phase)/rate(requests) is the
         # per-request cost.  aead_us is process-wide (net/secure.py).
@@ -1599,6 +1646,12 @@ class Gateway:
         tid = new_trace_id()
         msg.trace_id = tid
         msg.parent_span = GATEWAY_ROOT_SPAN
+        # Speculative pipeline (docs/SPECULATIVE.md): flag streamed
+        # generations as remote-draft so the worker opens the VerifyResult
+        # sub-protocol.  Workers that don't support it (FakeEngine, old
+        # builds) nack every chunk — the stream degrades to plain decode.
+        if stream and self.spec_pipeline != "off":
+            msg.generate_request.remote_draft = True
 
         # Size-aware dispatch (see wire.NATIVE_ENVELOPE_MIN_BYTES): short
         # prompts serialize faster through upb than through the ctypes
@@ -1708,8 +1761,23 @@ class Gateway:
                             tid, "kv_hint", 0, parent=GATEWAY_ROOT_SPAN,
                             donor=donor[:8], worker=worker.peer_id[:8])
                 gr = msg.generate_request
-                req_frame = (base_frame if not gr.kv_donor and not gr.migrate
-                             else _native_req_frame(gr.kv_donor, gr.migrate))
+                if sctx.out is not None and getattr(gr, "remote_draft",
+                                                    False):
+                    # Failover replay runs plain: the in-flight draft
+                    # window died with the worker, and the replay-trim
+                    # contract only covers text frames.  Token replay
+                    # resynchronizes the client; a fresh request would
+                    # re-enter the pipeline from scratch.
+                    gr.remote_draft = False
+                req_frame = None
+                if not getattr(gr, "remote_draft", False):
+                    # The native encoder has no remote_draft field — a
+                    # remote-draft request must take the pb path so the
+                    # flag survives serialization.
+                    req_frame = (base_frame
+                                 if not gr.kv_donor and not gr.migrate
+                                 else _native_req_frame(gr.kv_donor,
+                                                        gr.migrate))
                 if sctx.out is not None:
                     # MID-STREAM FAILOVER: headers (and sent_text chars)
                     # already reached the client from a worker that then
@@ -2078,7 +2146,8 @@ class Gateway:
 
     async def _open_stream(self, worker_id: str, msg, frame: bytes,
                            deadline: float | None, stall_ttft: float,
-                           acc: dict, use_pool: bool = True):
+                           acc: dict, use_pool: bool = True,
+                           vsink: list | None = None):
         """Open an inference stream to ``worker_id``, send the encoded
         ``frame`` and read the FIRST response frame; returns
         ``(stream, first_resp)`` with the caller owning the stream.
@@ -2099,13 +2168,24 @@ class Gateway:
             t = max(0.05, min(600.0, remaining()))
             return min(t, stall_ttft) if stall_ttft > 0 else t
 
+        async def _first(s):
+            """First NON-verify frame: a remote-draft worker yields the
+            VerifyResult handshake before its first text frame — divert
+            those into vsink for the pump instead of classifying them."""
+            while True:
+                raw = await self._recv_pb(s, timeout=_recv_timeout(),
+                                          acc=acc)
+                if (vsink is not None
+                        and raw.WhichOneof("message") == "verify_result"):
+                    vsink.append(raw.verify_result)
+                    continue
+                return self._classify_frame(raw, worker_id)
+
         s = self._pool_get(worker_id) if use_pool else None
         if s is not None:
             try:
                 await self._send_frame(s, frame, acc=acc)
-                return s, self._classify_frame(
-                    await self._recv_pb(s, timeout=_recv_timeout(),
-                                        acc=acc), worker_id)
+                return s, await _first(s)
             except (asyncio.CancelledError, _WorkerDraining):
                 # A draining reject is a DELIBERATE answer, not a stale
                 # pooled stream: no redial (it would get the same
@@ -2133,9 +2213,7 @@ class Gateway:
                              trace_id=msg.trace_id)
         try:
             await self._send_frame(s, frame, acc=acc)
-            return s, self._classify_frame(
-                await self._recv_pb(s, timeout=_recv_timeout(), acc=acc),
-                worker_id)
+            return s, await _first(s)
         except BaseException as e:
             s.close()
             if (isinstance(e, (asyncio.TimeoutError, OSError))
@@ -2242,6 +2320,47 @@ class Gateway:
                     if isinstance(r, tuple):
                         r[0].close()
 
+    def _drafter(self):
+        """The gateway's local draft model, loaded lazily on the first
+        remote-draft stream.  Returns None in "worker" mode or when the
+        checkpoint is unusable — the pump then sends pure ack credits and
+        the stream still paces the worker (worker-draft speculation)."""
+        if self.spec_pipeline != "gateway":
+            return None
+        if self._spec_drafter is None and not self._spec_drafter_tried:
+            self._spec_drafter_tried = True
+            if not self.spec_draft_path:
+                log.warning("spec_pipeline=gateway with no draft "
+                            "checkpoint; degrading to ack pacing")
+            else:
+                try:
+                    from crowdllama_tpu.gateway.draft import GatewayDrafter
+
+                    self._spec_drafter = GatewayDrafter.from_checkpoint(
+                        self.spec_draft_path)
+                    log.info("gateway draft model loaded from %s",
+                             self.spec_draft_path)
+                except Exception as e:
+                    log.warning("gateway draft load failed (%s); "
+                                "degrading to ack pacing", e)
+        return self._spec_drafter
+
+    def _spec_pump(self, s, msg, acc: dict, worker_id: str = ""):
+        """Build the per-stream draft pump wired to ``s``'s writer,
+        warm-starting its depth controller from the last stream to the
+        same worker (the wire doesn't change between streams)."""
+        from crowdllama_tpu.gateway.draft import SpecPipelinePump
+
+        async def _send(frame: bytes) -> None:
+            await self._send_frame(s, frame, acc=acc)
+
+        pump = SpecPipelinePump(model=msg.generate_request.model,
+                                send=_send, drafter=self._drafter())
+        wire = self._spec_wire.get(worker_id)
+        if wire is not None:
+            pump.ctrl.rtt_ewma, pump.ctrl.step_ewma = wire
+        return pump
+
     async def _forward(self, request, worker_id: str, msg, stream: bool,
                        shape: str, t0: float,
                        acc: dict | None = None,
@@ -2312,18 +2431,35 @@ class Gateway:
             raise _BudgetExhausted("budget exhausted before dial")
         frame = req_frame if req_frame is not None \
             else self._encode_frame(msg, acc=acc)
+        # Speculative pipeline (docs/SPECULATIVE.md): a remote-draft
+        # stream interleaves VerifyResult frames with the text frames.
+        # Those feed the draft pump (which answers with DraftChunk
+        # frames) and never reach the client; hedging is disabled —
+        # a raced duplicate would double-consume the draft window — and
+        # the stream is never pooled (the sub-protocol is one-shot on
+        # the worker side too).
+        rd = bool(getattr(msg.generate_request, "remote_draft", False))
+        vsink: list | None = [] if rd else None
         # Hedged first-token dispatch: only on the FIRST attempt of a
         # stream — a failover replay already has client bytes out, and
         # failover itself covers that tail.
-        hedge_thr = self._hedge_threshold() if ctx.out is None else 0.0
+        hedge_thr = (self._hedge_threshold()
+                     if (ctx.out is None and not rd) else 0.0)
         if hedge_thr > 0:
             s, first, worker_id = await self._hedge_race(
                 worker_id, msg, frame, deadline, stall_ttft, acc,
                 hedge_thr)
         else:
             s, first = await self._open_stream(
-                worker_id, msg, frame, deadline, stall_ttft, acc)
+                worker_id, msg, frame, deadline, stall_ttft, acc,
+                vsink=vsink)
         ctx.winner = worker_id
+        pump = None
+        if rd:
+            pump = self._spec_pump(s, msg, acc, worker_id=worker_id)
+            for vr in vsink:
+                await pump.on_verify(vr)
+            vsink.clear()
         # Pool the stream back only after the worker's terminal frame was
         # READ (a mid-response abort leaves frames in flight — closing is
         # the only safe disposal).
@@ -2401,10 +2537,17 @@ class Gateway:
                 if remaining() <= 0:
                     raise _BudgetExhausted("budget exhausted mid-stream")
                 try:
-                    resp = classify(
-                        await self._recv_pb(
+                    while True:
+                        raw = await self._recv_pb(
                             s, timeout=_recv_timeout(stall_decode),
-                            acc=acc))
+                            acc=acc)
+                        if (pump is not None
+                                and raw.WhichOneof("message")
+                                == "verify_result"):
+                            await pump.on_verify(raw.verify_result)
+                            continue
+                        break
+                    resp = classify(raw)
                 except asyncio.TimeoutError as e:
                     if remaining() <= 0:
                         raise _BudgetExhausted(
@@ -2432,9 +2575,20 @@ class Gateway:
                 raise _StreamStarted(out, e) from e
             return out
         finally:
-            if clean:
+            if pump is not None:
+                self._spec_stats["chunks"] += pump.chunks_sent
+                self._spec_stats["acks"] += pump.acks_sent
+                self._spec_stats["nacks"] += pump.nacks
+                self._spec_stats["accepted"] += pump.tokens_accepted
+                self._spec_stats["offered"] += pump.tokens_offered
+                if pump.ctrl.rtt_ewma > 0.0 and pump.ctrl.step_ewma > 0.0:
+                    self._spec_wire[worker_id] = (pump.ctrl.rtt_ewma,
+                                                  pump.ctrl.step_ewma)
+            if clean and pump is None:
                 self._pool_put(worker_id, s)
             else:
+                # Remote-draft streams are one-shot on both sides: the
+                # worker's reader task may still own half a frame.
                 s.close()
 
     @staticmethod

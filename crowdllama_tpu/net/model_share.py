@@ -105,6 +105,66 @@ def _sha256_file(path: Path) -> str:
     return h.hexdigest()
 
 
+#: per-checkpoint integrity record written at promote time — the digests
+#: the wire transfer verified, persisted so a CACHED checkpoint can be
+#: re-verified before load (a half-written disk, bit rot, or a concurrent
+#: writer corrupts silently otherwise).  Dotfile: _shareable() rejects it,
+#: so it can never be served or fetched as checkpoint content.
+MANIFEST_NAME = ".crowdllama_manifest.json"
+
+
+def write_cache_manifest(dest: Path, files: list[dict]) -> None:
+    """Persist the verified per-file digests next to the checkpoint."""
+    import json as _json
+
+    record = [{"name": str(f["name"]), "size": int(f["size"]),
+               "sha256": str(f["sha256"])} for f in files]
+    (dest / MANIFEST_NAME).write_text(
+        _json.dumps({"files": record}, indent=0))
+
+
+def verify_cached(dest: str | Path) -> bool:
+    """Re-verify a cached checkpoint against its promote-time manifest.
+
+    True when every recorded file matches its digest, or when there is no
+    manifest at all (a locally-provisioned checkpoint predating the
+    record — nothing to verify against).  False on any mismatch or
+    missing file: the caller must evict and refetch."""
+    import json as _json
+
+    dest = Path(dest)
+    mpath = dest / MANIFEST_NAME
+    if not mpath.exists():
+        return True
+    try:
+        record = _json.loads(mpath.read_text()).get("files") or []
+    except (ValueError, OSError):
+        return False
+    for f in record:
+        p = dest / str(f.get("name", ""))
+        if not p.is_file() or p.stat().st_size != int(f.get("size", -1)):
+            return False
+        if _sha256_file(p) != str(f.get("sha256", "")):
+            return False
+    return True
+
+
+async def ensure_model(host: Host, source: Contact, model: str,
+                       dest_root: str | Path) -> Path:
+    """Cached-or-fetch: return a VERIFIED local checkpoint dir for
+    ``model``, re-downloading when the cache is absent or fails its
+    manifest check (corrupt artifacts are evicted, never loaded)."""
+    dest = dest_under_root(dest_root, model)
+    if dest.is_dir():
+        ok = await asyncio.to_thread(verify_cached, dest)
+        if ok:
+            return dest
+        log.warning("cached checkpoint %s failed sha256 verification; "
+                    "evicting and refetching", dest)
+        await asyncio.to_thread(shutil.rmtree, dest, ignore_errors=True)
+    return await fetch_model(host, source, model, dest_root)
+
+
 class ModelShareService:
     """Serves this worker's checkpoints and handles pull triggers.
 
@@ -286,6 +346,9 @@ async def fetch_model(host: Host, source: Contact, model: str,
         log.info("pulled %s/%s (%d bytes, verified)", model, name, size)
 
     # Atomic-ish promote: all files verified, swap staging into place.
+    # The manifest rides along so verify_cached() can re-check the
+    # artifact on every later cache hit (draft-checkpoint loads included).
+    write_cache_manifest(staging, files)
     if dest.exists():
         await asyncio.to_thread(shutil.rmtree, dest)
     staging.rename(dest)

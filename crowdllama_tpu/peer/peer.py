@@ -676,6 +676,25 @@ class Peer:
                         await wire.write_length_prefixed_pb(
                             stream.writer, reply)
                 return True
+            if which == "draft_chunk":
+                # A DraftChunk outside a remote-draft stream (stale gateway
+                # pump after failover, or a pre-remote-draft worker build
+                # being probed): nack it terminally so the pump stops
+                # instead of waiting out its RTT budget.  In-stream chunks
+                # never reach here — the reader task owns the transport.
+                from crowdllama_tpu.core.messages import (
+                    extract_draft_chunk,
+                    verify_result_msg,
+                )
+
+                dc = extract_draft_chunk(msg)
+                nack = verify_result_msg(
+                    chunk_id=dc.chunk_id, position=dc.position,
+                    accepted=0, tokens=[], done=True,
+                    draft_k=0, depth_hint=1)
+                nack.trace_id = tid
+                await wire.write_length_prefixed_pb(stream.writer, nack)
+                return True
             req = msg.generate_request
             if which != "generate_request":
                 raise ValueError("expected GenerateRequest")
@@ -701,20 +720,51 @@ class Peer:
                 # and coalesces every later frame produced within one
                 # event-loop tick into a single sealed write
                 # (wire.FrameBatcher — flushes via call_soon).
+                feed = reader_task = None
+                remote_draft = bool(getattr(req, "remote_draft", False))
+                if remote_draft:
+                    # Gateway-drafted pipeline (docs/SPECULATIVE.md): the
+                    # gateway keeps sending DraftChunk frames on THIS
+                    # stream while we stream responses back.  A reader
+                    # task drains them into the scheduler's credit feed —
+                    # or nacks each one when the engine can't verify
+                    # (FakeEngine, plain runner) so the gateway degrades
+                    # to an unpaced plain stream.
+                    from crowdllama_tpu.core.spec_pipeline import DraftFeed
+
+                    feed = DraftFeed()
+                    consume = bool(getattr(
+                        self.engine, "supports_remote_draft", False))
+                    reader_task = asyncio.get_running_loop().create_task(
+                        self._read_draft_chunks(stream, feed, tid, consume))
                 flush_ns = 0
                 batcher = wire.FrameBatcher(stream.writer)
-                async for frame in self.engine.handle_streaming_frames(
-                        msg, worker_id=self.peer_id):
+                try:
+                    async for frame in self.engine.handle_streaming_frames(
+                            msg, worker_id=self.peer_id, draft_feed=feed):
+                        t0 = time.perf_counter_ns()
+                        batcher.write(frame)
+                        await batcher.drain()
+                        flush_ns += time.perf_counter_ns() - t0
                     t0 = time.perf_counter_ns()
-                    batcher.write(frame)
-                    await batcher.drain()
+                    await batcher.flush()
                     flush_ns += time.perf_counter_ns() - t0
-                t0 = time.perf_counter_ns()
-                await batcher.flush()
-                flush_ns += time.perf_counter_ns() - t0
+                finally:
+                    if reader_task is not None:
+                        reader_task.cancel()
+                        try:
+                            await reader_task
+                        except (asyncio.CancelledError, Exception):
+                            pass
+                        feed.close()
                 if tid:
                     self.obs.trace.record(tid, "stream_flush", flush_ns,
                                           parent=msg.parent_span)
+                if remote_draft:
+                    # One-shot stream: the cancelled reader may have left a
+                    # partial DraftChunk frame in the receive buffer — a
+                    # pooled reuse would misparse it as the next request.
+                    return False
             else:
                 reply = await self.engine.handle(msg, worker_id=self.peer_id)
                 reply.trace_id = tid
@@ -789,6 +839,61 @@ class Peer:
             except Exception:
                 return False  # writer dead: end the stream's serve loop
             return True  # error frame delivered; the exchange is complete
+
+    async def _read_draft_chunks(self, stream: Stream, feed, tid: str,
+                                 consume: bool) -> None:
+        """Reader side of a remote-draft stream (docs/SPECULATIVE.md):
+        drain incoming DraftChunk frames into the scheduler's credit feed
+        while the engine streams responses the other way.  ``consume``
+        False (engine can't verify) nacks every chunk immediately so the
+        gateway's pump degrades to plain streaming instead of stalling.
+        Any transport error just closes the feed — the scheduler releases
+        the stream to free_run and the generation finishes on its own."""
+        from crowdllama_tpu.testing import faults
+        from crowdllama_tpu.testing.faults import KillStream
+
+        try:
+            while True:
+                msg = await wire.read_length_prefixed_pb(
+                    stream.reader, timeout=600.0)
+                if msg.WhichOneof("message") != "draft_chunk":
+                    log.debug("remote-draft reader: unexpected %s frame",
+                              msg.WhichOneof("message"))
+                    continue
+                dc = msg.draft_chunk
+                await faults.inject("spec.draft_chunk", worker=self.peer_id,
+                                    chunk_id=int(dc.chunk_id))
+                if consume:
+                    feed.push(dc.chunk_id, dc.position, list(dc.tokens))
+                    continue
+                from crowdllama_tpu.core.messages import verify_result_msg
+
+                await faults.inject("spec.verify", worker=self.peer_id,
+                                    chunk_id=int(dc.chunk_id))
+                nack = verify_result_msg(
+                    chunk_id=dc.chunk_id, position=dc.position,
+                    accepted=0, tokens=[], done=False,
+                    draft_k=0, depth_hint=1)
+                if tid:
+                    nack.trace_id = tid
+                # Whole-frame write: FrameBatcher seals complete frames, so
+                # interleaving with the engine's response frames is safe at
+                # frame granularity.
+                await wire.write_length_prefixed_pb(stream.writer, nack)
+        except asyncio.CancelledError:
+            raise
+        except KillStream as e:
+            # Injected worker death mid-verify (chaos): drop the transport
+            # with no error frame, exactly like the generation-path kill.
+            log.warning("fault injection killed draft reader: %s", e)
+            stream.close()
+            feed.close()
+        except (wire.WireError, asyncio.TimeoutError, OSError) as e:
+            log.debug("draft chunk reader ended: %s", e)
+            feed.close()
+        except Exception as e:
+            log.warning("draft chunk reader failed: %s", e)
+            feed.close()
 
     _KV_FRAME_BYTES = 4 * 1024 * 1024  # page payload per KvPages frame
 

@@ -40,6 +40,15 @@ site                  attrs / where
                       id), ``dst`` (receiver peer id).  A partition is a
                       pair of ``error`` rules matching both directions;
                       ``delay`` models gossip latency.
+``spec.draft_chunk``  after the worker's remote-draft reader task takes a
+                      DraftChunk off the stream (peer/peer.py
+                      ``_read_draft_chunks``): ``worker``, ``chunk_id``
+``spec.verify``       before a VerifyResult frame is written — the engine's
+                      verify emission (engine/engine.py
+                      ``handle_streaming_frames``) and the peer's
+                      unsupported-engine nack: ``worker``, ``chunk_id``.
+                      ``kill_stream`` here is the mid-verify worker death
+                      the failover chaos test drives.
 ====================  =====================================================
 
 Actions:
@@ -104,6 +113,10 @@ FAULT_SITES: dict[str, str] = {
     "gossip.send": "before a gateway replica pushes an anti-entropy frame",
     "gossip.recv": "before an inbound gossip frame is merged",
     "obs.scrape": "before the gateway fetches one worker's metric snapshot",
+    "spec.draft_chunk": "after the worker reads a DraftChunk off a "
+                        "remote-draft stream (peer/peer.py)",
+    "spec.verify": "before a VerifyResult frame is written (engine "
+                   "emission and the peer's unsupported-engine nack)",
 }
 
 

@@ -1,0 +1,104 @@
+"""Network emulation for benchmarks and tests: injected-latency relays.
+
+One :class:`DelayProxy` — a transparent TCP relay delivering every chunk
+a fixed one-way delay after it was read — shared by every harness that
+sweeps synthetic RTT (benchmarks/ep_dispatch.py, benchmarks/kv_transfer.py,
+benchmarks/spec_rtt.py, and RTT-sensitive tests).  It used to live inside
+ep_dispatch.py with kv_transfer importing across benchmark modules; the
+speculative-pipeline RTT harness made it a three-way copy, so it moved
+here.
+
+The relay is deliberately dumb: no bandwidth shaping, no loss, no
+reordering — injected RTT is the one variable the swarm benchmarks sweep,
+and everything else staying ideal keeps the sweep attributable.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+
+class DelayProxy:
+    """Transparent TCP relay that delivers every chunk ``delay_s`` after it
+    was read, per direction (injected RTT = 2 * delay_s per round trip).
+
+    Delivery is timestamp-scheduled (reader task enqueues, writer task
+    sleeps until due), so reads never stall behind the sleep: a multi-chunk
+    message pays the delay ONCE, not once per chunk."""
+
+    def __init__(self, target_port: int, delay_s: float,
+                 host: str = "127.0.0.1"):
+        self._target = target_port
+        self._delay = delay_s
+        self._host = host
+        self._server: asyncio.base_events.Server | None = None
+        self._tasks: set[asyncio.Task] = set()
+
+    async def start(self) -> int:
+        self._server = await asyncio.start_server(
+            self._on_conn, self._host, 0)
+        return self._server.sockets[0].getsockname()[1]
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        for t in list(self._tasks):
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+
+    def _track(self, coro) -> None:
+        t = asyncio.create_task(coro)
+        self._tasks.add(t)
+        t.add_done_callback(self._tasks.discard)
+
+    async def _on_conn(self, reader, writer):
+        try:
+            up_r, up_w = await asyncio.open_connection(
+                self._host, self._target)
+        except OSError:
+            writer.close()
+            return
+        self._track(self._pump(reader, up_w))
+        self._track(self._pump(up_r, writer))
+
+    async def _pump(self, reader, writer):
+        loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue()
+
+        async def drain_delayed():
+            while True:
+                item = await q.get()
+                if item is None:
+                    break
+                due, data = item
+                dt = due - loop.time()
+                if dt > 0:
+                    await asyncio.sleep(dt)
+                try:
+                    writer.write(data)
+                    await writer.drain()
+                except (ConnectionError, OSError):
+                    return
+            try:
+                if writer.can_write_eof():
+                    writer.write_eof()  # propagate half-close
+            except (ConnectionError, OSError):
+                pass
+
+        w = asyncio.create_task(drain_delayed())
+        try:
+            while True:
+                chunk = await reader.read(65536)
+                if not chunk:
+                    break
+                q.put_nowait((loop.time() + self._delay, chunk))
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            q.put_nowait(None)
+            try:
+                await w
+            except asyncio.CancelledError:
+                w.cancel()
+                raise

@@ -185,6 +185,47 @@ def apply_schema_edits(fdp: descriptor_pb2.FileDescriptorProto) -> None:
     _ensure_field(msn, _field("error", 4, STR))
     _ensure_message(fdp, msn)
 
+    # PR 20: gateway-side speculative pipeline (docs/SPECULATIVE.md).
+    # GenerateRequest.remote_draft: the client (a gateway hosting the
+    # distilled draft model) will pace this stream with DraftChunk frames
+    # on the same inference stream and expects VerifyResult frames
+    # interleaved with the GenerateResponse frames.  Absent == false ==
+    # the pre-PR-20 streaming protocol, bit for bit.
+    _ensure_field(gen_req, _field("remote_draft", 14, BOOL))
+
+    # DraftChunk: client → worker.  One chunk of speculative draft tokens
+    # proposed by the gateway's local draft model, starting at absolute
+    # sequence ``position`` (prompt + committed completion tokens).  An
+    # EMPTY tokens list is a pure pipeline credit ("ack"): it authorizes
+    # one more verify round without proposing anything — the worker-draft
+    # pacing mode.
+    dch = descriptor_pb2.DescriptorProto(name="DraftChunk")
+    _ensure_field(dch, _field("model", 1, STR))
+    _ensure_field(dch, _field("chunk_id", 2, U64))
+    _ensure_field(dch, _field("position", 3, I32))
+    _ensure_field(dch, _field("tokens", 4, I32, REP))
+    _ensure_message(fdp, dch)
+
+    # VerifyResult: worker → client.  The outcome of one verify round:
+    # how many drafts of ``chunk_id`` were accepted, every token id the
+    # round actually emitted (accepted drafts + the model's own token),
+    # and the committed absolute position afterwards.  chunk_id 0 is the
+    # stream handshake (carries prompt_ids + the first emitted token so
+    # the gateway's draft session needs no tokenizer); ``draft_k`` is the
+    # worker's preferred drafts-per-chunk (0 = stop drafting, send pure
+    # credits) and ``depth_hint`` its max-in-flight window (an AutoTuner
+    # dial on the worker).
+    vr = descriptor_pb2.DescriptorProto(name="VerifyResult")
+    _ensure_field(vr, _field("chunk_id", 1, U64))
+    _ensure_field(vr, _field("position", 2, I32))
+    _ensure_field(vr, _field("accepted", 3, I32))
+    _ensure_field(vr, _field("tokens", 4, I32, REP))
+    _ensure_field(vr, _field("done", 5, BOOL))
+    _ensure_field(vr, _field("draft_k", 6, I32))
+    _ensure_field(vr, _field("depth_hint", 7, I32))
+    _ensure_field(vr, _field("prompt_ids", 8, I32, REP))
+    _ensure_message(fdp, vr)
+
     (base,) = [m for m in fdp.message_type if m.name == "BaseMessage"]
     _ensure_field(base, _field("kv_fetch_request", 7, MSG,
                                type_name=".llama.v1.KvFetchRequest",
@@ -209,6 +250,12 @@ def apply_schema_edits(fdp: descriptor_pb2.FileDescriptorProto) -> None:
                                oneof_index=0))
     _ensure_field(base, _field("metrics_snapshot", 14, MSG,
                                type_name=".llama.v1.MetricsSnapshot",
+                               oneof_index=0))
+    _ensure_field(base, _field("draft_chunk", 15, MSG,
+                               type_name=".llama.v1.DraftChunk",
+                               oneof_index=0))
+    _ensure_field(base, _field("verify_result", 16, MSG,
+                               type_name=".llama.v1.VerifyResult",
                                oneof_index=0))
 
 

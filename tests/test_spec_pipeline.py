@@ -1,0 +1,456 @@
+"""Gateway-drafted speculative pipeline (docs/SPECULATIVE.md, ISSUE 20).
+
+Unit layers: the RTT-aware depth controller's math, the DraftFeed credit
+queue, DraftSession's pipelined chunk-position contract, the pump's
+flow-control invariants, and proto3 wire back-compat of the new arms.
+End-to-end: a REAL loopback swarm (JaxEngine workers on the permutation
+test checkpoint) asserting the one contract everything else exists to
+protect — the client stream is byte-identical across plain decode, the
+pipelined gateway-draft arm, and a worker killed mid-verify round.
+"""
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+from crowdllama_tpu.utils.crypto_compat import Ed25519PrivateKey
+
+from crowdllama_tpu.config import Configuration, Intervals
+from crowdllama_tpu.core import llama_v1_pb2 as pb
+from crowdllama_tpu.core import wire
+from crowdllama_tpu.core.messages import (
+    create_generate_request,
+    draft_chunk_msg,
+    verify_result_msg,
+)
+from crowdllama_tpu.core.spec_pipeline import (
+    DraftFeed,
+    PipelineDepthController,
+)
+from crowdllama_tpu.engine.scheduler import Scheduler
+from crowdllama_tpu.gateway.draft import DraftSession, SpecPipelinePump
+from crowdllama_tpu.testing import faults
+from crowdllama_tpu.testing.faults import FaultPlan, FaultRule
+
+
+# --------------------------------------------------- depth controller
+
+
+def test_controller_cold_start_is_stop_and_wait():
+    c = PipelineDepthController()
+    assert c.depth() == 1  # no estimates yet: one chunk in flight
+
+
+def test_controller_depth_grows_with_rtt():
+    c = PipelineDepthController()
+    c.observe_step(0.002)
+    depths = []
+    for rtt in (0.002, 0.01, 0.02):
+        c.rtt_ewma = 0.0
+        c.observe_rtt(rtt)
+        depths.append(c.depth())
+    assert depths == sorted(depths) and depths[-1] > depths[0]
+    c.rtt_ewma = 10.0  # absurd wire: depth must stay bounded
+    assert c.depth() == c.max_depth
+
+
+def test_controller_step_estimate_tracks_burst_floor():
+    """Regression: at low depth, verify arrivals bunch into RTT-spaced
+    bursts, so the gap stream mixes true round times with RTT-sized
+    boundary gaps.  An EWMA over that mix pins the step estimate near
+    the RTT and depth can never grow — the controller must track the
+    FLOOR of the gap distribution instead."""
+    c = PipelineDepthController()
+    c.observe_rtt(0.02)
+    for _ in range(20):
+        c.observe_step(0.002)  # within-burst gap: the worker's round
+        c.observe_step(0.02)   # burst boundary: wire time, not a round
+    assert c.step_ewma < 0.004, "step estimate contaminated by RTT gaps"
+    assert c.depth() >= 6
+
+
+def test_controller_step_estimate_rises_when_worker_slows():
+    c = PipelineDepthController()
+    c.observe_step(0.002)
+    for _ in range(200):
+        c.observe_step(0.01)  # the worker genuinely got slower
+    assert c.step_ewma == pytest.approx(0.01, rel=0.05)
+
+
+def test_controller_ignores_coalesced_arrivals():
+    c = PipelineDepthController()
+    c.observe_step(0.002)
+    c.observe_step(0.0)      # two frames in one TCP read: not a sample
+    c.observe_step(0.00005)
+    assert c.step_ewma == pytest.approx(0.002)
+
+
+def test_controller_pause_probe_resume():
+    c = PipelineDepthController()
+    assert c.draft_k(3) == 3
+    while not c.paused:
+        c.observe_accept(0, 3)  # acceptance collapse
+    assert c.draft_k(3) == 0  # paused: chunks degrade to ack credits
+    # One k=1 probe per probe_interval paused rounds keeps the pause
+    # from being absorbing.
+    ks = [c.draft_k(3) for _ in range(c.probe_interval)]
+    assert ks.count(1) == 1 and set(ks) == {0, 1}
+    while c.paused:
+        c.observe_accept(3, 3)  # workload recovered
+    assert c.draft_k(3) == 3
+
+
+# --------------------------------------------------------- draft feed
+
+
+def test_draft_feed_push_close_waker():
+    feed = DraftFeed()
+    wakes = []
+    feed._waker = lambda: wakes.append(1)
+    feed.push(1, 0, [7, 8])
+    feed.push(2, 3, [])
+    assert list(feed.chunks) == [(1, 0, [7, 8]), (2, 3, [])]
+    assert not feed.closed and not feed.free_run
+    feed.close()
+    assert feed.closed and len(wakes) == 3
+
+
+# ------------------------------------------------------ draft session
+
+
+class _StubDrafter:
+    """Deterministic drafter: the model predicts token+1, no KV state.
+    Lets the session's pointer arithmetic be asserted exactly without
+    loading weights."""
+
+    max_seq = 64
+
+    def _prefill(self, padded, plen):
+        return int(padded[0, int(plen) - 1]) + 1, None, None
+
+    def _step(self, tok, pos, k, v):
+        return int(tok) + 1, None, None
+
+
+def test_draft_session_pipelined_positions():
+    """Chunk i+1 is positioned assuming chunk i fully accepts: the
+    worker's generative emit after a full accept is the rollout's next
+    token, so the sent pointer skips one drafted token per chunk."""
+    s = DraftSession(_StubDrafter(), [1, 2, 3], first_token=4)
+    pos, toks = s.next_chunk(3)
+    assert (pos, toks) == (1, [5, 6, 7])
+    pos, toks = s.next_chunk(3)  # in flight behind chunk 1
+    assert (pos, toks) == (5, [9, 10, 11])
+    # Worker verifies chunk 1: accepts all 3 drafts + emits 8.
+    s.observe([5, 6, 7, 8])
+    assert s.seq[-4:] == [5, 6, 7, 8]
+    pos, toks = s.next_chunk(3)
+    assert (pos, toks) == (9, [13, 14, 15])
+
+
+def test_draft_session_divergence_drops_rollout():
+    s = DraftSession(_StubDrafter(), [1, 2, 3], first_token=4)
+    s.next_chunk(3)
+    s.observe([5, 99])  # partial accept: the model disagreed at 99
+    assert s.spec == [] and s.sent == 0
+    pos, toks = s.next_chunk(3)  # re-drafts from the corrected prefix
+    assert pos == 3 and toks == [100, 101, 102]
+
+
+# --------------------------------------------------------------- pump
+
+
+class _StubSession:
+    def __init__(self):
+        self.asked = []
+
+    def next_chunk(self, k):
+        self.asked.append(k)
+        return 0, list(range(k))
+
+    def observe(self, toks):
+        pass
+
+
+def _warm_pump(session):
+    sent = []
+
+    async def send(frame):
+        sent.append(wire.decode_payload(frame[4:]))  # strip length prefix
+
+    pump = SpecPipelinePump(model="tiny-test", send=send, drafter=None)
+    pump.session = session
+    pump.worker_k = 3
+    pump.worker_depth = 8
+    pump.ctrl.observe_rtt(0.02)
+    pump.ctrl.observe_step(0.002)  # warm wire: depth() == max_depth
+    return pump, sent
+
+
+async def test_pump_keeps_depth_chunks_in_flight():
+    pump, sent = _warm_pump(_StubSession())
+    await pump.fill()
+    assert len(pump._inflight) == pump.ctrl.depth() == 8
+    assert all(m.WhichOneof("message") == "draft_chunk" for m in sent)
+    assert all(list(m.draft_chunk.tokens) == [0, 1, 2] for m in sent)
+    assert pump.chunks_sent == 8 and pump.tokens_offered == 24
+
+
+async def test_pump_without_drafter_stays_stop_and_wait():
+    """A pure-ack credit predicts nothing, so pipelining acks just queues
+    worker rounds — no session means the stop-and-wait baseline."""
+    pump, sent = _warm_pump(None)
+    await pump.fill()
+    assert len(pump._inflight) == 1
+    assert list(sent[0].draft_chunk.tokens) == []
+    assert pump.acks_sent == 1
+
+
+async def test_pump_counts_nacks_and_tops_up():
+    pump, sent = _warm_pump(_StubSession())
+    await pump.fill()
+    vr = verify_result_msg(chunk_id=1, position=0, accepted=0, tokens=[],
+                           draft_k=3, depth_hint=8).verify_result
+    await pump.on_verify(vr)
+    assert pump.nacks == 1
+    assert 1 not in pump._inflight
+    # Topped back up: the outstanding window never sits below the
+    # controller's (freshly re-estimated) depth.
+    assert len(pump._inflight) >= pump.ctrl.depth()
+
+
+# ------------------------------------------------- proto wire compat
+
+
+def test_remote_draft_field_is_back_compat():
+    # A pre-remote-draft writer's request (field 14 absent) must read as
+    # a plain stream on a new worker.
+    old = pb.BaseMessage()
+    old.generate_request.model = "tiny-test"
+    old.generate_request.prompt = "hi"
+    parsed = wire.decode_payload(wire.encode_frame(old)[4:])
+    assert parsed.generate_request.remote_draft is False
+    req = create_generate_request("tiny-test", "hi", stream=True)
+    req.generate_request.remote_draft = True
+    again = pb.BaseMessage()
+    again.ParseFromString(req.SerializeToString())
+    assert again.generate_request.remote_draft is True
+    assert pb.GenerateRequest.DESCRIPTOR.fields_by_name[
+        "remote_draft"].number == 14
+
+
+def test_draft_chunk_and_verify_result_arms():
+    # Arm numbers are the wire contract with deployed peers: 15/16 were
+    # burned for the speculative pipeline and must never be reused.
+    fields = pb.BaseMessage.DESCRIPTOR.fields_by_name
+    assert fields["draft_chunk"].number == 15
+    assert fields["verify_result"].number == 16
+
+    dc = draft_chunk_msg(model="m", chunk_id=3, position=9,
+                         tokens=[1, 2, 3])
+    rt = pb.BaseMessage()
+    rt.ParseFromString(dc.SerializeToString())
+    assert rt.WhichOneof("message") == "draft_chunk"
+    assert (rt.draft_chunk.chunk_id, rt.draft_chunk.position,
+            list(rt.draft_chunk.tokens)) == (3, 9, [1, 2, 3])
+
+    vr = verify_result_msg(chunk_id=0, position=1, accepted=0,
+                           tokens=[42], done=False, draft_k=3,
+                           depth_hint=8, prompt_ids=[7, 8, 9])
+    rt = pb.BaseMessage()
+    rt.ParseFromString(vr.SerializeToString())
+    assert rt.WhichOneof("message") == "verify_result"
+    v = rt.verify_result
+    assert (v.chunk_id, v.position, list(v.tokens), v.draft_k,
+            v.depth_hint, list(v.prompt_ids)) == (0, 1, [42], 3, 8,
+                                                  [7, 8, 9])
+
+
+# --------------------------------------------- scheduler credit pacing
+
+
+async def test_paced_dispatch_defers_while_round_in_flight():
+    """Regression: _dispatch_paced used to validate credits while the
+    previous round was still in flight, so per-slot generated counts
+    were pre-retire and every correctly-pipelined (future-positioned)
+    chunk was flushed as stale — acceptance collapsed to the ack floor.
+    With a round in flight the dispatcher must wait for retire."""
+    s = object.__new__(Scheduler)
+    s._inflight = object()
+    assert await s._dispatch_paced(None, [(0, object())]) is None
+
+
+# ------------------------------------------------------------- swarm
+
+
+def _cfg(bootstrap, **kw):
+    cfg = Configuration(listen_host="127.0.0.1",
+                        bootstrap_peers=[bootstrap],
+                        intervals=Intervals.default())
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+async def _wait_for(cond, timeout=30.0, what="condition"):
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        if cond():
+            return
+        await asyncio.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _chat_body(n_tokens=24):
+    return {"model": "tiny-test", "stream": True,
+            "options": {"num_predict": n_tokens},
+            "messages": [{"role": "user",
+                          "content": "tell me a story about the swarm"}]}
+
+
+def _content(raw: str) -> str:
+    lines = [json.loads(ln) for ln in raw.splitlines() if ln.strip()]
+    assert lines[-1]["done"] is True
+    assert "error" not in lines[-1]
+    return "".join(ln.get("message", {}).get("content", "") for ln in lines)
+
+
+@pytest.mark.chaos
+async def test_gateway_draft_byte_identity_and_midverify_kill(tmp_path):
+    """The whole contract on a real loopback swarm: two spec-enabled
+    JaxEngine workers on the permutation checkpoint behind a drafting
+    gateway.  (1) The pipelined gateway-draft stream is byte-identical
+    to plain decode, with drafted chunks genuinely verified and ZERO
+    stale nacks (the scheduler in-flight pacing regression would show
+    up here as a nack storm).  (2) A worker killed mid-verify round
+    fails over with token replay and the client still can't tell."""
+    from crowdllama_tpu.engine.engine import FakeEngine, JaxEngine
+    from crowdllama_tpu.gateway.gateway import Gateway
+    from crowdllama_tpu.net.discovery import new_host_and_dht
+    from crowdllama_tpu.peer.peer import Peer
+    from crowdllama_tpu.testing.modelgen import permutation_checkpoint
+
+    ckpt = permutation_checkpoint("tiny-test", tmp_path / "ckpt",
+                                  max_context=128)
+    boot_host, _ = await new_host_and_dht(
+        Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    bootstrap = f"127.0.0.1:{boot_host.listen_port}"
+
+    def eng():
+        return JaxEngine(
+            _cfg(bootstrap, model="tiny-test", model_path=ckpt,
+                 spec_decode="draft", spec_draft=3,
+                 spec_draft_model="tiny-test", spec_draft_path=ckpt,
+                 max_batch_slots=2, warmup=False),
+            max_context_length=128)
+
+    engines = [eng(), eng()]
+    workers = []
+    for e in engines:
+        await e.start()
+        w = Peer(Ed25519PrivateKey.generate(), _cfg(bootstrap),
+                 engine=e, worker_mode=True)
+        await w.start()
+        workers.append(w)
+    consumer = Peer(Ed25519PrivateKey.generate(), _cfg(bootstrap),
+                    engine=FakeEngine(models=[]), worker_mode=False)
+    await consumer.start()
+    gateway = Gateway(consumer, port=0, host="127.0.0.1",
+                      spec_pipeline="gateway", spec_draft_path=ckpt)
+    await gateway.start()
+    gw_port = gateway._runner.addresses[0][1]
+    try:
+        await _wait_for(
+            lambda: len({p.peer_id for p in
+                         consumer.peer_manager.get_healthy_peers()
+                         if p.is_worker}) == 2,
+            what="both workers discovered")
+        url = f"http://127.0.0.1:{gw_port}/api/chat"
+        async with aiohttp.ClientSession() as s:
+            # Plain decode: the byte-identity reference.
+            gateway.spec_pipeline = "off"
+            async with s.post(url, json=_chat_body()) as resp:
+                assert resp.status == 200
+                baseline = _content(await resp.text())
+            assert len(baseline) > 8
+
+            # Pipelined gateway drafting: same bytes, and the stats prove
+            # the fast path actually ran (drafts offered AND accepted; a
+            # stale nack here means the worker flushed a pipelined chunk).
+            gateway.spec_pipeline = "gateway"
+            async with s.post(url, json=_chat_body()) as resp:
+                assert resp.status == 200
+                assert _content(await resp.text()) == baseline
+            assert gateway._spec_stats["offered"] > 0
+            assert gateway._spec_stats["accepted"] > 0
+            assert gateway._spec_stats["nacks"] == 0
+
+            # Kill the serving worker mid-verify round: failover + token
+            # replay must keep the stream byte-identical.
+            plan = FaultPlan(seed=7, rules=[
+                FaultRule(site="spec.verify", action="kill_stream",
+                          after=2, times=1)])
+            with faults.installed(plan):
+                async with s.post(url, json=_chat_body()) as resp:
+                    assert resp.status == 200
+                    assert _content(await resp.text()) == baseline
+            assert plan.log and plan.log[0][2] == "kill_stream"
+    finally:
+        faults.clear()
+        await gateway.stop()
+        await consumer.stop()
+        for w in workers:
+            await w.stop()
+        for e in engines:
+            await e.stop()
+        await boot_host.close()
+
+
+@pytest.mark.chaos
+async def test_gateway_draft_degrades_against_plain_worker():
+    """spec_pipeline=gateway against a worker that cannot verify
+    (FakeEngine): the peer nacks every credit, the pump degrades, and
+    the client stream is identical to the off-mode stream."""
+    from crowdllama_tpu.engine.engine import FakeEngine
+    from crowdllama_tpu.gateway.gateway import Gateway
+    from crowdllama_tpu.net.discovery import new_host_and_dht
+    from crowdllama_tpu.peer.peer import Peer
+
+    boot_host, _ = await new_host_and_dht(
+        Ed25519PrivateKey.generate(), listen_host="127.0.0.1")
+    bootstrap = f"127.0.0.1:{boot_host.listen_port}"
+    worker = Peer(Ed25519PrivateKey.generate(), _cfg(bootstrap),
+                  engine=FakeEngine(models=["tiny-test"]),
+                  worker_mode=True)
+    await worker.start()
+    consumer = Peer(Ed25519PrivateKey.generate(), _cfg(bootstrap),
+                    engine=FakeEngine(models=[]), worker_mode=False)
+    await consumer.start()
+    # No draft checkpoint on purpose: the pump runs in ack mode over the
+    # remote-draft wire and the FakeEngine worker nacks every credit.
+    gateway = Gateway(consumer, port=0, host="127.0.0.1",
+                      spec_pipeline="gateway")
+    await gateway.start()
+    gw_port = gateway._runner.addresses[0][1]
+    try:
+        await _wait_for(
+            lambda: any(p.is_worker for p in
+                        consumer.peer_manager.get_healthy_peers()),
+            what="worker discovered")
+        url = f"http://127.0.0.1:{gw_port}/api/chat"
+        async with aiohttp.ClientSession() as s:
+            gateway.spec_pipeline = "off"
+            async with s.post(url, json=_chat_body()) as resp:
+                assert resp.status == 200
+                baseline = _content(await resp.text())
+            gateway.spec_pipeline = "gateway"
+            async with s.post(url, json=_chat_body()) as resp:
+                assert resp.status == 200
+                assert _content(await resp.text()) == baseline
+    finally:
+        await gateway.stop()
+        await consumer.stop()
+        await worker.stop()
+        await boot_host.close()
